@@ -1,0 +1,2 @@
+"""CLI entrypoints (`weed-tpu ...`), mirroring the reference's command registry
+(`weed/command/command.go`)."""

@@ -1,0 +1,34 @@
+"""`weed-tpu` command dispatch (reference: `weed/weed.go:50`, `weed/command/`).
+
+Subcommands are registered lazily; each module under seaweedfs_tpu.command
+exposes `run(args) -> int` and `HELP`.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+COMMANDS: dict[str, tuple[str, str]] = {
+    # name -> (module, one-line help)
+    "version": ("seaweedfs_tpu.command.version", "print version"),
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print("weed-tpu: TPU-native distributed object store\n\ncommands:")
+        for name, (_, help_line) in sorted(COMMANDS.items()):
+            print(f"  {name:18s} {help_line}")
+        return 0
+    name, *rest = argv
+    if name not in COMMANDS:
+        print(f"unknown command {name!r}; see `weed-tpu help`", file=sys.stderr)
+        return 2
+    mod = importlib.import_module(COMMANDS[name][0])
+    return int(mod.run(rest) or 0)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

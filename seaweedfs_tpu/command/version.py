@@ -1,0 +1,10 @@
+from __future__ import annotations
+
+import seaweedfs_tpu
+
+HELP = "print version"
+
+
+def run(args: list[str]) -> int:
+    print(f"seaweedfs-tpu {seaweedfs_tpu.__version__}")
+    return 0

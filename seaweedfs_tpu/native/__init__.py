@@ -1,0 +1,117 @@
+"""Native C++ CPU kernels loaded via ctypes.
+
+The reference leaned on assembly inside Go libraries for its hot paths
+(klauspost/reedsolomon AVX2 GF(2^8), stdlib SSE4.2 CRC32C, asm MD5 —
+SURVEY.md §2.2). Here those CPU paths are C++ (`seaweedfs_tpu/native/src`),
+compiled on first use into `_seaweed_native.so` and exposed through ctypes.
+They serve as (a) the CPU fallback when no TPU is attached and (b) the
+baseline the TPU kernels are benchmarked against.
+
+If compilation fails (no toolchain), callers fall back to numpy paths —
+correctness is preserved, only throughput drops.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src")
+_SO_PATH = os.path.join(_HERE, "_seaweed_native.so")
+
+_lock = threading.Lock()
+
+
+class NativeLib:
+    def __init__(self, cdll: ctypes.CDLL) -> None:
+        self._lib = cdll
+        self._lib.sw_crc32c_update.restype = ctypes.c_uint32
+        self._lib.sw_crc32c_update.argtypes = [
+            ctypes.c_uint32,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        self._lib.sw_gf256_matmul.restype = None
+        self._lib.sw_gf256_matmul.argtypes = [
+            ctypes.c_char_p,  # matrix rows*cols
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p),  # input shard pointers [cols]
+            ctypes.POINTER(ctypes.c_char_p),  # output shard pointers [rows]
+            ctypes.c_size_t,  # shard length
+        ]
+        self._lib.sw_md5_batch.restype = None
+        self._lib.sw_md5_batch.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+        ]
+
+    def has(self, _name: str) -> bool:
+        return True
+
+    def crc32c_update(self, crc: int, data) -> int:
+        if not isinstance(data, bytes):
+            data = bytes(data)
+        return int(self._lib.sw_crc32c_update(crc & 0xFFFFFFFF, data, len(data)))
+
+    def gf256_matmul(self, matrix: bytes, rows: int, cols: int, inputs, out_len: int):
+        """matrix is rows*cols GF(2^8) coefficients; inputs is a list of
+        `cols` byte strings of length out_len; returns list of `rows` outputs."""
+        in_arr = (ctypes.c_char_p * cols)(*[bytes(x) for x in inputs])
+        outs = [ctypes.create_string_buffer(out_len) for _ in range(rows)]
+        out_arr = (ctypes.c_char_p * rows)(
+            *[ctypes.cast(o, ctypes.c_char_p) for o in outs]
+        )
+        self._lib.sw_gf256_matmul(matrix, rows, cols, in_arr, out_arr, out_len)
+        return [o.raw for o in outs]
+
+    def md5_batch(self, blobs: bytes, n: int, blob_len: int) -> bytes:
+        out = ctypes.create_string_buffer(n * 16)
+        self._lib.sw_md5_batch(blobs, n, blob_len, ctypes.cast(out, ctypes.c_char_p))
+        return out.raw
+
+
+def _build() -> bool:
+    srcs = [os.path.join(_SRC, f) for f in sorted(os.listdir(_SRC)) if f.endswith(".cpp")]
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        "-o", _SO_PATH, *srcs,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        try:  # retry without -march=native for odd toolchains
+            cmd.remove("-march=native")
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            return True
+        except Exception:
+            return False
+
+
+def _load() -> NativeLib | None:
+    with _lock:
+        if not os.path.exists(_SO_PATH) or any(
+            os.path.getmtime(os.path.join(_SRC, f)) > os.path.getmtime(_SO_PATH)
+            for f in os.listdir(_SRC)
+            if f.endswith(".cpp")
+        ):
+            if not _build():
+                return None
+        try:
+            return NativeLib(ctypes.CDLL(_SO_PATH))
+        except OSError:
+            return None
+
+
+lib: NativeLib | None = None
+if os.environ.get("SEAWEEDFS_TPU_DISABLE_NATIVE") != "1":
+    try:
+        lib = _load()
+    except Exception:
+        lib = None

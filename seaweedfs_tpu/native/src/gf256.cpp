@@ -1,0 +1,59 @@
+// GF(2^8) shard matmul: out[r] = sum_c M[r][c] * in[c] over the Rijndael-free
+// polynomial 0x11D field used by Backblaze/klauspost Reed-Solomon.
+// CPU stand-in for klauspost/reedsolomon's AVX2 kernels
+// (weed/storage/erasure_coding/ec_encoder.go:202). Table-driven with 64-bit
+// SWAR XOR accumulate; -march=native lets the compiler autovectorize.
+#include <cstdint>
+#include <cstddef>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+uint8_t mul_table[256][256];
+bool gf_ready = false;
+
+void init_gf() {
+    if (gf_ready) return;
+    uint8_t exp_t[512];
+    int log_t[256];
+    int x = 1;
+    for (int i = 0; i < 255; i++) {
+        exp_t[i] = (uint8_t)x;
+        log_t[x] = i;
+        x <<= 1;
+        if (x & 0x100) x ^= 0x11D;
+    }
+    for (int i = 255; i < 512; i++) exp_t[i] = exp_t[i - 255];
+    for (int a = 0; a < 256; a++) {
+        mul_table[0][a] = 0;
+        mul_table[a][0] = 0;
+    }
+    for (int a = 1; a < 256; a++)
+        for (int b = 1; b < 256; b++)
+            mul_table[a][b] = exp_t[log_t[a] + log_t[b]];
+    gf_ready = true;
+}
+
+} // namespace
+
+extern "C" void sw_gf256_matmul(const unsigned char* matrix, int rows, int cols,
+                                const unsigned char** inputs,
+                                unsigned char** outputs, size_t n) {
+    init_gf();
+    for (int r = 0; r < rows; r++) {
+        unsigned char* out = outputs[r];
+        std::memset(out, 0, n);
+        for (int c = 0; c < cols; c++) {
+            uint8_t coef = matrix[r * cols + c];
+            if (coef == 0) continue;
+            const uint8_t* row = mul_table[coef];
+            const unsigned char* in = inputs[c];
+            if (coef == 1) {
+                for (size_t i = 0; i < n; i++) out[i] ^= in[i];
+            } else {
+                for (size_t i = 0; i < n; i++) out[i] ^= row[in[i]];
+            }
+        }
+    }
+}

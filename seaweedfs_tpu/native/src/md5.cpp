@@ -1,0 +1,79 @@
+// Batched MD5 over equal-length blobs. CPU stand-in for Go's asm crypto/md5
+// used on the reference's upload path
+// (weed/server/filer_server_handlers_write_upload.go:48).
+#include <cstdint>
+#include <cstddef>
+#include <cstring>
+
+namespace {
+
+struct MD5Ctx {
+    uint32_t a, b, c, d;
+};
+
+const uint32_t K[64] = {
+    0xd76aa478, 0xe8c7b756, 0x242070db, 0xc1bdceee, 0xf57c0faf, 0x4787c62a,
+    0xa8304613, 0xfd469501, 0x698098d8, 0x8b44f7af, 0xffff5bb1, 0x895cd7be,
+    0x6b901122, 0xfd987193, 0xa679438e, 0x49b40821, 0xf61e2562, 0xc040b340,
+    0x265e5a51, 0xe9b6c7aa, 0xd62f105d, 0x02441453, 0xd8a1e681, 0xe7d3fbc8,
+    0x21e1cde6, 0xc33707d6, 0xf4d50d87, 0x455a14ed, 0xa9e3e905, 0xfcefa3f8,
+    0x676f02d9, 0x8d2a4c8a, 0xfffa3942, 0x8771f681, 0x6d9d6122, 0xfde5380c,
+    0xa4beea44, 0x4bdecfa9, 0xf6bb4b60, 0xbebfbc70, 0x289b7ec6, 0xeaa127fa,
+    0xd4ef3085, 0x04881d05, 0xd9d4d039, 0xe6db99e5, 0x1fa27cf8, 0xc4ac5665,
+    0xf4292244, 0x432aff97, 0xab9423a7, 0xfc93a039, 0x655b59c3, 0x8f0ccc92,
+    0xffeff47d, 0x85845dd1, 0x6fa87e4f, 0xfe2ce6e0, 0xa3014314, 0x4e0811a1,
+    0xf7537e82, 0xbd3af235, 0x2ad7d2bb, 0xeb86d391};
+
+const int S[64] = {7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22,
+                   5, 9,  14, 20, 5, 9,  14, 20, 5, 9,  14, 20, 5, 9,  14, 20,
+                   4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23,
+                   6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21};
+
+inline uint32_t rotl(uint32_t x, int s) { return (x << s) | (x >> (32 - s)); }
+
+void md5_block(MD5Ctx& ctx, const uint8_t* p) {
+    uint32_t m[16];
+    std::memcpy(m, p, 64);
+    uint32_t a = ctx.a, b = ctx.b, c = ctx.c, d = ctx.d;
+    for (int i = 0; i < 64; i++) {
+        uint32_t f;
+        int g;
+        if (i < 16) { f = (b & c) | (~b & d); g = i; }
+        else if (i < 32) { f = (d & b) | (~d & c); g = (5 * i + 1) & 15; }
+        else if (i < 48) { f = b ^ c ^ d; g = (3 * i + 5) & 15; }
+        else { f = c ^ (b | ~d); g = (7 * i) & 15; }
+        uint32_t tmp = d;
+        d = c;
+        c = b;
+        b = b + rotl(a + f + K[i] + m[g], S[i]);
+        a = tmp;
+    }
+    ctx.a += a; ctx.b += b; ctx.c += c; ctx.d += d;
+}
+
+void md5_one(const uint8_t* data, size_t len, uint8_t* out) {
+    MD5Ctx ctx{0x67452301, 0xefcdab89, 0x98badcfe, 0x10325476};
+    size_t full = len / 64;
+    for (size_t i = 0; i < full; i++) md5_block(ctx, data + i * 64);
+    uint8_t tail[128] = {0};
+    size_t rem = len - full * 64;
+    std::memcpy(tail, data + full * 64, rem);
+    tail[rem] = 0x80;
+    size_t tail_len = (rem + 9 <= 64) ? 64 : 128;
+    uint64_t bits = (uint64_t)len * 8;
+    std::memcpy(tail + tail_len - 8, &bits, 8);
+    md5_block(ctx, tail);
+    if (tail_len == 128) md5_block(ctx, tail + 64);
+    std::memcpy(out, &ctx.a, 4);
+    std::memcpy(out + 4, &ctx.b, 4);
+    std::memcpy(out + 8, &ctx.c, 4);
+    std::memcpy(out + 12, &ctx.d, 4);
+}
+
+} // namespace
+
+extern "C" void sw_md5_batch(const unsigned char* blobs, size_t n,
+                             size_t blob_len, unsigned char* out) {
+    for (size_t i = 0; i < n; i++)
+        md5_one(blobs + i * blob_len, blob_len, out + i * 16);
+}

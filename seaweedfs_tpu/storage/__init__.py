@@ -1,0 +1,6 @@
+"""Storage engine: on-disk formats bit-compatible with the reference.
+
+Reference layout docs: /root/reference/weed/storage (needle, types, idx,
+super_block, erasure_coding). All multi-byte integers are big-endian
+(`weed/util/bytes.go:43-70`).
+"""

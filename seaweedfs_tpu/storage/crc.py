@@ -1,0 +1,109 @@
+"""CRC32-Castagnoli needle checksums (`weed/storage/needle/crc.go:12-55`).
+
+Three execution paths, all bit-identical:
+  1. native C++ slice-by-8 via ctypes (seaweedfs_tpu.native) — default on CPU;
+  2. numpy table fallback (used if the native library is unavailable);
+  3. the TPU bit-plane matmul kernel for large batches of fixed-size blocks
+     (seaweedfs_tpu.ops.crc32c_kernel) — the upload-path batch hasher.
+
+Streaming semantics match Go's hash/crc32: `update(crc, data)` continues a
+previous CRC, `crc32c(data) == update(0, data)`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_CASTAGNOLI_POLY_REFLECTED = 0x82F63B78
+
+
+def _make_tables(n: int = 8) -> np.ndarray:
+    t = np.zeros((n, 256), dtype=np.uint64)
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ (_CASTAGNOLI_POLY_REFLECTED if c & 1 else 0)
+        t[0, i] = c
+    for k in range(1, n):
+        for i in range(256):
+            c = t[k - 1, i]
+            t[k, i] = t[0, c & 0xFF] ^ (c >> np.uint64(8))
+    return t
+
+
+_TABLES = _make_tables()
+_T0 = _TABLES[0].astype(np.uint32)
+
+_native = None
+
+
+def _get_native():
+    global _native
+    if _native is None:
+        try:
+            from seaweedfs_tpu.native import lib as _lib
+
+            _native = _lib if _lib is not None and _lib.has("crc32c") else False
+        except Exception:
+            _native = False
+    return _native
+
+
+def update(crc: int, data: bytes | bytearray | memoryview | np.ndarray) -> int:
+    """Continue a CRC32C over more data (Go crc32.Update semantics)."""
+    native = _get_native()
+    if native:
+        return native.crc32c_update(crc, data)
+    if isinstance(data, np.ndarray):
+        data = data.tobytes()
+    c = np.uint64(crc ^ 0xFFFFFFFF)
+    buf = np.frombuffer(bytes(data), dtype=np.uint8)
+    i = 0
+    n = len(buf)
+    # slice-by-8 in chunked numpy is still byte-serial; keep the pure loop for
+    # small inputs and rely on the native path for throughput.
+    t = _TABLES
+    while n - i >= 8:
+        c ^= np.uint64(int.from_bytes(buf[i : i + 8].tobytes(), "little"))
+        c = (
+            t[7, int(c & np.uint64(0xFF))]
+            ^ t[6, int((c >> np.uint64(8)) & np.uint64(0xFF))]
+            ^ t[5, int((c >> np.uint64(16)) & np.uint64(0xFF))]
+            ^ t[4, int((c >> np.uint64(24)) & np.uint64(0xFF))]
+            ^ t[3, int((c >> np.uint64(32)) & np.uint64(0xFF))]
+            ^ t[2, int((c >> np.uint64(40)) & np.uint64(0xFF))]
+            ^ t[1, int((c >> np.uint64(48)) & np.uint64(0xFF))]
+            ^ t[0, int((c >> np.uint64(56)) & np.uint64(0xFF))]
+        )
+        i += 8
+    cc = int(c) & 0xFFFFFFFF
+    while i < n:
+        cc = _T0[(cc ^ int(buf[i])) & 0xFF] ^ (cc >> 8)
+        cc = int(cc) & 0xFFFFFFFF
+        i += 1
+    return cc ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes | bytearray | memoryview) -> int:
+    return update(0, data)
+
+
+def legacy_value(crc: int) -> int:
+    """Deprecated on-disk CRC transform kept for backward compatibility
+    (`weed/storage/needle/crc.go:26-29`): rotate + magic constant. Readers must
+    accept both this and the raw value."""
+    rotated = ((crc >> 15) | (crc << 17)) & 0xFFFFFFFF
+    return (rotated + 0xA282EAD8) & 0xFFFFFFFF
+
+
+class CRCWriter:
+    """Streaming CRC over writes, like `NewCRCwriter`."""
+
+    def __init__(self) -> None:
+        self.crc = 0
+
+    def write(self, data: bytes) -> None:
+        self.crc = update(self.crc, data)
+
+    def sum(self) -> int:
+        return self.crc

@@ -1,0 +1,40 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Unit tests never require real TPU hardware; multi-chip sharding is validated
+on `--xla_force_host_platform_device_count=8` exactly as the driver's
+dryrun_multichip does. Kernel-vs-native byte-identity tests are platform
+independent.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+REFERENCE = pathlib.Path("/root/reference")
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def reference_fixtures():
+    """Paths to the reference repo's checked-in golden binary fixtures."""
+    if not REFERENCE.exists():
+        pytest.skip("reference repo not mounted")
+    return {
+        "ec_dat": REFERENCE / "weed/storage/erasure_coding/1.dat",
+        "ec_idx": REFERENCE / "weed/storage/erasure_coding/1.idx",
+        "needle_dat": REFERENCE / "weed/storage/needle/43.dat",
+        "idx_187": REFERENCE / "test/data/187.idx",
+    }
