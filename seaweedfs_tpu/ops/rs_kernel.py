@@ -1,0 +1,195 @@
+"""Reed-Solomon GF(2^8) shard transforms as TPU bit-plane matmuls (JAX).
+
+The trick (SURVEY.md §7 step 3): a GF(2^8) multiply-accumulate over shards is
+GF(2)-linear in the *bits* of the input bytes. Expanding each coefficient into
+an 8x8 GF(2) bit-matrix turns the whole shard transform into
+
+    out_bits(N, R*8) = in_bits(N, C*8) @ A(C*8, R*8)   (mod 2)
+
+— one int8 matrix multiply on the MXU plus cheap VPU unpack/pack, instead of
+the byte-wise table lookups (PSHUFB) CPU implementations use. The same kernel
+does encode (A from the parity rows), reconstruct (A from inverted sub-matrix)
+and decode; only the small host-side matrix differs.
+
+Byte-identical to ops.gf256.gf_matmul_bytes (the numpy oracle), the C++
+native path, and therefore klauspost/reedsolomon as used by the reference
+(`weed/storage/erasure_coding/ec_encoder.go:202,239`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from . import gf256
+
+DATA_SHARDS = 10
+PARITY_SHARDS = 4
+TOTAL_SHARDS = DATA_SHARDS + PARITY_SHARDS
+
+# Default chunk: bound device memory per call; callers stream larger inputs.
+DEFAULT_CHUNK = 64 * 1024 * 1024
+
+
+def _jax():
+    import jax  # deferred so numpy-only callers never pay for jax init
+
+    return jax
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_transform(rows: int, cols: int, a_bytes: bytes):
+    """jit-compiled bit-plane transform for a fixed bit-matrix."""
+    jax = _jax()
+    jnp = jax.numpy
+    a = jnp.asarray(
+        np.frombuffer(a_bytes, dtype=np.uint8).reshape(cols * 8, rows * 8),
+        dtype=jnp.int8,
+    )
+
+    @jax.jit
+    def transform(shards):  # (cols, n) uint8
+        n = shards.shape[1]
+        xt = shards.T  # (n, cols)
+        k = jnp.arange(8, dtype=jnp.uint8)
+        bits = (xt[:, :, None] >> k) & jnp.uint8(1)  # (n, cols, 8)
+        bits = bits.reshape(n, cols * 8).astype(jnp.int8)
+        y = jax.lax.dot_general(
+            bits,
+            a,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # (n, rows*8)
+        ybits = (y & 1).astype(jnp.uint8).reshape(n, rows, 8)
+        packed = jnp.sum(
+            ybits.astype(jnp.int32) << jnp.arange(8, dtype=jnp.int32), axis=-1
+        ).astype(jnp.uint8)
+        return packed.T  # (rows, n)
+
+    return transform
+
+
+@functools.lru_cache(maxsize=256)
+def _cached_bit_matrix(matrix_bytes: bytes, rows: int, cols: int) -> np.ndarray:
+    m = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(rows, cols)
+    return gf256.bit_matrix(m)
+
+
+def gf_matmul_jax(matrix: np.ndarray, shards, chunk: int = DEFAULT_CHUNK):
+    """out[r] = XOR_c matrix[r,c] x shards[c] on the accelerator.
+
+    matrix: (rows, cols) uint8 numpy (host). shards: (cols, n) uint8 —
+    numpy or jax array. Returns a jax array (rows, n) uint8 (device).
+    """
+    jax = _jax()
+    jnp = jax.numpy
+    rows, cols = matrix.shape
+    a = _cached_bit_matrix(matrix.tobytes(), rows, cols)
+    fn = _compiled_transform(rows, cols, a.tobytes())
+    shards = jnp.asarray(shards, dtype=jnp.uint8)
+    n = shards.shape[1]
+    if n <= chunk:
+        return fn(shards)
+    outs = [fn(shards[:, i : i + chunk]) for i in range(0, n, chunk)]
+    return jnp.concatenate(outs, axis=1)
+
+
+class RSCodec:
+    """RS(data, parity) codec with pluggable execution backends.
+
+    backend: "jax" (TPU/accelerator bit-plane matmul), "native" (C++ via
+    ctypes), "numpy" (table oracle). Mirrors the reference's pluggable
+    `Encoder` boundary from BASELINE.json (klauspost CPU vs TPU sidecar).
+    """
+
+    def __init__(
+        self,
+        data_shards: int = DATA_SHARDS,
+        parity_shards: int = PARITY_SHARDS,
+        backend: str = "auto",
+    ) -> None:
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        # "auto" resolves lazily on first use so constructing a codec (e.g.
+        # opening an EcVolume that may never reconstruct) doesn't init JAX.
+        self._backend = backend
+
+    @property
+    def backend(self) -> str:
+        if self._backend == "auto":
+            self._backend = self._pick_backend()
+        return self._backend
+
+    @staticmethod
+    def _pick_backend() -> str:
+        try:
+            import jax
+
+            platform = jax.default_backend()
+            if platform not in ("cpu",):
+                return "jax"
+        except Exception:
+            pass
+        try:
+            from seaweedfs_tpu.native import lib
+
+            if lib is not None:
+                return "native"
+        except Exception:
+            pass
+        return "numpy"
+
+    # --- core ---------------------------------------------------------------
+    def _apply(self, matrix: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        if self.backend == "jax":
+            return np.asarray(gf_matmul_jax(matrix, shards))
+        if self.backend == "native":
+            from seaweedfs_tpu.native import lib
+
+            n = shards.shape[1]
+            outs = lib.gf256_matmul(
+                matrix.tobytes(),
+                matrix.shape[0],
+                matrix.shape[1],
+                [shards[c].tobytes() for c in range(shards.shape[0])],
+                n,
+            )
+            return np.stack([np.frombuffer(o, dtype=np.uint8) for o in outs])
+        return gf256.gf_matmul_bytes(matrix, shards)
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """data: (data_shards, n) uint8 -> parity (parity_shards, n) uint8."""
+        if data.shape[0] != self.data_shards:
+            raise ValueError(f"expected {self.data_shards} data shards")
+        m = gf256.parity_rows(self.data_shards, self.parity_shards)
+        return self._apply(m, np.ascontiguousarray(data, dtype=np.uint8))
+
+    def encode_all(self, data: np.ndarray) -> np.ndarray:
+        """(data_shards, n) -> all (total, n) shards (data rows pass through)."""
+        parity = self.encode(data)
+        return np.concatenate([np.asarray(data, dtype=np.uint8), parity], axis=0)
+
+    def reconstruct(
+        self, shards: dict[int, np.ndarray], targets: list[int] | None = None
+    ) -> dict[int, np.ndarray]:
+        """Recover missing shards. shards: {shard_id: (n,) uint8} with at
+        least data_shards present; targets default to all missing ids."""
+        present = sorted(shards)
+        if targets is None:
+            targets = [i for i in range(self.total_shards) if i not in shards]
+        if not targets:
+            return {}
+        m = gf256.decode_matrix(
+            self.data_shards, self.parity_shards, tuple(present), tuple(targets)
+        )
+        use = present[: self.data_shards]
+        stack = np.stack([np.asarray(shards[i], dtype=np.uint8) for i in use])
+        out = self._apply(m, stack)
+        return {t: out[i] for i, t in enumerate(targets)}
+
+    def verify(self, shards: np.ndarray) -> bool:
+        """shards: (total, n); recompute parity from data rows and compare."""
+        parity = self.encode(shards[: self.data_shards])
+        return bool(np.array_equal(parity, shards[self.data_shards :]))

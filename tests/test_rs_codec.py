@@ -1,0 +1,135 @@
+"""RS(10,4) codec: field math, matrix construction, cross-backend byte identity."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.rs_kernel import RSCodec, gf_matmul_jax
+
+
+class TestGF256:
+    def test_field_basics(self):
+        assert gf256.gf_mul(0, 5) == 0
+        assert gf256.gf_mul(1, 77) == 77
+        assert gf256.gf_mul(2, 2) == 4
+        assert gf256.gf_mul(0x80, 2) == 0x1D  # wraps through poly 0x11D
+        for a in (1, 2, 5, 77, 200, 255):
+            assert gf256.gf_div(gf256.gf_mul(a, 13), 13) == a
+            assert gf256.gf_mul(a, gf256.gf_div(1, a)) == 1
+
+    def test_gf_exp(self):
+        assert gf256.gf_exp(0, 0) == 1  # klauspost galExp convention
+        assert gf256.gf_exp(0, 5) == 0
+        assert gf256.gf_exp(2, 8) == gf256.gf_mul(gf256.gf_exp(2, 7), 2)
+
+    def test_mat_invert(self):
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            m = rng.randint(0, 256, size=(6, 6)).astype(np.uint8)
+            try:
+                inv = gf256.mat_invert(m)
+            except np.linalg.LinAlgError:
+                continue
+            assert np.array_equal(gf256.mat_mul(m, inv), gf256.identity(6))
+
+    def test_rs_matrix_identity_top(self):
+        m = gf256.rs_matrix(10, 4)
+        assert m.shape == (14, 10)
+        assert np.array_equal(m[:10], gf256.identity(10))
+        # any 10 rows of the encoding matrix must be invertible (MDS property)
+        rng = np.random.RandomState(1)
+        for _ in range(10):
+            rows = sorted(rng.choice(14, size=10, replace=False))
+            gf256.mat_invert(m[rows])  # must not raise
+
+    def test_bit_matrix_equiv(self):
+        """bit-plane expansion reproduces the field product for single bytes."""
+        m = np.array([[3, 7], [2, 9]], dtype=np.uint8)
+        a = gf256.bit_matrix(m)  # (16, 16)
+        rng = np.random.RandomState(2)
+        x = rng.randint(0, 256, size=(2, 32)).astype(np.uint8)
+        want = gf256.gf_matmul_bytes(m, x)
+        bits = ((x.T[:, :, None] >> np.arange(8)) & 1).reshape(32, 16)
+        ybits = (bits @ a) & 1
+        got = (ybits.reshape(32, 2, 8) << np.arange(8)).sum(-1).astype(np.uint8).T
+        assert np.array_equal(want, got)
+
+
+class TestRSCodec:
+    @pytest.fixture(scope="class")
+    def data(self):
+        rng = np.random.RandomState(7)
+        return rng.randint(0, 256, size=(10, 4096)).astype(np.uint8)
+
+    def test_encode_backends_identical(self, data):
+        outs = {}
+        for backend in ("numpy", "native", "jax"):
+            try:
+                outs[backend] = RSCodec(backend=backend).encode(data)
+            except Exception as e:
+                if backend == "numpy":
+                    raise
+                pytest.skip(f"backend {backend} unavailable: {e}")
+        base = outs["numpy"]
+        for name, out in outs.items():
+            assert np.array_equal(out, base), f"{name} parity differs from numpy"
+
+    def test_parity_nonzero(self, data):
+        parity = RSCodec(backend="numpy").encode(data)
+        assert parity.shape == (4, 4096)
+        assert parity.any()
+
+    @pytest.mark.parametrize("missing", [[0], [13], [0, 5], [3, 11], [0, 1, 2, 3], [10, 11, 12, 13], [0, 4, 10, 13]])
+    def test_reconstruct(self, data, missing):
+        codec = RSCodec(backend="numpy")
+        shards = codec.encode_all(data)
+        surviving = {
+            i: shards[i] for i in range(14) if i not in missing
+        }
+        recovered = codec.reconstruct(surviving)
+        assert sorted(recovered) == sorted(missing)
+        for i in missing:
+            assert np.array_equal(recovered[i], shards[i]), f"shard {i} mismatch"
+
+    def test_reconstruct_jax_matches(self, data):
+        codec_np = RSCodec(backend="numpy")
+        codec_jax = RSCodec(backend="jax")
+        shards = codec_np.encode_all(data)
+        surviving = {i: shards[i] for i in range(14) if i not in (2, 7, 11)}
+        r_np = codec_np.reconstruct(surviving)
+        r_jax = codec_jax.reconstruct(surviving)
+        for k in r_np:
+            assert np.array_equal(r_np[k], r_jax[k])
+
+    def test_too_few_shards_raises(self, data):
+        codec = RSCodec(backend="numpy")
+        shards = codec.encode_all(data)
+        surviving = {i: shards[i] for i in range(9)}  # only 9 < 10
+        with pytest.raises(ValueError):
+            codec.reconstruct(surviving)
+
+    def test_verify(self, data):
+        codec = RSCodec(backend="numpy")
+        shards = codec.encode_all(data)
+        assert codec.verify(shards)
+        shards[12, 100] ^= 1
+        assert not codec.verify(shards)
+
+    def test_odd_lengths(self):
+        """non-multiple-of-128 lengths must work (tail blocks)."""
+        rng = np.random.RandomState(3)
+        for n in (1, 7, 100, 255, 1000):
+            data = rng.randint(0, 256, size=(10, n)).astype(np.uint8)
+            p_np = RSCodec(backend="numpy").encode(data)
+            p_jax = RSCodec(backend="jax").encode(data)
+            assert np.array_equal(p_np, p_jax)
+
+
+class TestJaxChunking:
+    def test_chunked_equals_whole(self):
+        rng = np.random.RandomState(4)
+        m = gf256.parity_rows(10, 4)
+        data = rng.randint(0, 256, size=(10, 1000)).astype(np.uint8)
+        whole = np.asarray(gf_matmul_jax(m, data))
+        chunked = np.asarray(gf_matmul_jax(m, data, chunk=96))
+        assert np.array_equal(whole, chunked)
