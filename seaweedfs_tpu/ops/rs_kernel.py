@@ -85,6 +85,11 @@ def gf_matmul_jax(matrix: np.ndarray, shards, chunk: int = DEFAULT_CHUNK):
     jax = _jax()
     jnp = jax.numpy
     rows, cols = matrix.shape
+    if jax.default_backend() == "tpu":
+        # fused Pallas path: ~10x the XLA-materialized version on real chips
+        from . import rs_pallas
+
+        return rs_pallas.gf_matmul_pallas(matrix, shards)
     a = _cached_bit_matrix(matrix.tobytes(), rows, cols)
     fn = _compiled_transform(rows, cols, a.tobytes())
     shards = jnp.asarray(shards, dtype=jnp.uint8)

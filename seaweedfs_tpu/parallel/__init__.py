@@ -1,0 +1,25 @@
+"""Multi-chip execution: device meshes + shard_map'd storage kernels.
+
+The reference scales by sharding objects across volume servers over
+point-to-point RPC (SURVEY.md §2.3); the TPU-native analog is a
+`jax.sharding.Mesh` over chips with volume *batches* sharded along a data
+axis — EC encode/rebuild and batch hashing are embarrassingly parallel per
+volume, so collectives ride ICI only for result gathering, and DCN only
+distributes host-level batches (SURVEY.md §2.4).
+"""
+
+from .mesh import make_mesh
+from .ec_shard_map import (
+    sharded_encode,
+    sharded_crc32c,
+    sharded_md5,
+    pipeline_step,
+)
+
+__all__ = [
+    "make_mesh",
+    "sharded_encode",
+    "sharded_crc32c",
+    "sharded_md5",
+    "pipeline_step",
+]

@@ -8,12 +8,21 @@ independent.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Force CPU: the ambient sitecustomize imports jax with JAX_PLATFORMS=axon
+# (the tunneled TPU) before conftest runs, so the env var alone is too late —
+# update the live config. Unit tests always run on the virtual 8-device CPU
+# mesh; real-chip work goes through bench.py / __graft_entry__.py.
+if os.environ.get("SEAWEEDFS_TPU_TEST_REAL") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 import sys

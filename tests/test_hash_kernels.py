@@ -1,0 +1,120 @@
+"""Hash kernels: CRC32C-as-matmul, batched MD5, CDC — vs stdlib/native oracles."""
+
+import hashlib
+import zlib
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import cdc, crc32c_kernel, md5_kernel
+from seaweedfs_tpu.storage import crc as crc_cpu
+
+
+class TestCRCBatch:
+    @pytest.mark.parametrize("length", [1, 8, 64, 100, 4096])
+    def test_matches_cpu(self, length):
+        rng = np.random.RandomState(length)
+        blocks = rng.randint(0, 256, size=(17, length)).astype(np.uint8)
+        got = crc32c_kernel.crc32c_batch(blocks, backend="jax")
+        want = np.array(
+            [crc_cpu.crc32c(blocks[i].tobytes()) for i in range(17)], dtype=np.uint32
+        )
+        assert np.array_equal(got, want)
+
+    def test_zero_block_constant(self):
+        # internal consistency: affine constant equals CRC of zeros
+        blocks = np.zeros((3, 256), dtype=np.uint8)
+        got = crc32c_kernel.crc32c_batch(blocks, backend="jax")
+        assert (got == crc_cpu.crc32c(b"\x00" * 256)).all()
+
+    def test_combine(self):
+        rng = np.random.RandomState(1)
+        a = rng.bytes(1000)
+        b = rng.bytes(777)
+        ca, cb = crc_cpu.crc32c(a), crc_cpu.crc32c(b)
+        assert crc32c_kernel.crc32c_combine(ca, cb, len(b)) == crc_cpu.crc32c(a + b)
+
+    def test_combine_empty(self):
+        a = b"hello"
+        assert crc32c_kernel.crc32c_combine(crc_cpu.crc32c(a), 0, 0) == crc_cpu.crc32c(a)
+
+
+class TestMD5Batch:
+    @pytest.mark.parametrize("length", [0, 1, 55, 56, 63, 64, 65, 119, 120, 4096])
+    def test_matches_hashlib(self, length):
+        rng = np.random.RandomState(length + 1)
+        blobs = rng.randint(0, 256, size=(9, length)).astype(np.uint8)
+        got = md5_kernel.md5_batch(blobs, backend="jax")
+        for i in range(9):
+            want = hashlib.md5(blobs[i].tobytes()).digest()
+            assert got[i].tobytes() == want, f"len={length} blob {i}"
+
+    def test_native_matches(self):
+        from seaweedfs_tpu import native
+
+        if native.lib is None:
+            pytest.skip("native lib unavailable")
+        rng = np.random.RandomState(5)
+        blobs = rng.randint(0, 256, size=(64, 4096)).astype(np.uint8)
+        got = md5_kernel.md5_batch(blobs, backend="native")
+        want = md5_kernel.md5_batch(blobs, backend="hashlib")
+        assert np.array_equal(got, want)
+
+
+class TestCDC:
+    def test_jax_matches_numpy(self):
+        rng = np.random.RandomState(2)
+        data = rng.randint(0, 256, size=100_000).astype(np.uint8)
+        assert np.array_equal(
+            cdc.gear_hashes(data, backend="jax"), cdc.gear_hashes_numpy(data)
+        )
+
+    def test_boundaries_cover_buffer(self):
+        rng = np.random.RandomState(3)
+        data = rng.randint(0, 256, size=300_000).astype(np.uint8)
+        cuts = cdc.find_boundaries(data, backend="numpy")
+        assert cuts[-1] == len(data)
+        prev = 0
+        sizes = []
+        for c in cuts:
+            sizes.append(c - prev)
+            prev = c
+        assert all(s <= 65536 for s in sizes)
+        assert all(s >= 2048 for s in sizes[:-1]) or len(sizes) == 1
+
+    def test_content_defined_shift_stability(self):
+        """Inserting bytes at the front must not move most later boundaries —
+        the whole point of CDC vs fixed-size chunking."""
+        rng = np.random.RandomState(4)
+        data = rng.randint(0, 256, size=400_000).astype(np.uint8)
+        shifted = np.concatenate([rng.randint(0, 256, size=137).astype(np.uint8), data])
+        cuts_a = set(cdc.find_boundaries(data, backend="numpy"))
+        cuts_b = {c - 137 for c in cdc.find_boundaries(shifted, backend="numpy")}
+        common = cuts_a & cuts_b
+        assert len(common) >= len(cuts_a) * 0.5
+
+    def test_chunk_stream_matches_whole_buffer(self):
+        rng = np.random.RandomState(6)
+        data = rng.bytes(1_000_000)
+        pos = 0
+
+        def reader(n):
+            nonlocal pos
+            piece = data[pos : pos + n]
+            pos += len(piece)
+            return piece
+
+        chunks = list(
+            cdc.chunk_stream(reader, segment=200_000, backend="numpy")
+        )
+        assert sum(l for _, l in chunks) == len(data)
+        assert chunks[0][0] == 0
+        for (o1, l1), (o2, _) in zip(chunks, chunks[1:]):
+            assert o1 + l1 == o2
+
+    def test_deterministic(self):
+        rng = np.random.RandomState(7)
+        data = rng.randint(0, 256, size=50_000).astype(np.uint8)
+        assert cdc.find_boundaries(data, backend="numpy") == cdc.find_boundaries(
+            data, backend="numpy"
+        )
