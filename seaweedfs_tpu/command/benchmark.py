@@ -1,0 +1,91 @@
+"""`weed-tpu benchmark`: self-contained write/read load generator with
+latency percentiles (reference: `weed/command/benchmark.go:113-260`)."""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import random
+import time
+
+
+def _percentiles(samples: list[float]) -> dict:
+    if not samples:
+        return {}
+    s = sorted(samples)
+
+    def pct(p: float) -> float:
+        return s[min(len(s) - 1, int(p * len(s)))]
+
+    return {
+        "p50_ms": round(pct(0.50) * 1000, 2),
+        "p90_ms": round(pct(0.90) * 1000, 2),
+        "p99_ms": round(pct(0.99) * 1000, 2),
+        "max_ms": round(s[-1] * 1000, 2),
+    }
+
+
+def run(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu benchmark")
+    p.add_argument("-master", default="http://127.0.0.1:9333")
+    p.add_argument("-n", type=int, default=1000, help="number of files")
+    p.add_argument("-size", type=int, default=1024, help="file size bytes")
+    p.add_argument("-c", type=int, default=16, help="concurrency")
+    p.add_argument("-collection", default="benchmark")
+    p.add_argument("-seed", type=int, default=0)
+    opts = p.parse_args(args)
+
+    from seaweedfs_tpu.filer.wdclient import WeedClient
+
+    client = WeedClient(opts.master)
+    rng = random.Random(opts.seed)
+    payload = bytes(rng.randrange(256) for _ in range(opts.size))
+
+    write_lat: list[float] = []
+    fids: list[str] = []
+
+    def do_write(i: int):
+        t0 = time.perf_counter()
+        out = client.upload(payload, collection=opts.collection)
+        dt = time.perf_counter() - t0
+        return out["fid"], dt
+
+    t_start = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(opts.c) as ex:
+        for fid, dt in ex.map(do_write, range(opts.n)):
+            fids.append(fid)
+            write_lat.append(dt)
+    write_wall = time.perf_counter() - t_start
+
+    read_lat: list[float] = []
+
+    def do_read(fid: str):
+        t0 = time.perf_counter()
+        data = client.fetch(fid)
+        assert len(data) == opts.size
+        return time.perf_counter() - t0
+
+    order = fids[:]
+    rng.shuffle(order)
+    t_start = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(opts.c) as ex:
+        read_lat = list(ex.map(do_read, order))
+    read_wall = time.perf_counter() - t_start
+
+    report = {
+        "write": {
+            "requests": opts.n,
+            "req_per_sec": round(opts.n / write_wall, 1),
+            "mb_per_sec": round(opts.n * opts.size / write_wall / 1e6, 2),
+            **_percentiles(write_lat),
+        },
+        "read": {
+            "requests": len(order),
+            "req_per_sec": round(len(order) / read_wall, 1),
+            "mb_per_sec": round(len(order) * opts.size / read_wall / 1e6, 2),
+            **_percentiles(read_lat),
+        },
+    }
+    print(json.dumps(report, indent=2))
+    return 0

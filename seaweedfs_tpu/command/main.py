@@ -9,9 +9,39 @@ from __future__ import annotations
 import importlib
 import sys
 
-COMMANDS: dict[str, tuple[str, str]] = {
-    # name -> (module, one-line help)
-    "version": ("seaweedfs_tpu.command.version", "print version"),
+COMMANDS: dict[str, tuple[str, str, str]] = {
+    # name -> (module, function, one-line help)
+    "version": ("seaweedfs_tpu.command.version", "run", "print version"),
+    "master": (
+        "seaweedfs_tpu.command.server_cmds", "run_master",
+        "start the cluster master (assign/lookup/heartbeats)",
+    ),
+    "volume": (
+        "seaweedfs_tpu.command.server_cmds", "run_volume",
+        "start a volume server (blob storage data plane)",
+    ),
+    "filer": (
+        "seaweedfs_tpu.command.server_cmds", "run_filer",
+        "start a filer (file namespace over the blob store)",
+    ),
+    "server": (
+        "seaweedfs_tpu.command.server_cmds", "run_server",
+        "start master + volume server (+ -filer, -s3) in one process",
+    ),
+    "shell": (
+        "seaweedfs_tpu.shell.shell", "run",
+        "interactive admin shell (ec.*, volume.*, fs.*)",
+    ),
+    "benchmark": (
+        "seaweedfs_tpu.command.benchmark", "run",
+        "write/read load generator with latency percentiles",
+    ),
+    "upload": ("seaweedfs_tpu.command.upload", "run", "upload files via assign+PUT"),
+    "download": ("seaweedfs_tpu.command.upload", "run_download", "download a fid"),
+    "fix": (
+        "seaweedfs_tpu.command.fix", "run",
+        "rebuild a volume .idx from its .dat",
+    ),
 }
 
 
@@ -19,15 +49,16 @@ def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
         print("weed-tpu: TPU-native distributed object store\n\ncommands:")
-        for name, (_, help_line) in sorted(COMMANDS.items()):
+        for name, (_, _, help_line) in sorted(COMMANDS.items()):
             print(f"  {name:18s} {help_line}")
         return 0
     name, *rest = argv
     if name not in COMMANDS:
         print(f"unknown command {name!r}; see `weed-tpu help`", file=sys.stderr)
         return 2
-    mod = importlib.import_module(COMMANDS[name][0])
-    return int(mod.run(rest) or 0)
+    module, fn_name, _ = COMMANDS[name]
+    mod = importlib.import_module(module)
+    return int(getattr(mod, fn_name)(rest) or 0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,160 @@
+"""Server subcommands: master / volume / filer / combined server
+(reference: `weed/command/master.go`, `volume.go`, `filer.go`, `server.go`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+
+def _wait_forever() -> int:
+    stop = threading.Event()
+
+    def handler(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    stop.wait()
+    return 0
+
+
+def run_master(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu master")
+    p.add_argument("-port", type=int, default=9333)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-mdir", default=None, help="metadata dir (sequence state)")
+    p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+    p.add_argument("-defaultReplication", default="000")
+    p.add_argument("-garbageThreshold", type=float, default=0.3)
+    p.add_argument("-pulseSeconds", type=int, default=5)
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.server.master import MasterServer
+
+    m = MasterServer(
+        host=opts.ip,
+        port=opts.port,
+        volume_size_limit_mb=opts.volumeSizeLimitMB,
+        pulse_seconds=opts.pulseSeconds,
+        default_replication=opts.defaultReplication,
+        meta_dir=opts.mdir,
+        garbage_threshold=opts.garbageThreshold,
+    )
+    m.start()
+    print(f"master listening at {m.url}")
+    return _wait_forever()
+
+
+def run_volume(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu volume")
+    p.add_argument("-port", type=int, default=8080)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-dir", default="./data", help="comma-separated data dirs")
+    p.add_argument("-mserver", default="http://127.0.0.1:9333")
+    p.add_argument("-dataCenter", default="")
+    p.add_argument("-rack", default="")
+    p.add_argument("-max", type=int, default=100)
+    p.add_argument("-publicUrl", default="")
+    p.add_argument("-pulseSeconds", type=int, default=5)
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    vs = VolumeServer(
+        opts.dir.split(","),
+        opts.mserver,
+        host=opts.ip,
+        port=opts.port,
+        public_url=opts.publicUrl,
+        data_center=opts.dataCenter,
+        rack=opts.rack,
+        pulse_seconds=opts.pulseSeconds,
+        max_volume_count=opts.max,
+    )
+    vs.start()
+    print(f"volume server listening at {vs.url}")
+    return _wait_forever()
+
+
+def run_filer(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="weed-tpu filer")
+    p.add_argument("-port", type=int, default=8888)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-master", default="http://127.0.0.1:9333")
+    p.add_argument("-store", default="memory", choices=["memory", "sqlite"])
+    p.add_argument("-storePath", default=None)
+    p.add_argument("-maxMB", type=int, default=4, help="chunk size")
+    p.add_argument("-collection", default="")
+    p.add_argument("-defaultReplicaPlacement", default="")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    f = FilerServer(
+        opts.master,
+        host=opts.ip,
+        port=opts.port,
+        store_kind=opts.store,
+        store_path=opts.storePath,
+        chunk_size_mb=opts.maxMB,
+        default_replication=opts.defaultReplicaPlacement,
+        collection=opts.collection,
+    )
+    f.start()
+    print(f"filer listening at {f.url}")
+    return _wait_forever()
+
+
+def run_server(args: list[str]) -> int:
+    """Combined master + volume + filer (+S3) in one process
+    (`weed/command/server.go`)."""
+    p = argparse.ArgumentParser(prog="weed-tpu server")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-master.port", dest="master_port", type=int, default=9333)
+    p.add_argument("-volume.port", dest="volume_port", type=int, default=8080)
+    p.add_argument("-filer.port", dest="filer_port", type=int, default=8888)
+    p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+    p.add_argument("-dir", default="./data")
+    p.add_argument("-filer", action="store_true", help="also run filer")
+    p.add_argument("-s3", action="store_true", help="also run S3 gateway")
+    p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+    p.add_argument("-defaultReplication", default="000")
+    p.add_argument("-filer.store", dest="filer_store", default="memory")
+    p.add_argument("-filer.storePath", dest="filer_store_path", default=None)
+    opts = p.parse_args(args)
+
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    m = MasterServer(
+        host=opts.ip,
+        port=opts.master_port,
+        volume_size_limit_mb=opts.volumeSizeLimitMB,
+        default_replication=opts.defaultReplication,
+    )
+    m.start()
+    print(f"master listening at {m.url}")
+    vs = VolumeServer(
+        opts.dir.split(","), m.url, host=opts.ip, port=opts.volume_port
+    )
+    vs.start()
+    print(f"volume server listening at {vs.url}")
+    if opts.filer or opts.s3:
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        f = FilerServer(
+            m.url,
+            host=opts.ip,
+            port=opts.filer_port,
+            store_kind=opts.filer_store,
+            store_path=opts.filer_store_path,
+        )
+        f.start()
+        print(f"filer listening at {f.url}")
+        if opts.s3:
+            from seaweedfs_tpu.s3.server import S3Server
+
+            s3 = S3Server(f, host=opts.ip, port=opts.s3_port)
+            s3.start()
+            print(f"s3 gateway listening at {s3.url}")
+    return _wait_forever()

@@ -1,0 +1,7 @@
+"""Filer: POSIX-ish namespace over pluggable metadata stores, files as chunk
+lists on volume servers (reference: `weed/filer/`)."""
+
+from .entry import Attributes, Entry, FileChunk
+from .filer import Filer
+
+__all__ = ["Attributes", "Entry", "FileChunk", "Filer"]

@@ -1,0 +1,156 @@
+"""Filer core: path->Entry over a FilerStore, with parent-dir maintenance,
+recursive delete, rename, and a metadata event log with subscriptions.
+
+Reference: `weed/filer/filer.go:37`, `filer_delete_entry.go`,
+`filer_rename.go`, `filer_notify.go:20` (event log), `meta_aggregator.go`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from .entry import Attributes, Entry, FileChunk
+from .filerstore import FilerStore, MemoryStore
+
+
+class FilerError(Exception):
+    pass
+
+
+def normalize(path: str) -> str:
+    if not path.startswith("/"):
+        path = "/" + path
+    while "//" in path:
+        path = path.replace("//", "/")
+    if len(path) > 1 and path.endswith("/"):
+        path = path[:-1]
+    return path
+
+
+class MetaEvent:
+    def __init__(self, directory: str, old: Entry | None, new: Entry | None) -> None:
+        self.directory = directory
+        self.old_entry = old
+        self.new_entry = new
+        self.ts_ns = time.time_ns()
+
+
+class Filer:
+    def __init__(self, store: FilerStore | None = None) -> None:
+        self.store = store or MemoryStore()
+        self._lock = threading.RLock()
+        self._subscribers: list[Callable[[MetaEvent], None]] = []
+        self._log: list[MetaEvent] = []
+        root = self.store.find_entry("/")
+        if root is None:
+            self.store.insert_entry(
+                Entry(full_path="/", is_directory=True,
+                      attributes=Attributes(mode=0o755))
+            )
+
+    # --- events ---------------------------------------------------------------
+    def subscribe(self, fn: Callable[[MetaEvent], None]) -> None:
+        self._subscribers.append(fn)
+
+    def events_since(self, ts_ns: int) -> list[MetaEvent]:
+        return [e for e in self._log if e.ts_ns > ts_ns]
+
+    def _notify(self, directory: str, old: Entry | None, new: Entry | None) -> None:
+        ev = MetaEvent(directory, old, new)
+        self._log.append(ev)
+        if len(self._log) > 100_000:
+            del self._log[:50_000]
+        for fn in list(self._subscribers):
+            try:
+                fn(ev)
+            except Exception:
+                pass
+
+    # --- core ops ---------------------------------------------------------------
+    def _ensure_parents(self, path: str) -> None:
+        parent = path.rsplit("/", 1)[0] or "/"
+        if parent == path:
+            return
+        if self.store.find_entry(parent) is None:
+            self._ensure_parents(parent)
+            e = Entry(full_path=parent, is_directory=True,
+                      attributes=Attributes(mode=0o755))
+            self.store.insert_entry(e)
+            self._notify(e.parent, None, e)
+
+    def create_entry(self, entry: Entry) -> None:
+        entry.full_path = normalize(entry.full_path)
+        with self._lock:
+            existing = self.store.find_entry(entry.full_path)
+            if existing is not None and existing.is_directory != entry.is_directory:
+                raise FilerError(
+                    f"{entry.full_path} exists as "
+                    f"{'directory' if existing.is_directory else 'file'}"
+                )
+            self._ensure_parents(entry.full_path)
+            self.store.insert_entry(entry)
+            self._notify(entry.parent, existing, entry)
+
+    def find_entry(self, path: str) -> Entry | None:
+        return self.store.find_entry(normalize(path))
+
+    def update_entry(self, entry: Entry) -> None:
+        with self._lock:
+            old = self.store.find_entry(entry.full_path)
+            self.store.update_entry(entry)
+            self._notify(entry.parent, old, entry)
+
+    def delete_entry(
+        self, path: str, recursive: bool = False
+    ) -> list[FileChunk]:
+        """Delete; returns the chunks whose blobs should be reclaimed
+        (`filer_delete_entry.go`)."""
+        path = normalize(path)
+        with self._lock:
+            entry = self.store.find_entry(path)
+            if entry is None:
+                return []
+            collected: list[FileChunk] = []
+            if entry.is_directory:
+                children = list(self.store.list_entries(path, "", True, 1 << 31))
+                if children and not recursive:
+                    raise FilerError(f"{path} is not empty")
+                for child in children:
+                    collected.extend(self.delete_entry(child.full_path, recursive=True))
+            collected.extend(entry.chunks)
+            self.store.delete_entry(path)
+            self._notify(entry.parent, entry, None)
+            return collected
+
+    def list_entries(
+        self, dir_path: str, start_from: str = "", inclusive: bool = False,
+        limit: int = 1024,
+    ) -> list[Entry]:
+        return list(
+            self.store.list_entries(normalize(dir_path), start_from, inclusive, limit)
+        )
+
+    def rename(self, old_path: str, new_path: str) -> None:
+        """Atomic-within-this-filer rename, directories recursively
+        (`filer_rename.go`, gRPC AtomicRenameEntry)."""
+        old_path, new_path = normalize(old_path), normalize(new_path)
+        with self._lock:
+            entry = self.store.find_entry(old_path)
+            if entry is None:
+                raise FilerError(f"{old_path} not found")
+            if self.store.find_entry(new_path) is not None:
+                raise FilerError(f"{new_path} already exists")
+            self._ensure_parents(new_path)
+            if entry.is_directory:
+                for child in list(self.store.list_entries(old_path, "", True, 1 << 31)):
+                    self.rename(
+                        child.full_path, new_path + "/" + child.name
+                    )
+            old_copy = Entry.from_dict(entry.to_dict())
+            self.store.delete_entry(old_path)
+            entry.full_path = new_path
+            self.store.insert_entry(entry)
+            self._notify(old_copy.parent, old_copy, None)
+            self._notify(entry.parent, None, entry)

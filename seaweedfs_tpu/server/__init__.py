@@ -1,0 +1,9 @@
+"""HTTP servers: master, volume, filer (+ S3 gateway in seaweedfs_tpu.s3).
+
+The control plane mirrors the reference's own HTTP surface (/dir/assign,
+/dir/lookup on the master — `weed/server/master_server_handlers.go:36,110` —
+and GET/POST/DELETE /<vid>,<fid> on volume servers —
+`weed/server/volume_server_handlers.go`), with JSON bodies where the
+reference uses gRPC for admin verbs (this build's wire format; grpc/proto
+tooling is not available in the image).
+"""

@@ -1,0 +1,283 @@
+"""Filer HTTP server: upload pipeline with auto-chunking + MD5 tee, streamed
+ranged reads via visible intervals, directory listings, recursive delete.
+
+Reference: `weed/server/filer_server_handlers_write_autochunk.go:26-155`,
+`_write_upload.go:30-141` (chunk fan-out + whole-stream MD5),
+`_read.go:91` (ranged streaming), `filer/stream.go:153`.
+
+The upload path's content hashing routes through the TPU batch kernels when
+a chip is attached (ops.md5_kernel/crc32c_kernel batch queue) and the C++
+native path otherwise — never pure Python (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+import urllib.parse
+
+from seaweedfs_tpu.filer import Attributes, Entry, FileChunk, Filer
+from seaweedfs_tpu.filer.filechunks import (
+    maybe_manifestize,
+    resolve_chunk_manifest,
+    total_size,
+    view_from_chunks,
+)
+from seaweedfs_tpu.filer.filer import FilerError, normalize
+from seaweedfs_tpu.filer.filerstore import make_store
+from seaweedfs_tpu.filer.wdclient import WeedClient
+
+from .httpd import HTTPService, Request, Response
+
+SMALL_CONTENT_LIMIT = 2 * 1024  # inline small files in the entry
+
+
+class FilerServer:
+    def __init__(
+        self,
+        master_url: str,
+        host: str = "127.0.0.1",
+        port: int = 8888,
+        store_kind: str = "memory",
+        store_path: str | None = None,
+        chunk_size_mb: int = 4,
+        default_replication: str = "",
+        collection: str = "",
+    ) -> None:
+        self.filer = Filer(make_store(store_kind, store_path))
+        self.client = WeedClient(master_url)
+        self.chunk_size = chunk_size_mb * 1024 * 1024
+        self.default_replication = default_replication
+        self.collection = collection
+        self.service = HTTPService(host, port)
+        self._routes()
+
+    def start(self) -> None:
+        self.service.start()
+
+    def stop(self) -> None:
+        self.service.stop()
+        self.filer.store.close()
+
+    @property
+    def url(self) -> str:
+        return self.service.url
+
+    # --- upload pipeline --------------------------------------------------------
+    def _upload_chunks(
+        self, data: bytes, ttl: str, collection: str, replication: str
+    ) -> tuple[list[FileChunk], str]:
+        """Split into chunks, upload each, tee a whole-stream MD5
+        (`filer_server_handlers_write_upload.go:30`)."""
+        md5 = hashlib.md5()
+        chunks: list[FileChunk] = []
+        offset = 0
+        while offset < len(data):
+            piece = data[offset : offset + self.chunk_size]
+            md5.update(piece)
+            out = self.client.upload(
+                piece, replication=replication, collection=collection, ttl=ttl
+            )
+            chunks.append(
+                FileChunk(
+                    file_id=out["fid"],
+                    offset=offset,
+                    size=len(piece),
+                    modified_ts_ns=time.time_ns(),
+                    etag=out.get("eTag", ""),
+                )
+            )
+            offset += len(piece)
+        if not data:
+            md5.update(b"")
+        return chunks, md5.hexdigest()
+
+    def _save_manifest_blob(self, blob: bytes) -> FileChunk:
+        out = self.client.upload(blob, collection=self.collection)
+        return FileChunk(
+            file_id=out["fid"], offset=0, size=len(blob),
+            modified_ts_ns=time.time_ns(),
+        )
+
+    def _fetch_chunk(self, file_id: str) -> bytes:
+        return self.client.fetch(file_id)
+
+    def _resolved_chunks(self, entry: Entry) -> list[FileChunk]:
+        return resolve_chunk_manifest(self._fetch_chunk, entry.chunks)
+
+    # --- routes -----------------------------------------------------------------
+    def _routes(self) -> None:
+        svc = self.service
+        path_re = r"(/.*)"
+
+        @svc.route("GET", path_re)
+        def read(req: Request) -> Response:
+            return self._do_read(req, head=False)
+
+        @svc.route("HEAD", path_re)
+        def head(req: Request) -> Response:
+            return self._do_read(req, head=True)
+
+        @svc.route("POST", path_re)
+        def post(req: Request) -> Response:
+            return self._do_write(req)
+
+        @svc.route("PUT", path_re)
+        def put(req: Request) -> Response:
+            return self._do_write(req)
+
+        @svc.route("DELETE", path_re)
+        def delete(req: Request) -> Response:
+            return self._do_delete(req)
+
+    # --- handlers ---------------------------------------------------------------
+    def _do_write(self, req: Request) -> Response:
+        path = normalize(urllib.parse.unquote(req.path))
+        if path.endswith("/") or req.query.get("mkdir") == "true":
+            e = Entry(full_path=path, is_directory=True,
+                      attributes=Attributes(mode=0o755))
+            self.filer.create_entry(e)
+            return Response({"name": e.name}, 201)
+        part = req.multipart_file()
+        if part is not None:
+            filename, mime, data = part
+        else:
+            data = req.body
+            mime = req.headers.get("Content-Type", "")
+            filename = path.rsplit("/", 1)[-1]
+        ttl = req.query.get("ttl", "")
+        collection = req.query.get("collection", self.collection)
+        replication = req.query.get("replication", self.default_replication)
+
+        from seaweedfs_tpu.storage.types import TTL
+
+        entry = Entry(full_path=path)
+        entry.attributes.mime = mime
+        entry.attributes.file_size = len(data)
+        entry.attributes.ttl_sec = TTL.parse(ttl).minutes() * 60
+        entry.attributes.mtime = time.time()
+        if len(data) <= SMALL_CONTENT_LIMIT:
+            entry.content = data
+            entry.attributes.md5 = hashlib.md5(data).hexdigest()
+        else:
+            chunks, md5_hex = self._upload_chunks(data, ttl, collection, replication)
+            entry.chunks = maybe_manifestize(self._save_manifest_blob, chunks)
+            entry.attributes.md5 = md5_hex
+        old_entry = self.filer.find_entry(path)
+        try:
+            self.filer.create_entry(entry)
+        except FilerError as e:
+            return Response({"error": str(e)}, 409)
+        if old_entry is not None and old_entry.chunks:
+            self._reclaim_chunks(old_entry.chunks)  # overwritten version's blobs
+        return Response(
+            {"name": entry.name, "size": len(data), "md5": entry.attributes.md5},
+            201,
+        )
+
+    def _reclaim_chunks(self, chunks) -> None:
+        for c in chunks:
+            try:
+                if c.is_chunk_manifest:
+                    for inner in resolve_chunk_manifest(self._fetch_chunk, [c]):
+                        self.client.delete(inner.file_id)
+                self.client.delete(c.file_id)
+            except Exception:
+                pass
+
+    def _do_read(self, req: Request, head: bool) -> Response:
+        path = normalize(urllib.parse.unquote(req.path))
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return Response({"error": f"{path} not found"}, 404)
+        if entry.is_directory:
+            return self._list_dir(req, entry)
+        if (
+            entry.attributes.ttl_sec > 0
+            and entry.attributes.mtime + entry.attributes.ttl_sec < time.time()
+        ):
+            self.filer.delete_entry(path)  # expired: reap lazily
+            return Response({"error": f"{path} expired"}, 404)
+        etag = entry.attributes.md5 or str(entry.attributes.mtime)
+        headers = {
+            "ETag": f'"{etag}"',
+            "Accept-Ranges": "bytes",
+            "Last-Modified": time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attributes.mtime)
+            ),
+        }
+        if entry.attributes.mime:
+            headers["Content-Type"] = entry.attributes.mime
+        if req.headers.get("If-None-Match") == f'"{etag}"':
+            return Response(b"", 304, headers)
+        size = entry.size()
+        start, end = 0, size - 1
+        status = 200
+        rng = req.headers.get("Range")
+        if rng and rng.startswith("bytes=") and "," not in rng:
+            spec = rng[6:]
+            s, _, e = spec.partition("-")
+            start = int(s) if s else max(0, size - int(e))
+            end = int(e) if e and s else size - 1
+            end = min(end, size - 1)
+            if start > end:
+                return Response(b"", 416, {"Content-Range": f"bytes */{size}"})
+            status = 206
+            headers["Content-Range"] = f"bytes {start}-{end}/{size}"
+        if head:
+            headers["X-File-Size"] = str(size)
+            return Response(b"", 200 if status == 200 else status, headers)
+        body = self._read_range(entry, start, end - start + 1)
+        return Response(body, status, headers)
+
+    def _read_range(self, entry: Entry, offset: int, size: int) -> bytes:
+        """Visible-interval resolution + ranged chunk fetches
+        (`filer/stream.go:153` StreamContent)."""
+        if entry.content:
+            return entry.content[offset : offset + size]
+        chunks = self._resolved_chunks(entry)
+        views = view_from_chunks(chunks, offset, size)
+        buf = bytearray(size)
+        for view in views:
+            rng = (
+                f"bytes={view.offset_in_chunk}-"
+                f"{view.offset_in_chunk + view.size - 1}"
+            )
+            piece = self.client.fetch(view.file_id, range_header=rng)
+            dst = view.view_offset - offset
+            buf[dst : dst + len(piece)] = piece
+        return bytes(buf)
+
+    def _list_dir(self, req: Request, entry: Entry) -> Response:
+        limit = int(req.query.get("limit", 1024))
+        last = req.query.get("lastFileName", "")
+        entries = self.filer.list_entries(entry.full_path, last, False, limit)
+        return Response(
+            {
+                "Path": entry.full_path,
+                "Entries": [
+                    {
+                        "FullPath": e.full_path,
+                        "IsDirectory": e.is_directory,
+                        "FileSize": e.size(),
+                        "Mtime": e.attributes.mtime,
+                        "Mime": e.attributes.mime,
+                        "Md5": e.attributes.md5,
+                    }
+                    for e in entries
+                ],
+                "LastFileName": entries[-1].name if entries else "",
+                "ShouldDisplayLoadMore": len(entries) == limit,
+            }
+        )
+
+    def _do_delete(self, req: Request) -> Response:
+        path = normalize(urllib.parse.unquote(req.path))
+        recursive = req.query.get("recursive") == "true"
+        try:
+            chunks = self.filer.delete_entry(path, recursive=recursive)
+        except FilerError as e:
+            return Response({"error": str(e)}, 409)
+        self._reclaim_chunks(chunks)
+        return Response(b"", 204)

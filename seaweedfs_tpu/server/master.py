@@ -1,0 +1,262 @@
+"""Master server: assign/lookup HTTP API + heartbeat ingest + growth + vacuum.
+
+Reference: `weed/server/master_server.go`, `master_server_handlers.go:36,110`,
+`master_grpc_server.go:62`, `topology_vacuum.go:216`. Single-master build
+(the reference's Raft layer elects one leader that does exactly this role;
+multi-master HA rides on the same state machine and is tracked as a gap in
+ROADMAP.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from seaweedfs_tpu.storage.types import ReplicaPlacement, TTL
+from seaweedfs_tpu.topology import Topology
+from seaweedfs_tpu.topology.sequence import MemorySequencer
+from seaweedfs_tpu.topology.volume_layout import NoWritableVolume
+
+from .httpd import HTTPService, Request, Response, post_json
+
+
+class MasterServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 9333,
+        volume_size_limit_mb: int = 30 * 1024,
+        pulse_seconds: int = 5,
+        default_replication: str = "000",
+        meta_dir: str | None = None,
+        garbage_threshold: float = 0.3,
+    ) -> None:
+        seq = MemorySequencer(f"{meta_dir}/sequence.json" if meta_dir else None)
+        self.topo = Topology(
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024,
+            pulse_seconds=pulse_seconds,
+            sequencer=seq,
+        )
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self.service = HTTPService(host, port)
+        self._grow_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._routes()
+
+    # --- lifecycle -------------------------------------------------------------
+    def start(self) -> None:
+        self.service.start()
+        threading.Thread(target=self._maintenance_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.service.stop()
+
+    @property
+    def url(self) -> str:
+        return self.service.url
+
+    def _maintenance_loop(self) -> None:
+        while not self._stop.wait(self.topo.pulse_seconds):
+            self.topo.expire_dead_nodes()
+            try:
+                self._vacuum_check()
+            except Exception:
+                pass
+
+    # --- growth ----------------------------------------------------------------
+    def _grow_volumes(
+        self, collection: str, rp: ReplicaPlacement, ttl_u32: int, dc: str
+    ) -> None:
+        """Pick servers then instruct them to allocate (`volume_growth.go:243`)."""
+        with self._grow_lock:
+            lo = self.topo.layout(collection, rp, ttl_u32)
+            if lo.active_volume_count(dc) > 0:
+                return  # another request already grew (in this DC if pinned)
+            grown = self.topo.grow(collection, rp, ttl_u32, dc)
+            ttl_s = str(TTL.from_u32(ttl_u32))
+            for vid, nodes in grown:
+                ok_nodes = []
+                for node in nodes:
+                    try:
+                        post_json(
+                            f"http://{node.url}/admin/allocate_volume",
+                            {
+                                "volume": vid,
+                                "collection": collection,
+                                "replication": str(rp),
+                                "ttl": ttl_s,
+                            },
+                            timeout=10,
+                        )
+                        ok_nodes.append(node)
+                    except Exception:
+                        continue
+                # registration happens via the servers' next heartbeat; to make
+                # assign usable immediately, register optimistically
+                from seaweedfs_tpu.topology.node import VolumeInfo
+
+                if len(ok_nodes) == rp.copy_count():
+                    for node in ok_nodes:
+                        info = VolumeInfo(
+                            id=vid,
+                            collection=collection,
+                            replica_placement=rp.to_byte(),
+                            ttl=ttl_u32,
+                        )
+                        node.volumes[vid] = info
+                        self.topo._register_volume(info, node)
+
+    # --- vacuum ----------------------------------------------------------------
+    def _vacuum_check(self) -> None:
+        """Ask volume servers to compact garbage-heavy volumes
+        (`topology_vacuum.go:216`)."""
+        for node in self.topo.all_nodes():
+            for vid, info in list(node.volumes.items()):
+                if info.size == 0 or info.read_only:
+                    continue
+                if info.deleted_byte_count / max(info.size, 1) > self.garbage_threshold:
+                    try:
+                        post_json(
+                            f"http://{node.url}/admin/vacuum",
+                            {"volume": vid},
+                            timeout=120,
+                        )
+                    except Exception:
+                        pass
+
+    # --- routes ----------------------------------------------------------------
+    def _routes(self) -> None:
+        svc = self.service
+
+        @svc.route("POST", r"/heartbeat")
+        def heartbeat(req: Request) -> Response:
+            hb = req.json()
+            self.topo.sync_heartbeat(hb)
+            return Response(
+                {
+                    "volume_size_limit": self.topo.volume_size_limit,
+                    "leader": self.url,
+                }
+            )
+
+        def do_assign(req: Request) -> Response:
+            count = int(req.query.get("count", 1))
+            replication = req.query.get("replication") or self.default_replication
+            collection = req.query.get("collection", "")
+            ttl = req.query.get("ttl", "")
+            dc = req.query.get("dataCenter", "")
+            rp = ReplicaPlacement.parse(replication)
+            ttl_u32 = TTL.parse(ttl).to_u32()
+            lo = self.topo.layout(collection, rp, ttl_u32)
+            if lo.active_volume_count(dc) == 0:
+                try:
+                    self._grow_volumes(collection, rp, ttl_u32, dc)
+                except Exception as e:
+                    return Response({"error": f"cannot grow volumes: {e}"}, 500)
+            try:
+                fid, cnt, nodes = self.topo.pick_for_write(
+                    count, replication, ttl, collection, dc
+                )
+            except NoWritableVolume:
+                # raced with a full/readonly transition: grow then retry once
+                try:
+                    self._grow_volumes(collection, rp, ttl_u32, dc)
+                    fid, cnt, nodes = self.topo.pick_for_write(
+                        count, replication, ttl, collection, dc
+                    )
+                except (NoWritableVolume, Exception) as e:
+                    return Response({"error": str(e)}, 404)
+            main = nodes[0]
+            return Response(
+                {
+                    "fid": fid,
+                    "url": main.id,
+                    "publicUrl": main.url,
+                    "count": cnt,
+                    "replicas": [
+                        {"url": n.id, "publicUrl": n.url} for n in nodes[1:]
+                    ],
+                }
+            )
+
+        svc.route("GET", r"/dir/assign")(do_assign)
+        svc.route("POST", r"/dir/assign")(do_assign)
+
+        def do_lookup(req: Request) -> Response:
+            vid_s = req.query.get("volumeId", "")
+            if "," in vid_s:
+                vid_s = vid_s.split(",")[0]
+            try:
+                vid = int(vid_s)
+            except ValueError:
+                return Response({"error": f"unknown volumeId {vid_s}"}, 400)
+            nodes = self.topo.lookup(vid, req.query.get("collection", ""))
+            if not nodes:
+                return Response(
+                    {"volumeOrFileId": vid_s, "error": "volume id not found"}, 404
+                )
+            return Response(
+                {
+                    "volumeOrFileId": vid_s,
+                    "locations": [
+                        {"url": n.id, "publicUrl": n.url} for n in nodes
+                    ],
+                }
+            )
+
+        svc.route("GET", r"/dir/lookup")(do_lookup)
+        svc.route("POST", r"/dir/lookup")(do_lookup)
+
+        @svc.route("GET", r"/dir/ec_lookup")
+        def ec_lookup(req: Request) -> Response:
+            vid = int(req.query.get("volumeId", 0))
+            shard_map = self.topo.lookup_ec_shards(vid)
+            if shard_map is None:
+                return Response({"error": "ec volume not found"}, 404)
+            return Response(
+                {
+                    "volumeId": vid,
+                    "shards": {
+                        str(sid): [n.url for n in nodes]
+                        for sid, nodes in shard_map.items()
+                    },
+                }
+            )
+
+        @svc.route("GET", r"/dir/status")
+        def dir_status(req: Request) -> Response:
+            return Response({"Topology": self.topo.to_dict(), "Version": "seaweedfs-tpu"})
+
+        @svc.route("GET", r"/cluster/status")
+        def cluster_status(req: Request) -> Response:
+            return Response(
+                {"IsLeader": True, "Leader": self.url, "MaxVolumeId": self.topo._max_volume_id}
+            )
+
+        @svc.route("GET", r"/vol/status")
+        def vol_status(req: Request) -> Response:
+            out = {}
+            for node in self.topo.all_nodes():
+                out[node.id] = {
+                    str(vid): {
+                        "size": v.size,
+                        "file_count": v.file_count,
+                        "delete_count": v.delete_count,
+                        "garbage": v.deleted_byte_count,
+                    }
+                    for vid, v in node.volumes.items()
+                }
+            return Response({"Volumes": out})
+
+        @svc.route("GET", r"/vol/vacuum")
+        def vol_vacuum(req: Request) -> Response:
+            threshold = float(req.query.get("garbageThreshold", self.garbage_threshold))
+            old = self.garbage_threshold
+            self.garbage_threshold = threshold
+            try:
+                self._vacuum_check()
+            finally:
+                self.garbage_threshold = old
+            return Response({"ok": True})

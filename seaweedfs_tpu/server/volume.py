@@ -1,0 +1,412 @@
+"""Volume server: HTTP data plane + admin plane + heartbeat loop.
+
+Reference: `weed/server/volume_server_handlers_read.go:45` /
+`_write.go:18` (GET/POST/DELETE /<vid>,<fid>), `store_replicate.go:26`
+(synchronous replica fan-out), `volume_grpc_erasure_coding.go` (EC verbs —
+JSON admin endpoints here), `volume_grpc_client_to_master.go:50` (heartbeat).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+
+from seaweedfs_tpu.storage import crc as crc_mod
+from seaweedfs_tpu.storage.erasure_coding import encoder as ec_encoder
+from seaweedfs_tpu.storage.erasure_coding import geometry
+from seaweedfs_tpu.storage.file_id import parse_key_hash_with_delta
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.volume import NotFound, VolumeError, volume_file_name
+
+from .httpd import HTTPService, Request, Response, get_json, http_request, post_json
+
+FID_RE = r"/(\d+),([0-9a-fA-F_]+)(?:\.[^/]*)?"
+
+
+class VolumeServer:
+    def __init__(
+        self,
+        directories: list[str],
+        master_url: str,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        public_url: str = "",
+        data_center: str = "",
+        rack: str = "",
+        pulse_seconds: int = 5,
+        max_volume_count: int = 100,
+    ) -> None:
+        self.master_url = master_url.rstrip("/")
+        self.service = HTTPService(host, port)
+        self.store: Store | None = None
+        self._dirs = directories
+        self._host = host
+        self._public_url = public_url
+        self.data_center = data_center
+        self.rack = rack
+        self.pulse_seconds = pulse_seconds
+        self.max_volume_count = max_volume_count
+        self.volume_size_limit = 30 * 1024 * 1024 * 1024
+        self._stop = threading.Event()
+        self._routes()
+
+    def start(self) -> None:
+        self.service.start()
+        self.store = Store(
+            self._dirs,
+            ip=self._host,
+            port=self.service.port,
+            public_url=self._public_url,
+        )
+        for loc in self.store.locations:
+            loc.max_volume_count = self.max_volume_count
+        self.heartbeat_once()
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.service.stop()
+        if self.store:
+            self.store.close()
+
+    @property
+    def url(self) -> str:
+        return self.service.url
+
+    # --- heartbeat --------------------------------------------------------------
+    def heartbeat_once(self) -> None:
+        hb = self.store.collect_heartbeat()
+        hb["data_center"] = self.data_center
+        hb["rack"] = self.rack
+        hb["max_volume_count"] = self.max_volume_count
+        try:
+            resp = post_json(f"{self.master_url}/heartbeat", hb, timeout=10)
+            self.volume_size_limit = int(
+                resp.get("volume_size_limit", self.volume_size_limit)
+            )
+        except Exception:
+            pass
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.pulse_seconds):
+            self.heartbeat_once()
+
+    # --- replication --------------------------------------------------------------
+    def _replicate(
+        self,
+        method: str,
+        vid: int,
+        fid: str,
+        body: bytes,
+        headers: dict,
+        extra_query: dict | None = None,
+    ) -> None:
+        """Fan out to the other replica locations (`store_replicate.go:26`).
+        All-or-nothing: any replica failure surfaces as an error so the client
+        can retry with a fresh assignment. The original request's ttl/headers
+        are forwarded so replicas store identical needles."""
+        try:
+            info = get_json(f"{self.master_url}/dir/lookup?volumeId={vid}", timeout=5)
+        except Exception as e:
+            raise VolumeError(f"replicate lookup failed: {e}")
+        me = f"{self._host}:{self.service.port}"
+        qs = "type=replicate"
+        for k, v in (extra_query or {}).items():
+            qs += f"&{k}={urllib.parse.quote(str(v))}"
+        for loc in info.get("locations", []):
+            target = loc["url"]
+            if target == me:
+                continue
+            status, _, out = http_request(
+                method,
+                f"http://{target}/{vid},{fid}?{qs}",
+                body=body,
+                headers={k: v for k, v in headers.items() if v},
+            )
+            if status >= 400:
+                raise VolumeError(f"replica write to {target} failed: {out[:200]!r}")
+
+    # --- routes -------------------------------------------------------------------
+    def _routes(self) -> None:
+        svc = self.service
+
+        @svc.route("GET", FID_RE)
+        def read(req: Request) -> Response:
+            return self._do_read(req, head=False)
+
+        @svc.route("HEAD", FID_RE)
+        def head(req: Request) -> Response:
+            return self._do_read(req, head=True)
+
+        @svc.route("POST", FID_RE)
+        def write(req: Request) -> Response:
+            return self._do_write(req)
+
+        @svc.route("PUT", FID_RE)
+        def put(req: Request) -> Response:
+            return self._do_write(req)
+
+        @svc.route("DELETE", FID_RE)
+        def delete(req: Request) -> Response:
+            return self._do_delete(req)
+
+        @svc.route("GET", r"/status")
+        def status(req: Request) -> Response:
+            hb = self.store.collect_heartbeat()
+            return Response({"Version": "seaweedfs-tpu", **hb})
+
+        @svc.route("POST", r"/admin/allocate_volume")
+        def allocate(req: Request) -> Response:
+            p = req.json()
+            self.store.add_volume(
+                int(p["volume"]),
+                p.get("collection", ""),
+                p.get("replication", "000"),
+                p.get("ttl", ""),
+            )
+            return Response({"ok": True})
+
+        @svc.route("POST", r"/admin/delete_volume")
+        def delete_volume(req: Request) -> Response:
+            self.store.delete_volume(int(req.json()["volume"]))
+            return Response({"ok": True})
+
+        @svc.route("POST", r"/admin/vacuum")
+        def vacuum(req: Request) -> Response:
+            vid = int(req.json()["volume"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            garbage = v.garbage_level()
+            v.compact()
+            v.commit_compact()
+            self.heartbeat_once()
+            return Response({"ok": True, "garbage_was": garbage})
+
+        @svc.route("POST", r"/admin/volume/readonly")
+        def readonly(req: Request) -> Response:
+            p = req.json()
+            self.store.mark_readonly(int(p["volume"]), bool(p.get("readonly", True)))
+            return Response({"ok": True})
+
+        # --- EC verbs (volume_grpc_erasure_coding.go) ---
+        @svc.route("POST", r"/admin/ec/generate")
+        def ec_generate(req: Request) -> Response:
+            p = req.json()
+            vid = int(p["volume"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            v.readonly = True
+            base = v.base_name
+            ec_encoder.write_ec_files(base)
+            ec_encoder.write_sorted_file_from_idx(base)
+            ec_encoder.save_volume_info(base + ".vif", version=v.version())
+            return Response({"ok": True, "shards": list(range(14))})
+
+        @svc.route("POST", r"/admin/ec/mount")
+        def ec_mount(req: Request) -> Response:
+            p = req.json()
+            ev = self.store.mount_ec_volume(int(p["volume"]), p.get("collection", ""))
+            self.heartbeat_once()
+            return Response({"ok": True, "shards": ev.shard_ids()})
+
+        @svc.route("POST", r"/admin/ec/unmount")
+        def ec_unmount(req: Request) -> Response:
+            self.store.unmount_ec_volume(int(req.json()["volume"]))
+            self.heartbeat_once()
+            return Response({"ok": True})
+
+        @svc.route("POST", r"/admin/ec/rebuild")
+        def ec_rebuild(req: Request) -> Response:
+            p = req.json()
+            vid = int(p["volume"])
+            collection = p.get("collection", "")
+            for loc in self.store.locations:
+                from seaweedfs_tpu.storage.erasure_coding.ec_volume import (
+                    ec_shard_file_name,
+                )
+
+                base = ec_shard_file_name(collection, loc.directory, vid)
+                import os
+
+                if any(
+                    os.path.exists(base + geometry.to_ext(i)) for i in range(14)
+                ):
+                    rebuilt = ec_encoder.rebuild_ec_files(base)
+                    return Response({"ok": True, "rebuilt": rebuilt})
+            return Response({"error": f"no shards for volume {vid}"}, 404)
+
+        @svc.route("POST", r"/admin/ec/delete_volume")
+        def ec_delete(req: Request) -> Response:
+            """Delete the original volume files after EC spread
+            (`command_ec_encode.go` deletes source replicas)."""
+            vid = int(req.json()["volume"])
+            self.store.delete_volume(vid)
+            return Response({"ok": True})
+
+        @svc.route("GET", r"/admin/ec/shard")
+        def ec_shard_read(req: Request) -> Response:
+            """Raw shard byte range — remote EC reads (`store_ec.go:281`)."""
+            vid = int(req.query["volume"])
+            shard = int(req.query["shard"])
+            offset = int(req.query.get("offset", 0))
+            size = int(req.query.get("size", -1))
+            ev = self.store.get_ec_volume(vid)
+            if ev is None:
+                return Response({"error": "ec volume not mounted"}, 404)
+            import os
+
+            fd = ev.shards.get(shard)
+            if fd is None:
+                return Response({"error": f"shard {shard} not local"}, 404)
+            if size < 0:
+                size = ev.shard_size - offset
+            data = os.pread(fd, size, offset)
+            return Response(data, content_type="application/octet-stream")
+
+        @svc.route("GET", r"/admin/tail")
+        def tail(req: Request) -> Response:
+            """Needles appended after since_ns (`volume_backup.go:66`)."""
+            vid = int(req.query["volume"])
+            since_ns = int(req.query.get("since_ns", 0))
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            start = (
+                v.binary_search_by_append_at_ns(since_ns) if since_ns else None
+            )
+            if start is None:
+                from seaweedfs_tpu.storage.super_block import SUPER_BLOCK_SIZE
+
+                start = SUPER_BLOCK_SIZE
+            import os
+
+            data = os.pread(v._fd, v.size() - start, start)
+            return Response(data, content_type="application/octet-stream")
+
+    # --- handlers -------------------------------------------------------------
+    def _parse_fid(self, req: Request) -> tuple[int, int, int]:
+        vid = int(req.match.group(1))
+        key, cookie = parse_key_hash_with_delta(req.match.group(2))
+        return vid, key, cookie
+
+    def _do_read(self, req: Request, head: bool) -> Response:
+        try:
+            vid, key, cookie = self._parse_fid(req)
+        except ValueError as e:
+            return Response({"error": str(e)}, 400)
+        try:
+            n = self.store.read(vid, key, cookie=cookie)
+        except NotFound:
+            return Response(b"", 404)
+        except VolumeError as e:
+            return Response({"error": str(e)}, 404)
+        headers = {"ETag": f'"{n.etag()}"', "Accept-Ranges": "bytes"}
+        mime = n.mime.decode() if n.has_mime() and n.mime else "application/octet-stream"
+        if n.has_name() and n.name:
+            headers["Content-Disposition"] = (
+                f'inline; filename="{urllib.parse.quote(n.name.decode("utf-8", "replace"))}"'
+            )
+        if n.is_compressed():
+            headers["Content-Encoding"] = "gzip"
+        data = n.data
+        # range support
+        rng = req.headers.get("Range")
+        status = 200
+        if rng and rng.startswith("bytes=") and "," not in rng:
+            spec = rng[6:]
+            start_s, _, end_s = spec.partition("-")
+            start = int(start_s) if start_s else max(0, len(data) - int(end_s))
+            end = int(end_s) if end_s and start_s else len(data) - 1
+            end = min(end, len(data) - 1)
+            if start <= end:
+                headers["Content-Range"] = f"bytes {start}-{end}/{len(data)}"
+                data = data[start : end + 1]
+                status = 206
+        if head:
+            headers["Content-Length-Hint"] = str(len(data))
+            return Response(b"", status, headers, content_type=mime)
+        return Response(data, status, headers, content_type=mime)
+
+    def _do_write(self, req: Request) -> Response:
+        try:
+            vid, key, cookie = self._parse_fid(req)
+        except ValueError as e:
+            return Response({"error": str(e)}, 400)
+        is_replicate = req.query.get("type") == "replicate"
+        body = req.body
+        part = req.multipart_file()
+        if part is not None:
+            filename, mime, data = part
+        else:
+            data = body
+            filename = req.headers.get("X-File-Name", "")
+            mime = req.headers.get("Content-Type", "")
+            if mime in ("application/json", "application/x-www-form-urlencoded"):
+                mime = ""
+        n = Needle(cookie=cookie, id=key, data=data)
+        if filename:
+            n.name = filename.encode()
+            n.set_has_name()
+        if mime and len(mime) < 256 and mime != "application/octet-stream":
+            n.mime = mime.encode()
+            n.set_has_mime()
+        ttl_s = req.query.get("ttl", "")
+        if ttl_s:
+            from seaweedfs_tpu.storage.types import TTL
+
+            n.ttl = TTL.parse(ttl_s)
+            n.set_has_ttl()
+        import time as _time
+
+        n.last_modified = int(_time.time())
+        n.set_has_last_modified()
+        try:
+            offset, size = self.store.write(vid, n, check_cookie=not is_replicate)
+        except VolumeError as e:
+            return Response({"error": str(e)}, 500)
+        if not is_replicate:
+            v = self.store.get_volume(vid)
+            rp = v.super_block.replica_placement if v else None
+            if rp and rp.copy_count() > 1:
+                try:
+                    extra = {"ttl": ttl_s} if ttl_s else {}
+                    self._replicate(
+                        "POST", vid, req.match.group(2), body,
+                        {
+                            "Content-Type": req.headers.get("Content-Type", ""),
+                            "X-File-Name": req.headers.get("X-File-Name", ""),
+                        },
+                        extra_query=extra,
+                    )
+                except VolumeError as e:
+                    return Response({"error": str(e)}, 500)
+            if v and v.size() >= self.volume_size_limit:
+                self.heartbeat_once()  # tell master it's full
+        return Response(
+            {"name": filename, "size": len(data), "eTag": n.etag()}, 201
+        )
+
+    def _do_delete(self, req: Request) -> Response:
+        try:
+            vid, key, cookie = self._parse_fid(req)
+        except ValueError as e:
+            return Response({"error": str(e)}, 400)
+        is_replicate = req.query.get("type") == "replicate"
+        n = Needle(cookie=cookie, id=key)
+        try:
+            freed = self.store.delete(vid, n)
+        except VolumeError as e:
+            return Response({"error": str(e)}, 500)
+        if not is_replicate:
+            v = self.store.get_volume(vid)
+            rp = v.super_block.replica_placement if v else None
+            if rp and rp.copy_count() > 1:
+                try:
+                    self._replicate("DELETE", vid, req.match.group(2), b"", {})
+                except VolumeError as e:
+                    return Response({"error": str(e)}, 500)
+        return Response({"size": freed}, 202)

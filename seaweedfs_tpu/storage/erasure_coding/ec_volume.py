@@ -43,7 +43,10 @@ from .geometry import (
 )
 
 
-class NeedleNotFound(Exception):
+from seaweedfs_tpu.storage.volume import NotFound
+
+
+class NeedleNotFound(NotFound):
     pass
 
 

@@ -64,11 +64,3 @@ def walk_index_file(
     return None
 
 
-class IdxWriter:
-    """Append-only .idx writer."""
-
-    def __init__(self, f: BinaryIO) -> None:
-        self.f = f
-
-    def append(self, key: int, offset: int, size: int) -> None:
-        self.f.write(entry_to_bytes(key, offset, size))

@@ -1,0 +1,101 @@
+"""Per-volume needle index: id -> (offset, size), backed by the .idx file.
+
+Mirrors the reference's NeedleMapper semantics
+(`weed/storage/needle_map.go:23-37`, `needle_map_memory.go`): an in-memory
+map hydrated by replaying the .idx; every put/delete appends an entry
+(deletes append (key, tombstone_offset, -1)); bookkeeping tracks file/deleted
+counts and byte totals for heartbeats.
+
+A dict is the in-memory structure (the reference's CompactMap exists to fight
+Go GC pressure at hundreds of millions of entries per process; a Python dict
+of int->int packs the same information for our scale, and the LevelDB-backed
+variant can slot in behind the same interface later).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from . import idx as idx_mod
+from .types import TOMBSTONE_FILE_SIZE, size_is_valid
+
+
+@dataclass
+class MapMetrics:
+    file_count: int = 0
+    deleted_count: int = 0
+    deleted_bytes: int = 0
+    maximum_key: int = 0
+
+
+class NeedleMap:
+    """In-memory map + append-only .idx writer."""
+
+    def __init__(self, idx_path: str | None = None) -> None:
+        self._map: dict[int, tuple[int, int]] = {}
+        self.metrics = MapMetrics()
+        self._idx_path = idx_path
+        self._idx_file = None
+        if idx_path is not None:
+            exists = os.path.exists(idx_path)
+            if exists:
+                self._replay(idx_path)
+            self._idx_file = open(idx_path, "ab")
+
+    def _replay(self, path: str) -> None:
+        for key, offset, size in idx_mod.walk_index_file(path):
+            self._apply(key, offset, size)
+
+    def _apply(self, key: int, offset: int, size: int) -> None:
+        self.metrics.maximum_key = max(self.metrics.maximum_key, key)
+        if offset > 0 and size_is_valid(size):
+            old = self._map.get(key)
+            if old is not None:
+                self.metrics.deleted_count += 1
+                self.metrics.deleted_bytes += old[1]
+            else:
+                self.metrics.file_count += 1
+            self._map[key] = (offset, size)
+        else:
+            old = self._map.pop(key, None)
+            if old is not None:
+                self.metrics.deleted_count += 1
+                self.metrics.deleted_bytes += old[1]
+
+    # --- public API ---------------------------------------------------------
+    def get(self, key: int) -> tuple[int, int] | None:
+        return self._map.get(key)
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        self._apply(key, offset, size)
+        if self._idx_file is not None:
+            self._idx_file.write(idx_mod.entry_to_bytes(key, offset, size))
+            self._idx_file.flush()
+
+    def delete(self, key: int, tombstone_offset: int = 0) -> None:
+        self._apply(key, 0, TOMBSTONE_FILE_SIZE)
+        if self._idx_file is not None:
+            self._idx_file.write(
+                idx_mod.entry_to_bytes(key, tombstone_offset, TOMBSTONE_FILE_SIZE)
+            )
+            self._idx_file.flush()
+
+    def ascending_visit(self):
+        for key in sorted(self._map):
+            offset, size = self._map[key]
+            yield key, offset, size
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __contains__(self, key: int) -> bool:
+        return key in self._map
+
+    def content_size(self) -> int:
+        return sum(s for _, s in self._map.values())
+
+    def close(self) -> None:
+        if self._idx_file is not None:
+            self._idx_file.close()
+            self._idx_file = None

@@ -1,0 +1,253 @@
+"""Store: all volumes + EC shards on one volume server.
+
+Behavioral port of `weed/storage/store.go` + `disk_location.go` + `store_ec.go`
+(local parts): disk locations host regular volumes and EC volumes; the store
+routes reads/writes/deletes by volume id, tracks readonly state and free
+space, and assembles heartbeat messages for the master.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+from .erasure_coding.ec_volume import EcVolume, ec_shard_file_name
+from .needle import Needle
+from .types import TTL, ReplicaPlacement
+from .volume import NotFound, Volume, VolumeError, volume_file_name
+
+
+@dataclass
+class DiskLocation:
+    """One data directory (`weed/storage/disk_location.go:22`)."""
+
+    directory: str
+    max_volume_count: int = 0  # 0 = unlimited (auto)
+    min_free_space_bytes: int = 0
+    volumes: dict[int, Volume] = field(default_factory=dict)
+    ec_volumes: dict[int, EcVolume] = field(default_factory=dict)
+
+    def load_existing_volumes(self) -> None:
+        """Scan the directory for .dat/.idx pairs and .ecx files
+        (`disk_location.go:188` loads concurrently; sequential is fine here)."""
+        if not os.path.isdir(self.directory):
+            os.makedirs(self.directory, exist_ok=True)
+            return
+        for name in sorted(os.listdir(self.directory)):
+            base, ext = os.path.splitext(name)
+            if ext == ".dat":
+                collection, vid = _parse_base(base)
+                if vid is None or vid in self.volumes:
+                    continue
+                try:
+                    self.volumes[vid] = Volume(self.directory, collection, vid)
+                except Exception:
+                    continue  # unloadable volume: skip, like the reference logs+skips
+            elif ext == ".ecx":
+                collection, vid = _parse_base(base)
+                if vid is None or vid in self.ec_volumes:
+                    continue
+                try:
+                    self.ec_volumes[vid] = EcVolume(self.directory, collection, vid)
+                except Exception:
+                    continue
+
+    def is_disk_space_low(self) -> bool:
+        if self.min_free_space_bytes <= 0:
+            return False
+        st = os.statvfs(self.directory)
+        return st.f_bavail * st.f_frsize < self.min_free_space_bytes
+
+
+def _parse_base(base: str) -> tuple[str, int | None]:
+    if "_" in base:
+        collection, _, vid_s = base.rpartition("_")
+    else:
+        collection, vid_s = "", base
+    try:
+        return collection, int(vid_s)
+    except ValueError:
+        return "", None
+
+
+class Store:
+    def __init__(
+        self,
+        directories: list[str],
+        ip: str = "localhost",
+        port: int = 8080,
+        public_url: str = "",
+        min_free_space_bytes: int = 0,
+    ) -> None:
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.locations = [
+            DiskLocation(d, min_free_space_bytes=min_free_space_bytes)
+            for d in directories
+        ]
+        self._lock = threading.Lock()
+        for loc in self.locations:
+            loc.load_existing_volumes()
+
+    # --- lookup ---------------------------------------------------------------
+    def get_volume(self, vid: int) -> Volume | None:
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def get_ec_volume(self, vid: int) -> EcVolume | None:
+        for loc in self.locations:
+            v = loc.ec_volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def has_volume(self, vid: int) -> bool:
+        return self.get_volume(vid) is not None
+
+    def volume_ids(self) -> list[int]:
+        out: list[int] = []
+        for loc in self.locations:
+            out.extend(loc.volumes)
+        return sorted(out)
+
+    # --- volume lifecycle -----------------------------------------------------
+    def add_volume(
+        self,
+        vid: int,
+        collection: str = "",
+        replica_placement: str = "000",
+        ttl: str = "",
+    ) -> Volume:
+        with self._lock:
+            if self.has_volume(vid):
+                raise VolumeError(f"volume {vid} already exists")
+            loc = self._pick_location()
+            v = Volume(
+                loc.directory,
+                collection,
+                vid,
+                replica_placement=ReplicaPlacement.parse(replica_placement),
+                ttl=TTL.parse(ttl),
+            )
+            loc.volumes[vid] = v
+            return v
+
+    def _pick_location(self) -> DiskLocation:
+        candidates = [l for l in self.locations if not l.is_disk_space_low()]
+        if not candidates:
+            raise VolumeError("all disk locations are low on space")
+        return min(candidates, key=lambda l: len(l.volumes))
+
+    def delete_volume(self, vid: int) -> None:
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    v.destroy()
+                    return
+        raise VolumeError(f"volume {vid} not found")
+
+    def mark_readonly(self, vid: int, readonly: bool = True) -> None:
+        v = self.get_volume(vid)
+        if v is None:
+            raise VolumeError(f"volume {vid} not found")
+        v.readonly = readonly
+
+    # --- data ops -------------------------------------------------------------
+    def write(self, vid: int, n: Needle, check_cookie: bool = False) -> tuple[int, int]:
+        v = self.get_volume(vid)
+        if v is None:
+            raise VolumeError(f"volume {vid} not found")
+        return v.write_needle(n, check_cookie=check_cookie)
+
+    def read(self, vid: int, needle_id: int, cookie: int | None = None) -> Needle:
+        v = self.get_volume(vid)
+        if v is not None:
+            return v.read_needle(needle_id, cookie=cookie)
+        ev = self.get_ec_volume(vid)
+        if ev is not None:
+            return ev.read_needle(needle_id, cookie=cookie)
+        raise NotFound(f"volume {vid} not found")
+
+    def delete(self, vid: int, n: Needle) -> int:
+        v = self.get_volume(vid)
+        if v is None:
+            ev = self.get_ec_volume(vid)
+            if ev is not None:
+                ev.delete_needle(n.id)
+                return 0
+            raise VolumeError(f"volume {vid} not found")
+        return v.delete_needle(n)
+
+    # --- EC shard hosting -----------------------------------------------------
+    def mount_ec_volume(self, vid: int, collection: str = "") -> EcVolume:
+        for loc in self.locations:
+            base = ec_shard_file_name(collection, loc.directory, vid)
+            if os.path.exists(base + ".ecx"):
+                ev = EcVolume(loc.directory, collection, vid)
+                loc.ec_volumes[vid] = ev
+                return ev
+        raise VolumeError(f"no local .ecx for ec volume {vid}")
+
+    def unmount_ec_volume(self, vid: int) -> None:
+        for loc in self.locations:
+            ev = loc.ec_volumes.pop(vid, None)
+            if ev is not None:
+                ev.close()
+                return
+
+    # --- heartbeat ------------------------------------------------------------
+    def collect_heartbeat(self) -> dict:
+        """Message shape mirrors master_pb.Heartbeat (`store.go:249`)."""
+        volumes = []
+        max_file_key = 0
+        for loc in self.locations:
+            for v in loc.volumes.values():
+                max_file_key = max(max_file_key, v.max_needle_id())
+                volumes.append(
+                    {
+                        "id": v.id,
+                        "collection": v.collection,
+                        "size": v.size(),
+                        "file_count": v.file_count(),
+                        "delete_count": v.deleted_count(),
+                        "deleted_byte_count": v.deleted_bytes(),
+                        "read_only": v.readonly,
+                        "replica_placement": v.super_block.replica_placement.to_byte(),
+                        "ttl": v.super_block.ttl.to_u32(),
+                        "version": v.version(),
+                    }
+                )
+        ec_shards = []
+        for loc in self.locations:
+            for ev in loc.ec_volumes.values():
+                ec_shards.append(
+                    {
+                        "id": ev.volume_id,
+                        "collection": ev.collection,
+                        "ec_index_bits": sum(1 << s for s in ev.shard_ids()),
+                    }
+                )
+        return {
+            "ip": self.ip,
+            "port": self.port,
+            "public_url": self.public_url,
+            "max_file_key": max_file_key,
+            "max_volume_count": sum(
+                loc.max_volume_count or 100 for loc in self.locations
+            ),
+            "volumes": volumes,
+            "ec_shards": ec_shards,
+        }
+
+    def close(self) -> None:
+        for loc in self.locations:
+            for v in loc.volumes.values():
+                v.close()
+            for ev in loc.ec_volumes.values():
+                ev.close()

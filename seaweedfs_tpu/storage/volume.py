@@ -1,0 +1,376 @@
+"""Volume: one append-only .dat (+ .idx) pair holding millions of needles.
+
+Behavioral port of `weed/storage/volume.go` + `volume_read.go` +
+`volume_write.go` + `volume_loading.go` + `volume_checking.go` +
+`volume_vacuum.go` + `volume_backup.go`:
+
+  - superblock at offset 0; needles appended 8-byte aligned
+  - write: append needle, idx entry; duplicate-content writes detected
+  - read: map lookup -> positional read -> parse + cookie check + TTL expiry
+  - delete: append zero-data tombstone needle + tombstone idx entry
+  - vacuum: copy live needles to .cpd/.cpx shadow files, then atomic rename
+    with compaction-revision bump
+  - integrity check on load: last idx entry's needle must verify against .dat
+  - incremental backup: binary search needles by AppendAtNs
+
+Thread-safety: one writer lock; reads use positional os.pread.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import crc as crc_mod
+from . import idx as idx_mod
+from .needle import (
+    CURRENT_VERSION,
+    Needle,
+    get_actual_size,
+    needle_body_length,
+)
+from .needle_map import NeedleMap
+from .super_block import SUPER_BLOCK_SIZE, SuperBlock
+from .types import (
+    NEEDLE_HEADER_SIZE,
+    NEEDLE_PADDING_SIZE,
+    TTL,
+    ReplicaPlacement,
+    get_u64,
+    size_is_valid,
+)
+
+
+class VolumeError(Exception):
+    pass
+
+
+class NotFound(VolumeError):
+    pass
+
+
+def volume_file_name(dir_: str, collection: str, vid: int) -> str:
+    base = f"{collection}_{vid}" if collection else str(vid)
+    return os.path.join(dir_, base)
+
+
+class Volume:
+    def __init__(
+        self,
+        dir_: str,
+        collection: str,
+        volume_id: int,
+        replica_placement: ReplicaPlacement | None = None,
+        ttl: TTL | None = None,
+        version: int = CURRENT_VERSION,
+        preallocate: int = 0,
+    ) -> None:
+        self.dir = dir_
+        self.collection = collection
+        self.id = volume_id
+        self.base_name = volume_file_name(dir_, collection, volume_id)
+        self._write_lock = threading.Lock()
+        self.readonly = False
+        self.last_append_at_ns = 0
+
+        dat_path = self.base_name + ".dat"
+        is_new = not os.path.exists(dat_path)
+        if is_new:
+            self.super_block = SuperBlock(
+                version=version,
+                replica_placement=replica_placement or ReplicaPlacement(),
+                ttl=ttl or TTL(),
+            )
+            with open(dat_path, "wb") as f:
+                f.write(self.super_block.to_bytes())
+        self._fd = os.open(dat_path, os.O_RDWR)
+        if not is_new:
+            header = os.pread(self._fd, SUPER_BLOCK_SIZE, 0)
+            self.super_block = SuperBlock.from_bytes(header)
+        self.nm = NeedleMap(self.base_name + ".idx")
+        self._size = os.path.getsize(dat_path)
+        if not is_new:
+            self._check_idx_integrity()
+            self._load_last_append_at_ns()
+
+    # --- loading / integrity -------------------------------------------------
+    def _check_idx_integrity(self) -> None:
+        """verifyIndexFileIntegrity equivalent (`volume_checking.go:91,152`):
+        the last live idx entry's needle must parse at its offset."""
+        last = None
+        idx_path = self.base_name + ".idx"
+        size = os.path.getsize(idx_path)
+        if size == 0:
+            return
+        with open(idx_path, "rb") as f:
+            f.seek(size - 16)
+            last = idx_mod.entry_from_bytes(f.read(16))
+        key, offset, esize = last
+        if offset == 0 or not size_is_valid(esize):
+            return
+        blob = os.pread(
+            self._fd, get_actual_size(esize, self.version()), offset
+        )
+        n = Needle.from_bytes(blob, size=esize, version=self.version())
+        if n.id != key:
+            raise VolumeError(
+                f"volume {self.id}: idx tail mismatch id {n.id:x} != {key:x}"
+            )
+
+    def _load_last_append_at_ns(self) -> None:
+        entry = None
+        max_off = 0
+        for key, offset, size in self.nm.ascending_visit():
+            if offset > max_off:
+                max_off = offset
+                entry = (key, offset, size)
+        if entry is None:
+            return
+        _, offset, size = entry
+        version = self.version()
+        if version == 3:
+            blob = os.pread(self._fd, get_actual_size(size, version), offset)
+            if len(blob) >= get_actual_size(size, version):
+                ts_off = NEEDLE_HEADER_SIZE + size + 4
+                self.last_append_at_ns = get_u64(blob, ts_off)
+
+    def version(self) -> int:
+        return self.super_block.version
+
+    def close(self) -> None:
+        self.nm.close()
+        os.close(self._fd)
+
+    # --- stats ---------------------------------------------------------------
+    def size(self) -> int:
+        return self._size
+
+    def file_count(self) -> int:
+        return self.nm.metrics.file_count
+
+    def deleted_count(self) -> int:
+        return self.nm.metrics.deleted_count
+
+    def deleted_bytes(self) -> int:
+        return self.nm.metrics.deleted_bytes
+
+    def max_needle_id(self) -> int:
+        return self.nm.metrics.maximum_key
+
+    def garbage_level(self) -> float:
+        if self._size <= SUPER_BLOCK_SIZE:
+            return 0.0
+        return self.nm.metrics.deleted_bytes / self._size
+
+    def content_size(self) -> int:
+        return self.nm.content_size()
+
+    # --- write path ----------------------------------------------------------
+    def _is_unchanged(self, n: Needle) -> bool:
+        """Duplicate-write suppression (`volume_write.go:32`): same id, same
+        cookie, same checksum+data."""
+        nv = self.nm.get(n.id)
+        if nv is None or not size_is_valid(nv[1]):
+            return False
+        try:
+            old = self._read_at(nv[0], nv[1])
+        except VolumeError:
+            return False
+        return (
+            old.cookie == n.cookie
+            and old.checksum == crc_mod.crc32c(n.data)
+            and old.data == n.data
+        )
+
+    def write_needle(self, n: Needle, check_cookie: bool = False) -> tuple[int, int]:
+        """Append a needle; returns (offset, size). (`volume_write.go:137`)"""
+        if self.readonly:
+            raise VolumeError(f"volume {self.id} is read only")
+        with self._write_lock:
+            if check_cookie:
+                nv = self.nm.get(n.id)
+                if nv is not None and size_is_valid(nv[1]):
+                    existing = self._read_at(nv[0], nv[1])
+                    if existing.cookie != n.cookie:
+                        raise VolumeError("cookie mismatch on overwrite")
+            if self._is_unchanged(n):
+                return self.nm.get(n.id)[0], n.size
+            n.update_append_at_ns(self.last_append_at_ns)
+            offset = self._append(n)
+            self.last_append_at_ns = n.append_at_ns
+            if n.size > 0 or self.version() == 1:
+                self.nm.put(n.id, offset, n.size)
+            return offset, n.size
+
+    def _append(self, n: Needle) -> int:
+        offset = self._size
+        if offset % NEEDLE_PADDING_SIZE != 0:
+            offset += NEEDLE_PADDING_SIZE - offset % NEEDLE_PADDING_SIZE
+        blob = n.to_bytes(self.version())
+        os.pwrite(self._fd, blob, offset)
+        self._size = offset + len(blob)
+        return offset
+
+    def delete_needle(self, n: Needle) -> int:
+        """Returns the freed size, 0 if absent (`volume_write.go:216`)."""
+        if self.readonly:
+            raise VolumeError(f"volume {self.id} is read only")
+        with self._write_lock:
+            nv = self.nm.get(n.id)
+            if nv is None or not size_is_valid(nv[1]):
+                return 0
+            freed = nv[1]
+            n.data = b""
+            n.update_append_at_ns(self.last_append_at_ns)
+            offset = self._append(n)
+            self.last_append_at_ns = n.append_at_ns
+            self.nm.delete(n.id, offset)
+            return freed
+
+    # --- read path -----------------------------------------------------------
+    def _read_at(self, offset: int, size: int) -> Needle:
+        total = get_actual_size(size, self.version())
+        blob = os.pread(self._fd, total, offset)
+        if len(blob) < total:
+            raise VolumeError(
+                f"volume {self.id}: short read {len(blob)} < {total} at {offset}"
+            )
+        return Needle.from_bytes(blob, size=size, version=self.version())
+
+    def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
+        nv = self.nm.get(needle_id)
+        if nv is None or not size_is_valid(nv[1]):
+            raise NotFound(f"needle {needle_id:x} not found")
+        n = self._read_at(nv[0], nv[1])
+        if cookie is not None and n.cookie != cookie:
+            raise NotFound("cookie mismatch")
+        if n.has_ttl() and n.ttl.minutes() > 0 and n.has_last_modified():
+            expires = n.last_modified + n.ttl.minutes() * 60
+            if expires < time.time():
+                raise NotFound("needle expired")
+        return n
+
+    def read_needle_blob(self, offset: int, size: int) -> bytes:
+        return os.pread(self._fd, get_actual_size(size, self.version()), offset)
+
+    # --- vacuum --------------------------------------------------------------
+    def compact(self) -> None:
+        """Copy live needles to .cpd/.cpx shadow files (`volume_vacuum.go:67`
+        Compact2). Writes landing after this snapshot are caught up by
+        commit_compact's makeupDiff pass."""
+        dst_dat = self.base_name + ".cpd"
+        dst_idx = self.base_name + ".cpx"
+        with self._write_lock:
+            snapshot = list(self.nm.ascending_visit())
+            revision = self.super_block.compaction_revision
+            # remember how many live .idx entries the snapshot covers so the
+            # commit can replay only what came after
+            self._compact_idx_entries = (
+                os.path.getsize(self.base_name + ".idx") // 16
+            )
+        sb = SuperBlock(
+            version=self.version(),
+            replica_placement=self.super_block.replica_placement,
+            ttl=self.super_block.ttl,
+            compaction_revision=revision + 1,
+        )
+        with open(dst_dat, "wb") as out_dat, open(dst_idx, "wb") as out_idx:
+            out_dat.write(sb.to_bytes())
+            pos = SUPER_BLOCK_SIZE
+            for key, offset, size in snapshot:
+                blob = self.read_needle_blob(offset, size)
+                out_dat.write(blob)
+                out_idx.write(idx_mod.entry_to_bytes(key, pos, size))
+                pos += len(blob)
+
+    def commit_compact(self) -> None:
+        """makeupDiff + atomic swap of shadow files (`volume_vacuum.go:102,200`):
+        under the write lock, writes/deletes that landed after the compact
+        snapshot are replayed onto the shadow files, then both are renamed in."""
+        dst_dat = self.base_name + ".cpd"
+        dst_idx = self.base_name + ".cpx"
+        if not os.path.exists(dst_dat):
+            raise VolumeError("no compacted files to commit")
+        with self._write_lock:
+            self._makeup_diff(dst_dat, dst_idx)
+            self.nm.close()
+            os.close(self._fd)
+            os.replace(dst_dat, self.base_name + ".dat")
+            os.replace(dst_idx, self.base_name + ".idx")
+            self._fd = os.open(self.base_name + ".dat", os.O_RDWR)
+            header = os.pread(self._fd, SUPER_BLOCK_SIZE, 0)
+            self.super_block = SuperBlock.from_bytes(header)
+            self.nm = NeedleMap(self.base_name + ".idx")
+            self._size = os.path.getsize(self.base_name + ".dat")
+
+    def _makeup_diff(self, dst_dat: str, dst_idx: str) -> None:
+        """Replay idx entries appended after the compact snapshot onto the
+        shadow files. Caller holds the write lock."""
+        start = getattr(self, "_compact_idx_entries", None)
+        if start is None:
+            return
+        idx_path = self.base_name + ".idx"
+        with open(idx_path, "rb") as f:
+            f.seek(start * 16)
+            tail = f.read()
+        if not tail:
+            return
+        # shadow map: key -> (offset, size) as currently in the .cpx
+        shadow: dict[int, tuple[int, int]] = {}
+        for key, offset, size in idx_mod.walk_index_blob(
+            open(dst_idx, "rb").read()
+        ):
+            shadow[key] = (offset, size)
+        with open(dst_dat, "r+b") as out_dat, open(dst_idx, "ab") as out_idx:
+            out_dat.seek(0, 2)
+            pos = out_dat.tell()
+            for key, offset, size in idx_mod.walk_index_blob(tail):
+                if offset > 0 and size_is_valid(size):
+                    blob = self.read_needle_blob(offset, size)
+                    out_dat.write(blob)
+                    out_idx.write(idx_mod.entry_to_bytes(key, pos, size))
+                    shadow[key] = (pos, size)
+                    pos += len(blob)
+                else:
+                    from .types import TOMBSTONE_FILE_SIZE
+
+                    out_idx.write(
+                        idx_mod.entry_to_bytes(key, 0, TOMBSTONE_FILE_SIZE)
+                    )
+                    shadow.pop(key, None)
+        self._compact_idx_entries = None
+
+    def cleanup_compact(self) -> None:
+        for ext in (".cpd", ".cpx"):
+            p = self.base_name + ext
+            if os.path.exists(p):
+                os.remove(p)
+
+    # --- incremental backup --------------------------------------------------
+    def binary_search_by_append_at_ns(self, since_ns: int) -> int:
+        """Offset of the first needle with AppendAtNs > since_ns
+        (`volume_backup.go:171`). Scans via the sorted-by-offset entries."""
+        entries = sorted(
+            ((off, size) for _, off, size in self.nm.ascending_visit()),
+            key=lambda x: x[0],
+        )
+        lo, hi = 0, len(entries)
+        version = self.version()
+        while lo < hi:
+            mid = (lo + hi) // 2
+            off, size = entries[mid]
+            blob = os.pread(self._fd, get_actual_size(size, version), off)
+            ts = get_u64(blob, NEEDLE_HEADER_SIZE + size + 4)
+            if ts > since_ns:
+                hi = mid
+            else:
+                lo = mid + 1
+        return entries[lo][0] if lo < len(entries) else self._size
+
+    def destroy(self) -> None:
+        self.close()
+        for ext in (".dat", ".idx", ".cpd", ".cpx", ".vif"):
+            p = self.base_name + ext
+            if os.path.exists(p):
+                os.remove(p)

@@ -1,0 +1,114 @@
+"""VolumeLayout: writable-volume tracking per (collection, rp, ttl)
+(reference: `weed/topology/volume_layout.go:108,290`)."""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+
+from seaweedfs_tpu.storage.types import ReplicaPlacement
+
+from .node import DataNode, VolumeInfo
+
+
+class NoWritableVolume(Exception):
+    pass
+
+
+@dataclass
+class VolumeLayout:
+    replica_placement: ReplicaPlacement
+    ttl_u32: int
+    volume_size_limit: int = 30 * 1024 * 1024 * 1024
+    locations: dict[int, list[DataNode]] = field(default_factory=dict)
+    writables: set[int] = field(default_factory=set)
+    readonly: set[int] = field(default_factory=set)
+    oversized: set[int] = field(default_factory=set)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def register_volume(self, v: VolumeInfo, node: DataNode) -> None:
+        with self._lock:
+            locs = self.locations.setdefault(v.id, [])
+            if node not in locs:
+                locs.append(node)
+            if v.read_only:
+                self.readonly.add(v.id)
+            else:
+                self.readonly.discard(v.id)
+            if v.size >= self.volume_size_limit:
+                self.oversized.add(v.id)
+            else:
+                self.oversized.discard(v.id)  # vacuum shrank it back
+            self._refresh_writable(v.id)
+
+    def unregister_volume(self, vid: int, node: DataNode) -> None:
+        with self._lock:
+            locs = self.locations.get(vid, [])
+            if node in locs:
+                locs.remove(node)
+            if not locs:
+                self.locations.pop(vid, None)
+                self.writables.discard(vid)
+                self.readonly.discard(vid)
+                self.oversized.discard(vid)
+            else:
+                self._refresh_writable(vid)
+
+    def _refresh_writable(self, vid: int) -> None:
+        """Writable iff full replica count present, not oversized, not RO
+        (`volume_layout.go:enoughCopies`)."""
+        locs = self.locations.get(vid, [])
+        ok = (
+            len(locs) >= self.replica_placement.copy_count()
+            and vid not in self.readonly
+            and vid not in self.oversized
+        )
+        if ok:
+            self.writables.add(vid)
+        else:
+            self.writables.discard(vid)
+
+    def pick_for_write(
+        self, data_center: str = ""
+    ) -> tuple[int, list[DataNode]]:
+        """Random writable volume, optionally constrained to a DC
+        (`volume_layout.go:290` PickForWrite)."""
+        with self._lock:
+            candidates = list(self.writables)
+            if data_center:
+                candidates = [
+                    vid
+                    for vid in candidates
+                    if any(
+                        n.dc_name() == data_center for n in self.locations[vid]
+                    )
+                ]
+            if not candidates:
+                raise NoWritableVolume(
+                    f"no writable volumes (rp={self.replica_placement}, "
+                    f"dc={data_center or 'any'})"
+                )
+            vid = random.choice(candidates)
+            return vid, list(self.locations[vid])
+
+    def lookup(self, vid: int) -> list[DataNode]:
+        return list(self.locations.get(vid, []))
+
+    def set_oversized_if(self, vid: int, size: int) -> None:
+        if size >= self.volume_size_limit:
+            with self._lock:
+                self.oversized.add(vid)
+                self._refresh_writable(vid)
+
+    def active_volume_count(self, data_center: str = "") -> int:
+        if not data_center:
+            return len(self.writables)
+        return sum(
+            1
+            for vid in self.writables
+            if any(n.dc_name() == data_center for n in self.locations.get(vid, []))
+        )
+
+    def volume_ids(self) -> list[int]:
+        return sorted(self.locations)

@@ -1,0 +1,191 @@
+"""End-to-end master + volume servers over real HTTP sockets:
+assign -> PUT -> GET -> DELETE, replication fan-out, vacuum, EC lifecycle."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.server.httpd import get_json, http_request, post_json
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=1, volume_size_limit_mb=64)
+    master.start()
+    volumes = []
+    for i, rack in enumerate(["r1", "r2"]):
+        vs = VolumeServer(
+            [str(tmp_path / f"v{i}")],
+            master.url,
+            port=0,
+            rack=rack,
+            pulse_seconds=1,
+            max_volume_count=20,
+        )
+        vs.start()
+        volumes.append(vs)
+    yield master, volumes
+    for vs in volumes:
+        vs.stop()
+    master.stop()
+
+
+def assign(master, **params):
+    qs = "&".join(f"{k}={v}" for k, v in params.items())
+    return get_json(f"{master.url}/dir/assign?{qs}")
+
+
+class TestWriteReadDelete:
+    def test_basic_roundtrip(self, cluster):
+        master, _ = cluster
+        a = assign(master)
+        assert "fid" in a, a
+        url = f"http://{a['publicUrl']}/{a['fid']}"
+        status, _, body = http_request(
+            "POST", url, b"hello seaweed tpu",
+            {"Content-Type": "text/plain", "X-File-Name": "hi.txt"},
+        )
+        assert status == 201, body
+        out = json.loads(body)
+        assert out["size"] == len(b"hello seaweed tpu")
+
+        status, headers, body = http_request("GET", url)
+        assert status == 200
+        assert body == b"hello seaweed tpu"
+        assert headers.get("Content-Type") == "text/plain"
+        assert "ETag" in headers
+
+        # range read
+        status, headers, body = http_request("GET", url, headers={"Range": "bytes=0-4"})
+        assert status == 206
+        assert body == b"hello"
+
+        status, _, _ = http_request("DELETE", url)
+        assert status == 202
+        status, _, _ = http_request("GET", url)
+        assert status == 404
+
+    def test_wrong_cookie_rejected(self, cluster):
+        master, _ = cluster
+        a = assign(master)
+        url = f"http://{a['publicUrl']}/{a['fid']}"
+        http_request("POST", url, b"data")
+        # flip a cookie hex digit
+        fid = a["fid"]
+        bad = fid[:-1] + ("0" if fid[-1] != "0" else "1")
+        status, _, _ = http_request("GET", f"http://{a['publicUrl']}/{bad}")
+        assert status == 404
+
+    def test_lookup(self, cluster):
+        master, _ = cluster
+        a = assign(master)
+        vid = a["fid"].split(",")[0]
+        info = get_json(f"{master.url}/dir/lookup?volumeId={vid}")
+        assert any(
+            loc["publicUrl"] == a["publicUrl"] for loc in info["locations"]
+        )
+
+    def test_replication_010(self, cluster):
+        master, volumes = cluster
+        a = assign(master, replication="010")
+        url = f"http://{a['publicUrl']}/{a['fid']}"
+        status, _, body = http_request("POST", url, b"replicated!")
+        assert status == 201, body
+        vid = int(a["fid"].split(",")[0])
+        info = get_json(f"{master.url}/dir/lookup?volumeId={vid}")
+        assert len(info["locations"]) == 2
+        # read from BOTH replicas directly
+        for loc in info["locations"]:
+            status, _, body = http_request("GET", f"http://{loc['url']}/{a['fid']}")
+            assert status == 200 and body == b"replicated!", loc
+
+    def test_separate_collections(self, cluster):
+        master, _ = cluster
+        a1 = assign(master, collection="photos")
+        a2 = assign(master)
+        assert a1["fid"].split(",")[0] != a2["fid"].split(",")[0]
+
+
+class TestVacuumAndStatus:
+    def test_vacuum_shrinks_volume(self, cluster):
+        master, volumes = cluster
+        a = assign(master)
+        vid = int(a["fid"].split(",")[0])
+        vs = next(
+            v for v in volumes if v.store.get_volume(vid) is not None
+        )
+        # write then delete many needles on the same volume
+        fids = []
+        for i in range(20):
+            ai = assign(master)
+            if int(ai["fid"].split(",")[0]) != vid:
+                continue
+            u = f"http://{ai['publicUrl']}/{ai['fid']}"
+            http_request("POST", u, b"x" * 1000)
+            fids.append(u)
+        for u in fids[: len(fids) // 2 + 1]:
+            http_request("DELETE", u)
+        vol = vs.store.get_volume(vid)
+        before = vol.size()
+        out = post_json(f"{vs.url}/admin/vacuum", {"volume": vid})
+        assert out["ok"]
+        assert vs.store.get_volume(vid).size() < before
+
+    def test_status_endpoints(self, cluster):
+        master, volumes = cluster
+        assign(master)
+        st = get_json(f"{master.url}/dir/status")
+        assert st["Topology"]["data_centers"]
+        vst = get_json(f"{volumes[0].url}/status")
+        assert "volumes" in vst
+
+
+class TestECLifecycle:
+    def test_ec_encode_mount_read(self, cluster):
+        master, volumes = cluster
+        a = assign(master)
+        vid = int(a["fid"].split(",")[0])
+        contents = {}
+        for i in range(10):
+            ai = assign(master)
+            if int(ai["fid"].split(",")[0]) != vid:
+                continue
+            u = f"http://{ai['publicUrl']}/{ai['fid']}"
+            data = f"ec-needle-{i}".encode() * 50
+            http_request("POST", u, data)
+            contents[u] = data
+        assert contents
+        vs = next(v for v in volumes if v.store.get_volume(vid) is not None)
+        out = post_json(f"{vs.url}/admin/ec/generate", {"volume": vid})
+        assert out["ok"]
+        # delete the original volume, mount EC, read through the same fid URL
+        post_json(f"{vs.url}/admin/ec/delete_volume", {"volume": vid})
+        out = post_json(f"{vs.url}/admin/ec/mount", {"volume": vid})
+        assert sorted(out["shards"]) == list(range(14))
+        for u, data in contents.items():
+            status, _, body = http_request("GET", u)
+            assert status == 200 and body == data
+
+        # master learns shard locations via heartbeat
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                info = get_json(f"{master.url}/dir/ec_lookup?volumeId={vid}")
+                if len(info["shards"]) == 14:
+                    break
+            except IOError:
+                pass
+            time.sleep(0.3)
+        else:
+            pytest.fail("master never learned ec shards")
+
+        # EC delete through the data plane
+        victim = next(iter(contents))
+        status, _, _ = http_request("DELETE", victim)
+        assert status == 202
+        status, _, _ = http_request("GET", victim)
+        assert status == 404

@@ -1,0 +1,112 @@
+"""Visible-interval chunk resolution — mirrors the reference's
+`weed/filer/filechunks_test.go` scenarios."""
+
+from seaweedfs_tpu.filer.entry import FileChunk
+from seaweedfs_tpu.filer.filechunks import (
+    maybe_manifestize,
+    pack_manifest,
+    read_resolved_chunks,
+    resolve_chunk_manifest,
+    separate_garbage_chunks,
+    total_size,
+    unpack_manifest,
+    view_from_chunks,
+)
+
+
+def C(fid, offset, size, ts):
+    return FileChunk(file_id=fid, offset=offset, size=size, modified_ts_ns=ts)
+
+
+class TestVisibleIntervals:
+    def test_single_chunk(self):
+        v = read_resolved_chunks([C("a", 0, 100, 1)])
+        assert len(v) == 1 and (v[0].start, v[0].stop) == (0, 100)
+
+    def test_non_overlapping(self):
+        v = read_resolved_chunks([C("a", 0, 100, 1), C("b", 100, 50, 2)])
+        assert [(x.start, x.stop, x.file_id) for x in v] == [
+            (0, 100, "a"), (100, 150, "b"),
+        ]
+
+    def test_full_overwrite(self):
+        v = read_resolved_chunks([C("a", 0, 100, 1), C("b", 0, 100, 2)])
+        assert [(x.start, x.stop, x.file_id) for x in v] == [(0, 100, "b")]
+
+    def test_partial_overwrite_middle(self):
+        v = read_resolved_chunks([C("a", 0, 100, 1), C("b", 30, 20, 2)])
+        assert [(x.start, x.stop, x.file_id, x.offset_in_chunk) for x in v] == [
+            (0, 30, "a", 0), (30, 50, "b", 0), (50, 100, "a", 50),
+        ]
+
+    def test_newer_loses_to_newest(self):
+        chunks = [C("a", 0, 100, 1), C("b", 50, 100, 2), C("c", 20, 50, 3)]
+        v = read_resolved_chunks(chunks)
+        assert [(x.start, x.stop, x.file_id) for x in v] == [
+            (0, 20, "a"), (20, 70, "c"), (70, 150, "b"),
+        ]
+
+    def test_order_independent_of_input(self):
+        chunks = [C("a", 0, 100, 1), C("b", 50, 100, 2), C("c", 20, 50, 3)]
+        import itertools
+
+        want = [(x.start, x.stop, x.file_id) for x in read_resolved_chunks(chunks)]
+        for perm in itertools.permutations(chunks):
+            got = [(x.start, x.stop, x.file_id) for x in read_resolved_chunks(list(perm))]
+            assert got == want
+
+    def test_sparse_file_gap(self):
+        v = read_resolved_chunks([C("a", 0, 10, 1), C("b", 100, 10, 2)])
+        assert [(x.start, x.stop) for x in v] == [(0, 10), (100, 110)]
+
+
+class TestChunkViews:
+    def test_ranged_view(self):
+        chunks = [C("a", 0, 100, 1), C("b", 30, 20, 2)]
+        views = view_from_chunks(chunks, 25, 30)
+        # [25,30) from a, [30,50) from b, [50,55) from a@50
+        assert [(v.file_id, v.offset_in_chunk, v.size, v.view_offset) for v in views] == [
+            ("a", 25, 5, 25), ("b", 0, 20, 30), ("a", 50, 5, 50),
+        ]
+
+    def test_whole_file_view(self):
+        chunks = [C("a", 0, 64, 1), C("b", 64, 64, 2)]
+        views = view_from_chunks(chunks)
+        assert sum(v.size for v in views) == 128
+
+    def test_total_size(self):
+        assert total_size([C("a", 0, 10, 1), C("b", 100, 50, 2)]) == 150
+
+
+class TestGarbage:
+    def test_shadowed_chunks_are_garbage(self):
+        chunks = [C("old", 0, 100, 1), C("new", 0, 100, 2)]
+        live, garbage = separate_garbage_chunks(chunks)
+        assert [c.file_id for c in live] == ["new"]
+        assert [c.file_id for c in garbage] == ["old"]
+
+
+class TestManifest:
+    def test_pack_unpack(self):
+        chunks = [C(f"f{i}", i * 10, 10, i) for i in range(20)]
+        blob = pack_manifest(chunks)
+        assert unpack_manifest(blob) == chunks
+
+    def test_maybe_manifestize_and_resolve(self):
+        chunks = [C(f"f{i}", i * 10, 10, i + 1) for i in range(2500)]
+        stored: dict[str, bytes] = {}
+        counter = [0]
+
+        def save(blob: bytes) -> FileChunk:
+            fid = f"m{counter[0]}"
+            counter[0] += 1
+            stored[fid] = blob
+            return FileChunk(file_id=fid, offset=0, size=len(blob))
+
+        out = maybe_manifestize(save, chunks, batch=1000)
+        assert len(out) < len(chunks)
+        assert any(c.is_chunk_manifest for c in out)
+        resolved = resolve_chunk_manifest(lambda fid: stored[fid], out)
+        assert sorted(c.file_id for c in resolved) == sorted(c.file_id for c in chunks)
+        # resolution preserves the logical layout
+        assert total_size(resolved) == total_size(chunks)

@@ -1,0 +1,180 @@
+"""Filer: core namespace ops + full-cluster HTTP e2e (master + volume + filer)."""
+
+import hashlib
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.filer import Attributes, Entry, Filer
+from seaweedfs_tpu.filer.filer import FilerError
+from seaweedfs_tpu.filer.filerstore import MemoryStore, SqliteStore
+from seaweedfs_tpu.server.filer import FilerServer
+from seaweedfs_tpu.server.httpd import get_json, http_request
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        return MemoryStore()
+    return SqliteStore(str(tmp_path / "meta.db"))
+
+
+class TestFilerCore:
+    def test_create_find(self, store):
+        f = Filer(store)
+        f.create_entry(Entry(full_path="/dir/sub/file.txt"))
+        assert f.find_entry("/dir/sub/file.txt") is not None
+        # parents auto-created
+        assert f.find_entry("/dir").is_directory
+        assert f.find_entry("/dir/sub").is_directory
+
+    def test_list(self, store):
+        f = Filer(store)
+        for name in ["b.txt", "a.txt", "c.txt"]:
+            f.create_entry(Entry(full_path=f"/docs/{name}"))
+        names = [e.name for e in f.list_entries("/docs")]
+        assert names == ["a.txt", "b.txt", "c.txt"]
+        # pagination
+        names2 = [e.name for e in f.list_entries("/docs", start_from="a.txt")]
+        assert names2 == ["b.txt", "c.txt"]
+
+    def test_delete_requires_recursive(self, store):
+        f = Filer(store)
+        f.create_entry(Entry(full_path="/d/x"))
+        with pytest.raises(FilerError):
+            f.delete_entry("/d")
+        f.delete_entry("/d", recursive=True)
+        assert f.find_entry("/d") is None
+        assert f.find_entry("/d/x") is None
+
+    def test_rename_file_and_dir(self, store):
+        f = Filer(store)
+        f.create_entry(Entry(full_path="/a/one.txt"))
+        f.create_entry(Entry(full_path="/a/two.txt"))
+        f.rename("/a/one.txt", "/a/uno.txt")
+        assert f.find_entry("/a/uno.txt") is not None
+        assert f.find_entry("/a/one.txt") is None
+        f.rename("/a", "/b")
+        assert f.find_entry("/b/uno.txt") is not None
+        assert f.find_entry("/b/two.txt") is not None
+        assert f.find_entry("/a") is None
+
+    def test_metadata_events(self, store):
+        f = Filer(store)
+        seen = []
+        f.subscribe(lambda ev: seen.append(ev))
+        f.create_entry(Entry(full_path="/x/file"))
+        f.delete_entry("/x/file")
+        kinds = [(e.old_entry is not None, e.new_entry is not None) for e in seen]
+        assert (False, True) in kinds  # create
+        assert (True, False) in kinds  # delete
+
+
+@pytest.fixture()
+def full_cluster(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=1)
+    master.start()
+    vols = []
+    for i in range(2):
+        vs = VolumeServer(
+            [str(tmp_path / f"v{i}")], master.url, port=0, pulse_seconds=1,
+            max_volume_count=20,
+        )
+        vs.start()
+        vols.append(vs)
+    filer = FilerServer(master.url, port=0, chunk_size_mb=1)
+    filer.start()
+    yield master, vols, filer
+    filer.stop()
+    for v in vols:
+        v.stop()
+    master.stop()
+
+
+class TestFilerHTTP:
+    def test_small_file_inline(self, full_cluster):
+        _, _, filer = full_cluster
+        url = f"{filer.url}/notes/hello.txt"
+        status, _, body = http_request(
+            "PUT", url, b"small content", {"Content-Type": "text/plain"}
+        )
+        assert status == 201, body
+        status, headers, body = http_request("GET", url)
+        assert status == 200 and body == b"small content"
+        assert headers["Content-Type"] == "text/plain"
+        entry = filer.filer.find_entry("/notes/hello.txt")
+        assert entry.content == b"small content"  # inlined, no chunks
+        assert not entry.chunks
+
+    def test_chunked_upload_and_md5(self, full_cluster):
+        _, _, filer = full_cluster
+        data = os.urandom(3 * 1024 * 1024 + 12345)  # > 3 chunks at 1MB
+        url = f"{filer.url}/big/blob.bin"
+        status, _, body = http_request("PUT", url, data)
+        assert status == 201, body
+        out = json.loads(body)
+        assert out["md5"] == hashlib.md5(data).hexdigest()
+        entry = filer.filer.find_entry("/big/blob.bin")
+        assert len(entry.chunks) == 4
+        status, _, got = http_request("GET", url)
+        assert status == 200 and got == data
+
+    def test_range_read_across_chunks(self, full_cluster):
+        _, _, filer = full_cluster
+        data = bytes(range(256)) * 8192  # 2MB, 2 chunks
+        url = f"{filer.url}/r/data.bin"
+        http_request("PUT", url, data)
+        start, end = 1024 * 1024 - 100, 1024 * 1024 + 99
+        status, headers, got = http_request(
+            "GET", url, headers={"Range": f"bytes={start}-{end}"}
+        )
+        assert status == 206
+        assert got == data[start : end + 1]
+        assert headers["Content-Range"] == f"bytes {start}-{end}/{len(data)}"
+
+    def test_directory_listing(self, full_cluster):
+        _, _, filer = full_cluster
+        for name in ["a.txt", "b.txt"]:
+            http_request("PUT", f"{filer.url}/docs/{name}", b"x")
+        listing = get_json(f"{filer.url}/docs")
+        names = [e["FullPath"] for e in listing["Entries"]]
+        assert names == ["/docs/a.txt", "/docs/b.txt"]
+
+    def test_delete_reclaims_chunks(self, full_cluster):
+        _, vols, filer = full_cluster
+        data = os.urandom(2 * 1024 * 1024)
+        url = f"{filer.url}/tmp/junk.bin"
+        http_request("PUT", url, data)
+        entry = filer.filer.find_entry("/tmp/junk.bin")
+        fids = [c.file_id for c in entry.chunks]
+        status, _, _ = http_request("DELETE", url)
+        assert status == 204
+        status, _, _ = http_request("GET", url)
+        assert status == 404
+        # blobs gone from volume servers
+        for fid in fids:
+            for loc in get_json(
+                f"{filer.client.master_url}/dir/lookup?volumeId={fid.split(',')[0]}"
+            )["locations"]:
+                s, _, _ = http_request("GET", f"http://{loc['url']}/{fid}")
+                assert s == 404
+
+    def test_overwrite_latest_wins(self, full_cluster):
+        _, _, filer = full_cluster
+        url = f"{filer.url}/v/file.txt"
+        http_request("PUT", url, b"version one")
+        http_request("PUT", url, b"version TWO!")
+        _, _, got = http_request("GET", url)
+        assert got == b"version TWO!"
+
+    def test_conditional_get(self, full_cluster):
+        _, _, filer = full_cluster
+        url = f"{filer.url}/etag/f.txt"
+        http_request("PUT", url, b"cacheable")
+        status, headers, _ = http_request("GET", url)
+        etag = headers["ETag"]
+        status, _, body = http_request("GET", url, headers={"If-None-Match": etag})
+        assert status == 304 and body == b""

@@ -1,0 +1,157 @@
+"""Master topology state machine, driven by synthetic heartbeats — the
+reference proves its topology logic the same way (`weed/topology/topology_test.go`,
+`volume_growth_test.go`)."""
+
+import random
+
+import pytest
+
+from seaweedfs_tpu.storage.types import ReplicaPlacement
+from seaweedfs_tpu.topology import Topology
+from seaweedfs_tpu.topology.sequence import MemorySequencer, SnowflakeSequencer
+from seaweedfs_tpu.topology.volume_growth import NoFreeSpace, find_empty_slots
+from seaweedfs_tpu.topology.volume_layout import NoWritableVolume
+
+
+def hb(ip, port, volumes=(), dc="dc1", rack="r1", max_count=10, max_file_key=0):
+    return {
+        "ip": ip,
+        "port": port,
+        "public_url": f"{ip}:{port}",
+        "data_center": dc,
+        "rack": rack,
+        "max_volume_count": max_count,
+        "max_file_key": max_file_key,
+        "volumes": [
+            {"id": vid, "collection": "", "size": size, "replica_placement": rp}
+            for vid, size, rp in volumes
+        ],
+        "ec_shards": [],
+    }
+
+
+class TestHeartbeatSync:
+    def test_register_and_lookup(self):
+        topo = Topology()
+        topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[(1, 100, 0), (2, 200, 0)]))
+        topo.sync_heartbeat(hb("10.0.0.2", 8080, volumes=[(2, 200, 0)]))
+        assert [n.id for n in topo.lookup(1)] == ["10.0.0.1:8080"]
+        assert sorted(n.id for n in topo.lookup(2)) == ["10.0.0.1:8080", "10.0.0.2:8080"]
+        assert topo.lookup(99) == []
+
+    def test_volume_disappears(self):
+        topo = Topology()
+        topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[(1, 100, 0)]))
+        topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[]))
+        assert topo.lookup(1) == []
+
+    def test_writable_requires_full_replication(self):
+        topo = Topology()
+        # rp=010 needs 2 copies; only one present -> not writable
+        topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[(1, 100, 10)]))
+        lo = topo.layout("", ReplicaPlacement.parse("010"), 0)
+        assert lo.active_volume_count() == 0
+        topo.sync_heartbeat(hb("10.0.0.2", 8080, rack="r2", volumes=[(1, 100, 10)]))
+        assert lo.active_volume_count() == 1
+
+    def test_oversized_not_writable(self):
+        topo = Topology(volume_size_limit=1000)
+        topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[(1, 2000, 0)]))
+        lo = topo.layout("", ReplicaPlacement.parse("000"), 0)
+        assert lo.active_volume_count() == 0
+
+    def test_dead_node_expiry(self):
+        topo = Topology(pulse_seconds=0)
+        node = topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[(1, 100, 0)]))
+        node.last_seen -= 100
+        dead = topo.expire_dead_nodes()
+        assert [n.id for n in dead] == ["10.0.0.1:8080"]
+        assert topo.lookup(1) == []
+
+    def test_sequencer_advances_past_max_file_key(self):
+        topo = Topology()
+        topo.sync_heartbeat(hb("10.0.0.1", 8080, max_file_key=5000))
+        assert topo.sequencer.peek() > 5000
+
+
+class TestGrowth:
+    def _topo(self, dcs=2, racks=2, nodes=2, max_count=5):
+        topo = Topology()
+        for d in range(dcs):
+            for r in range(racks):
+                for n in range(nodes):
+                    topo.sync_heartbeat(
+                        hb(
+                            f"10.{d}.{r}.{n}",
+                            8080,
+                            dc=f"dc{d}",
+                            rack=f"rack{r}",
+                            max_count=max_count,
+                        )
+                    )
+        return topo
+
+    def test_000_single_copy(self):
+        topo = self._topo()
+        nodes = find_empty_slots(topo.data_centers, ReplicaPlacement.parse("000"))
+        assert len(nodes) == 1
+
+    def test_001_same_rack(self):
+        topo = self._topo()
+        nodes = find_empty_slots(topo.data_centers, ReplicaPlacement.parse("001"))
+        assert len(nodes) == 2
+        assert nodes[0].rack_name() == nodes[1].rack_name()
+        assert nodes[0].id != nodes[1].id
+
+    def test_010_diff_rack(self):
+        topo = self._topo()
+        nodes = find_empty_slots(topo.data_centers, ReplicaPlacement.parse("010"))
+        assert len(nodes) == 2
+        assert nodes[0].dc_name() == nodes[1].dc_name()
+        assert nodes[0].rack_name() != nodes[1].rack_name()
+
+    def test_100_diff_dc(self):
+        topo = self._topo()
+        nodes = find_empty_slots(topo.data_centers, ReplicaPlacement.parse("100"))
+        assert len(nodes) == 2
+        assert nodes[0].dc_name() != nodes[1].dc_name()
+
+    def test_110(self):
+        topo = self._topo()
+        nodes = find_empty_slots(topo.data_centers, ReplicaPlacement.parse("110"))
+        assert len(nodes) == 3
+        dcs = {n.dc_name() for n in nodes}
+        assert len(dcs) == 2
+
+    def test_insufficient_topology(self):
+        topo = self._topo(dcs=1)
+        with pytest.raises(NoFreeSpace):
+            find_empty_slots(topo.data_centers, ReplicaPlacement.parse("100"))
+
+    def test_no_free_slots(self):
+        topo = self._topo(max_count=0)
+        with pytest.raises(NoFreeSpace):
+            find_empty_slots(topo.data_centers, ReplicaPlacement.parse("000"))
+
+    def test_grow_returns_unique_vids(self):
+        topo = self._topo()
+        grown = topo.grow("", ReplicaPlacement.parse("000"), 0)
+        vids = [vid for vid, _ in grown]
+        assert len(vids) == len(set(vids)) == 7  # strategy for 1 copy
+
+
+class TestSequencers:
+    def test_memory_persistence(self, tmp_path):
+        p = str(tmp_path / "seq.json")
+        s = MemorySequencer(p)
+        a = s.next_file_id(5)
+        b = s.next_file_id()
+        assert b == a + 5
+        s2 = MemorySequencer(p)
+        assert s2.next_file_id() > b
+
+    def test_snowflake_unique(self):
+        s = SnowflakeSequencer(3)
+        ids = [s.next_file_id() for _ in range(1000)]
+        assert len(set(ids)) == 1000
+        assert ids == sorted(ids)

@@ -1,0 +1,217 @@
+"""Volume engine: write/read/delete, persistence, vacuum, integrity, backup."""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.needle_map import NeedleMap
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.types import TTL, ReplicaPlacement
+from seaweedfs_tpu.storage.volume import NotFound, Volume, VolumeError
+
+
+def make_needle(key, data, cookie=0x1234):
+    return Needle(cookie=cookie, id=key, data=data)
+
+
+class TestVolumeBasics:
+    def test_write_read_roundtrip(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        offset, size = v.write_needle(make_needle(1, b"hello"))
+        n = v.read_needle(1)
+        assert n.data == b"hello"
+        v.close()
+
+    def test_many_needles_and_reload(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        blobs = {k: os.urandom(50 + k * 7) for k in range(1, 100)}
+        for k, b in blobs.items():
+            v.write_needle(make_needle(k, b))
+        v.close()
+        # reload from disk: idx replay + integrity check
+        v2 = Volume(str(tmp_path), "", 1)
+        for k, b in blobs.items():
+            assert v2.read_needle(k).data == b
+        assert v2.file_count() == 99
+        assert v2.last_append_at_ns > 0
+        v2.close()
+
+    def test_overwrite_updates(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        v.write_needle(make_needle(5, b"first"))
+        v.write_needle(make_needle(5, b"second"))
+        assert v.read_needle(5).data == b"second"
+        assert v.deleted_count() == 1  # old version counts as garbage
+        v.close()
+
+    def test_duplicate_write_unchanged(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        v.write_needle(make_needle(5, b"same"))
+        size_before = v.size()
+        v.write_needle(make_needle(5, b"same"))
+        assert v.size() == size_before  # dedup: no new append
+        v.close()
+
+    def test_delete(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        v.write_needle(make_needle(7, b"doomed"))
+        freed = v.delete_needle(make_needle(7, b""))
+        assert freed > 0
+        with pytest.raises(NotFound):
+            v.read_needle(7)
+        v.close()
+        # deletion survives reload
+        v2 = Volume(str(tmp_path), "", 1)
+        with pytest.raises(NotFound):
+            v2.read_needle(7)
+        v2.close()
+
+    def test_cookie_check(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        v.write_needle(make_needle(9, b"secret", cookie=0xAAAA))
+        with pytest.raises(NotFound):
+            v.read_needle(9, cookie=0xBBBB)
+        assert v.read_needle(9, cookie=0xAAAA).data == b"secret"
+        v.close()
+
+    def test_readonly(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        v.readonly = True
+        with pytest.raises(VolumeError):
+            v.write_needle(make_needle(1, b"x"))
+        v.close()
+
+    def test_append_at_ns_monotonic(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        ts = []
+        for k in range(1, 20):
+            v.write_needle(make_needle(k, b"x" * k))
+            ts.append(v.last_append_at_ns)
+        assert ts == sorted(ts)
+        assert len(set(ts)) == len(ts)
+        v.close()
+
+
+class TestVacuum:
+    def test_compact_removes_garbage(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        for k in range(1, 50):
+            v.write_needle(make_needle(k, os.urandom(100)))
+        for k in range(1, 25):
+            v.delete_needle(make_needle(k, b""))
+        live = {k: v.read_needle(k).data for k in range(25, 50)}
+        size_before = v.size()
+        assert v.garbage_level() > 0.3
+        v.compact()
+        v.commit_compact()
+        assert v.size() < size_before
+        assert v.garbage_level() == 0.0
+        assert v.super_block.compaction_revision == 1
+        for k, b in live.items():
+            assert v.read_needle(k).data == b
+        for k in range(1, 25):
+            with pytest.raises(NotFound):
+                v.read_needle(k)
+        v.close()
+        # compacted volume survives reload
+        v2 = Volume(str(tmp_path), "", 1)
+        for k, b in live.items():
+            assert v2.read_needle(k).data == b
+        v2.close()
+
+    def test_writes_after_compact_before_commit_survive(self, tmp_path):
+        """makeupDiff: acknowledged writes/deletes landing between compact()
+        and commit_compact() must survive the swap (`volume_vacuum.go:200`)."""
+        v = Volume(str(tmp_path), "", 1)
+        for k in range(1, 10):
+            v.write_needle(make_needle(k, b"a" * 50))
+        v.delete_needle(make_needle(3, b""))
+        v.compact()
+        # writes after the snapshot
+        v.write_needle(make_needle(100, b"late write"))
+        v.write_needle(make_needle(5, b"overwritten late"))
+        v.delete_needle(make_needle(7, b""))
+        v.commit_compact()
+        assert v.read_needle(100).data == b"late write"
+        assert v.read_needle(5).data == b"overwritten late"
+        with pytest.raises(NotFound):
+            v.read_needle(7)
+        with pytest.raises(NotFound):
+            v.read_needle(3)
+        for k in (1, 2, 4, 6, 8, 9):
+            assert v.read_needle(k).data == b"a" * 50
+        v.close()
+        # and survives reload
+        v2 = Volume(str(tmp_path), "", 1)
+        assert v2.read_needle(100).data == b"late write"
+        v2.close()
+
+
+class TestBackup:
+    def test_binary_search_by_append_at_ns(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        marks = {}
+        for k in range(1, 30):
+            v.write_needle(make_needle(k, b"z" * 10))
+            marks[k] = v.last_append_at_ns
+        # everything after needle 15's timestamp
+        off = v.binary_search_by_append_at_ns(marks[15])
+        nv16 = v.nm.get(16)
+        assert off == nv16[0]
+        # nothing after the last timestamp
+        assert v.binary_search_by_append_at_ns(marks[29]) == v.size()
+        v.close()
+
+
+class TestNeedleMapMetrics:
+    def test_counts(self, tmp_path):
+        nm = NeedleMap(str(tmp_path / "t.idx"))
+        nm.put(1, 8, 100)
+        nm.put(2, 208, 50)
+        nm.put(1, 408, 70)  # overwrite
+        nm.delete(2)
+        assert nm.metrics.file_count == 2
+        assert nm.metrics.deleted_count == 2
+        assert nm.metrics.deleted_bytes == 150
+        assert nm.metrics.maximum_key == 2
+        nm.close()
+        nm2 = NeedleMap(str(tmp_path / "t.idx"))
+        assert len(nm2) == 1
+        assert nm2.get(1) == (408, 70)
+        nm2.close()
+
+
+class TestStore:
+    def test_store_lifecycle(self, tmp_path):
+        d1, d2 = str(tmp_path / "d1"), str(tmp_path / "d2")
+        store = Store([d1, d2])
+        store.add_volume(1)
+        store.add_volume(2, collection="pics", replica_placement="001")
+        store.write(1, make_needle(10, b"data1"))
+        store.write(2, make_needle(20, b"data2"))
+        assert store.read(1, 10).data == b"data1"
+        assert store.read(2, 20).data == b"data2"
+        hb = store.collect_heartbeat()
+        assert len(hb["volumes"]) == 2
+        assert hb["max_file_key"] == 20
+        store.close()
+        # reload discovers both volumes across directories
+        store2 = Store([d1, d2])
+        assert sorted(store2.volume_ids()) == [1, 2]
+        assert store2.read(2, 20).data == b"data2"
+        store2.close()
+
+    def test_balanced_placement(self, tmp_path):
+        store = Store([str(tmp_path / "a"), str(tmp_path / "b")])
+        for vid in range(1, 5):
+            store.add_volume(vid)
+        counts = [len(loc.volumes) for loc in store.locations]
+        assert counts == [2, 2]
+        store.close()
+
+    def test_ttl_stored(self, tmp_path):
+        store = Store([str(tmp_path / "x")])
+        v = store.add_volume(3, ttl="5d")
+        assert str(v.super_block.ttl) == "5d"
+        store.close()
