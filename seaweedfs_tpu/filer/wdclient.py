@@ -16,9 +16,12 @@ from seaweedfs_tpu.server.httpd import get_json, http_request
 
 
 class WeedClient:
-    def __init__(self, master_url: str, cache_ttl: float = 30.0) -> None:
+    def __init__(
+        self, master_url: str, cache_ttl: float = 30.0, jwt_key: str = ""
+    ) -> None:
         self.master_url = master_url.rstrip("/")
         self.cache_ttl = cache_ttl
+        self.jwt_key = jwt_key  # shared security.toml signing key
         self._vid_cache: dict[int, tuple[float, list[str]]] = {}
         self._lock = threading.Lock()
 
@@ -83,7 +86,10 @@ class WeedClient:
         if "error" in a and a["error"]:
             raise IOError(a["error"])
         fid, url = a["fid"], a["publicUrl"]
-        out = self.upload_to(fid, url, data, filename=filename, mime=mime, ttl=ttl)
+        out = self.upload_to(
+            fid, url, data, filename=filename, mime=mime, ttl=ttl,
+            auth=a.get("auth", ""),
+        )
         out["fid"] = fid
         out["url"] = url
         return out
@@ -96,12 +102,15 @@ class WeedClient:
         filename: str = "",
         mime: str = "",
         ttl: str = "",
+        auth: str = "",
     ) -> dict:
         headers = {}
         if filename:
             headers["X-File-Name"] = filename
         if mime:
             headers["Content-Type"] = mime
+        if auth:
+            headers["Authorization"] = f"BEARER {auth}"
         url = f"http://{location}/{fid}"
         if ttl:
             url += f"?ttl={ttl}"
@@ -125,6 +134,20 @@ class WeedClient:
         raise last_err or IOError(f"no locations for {file_id}")
 
     def delete(self, file_id: str) -> None:
+        headers = {}
+        if self.jwt_key:
+            # filer-signed wildcard token (empty fid claim), as the reference's
+            # filer does with its copy of the signing key
+            from seaweedfs_tpu.security.jwt import encode_jwt
+
+            token = encode_jwt(
+                self.jwt_key, {"fid": "", "exp": int(time.time()) + 10}
+            )
+            headers["Authorization"] = f"BEARER {token}"
+        last_err: Exception | None = None
         for url in self.lookup_file_id(file_id):
-            http_request("DELETE", url)
-            return
+            status, _, body = http_request("DELETE", url, headers=headers)
+            if status < 300 or status == 404:  # 404 = already gone, idempotent
+                return
+            last_err = IOError(f"DELETE {url} -> {status}: {body[:200]!r}")
+        raise last_err or IOError(f"no locations for {file_id}")

@@ -50,7 +50,7 @@ def _encode_fn(mesh, n_volumes: int, n: int):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     a = jnp.asarray(
         np.frombuffer(_parity_bit_matrix_bytes(), dtype=np.uint8).reshape(80, 32),
@@ -86,7 +86,7 @@ def _crc_fn(mesh, length: int):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     from seaweedfs_tpu.ops.crc32c_kernel import _compiled_batch
 
@@ -113,7 +113,7 @@ def sharded_crc32c(mesh, blocks):
 def _md5_fn(mesh, length: int):
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     from seaweedfs_tpu.ops.md5_kernel import _compiled_batch
 

@@ -1,0 +1,28 @@
+"""Security layer: JWT write/read tokens, IP guard, security.toml loading.
+
+Reference: `weed/security/jwt.go:17-28` (SeaweedFileIdClaims — master signs a
+per-fileId HS256 token, the volume server verifies it before accepting a
+write), `weed/security/guard.go:42-50` (IP whitelist), `weed/util/config.go`
+(security.toml discovery).
+"""
+
+from .jwt import (
+    decode_jwt,
+    encode_jwt,
+    gen_read_jwt,
+    gen_write_jwt,
+    verify_file_jwt,
+)
+from .guard import Guard
+from .config import SecurityConfig, load_security_config
+
+__all__ = [
+    "decode_jwt",
+    "encode_jwt",
+    "gen_read_jwt",
+    "gen_write_jwt",
+    "verify_file_jwt",
+    "Guard",
+    "SecurityConfig",
+    "load_security_config",
+]

@@ -1,0 +1,62 @@
+"""security.toml discovery + parsing (`weed/util/config.go:40-60`).
+
+Search order mirrors the reference: ./, ~/.seaweedfs, /etc/seaweedfs.
+Schema subset:
+
+    [jwt.signing]        # write tokens (master -> volume)
+    key = "..."
+    expires_after_seconds = 10
+
+    [jwt.signing.read]   # read tokens
+    key = "..."
+    expires_after_seconds = 60
+
+    [guard]
+    white_list = ["127.0.0.1", "10.0.0.0/8"]
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SecurityConfig:
+    write_key: str = ""
+    write_expires_sec: int = 10
+    read_key: str = ""
+    read_expires_sec: int = 60
+    white_list: list[str] = field(default_factory=list)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.write_key or self.read_key or self.white_list)
+
+
+def load_security_config(path: str | None = None) -> SecurityConfig:
+    import tomllib
+
+    candidates = (
+        [path]
+        if path
+        else [
+            "./security.toml",
+            os.path.expanduser("~/.seaweedfs/security.toml"),
+            "/etc/seaweedfs/security.toml",
+        ]
+    )
+    for cand in candidates:
+        if cand and os.path.exists(cand):
+            with open(cand, "rb") as f:
+                data = tomllib.load(f)
+            jwt_sign = data.get("jwt", {}).get("signing", {})
+            read = jwt_sign.get("read", {})
+            return SecurityConfig(
+                write_key=jwt_sign.get("key", ""),
+                write_expires_sec=int(jwt_sign.get("expires_after_seconds", 10)),
+                read_key=read.get("key", ""),
+                read_expires_sec=int(read.get("expires_after_seconds", 60)),
+                white_list=list(data.get("guard", {}).get("white_list", [])),
+            )
+    return SecurityConfig()

@@ -44,20 +44,40 @@ class FilerServer:
         chunk_size_mb: int = 4,
         default_replication: str = "",
         collection: str = "",
+        security=None,
+        metrics_port: int = -1,
     ) -> None:
+        from seaweedfs_tpu.security import Guard, SecurityConfig
+
+        from .httpd import MetricsService
+
+        self.security = security or SecurityConfig()
         self.filer = Filer(make_store(store_kind, store_path))
-        self.client = WeedClient(master_url)
+        self.client = WeedClient(master_url, jwt_key=self.security.write_key)
         self.chunk_size = chunk_size_mb * 1024 * 1024
         self.default_replication = default_replication
         self.collection = collection
         self.service = HTTPService(host, port)
+        if self.security.white_list:
+            self.service.guard = Guard(self.security.white_list)
+        # the filer's namespace is a catch-all (any path may be a file, incl.
+        # /metrics), so metrics get their own listener (`-metricsPort`;
+        # -1 = ephemeral port, 0 = disabled, >0 = fixed)
+        self.service.enable_metrics("filer", serve_route=False)
+        self.metrics_service = (
+            MetricsService(host, max(metrics_port, 0)) if metrics_port != 0 else None
+        )
         self._routes()
 
     def start(self) -> None:
         self.service.start()
+        if self.metrics_service is not None:
+            self.metrics_service.start()
 
     def stop(self) -> None:
         self.service.stop()
+        if self.metrics_service is not None:
+            self.metrics_service.stop()
         self.filer.store.close()
 
     @property
@@ -134,6 +154,23 @@ class FilerServer:
     # --- handlers ---------------------------------------------------------------
     def _do_write(self, req: Request) -> Response:
         path = normalize(urllib.parse.unquote(req.path))
+        if "mv.from" in req.query:
+            # POST /new/path?mv.from=/old/path — rename/move, matching the
+            # reference filer's mv.from query API (filer_server_handlers_write.go)
+            try:
+                self.filer.rename(req.query["mv.from"], path)
+            except FilerError as e:
+                return Response({"error": str(e)}, 409)
+            return Response({"ok": True}, 200)
+        if req.query.get("meta.entry") == "true":
+            # raw metadata restore (fs.meta.load): entry dict incl. chunks
+            try:
+                entry = Entry.from_dict(req.json())
+                entry.full_path = path
+                self.filer.create_entry(entry)
+            except (FilerError, KeyError, ValueError) as e:
+                return Response({"error": str(e)}, 409)
+            return Response({"name": entry.name}, 201)
         if path.endswith("/") or req.query.get("mkdir") == "true":
             e = Entry(full_path=path, is_directory=True,
                       attributes=Attributes(mode=0o755))
@@ -191,6 +228,8 @@ class FilerServer:
         entry = self.filer.find_entry(path)
         if entry is None:
             return Response({"error": f"{path} not found"}, 404)
+        if req.query.get("metadata") == "true":
+            return Response(entry.to_dict())
         if entry.is_directory:
             return self._list_dir(req, entry)
         if (

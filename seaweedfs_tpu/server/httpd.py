@@ -28,6 +28,7 @@ class Request:
         }
         self.headers = handler.headers
         self.method = handler.command
+        self.remote_ip = handler.client_address[0]
         self._body: bytes | None = None
 
     @property
@@ -95,8 +96,32 @@ class HTTPService:
         self.host = host
         self.port = port
         self.routes: list[tuple[str, re.Pattern, Callable[[Request], Response]]] = []
+        self.guard = None  # security.Guard — 403s non-whitelisted IPs when set
+        self.metrics_role: str | None = None  # instrument requests when set
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+
+    def enable_metrics(self, role: str, serve_route: bool = True) -> None:
+        """Count + time every request under this role label and (unless the
+        main port has a catch-all route, like the filer) serve Prometheus
+        text format on /metrics (`weed/stats/metrics.go`)."""
+        from seaweedfs_tpu.stats import default_registry
+
+        self.metrics_role = role
+        reg = default_registry()
+        self._m_total = reg.counter(
+            "seaweedfs_tpu_request_total", "requests", ("role", "method", "code")
+        )
+        self._m_seconds = reg.histogram(
+            "seaweedfs_tpu_request_seconds", "request latency", ("role", "method")
+        )
+        if serve_route:
+            @self.route("GET", r"/metrics")
+            def metrics(req: Request) -> Response:
+                return Response(
+                    reg.render().encode(),
+                    content_type="text/plain; version=0.0.4; charset=utf-8",
+                )
 
     def route(self, method: str, pattern: str):
         compiled = re.compile(pattern)
@@ -108,22 +133,38 @@ class HTTPService:
         return deco
 
     def _dispatch(self, handler: BaseHTTPRequestHandler) -> None:
+        import time as _time
+
+        start = _time.monotonic()
         path = urllib.parse.urlparse(handler.path).path
-        for method, pattern, fn in self.routes:
-            if method != handler.command:
-                continue
-            m = pattern.fullmatch(path)
-            if m is None:
-                continue
-            req = Request(handler, m)
-            try:
-                resp = fn(req)
-            except Exception as e:  # uniform JSON error surface
-                resp = Response({"error": str(e)}, status=500)
-            break
-        else:
+        if self.guard is not None and not self.guard.is_allowed(
+            handler.client_address[0]
+        ):
             req = None
-            resp = Response({"error": f"no route {handler.command} {path}"}, 404)
+            resp = Response({"error": "forbidden"}, 403)
+        else:
+            for method, pattern, fn in self.routes:
+                if method != handler.command:
+                    continue
+                m = pattern.fullmatch(path)
+                if m is None:
+                    continue
+                req = Request(handler, m)
+                try:
+                    resp = fn(req)
+                except Exception as e:  # uniform JSON error surface
+                    resp = Response({"error": str(e)}, status=500)
+                break
+            else:
+                req = None
+                resp = Response({"error": f"no route {handler.command} {path}"}, 404)
+        if self.metrics_role is not None:
+            self._m_total.labels(
+                self.metrics_role, handler.command, str(resp.status)
+            ).inc()
+            self._m_seconds.labels(self.metrics_role, handler.command).observe(
+                _time.monotonic() - start
+            )
         # drain an unread request body before responding — on a keep-alive
         # connection leftover body bytes would desynchronize the next request
         length = int(handler.headers.get("Content-Length") or 0)
@@ -172,6 +213,24 @@ class HTTPService:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+
+class MetricsService(HTTPService):
+    """Standalone /metrics listener for servers whose main port has a
+    catch-all namespace (the filer) — the reference's `-metricsPort`."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        super().__init__(host, port)
+        from seaweedfs_tpu.stats import default_registry
+
+        reg = default_registry()
+
+        @self.route("GET", r"/metrics")
+        def metrics(req: Request) -> Response:
+            return Response(
+                reg.render().encode(),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            )
 
 
 # --- tiny client helpers ----------------------------------------------------

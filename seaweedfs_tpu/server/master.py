@@ -12,6 +12,8 @@ from __future__ import annotations
 import threading
 import time
 
+from seaweedfs_tpu.security import Guard, SecurityConfig
+from seaweedfs_tpu.security.jwt import gen_write_jwt
 from seaweedfs_tpu.storage.types import ReplicaPlacement, TTL
 from seaweedfs_tpu.topology import Topology
 from seaweedfs_tpu.topology.sequence import MemorySequencer
@@ -30,6 +32,7 @@ class MasterServer:
         default_replication: str = "000",
         meta_dir: str | None = None,
         garbage_threshold: float = 0.3,
+        security: SecurityConfig | None = None,
     ) -> None:
         seq = MemorySequencer(f"{meta_dir}/sequence.json" if meta_dir else None)
         self.topo = Topology(
@@ -39,9 +42,16 @@ class MasterServer:
         )
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
+        self.security = security or SecurityConfig()
         self.service = HTTPService(host, port)
+        if self.security.white_list:
+            self.service.guard = Guard(self.security.white_list)
+        self.service.enable_metrics("master")
         self._grow_lock = threading.Lock()
         self._stop = threading.Event()
+        # cluster membership (filers/brokers announce themselves) + admin lock
+        self._members: dict[str, dict] = {}
+        self._admin_lock: tuple[str, float] | None = None  # (holder, expiry)
         self._routes()
 
     # --- lifecycle -------------------------------------------------------------
@@ -169,17 +179,22 @@ class MasterServer:
                 except (NoWritableVolume, Exception) as e:
                     return Response({"error": str(e)}, 404)
             main = nodes[0]
-            return Response(
-                {
-                    "fid": fid,
-                    "url": main.id,
-                    "publicUrl": main.url,
-                    "count": cnt,
-                    "replicas": [
-                        {"url": n.id, "publicUrl": n.url} for n in nodes[1:]
-                    ],
-                }
-            )
+            out = {
+                "fid": fid,
+                "url": main.id,
+                "publicUrl": main.url,
+                "count": cnt,
+                "replicas": [
+                    {"url": n.id, "publicUrl": n.url} for n in nodes[1:]
+                ],
+            }
+            if self.security.write_key:
+                # per-fileId write token the volume server will demand
+                # (`weed/security/jwt.go GenJwtForVolumeServer`)
+                out["auth"] = gen_write_jwt(
+                    self.security.write_key, fid, self.security.write_expires_sec
+                )
+            return Response(out)
 
         svc.route("GET", r"/dir/assign")(do_assign)
         svc.route("POST", r"/dir/assign")(do_assign)
@@ -249,6 +264,104 @@ class MasterServer:
                     for vid, v in node.volumes.items()
                 }
             return Response({"Volumes": out})
+
+        @svc.route("POST", r"/cluster/register")
+        def cluster_register(req: Request) -> Response:
+            """Filers/brokers announce themselves (the reference rides this on
+            the KeepConnected stream, `weed/cluster/cluster.go`)."""
+            p = req.json()
+            self._members[p["address"]] = {
+                "type": p.get("type", "filer"),
+                "address": p["address"],
+                "last_seen": time.time(),
+            }
+            return Response({"ok": True, "leader": self.url})
+
+        @svc.route("GET", r"/cluster/ps")
+        def cluster_ps(req: Request) -> Response:
+            now = time.time()
+            members = [
+                m for m in self._members.values()
+                if now - m["last_seen"] < 3 * max(self.topo.pulse_seconds, 5)
+            ]
+            return Response(
+                {
+                    "masters": [{"address": self.url, "isLeader": True}],
+                    "volumeServers": [
+                        {"address": n.url, "dataCenter": n.dc_name(),
+                         "rack": n.rack_name()}
+                        for n in self.topo.all_nodes()
+                    ],
+                    "filers": [m for m in members if m["type"] == "filer"],
+                    "brokers": [m for m in members if m["type"] == "broker"],
+                }
+            )
+
+        @svc.route("POST", r"/cluster/lock")
+        def cluster_lock(req: Request) -> Response:
+            """Exclusive admin-shell lease (`weed/shell` lock/unlock via master
+            lease). Re-entrant for the same holder; expires after ttl."""
+            p = req.json()
+            holder = p.get("holder", "shell")
+            ttl = float(p.get("ttl", 30))
+            now = time.time()
+            if self._admin_lock and self._admin_lock[1] > now and \
+                    self._admin_lock[0] != holder:
+                return Response(
+                    {"error": f"locked by {self._admin_lock[0]}"}, 409
+                )
+            self._admin_lock = (holder, now + ttl)
+            return Response({"ok": True, "holder": holder, "ttl": ttl})
+
+        @svc.route("POST", r"/cluster/unlock")
+        def cluster_unlock(req: Request) -> Response:
+            holder = req.json().get("holder", "shell")
+            if self._admin_lock and self._admin_lock[0] != holder:
+                return Response(
+                    {"error": f"locked by {self._admin_lock[0]}"}, 409
+                )
+            self._admin_lock = None
+            return Response({"ok": True})
+
+        @svc.route("GET", r"/col/list")
+        def col_list(req: Request) -> Response:
+            cols: dict[str, int] = {}
+            for node in self.topo.all_nodes():
+                for v in node.volumes.values():
+                    cols[v.collection] = cols.get(v.collection, 0) + 1
+            return Response(
+                {"collections": [
+                    {"name": k, "volumeCount": c} for k, c in sorted(cols.items())
+                ]}
+            )
+
+        @svc.route("POST", r"/col/delete")
+        def col_delete(req: Request) -> Response:
+            """Drop every volume of a collection on every server
+            (`master_server_handlers_admin.go collectionDeleteHandler`)."""
+            name = req.query.get("collection", "")
+            if not name:
+                try:
+                    name = req.json().get("collection", "")
+                except ValueError:
+                    pass
+            if not name:
+                # an empty name would match every default-collection volume —
+                # refuse, like the reference's 'collection not found'
+                return Response({"error": "collection name required"}, 400)
+            deleted = 0
+            for node in self.topo.all_nodes():
+                for vid, v in list(node.volumes.items()):
+                    if v.collection == name:
+                        try:
+                            post_json(
+                                f"http://{node.url}/admin/delete_volume",
+                                {"volume": vid}, timeout=30,
+                            )
+                            deleted += 1
+                        except Exception:
+                            pass
+            return Response({"ok": True, "deleted": deleted})
 
         @svc.route("GET", r"/vol/vacuum")
         def vol_vacuum(req: Request) -> Response:

@@ -9,9 +9,12 @@ JSON admin endpoints here), `volume_grpc_client_to_master.go:50` (heartbeat).
 from __future__ import annotations
 
 import json
+import re
 import threading
 import urllib.parse
 
+from seaweedfs_tpu.security import Guard, SecurityConfig
+from seaweedfs_tpu.security.jwt import token_from_request, verify_file_jwt
 from seaweedfs_tpu.storage import crc as crc_mod
 from seaweedfs_tpu.storage.erasure_coding import encoder as ec_encoder
 from seaweedfs_tpu.storage.erasure_coding import geometry
@@ -23,6 +26,7 @@ from seaweedfs_tpu.storage.volume import NotFound, VolumeError, volume_file_name
 from .httpd import HTTPService, Request, Response, get_json, http_request, post_json
 
 FID_RE = r"/(\d+),([0-9a-fA-F_]+)(?:\.[^/]*)?"
+_SAFE_EXT_RE = re.compile(r"\.(dat|idx|vif|ecx|ecj|ec\d\d)")
 
 
 class VolumeServer:
@@ -37,9 +41,14 @@ class VolumeServer:
         rack: str = "",
         pulse_seconds: int = 5,
         max_volume_count: int = 100,
+        security: SecurityConfig | None = None,
     ) -> None:
         self.master_url = master_url.rstrip("/")
+        self.security = security or SecurityConfig()
         self.service = HTTPService(host, port)
+        if self.security.white_list:
+            self.service.guard = Guard(self.security.white_list)
+        self.service.enable_metrics("volume")
         self.store: Store | None = None
         self._dirs = directories
         self._host = host
@@ -62,6 +71,9 @@ class VolumeServer:
         )
         for loc in self.store.locations:
             loc.max_volume_count = self.max_volume_count
+        for loc in self.store.locations:
+            for ev in loc.ec_volumes.values():
+                self._attach_shard_fetcher(ev)
         self.heartbeat_once()
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
 
@@ -92,6 +104,39 @@ class VolumeServer:
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.pulse_seconds):
             self.heartbeat_once()
+
+    def _attach_shard_fetcher(self, ev) -> None:
+        """Give an EcVolume remote shard sourcing: master ec_lookup for
+        locations, then /admin/ec/shard range reads off sibling servers
+        (`store_ec.go:281` readRemoteEcShardInterval)."""
+        me = f"{self._host}:{self.service.port}"
+        state = {"expires": 0.0, "shards": {}}
+
+        def fetch(shard_id: int, off: int, size: int) -> bytes | None:
+            import time as _time
+
+            now = _time.time()
+            if now > state["expires"]:
+                info = get_json(
+                    f"{self.master_url}/dir/ec_lookup?volumeId={ev.volume_id}",
+                    timeout=5,
+                )
+                state["shards"] = info.get("shards", {})
+                state["expires"] = now + 10
+            for target in state["shards"].get(str(shard_id), []):
+                if target == me:
+                    continue
+                status, _, body = http_request(
+                    "GET",
+                    f"http://{target}/admin/ec/shard?volume={ev.volume_id}"
+                    f"&shard={shard_id}&offset={off}&size={size}",
+                    timeout=30,
+                )
+                if status == 200 and len(body) == size:
+                    return body
+            return None
+
+        ev.shard_fetcher = fetch
 
     # --- replication --------------------------------------------------------------
     def _replicate(
@@ -171,6 +216,7 @@ class VolumeServer:
         @svc.route("POST", r"/admin/delete_volume")
         def delete_volume(req: Request) -> Response:
             self.store.delete_volume(int(req.json()["volume"]))
+            self.heartbeat_once()  # master forgets this replica promptly
             return Response({"ok": True})
 
         @svc.route("POST", r"/admin/vacuum")
@@ -209,7 +255,11 @@ class VolumeServer:
         @svc.route("POST", r"/admin/ec/mount")
         def ec_mount(req: Request) -> Response:
             p = req.json()
-            ev = self.store.mount_ec_volume(int(p["volume"]), p.get("collection", ""))
+            vid = int(p["volume"])
+            if self.store.get_ec_volume(vid) is not None:  # idempotent remount
+                self.store.unmount_ec_volume(vid)
+            ev = self.store.mount_ec_volume(vid, p.get("collection", ""))
+            self._attach_shard_fetcher(ev)
             self.heartbeat_once()
             return Response({"ok": True, "shards": ev.shard_ids()})
 
@@ -245,7 +295,54 @@ class VolumeServer:
             (`command_ec_encode.go` deletes source replicas)."""
             vid = int(req.json()["volume"])
             self.store.delete_volume(vid)
+            self.heartbeat_once()
             return Response({"ok": True})
+
+        @svc.route("POST", r"/admin/ec/to_volume")
+        def ec_to_volume(req: Request) -> Response:
+            """Reconstruct the original .dat/.idx from locally-collected EC
+            shards (`volume_grpc_erasure_coding.go:407 VolumeEcShardsToVolume`).
+            Missing data shards are rebuilt from parity first."""
+            import os
+
+            p = req.json()
+            vid = int(p["volume"])
+            collection = p.get("collection", "")
+            from seaweedfs_tpu.storage.erasure_coding import decoder as ec_decoder
+            from seaweedfs_tpu.storage.erasure_coding.ec_volume import (
+                ec_shard_file_name,
+            )
+
+            base = None
+            for loc in self.store.locations:
+                cand = ec_shard_file_name(collection, loc.directory, vid)
+                if os.path.exists(cand + ".ecx"):
+                    base = cand
+                    break
+            if base is None:
+                return Response({"error": f"no .ecx for volume {vid}"}, 404)
+            have = [
+                s for s in range(geometry.TOTAL_SHARDS_COUNT)
+                if os.path.exists(base + geometry.to_ext(s))
+            ]
+            if any(s not in have for s in range(geometry.DATA_SHARDS_COUNT)):
+                ec_encoder.rebuild_ec_files(base)
+            from seaweedfs_tpu.storage.super_block import SUPER_BLOCK_SIZE
+
+            # an EC volume with zero live needles still has its superblock
+            # striped into .ec00 — never write a .dat shorter than that
+            dat_size = max(
+                ec_decoder.find_dat_file_size(base, base), SUPER_BLOCK_SIZE
+            )
+            shard_names = [
+                base + geometry.to_ext(s)
+                for s in range(geometry.DATA_SHARDS_COUNT)
+            ]
+            ec_decoder.write_dat_file(base, dat_size, shard_names)
+            ec_decoder.write_idx_file_from_ec_index(base)
+            v = self.store.mount_volume(vid, collection)
+            self.heartbeat_once()
+            return Response({"ok": True, "size": v.size()})
 
         @svc.route("GET", r"/admin/ec/shard")
         def ec_shard_read(req: Request) -> Response:
@@ -267,6 +364,234 @@ class VolumeServer:
             data = os.pread(fd, size, offset)
             return Response(data, content_type="application/octet-stream")
 
+        # --- volume copy / move plane (volume_grpc_copy.go) ---
+        @svc.route("GET", r"/admin/volume/files")
+        def volume_files(req: Request) -> Response:
+            """List a volume's files + sizes so a receiver can pull them."""
+            import os
+
+            vid = int(req.query["volume"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            out = {}
+            for ext in (".dat", ".idx", ".vif"):
+                p = v.base_name + ext
+                if os.path.exists(p):
+                    out[ext] = os.path.getsize(p)
+            return Response(
+                {"collection": v.collection, "files": out,
+                 "version": v.version(),
+                 "last_append_at_ns": v.last_append_at_ns}
+            )
+
+        @svc.route("GET", r"/admin/volume/raw")
+        def volume_raw(req: Request) -> Response:
+            """Raw byte range of one volume/EC file — the copy stream
+            (`VolumeCopy`/`CopyFile` stream in volume_server.proto)."""
+            import os
+
+            vid = int(req.query["volume"])
+            ext = req.query["ext"]
+            collection = req.query.get("collection", "")
+            offset = int(req.query.get("offset", 0))
+            size = int(req.query.get("size", -1))
+            if not _SAFE_EXT_RE.fullmatch(ext):
+                return Response({"error": f"bad ext {ext}"}, 400)
+            v = self.store.get_volume(vid)
+            if v is not None:
+                path = v.base_name + ext
+            else:
+                path = None
+                for loc in self.store.locations:
+                    cand = volume_file_name(loc.directory, collection, vid) + ext
+                    if os.path.exists(cand):
+                        path = cand
+                        break
+            if path is None or not os.path.exists(path):
+                return Response({"error": f"no {ext} for volume {vid}"}, 404)
+            total = os.path.getsize(path)
+            if size < 0:
+                size = total - offset
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read(size)
+            return Response(
+                data, content_type="application/octet-stream",
+                headers={"X-Total-Size": str(total)},
+            )
+
+        @svc.route("POST", r"/admin/volume/copy")
+        def volume_copy(req: Request) -> Response:
+            """Pull a volume's .dat/.idx from another volume server and mount
+            it locally (`volume_grpc_copy.go VolumeCopy` — receiver-driven)."""
+            p = req.json()
+            vid = int(p["volume"])
+            source = p["source"].rstrip("/")
+            if self.store.has_volume(vid):
+                return Response({"error": f"volume {vid} already here"}, 409)
+            meta = get_json(f"{source}/admin/volume/files?volume={vid}", timeout=30)
+            collection = meta.get("collection", "")
+            loc = self.store._pick_location()
+            base = volume_file_name(loc.directory, collection, vid)
+            for ext in meta["files"]:
+                self._pull_file(source, vid, collection, ext, base + ext)
+            v = self.store.mount_volume(vid, collection)
+            self.heartbeat_once()
+            return Response(
+                {"ok": True, "volume": vid, "size": v.size(),
+                 "last_append_at_ns": v.last_append_at_ns}
+            )
+
+        @svc.route("POST", r"/admin/volume/mount")
+        def volume_mount(req: Request) -> Response:
+            p = req.json()
+            v = self.store.mount_volume(int(p["volume"]), p.get("collection", ""))
+            self.heartbeat_once()
+            return Response({"ok": True, "size": v.size()})
+
+        @svc.route("POST", r"/admin/volume/unmount")
+        def volume_unmount(req: Request) -> Response:
+            self.store.unmount_volume(int(req.json()["volume"]))
+            self.heartbeat_once()
+            return Response({"ok": True})
+
+        @svc.route("POST", r"/admin/ec/copy")
+        def ec_copy(req: Request) -> Response:
+            """Pull EC shard files (+ .ecx/.vif) from a source server
+            (`VolumeEcShardsCopy`)."""
+            import os
+
+            p = req.json()
+            vid = int(p["volume"])
+            collection = p.get("collection", "")
+            shards = [int(s) for s in p.get("shards", [])]
+            source = p["source"].rstrip("/")
+            from seaweedfs_tpu.storage.erasure_coding.ec_volume import (
+                ec_shard_file_name,
+            )
+
+            loc = self.store._pick_location()
+            base = ec_shard_file_name(collection, loc.directory, vid)
+            exts = [geometry.to_ext(s) for s in shards]
+            if p.get("copy_ecx", True) and not os.path.exists(base + ".ecx"):
+                exts += [".ecx"]
+            if p.get("copy_ecj", False):
+                exts.append(".ecj")
+            if p.get("copy_vif", True) and not os.path.exists(base + ".vif"):
+                exts.append(".vif")
+            copied = []
+            for ext in exts:
+                try:
+                    self._pull_file(source, vid, collection, ext, base + ext)
+                    copied.append(ext)
+                except IOError:
+                    if ext == ".ecj":  # deletion journal may not exist
+                        continue
+                    if ext == ".vif":  # synthesize a default when absent
+                        ec_encoder.save_volume_info(base + ".vif")
+                        continue
+                    raise
+            return Response({"ok": True, "copied": copied})
+
+        @svc.route("POST", r"/admin/ec/delete_shards")
+        def ec_delete_shards(req: Request) -> Response:
+            """Remove local shard files after they moved elsewhere
+            (`VolumeEcShardsDelete`)."""
+            import os
+
+            p = req.json()
+            vid = int(p["volume"])
+            collection = p.get("collection", "")
+            shards = [int(s) for s in p.get("shards", [])]
+            from seaweedfs_tpu.storage.erasure_coding.ec_volume import (
+                ec_shard_file_name,
+            )
+
+            removed = []
+            was_mounted = self.store.get_ec_volume(vid) is not None
+            if was_mounted:
+                self.store.unmount_ec_volume(vid)
+            for loc in self.store.locations:
+                base = ec_shard_file_name(collection, loc.directory, vid)
+                for s in shards:
+                    path = base + geometry.to_ext(s)
+                    if os.path.exists(path):
+                        os.remove(path)
+                        removed.append(s)
+                if p.get("delete_index", False):
+                    for ext in (".ecx", ".ecj", ".vif"):
+                        if os.path.exists(base + ext):
+                            os.remove(base + ext)
+            if was_mounted:
+                try:
+                    self.store.mount_ec_volume(vid, collection)
+                except VolumeError:
+                    pass  # index gone or no shards left
+            self.heartbeat_once()
+            return Response({"ok": True, "removed": removed})
+
+        @svc.route("GET", r"/admin/volume/needle_blob")
+        def needle_blob(req: Request) -> Response:
+            """Raw on-disk needle record (`ReadNeedleBlob`)."""
+            vid = int(req.query["volume"])
+            offset = int(req.query["offset"])
+            size = int(req.query["size"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            return Response(
+                v.read_needle_blob(offset, size),
+                content_type="application/octet-stream",
+            )
+
+        @svc.route("POST", r"/admin/volume/write_needle_blob")
+        def write_needle_blob(req: Request) -> Response:
+            """Append a needle copied raw from a replica (`WriteNeedleBlob` —
+            volume.check.disk repair path). Body = the on-disk record."""
+            vid = int(req.query["volume"])
+            size = int(req.query["size"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            n = Needle.from_bytes(req.body, size, v.version())
+            v.write_needle(n)
+            return Response({"ok": True, "id": n.id})
+
+        @svc.route("GET", r"/admin/volume/needles")
+        def volume_needles(req: Request) -> Response:
+            """Live needle ids+sizes from the index — replica diffing for
+            volume.check.disk (`volume_grpc_copy.go ReadNeedleMeta`-ish)."""
+            vid = int(req.query["volume"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            needles = [
+                {"id": key, "offset": off, "size": sz}
+                for key, off, sz in v.nm.ascending_visit()
+            ]
+            return Response({"volume": vid, "needles": needles})
+
+        @svc.route("GET", r"/admin/fsck")
+        def fsck(req: Request) -> Response:
+            """Walk the index and CRC-verify every live needle
+            (`volume_checking.go` + shell volume.fsck)."""
+            vid = int(req.query["volume"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            checked, errors = 0, []
+            for key, off, sz in v.nm.ascending_visit():
+                try:
+                    v.read_needle(key)
+                    checked += 1
+                except Exception as e:
+                    errors.append({"id": key, "error": str(e)})
+            return Response(
+                {"volume": vid, "checked": checked, "errors": errors,
+                 "ok": not errors}
+            )
+
         @svc.route("GET", r"/admin/tail")
         def tail(req: Request) -> Response:
             """Needles appended after since_ns (`volume_backup.go:66`)."""
@@ -286,6 +611,38 @@ class VolumeServer:
 
             data = os.pread(v._fd, v.size() - start, start)
             return Response(data, content_type="application/octet-stream")
+
+    def _pull_file(
+        self, source: str, vid: int, collection: str, ext: str, dest: str,
+        chunk: int = 16 * 1024 * 1024,
+    ) -> None:
+        """Ranged GETs of /admin/volume/raw until EOF -> dest file.
+        Downloads into a temp sibling and renames, so a failed pull never
+        clobbers an existing good file."""
+        import os
+
+        tmp = dest + ".pull"
+        try:
+            offset = 0
+            with open(tmp, "wb") as f:
+                while True:
+                    url = (
+                        f"{source}/admin/volume/raw?volume={vid}&ext={ext}"
+                        f"&collection={urllib.parse.quote(collection)}"
+                        f"&offset={offset}&size={chunk}"
+                    )
+                    status, headers, body = http_request("GET", url, timeout=120)
+                    if status != 200:
+                        raise IOError(f"pull {ext} from {source}: {status}")
+                    f.write(body)
+                    offset += len(body)
+                    total = int(headers.get("X-Total-Size", offset))
+                    if offset >= total or not body:
+                        break
+            os.replace(tmp, dest)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
 
     # --- handlers -------------------------------------------------------------
     def _parse_fid(self, req: Request) -> tuple[int, int, int]:
@@ -331,11 +688,25 @@ class VolumeServer:
             return Response(b"", status, headers, content_type=mime)
         return Response(data, status, headers, content_type=mime)
 
+    def _check_write_jwt(self, req: Request) -> bool:
+        """Demand the master-signed per-fileId token when a signing key is
+        configured (`volume_server_handlers.go:33-75` maybeCheckJwtAuthorization)."""
+        if not self.security.write_key:
+            return True
+        # multi-count assignments append _N to the fid; the master signed the
+        # base fid, so verify against that (weed/operation assign_file_id)
+        base = req.match.group(2).split("_")[0]
+        fid = f"{req.match.group(1)},{base}"
+        token = token_from_request(req.headers, req.query)
+        return verify_file_jwt(self.security.write_key, token, fid)
+
     def _do_write(self, req: Request) -> Response:
         try:
             vid, key, cookie = self._parse_fid(req)
         except ValueError as e:
             return Response({"error": str(e)}, 400)
+        if not self._check_write_jwt(req):
+            return Response({"error": "unauthorized"}, 401)
         is_replicate = req.query.get("type") == "replicate"
         body = req.body
         part = req.multipart_file()
@@ -379,6 +750,8 @@ class VolumeServer:
                         {
                             "Content-Type": req.headers.get("Content-Type", ""),
                             "X-File-Name": req.headers.get("X-File-Name", ""),
+                            # replicas verify the same master-signed token
+                            "Authorization": req.headers.get("Authorization", ""),
                         },
                         extra_query=extra,
                     )
@@ -395,6 +768,8 @@ class VolumeServer:
             vid, key, cookie = self._parse_fid(req)
         except ValueError as e:
             return Response({"error": str(e)}, 400)
+        if not self._check_write_jwt(req):
+            return Response({"error": "unauthorized"}, 401)
         is_replicate = req.query.get("type") == "replicate"
         n = Needle(cookie=cookie, id=key)
         try:
@@ -406,7 +781,10 @@ class VolumeServer:
             rp = v.super_block.replica_placement if v else None
             if rp and rp.copy_count() > 1:
                 try:
-                    self._replicate("DELETE", vid, req.match.group(2), b"", {})
+                    self._replicate(
+                        "DELETE", vid, req.match.group(2), b"",
+                        {"Authorization": req.headers.get("Authorization", "")},
+                    )
                 except VolumeError as e:
                     return Response({"error": str(e)}, 500)
         return Response({"size": freed}, 202)

@@ -1,0 +1,19 @@
+"""Admin shell (reference: `weed/shell/` — 60+ interactive cluster commands
+driven over master/volume/filer RPC; here over their HTTP admin APIs).
+
+Usage:
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    env = CommandEnv(master_url)
+    print(run_command(env, "volume.list"))
+"""
+
+from .env import CommandEnv, ShellError
+from .registry import COMMANDS, run_command
+
+# command modules register themselves on import
+from . import commands_cluster  # noqa: E402,F401
+from . import commands_volume  # noqa: E402,F401
+from . import commands_ec  # noqa: E402,F401
+from . import commands_fs  # noqa: E402,F401
+
+__all__ = ["CommandEnv", "ShellError", "COMMANDS", "run_command"]

@@ -1,0 +1,109 @@
+"""cluster.*, lock/unlock, collection.* (reference `weed/shell/command_cluster_ps.go`,
+`command_lock_unlock.go`, `command_collection_*.go`)."""
+
+from __future__ import annotations
+
+import json
+
+from .env import CommandEnv
+from .registry import command, parse_flags
+
+
+@command("lock", "acquire the exclusive admin lock on the master")
+def cmd_lock(env: CommandEnv, args: list[str]) -> str:
+    env.acquire_lock()
+    return "lock acquired"
+
+
+@command("unlock", "release the admin lock")
+def cmd_unlock(env: CommandEnv, args: list[str]) -> str:
+    env.release_lock()
+    return "lock released"
+
+
+@command("cluster.ps", "list cluster processes (masters, volume servers, filers)")
+def cmd_cluster_ps(env: CommandEnv, args: list[str]) -> str:
+    info = env.get(f"{env.master_url}/cluster/ps")
+    lines = []
+    for m in info.get("masters", []):
+        lines.append(f"master {m['address']}" + (" leader" if m.get("isLeader") else ""))
+    for v in info.get("volumeServers", []):
+        lines.append(f"volumeServer {v['address']} dc={v['dataCenter']} rack={v['rack']}")
+    for f in info.get("filers", []):
+        lines.append(f"filer {f['address']}")
+    for b in info.get("brokers", []):
+        lines.append(f"broker {b['address']}")
+    return "\n".join(lines)
+
+
+@command("cluster.check", "sanity-check cluster topology and replica health")
+def cmd_cluster_check(env: CommandEnv, args: list[str]) -> str:
+    servers = env.servers()
+    problems = []
+    if not servers:
+        problems.append("no volume servers registered")
+    replicas = env.volume_replicas()
+    for vid, holders in sorted(replicas.items()):
+        rp_byte = holders[0].volumes[vid].get("replica_placement", 0)
+        want = (rp_byte // 100) + (rp_byte // 10) % 10 + rp_byte % 10 + 1
+        if len(holders) < want:
+            problems.append(
+                f"volume {vid}: {len(holders)}/{want} replicas "
+                f"({', '.join(h.id for h in holders)})"
+            )
+    header = (
+        f"topology: {len(servers)} volume servers, {len(replicas)} volumes"
+    )
+    if not problems:
+        return header + "\ncluster is healthy"
+    return header + "\n" + "\n".join(problems)
+
+
+@command("collection.list", "list collections")
+def cmd_collection_list(env: CommandEnv, args: list[str]) -> str:
+    info = env.get(f"{env.master_url}/col/list")
+    return "\n".join(
+        f"collection {c['name'] or '(default)'}: {c['volumeCount']} volumes"
+        for c in info["collections"]
+    )
+
+
+@command("collection.delete", "-collection <name> — delete all its volumes",
+         needs_lock=True)
+def cmd_collection_delete(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    name = flags.get("collection", flags.get("", ""))
+    out = env.post(f"{env.master_url}/col/delete?collection={name}")
+    return f"deleted {out['deleted']} volumes of collection {name!r}"
+
+
+@command("volume.list", "list volumes per server (ref command_volume_list.go)")
+def cmd_volume_list(env: CommandEnv, args: list[str]) -> str:
+    lines = []
+    for sv in env.servers():
+        lines.append(
+            f"{sv.id} dc={sv.dc} rack={sv.rack} "
+            f"volumes={len(sv.volumes)}/{sv.max_volume_count}"
+        )
+        for vid, v in sorted(sv.volumes.items()):
+            rp = v.get("replica_placement", 0)
+            lines.append(
+                f"  volume {vid} collection={v.get('collection', '') or '(default)'} "
+                f"size={v.get('size', 0)} files={v.get('file_count', 0)} "
+                f"deleted={v.get('delete_count', 0)} rp={rp:03d} "
+                f"{'readonly' if v.get('read_only') else 'writable'}"
+            )
+        for vid, shards in sorted(sv.ec_shards.items()):
+            lines.append(f"  ec volume {vid} shards={shards}")
+    return "\n".join(lines)
+
+
+@command("volume.status", "-volumeId <n> — show one volume's replicas + stats")
+def cmd_volume_status(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags.get("volumeId", flags.get("", 0)))
+    out = []
+    for sv in env.servers():
+        if vid in sv.volumes:
+            out.append(json.dumps({"server": sv.id, **sv.volumes[vid]}))
+    return "\n".join(out) if out else f"volume {vid} not found"

@@ -1,0 +1,186 @@
+"""fs.* commands against the filer (reference `weed/shell/command_fs_ls.go`,
+`command_fs_du.go`, `command_fs_cat.go`, `command_fs_rm.go`,
+`command_fs_meta_save.go` / `_load.go`, `command_fs_verify.go`)."""
+
+from __future__ import annotations
+
+import json
+
+from seaweedfs_tpu.server.httpd import http_request
+
+from .env import CommandEnv, ShellError
+from .registry import command, parse_flags
+
+
+def _list_dir(env: CommandEnv, path: str) -> list[dict]:
+    status, _, body = env.filer_read(path if path.startswith("/") else "/" + path)
+    if status == 404:
+        raise ShellError(f"{path}: no such file or directory")
+    out = json.loads(body)
+    return out.get("Entries") or []
+
+
+def _walk(env: CommandEnv, path: str):
+    """Depth-first over the filer namespace."""
+    for e in _list_dir(env, path):
+        yield e
+        if e["IsDirectory"]:
+            yield from _walk(env, e["FullPath"])
+
+
+@command("fs.ls", "[-l] <dir> — list a filer directory")
+def cmd_fs_ls(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    path = flags.get("", "/")
+    entries = _list_dir(env, path)
+    if "l" in flags:
+        return "\n".join(
+            f"{'d' if e['IsDirectory'] else '-'} {e['FileSize']:>12} "
+            f"{e['FullPath']}"
+            for e in entries
+        )
+    return "\n".join(e["FullPath"].rsplit("/", 1)[-1] for e in entries)
+
+
+@command("fs.du", "<dir> — directory byte/file counts")
+def cmd_fs_du(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    path = flags.get("", "/")
+    total_bytes = files = dirs = 0
+    for e in _walk(env, path):
+        if e["IsDirectory"]:
+            dirs += 1
+        else:
+            files += 1
+            total_bytes += e["FileSize"]
+    return f"{total_bytes} bytes, {files} files, {dirs} directories under {path}"
+
+
+@command("fs.tree", "<dir> — recursive listing")
+def cmd_fs_tree(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    root = flags.get("", "/")
+    lines = []
+    depth0 = root.rstrip("/").count("/")
+    for e in _walk(env, root):
+        depth = e["FullPath"].count("/") - depth0 - 1
+        name = e["FullPath"].rsplit("/", 1)[-1]
+        lines.append("  " * depth + name + ("/" if e["IsDirectory"] else ""))
+    return "\n".join(lines)
+
+
+@command("fs.cat", "<file> — print file content")
+def cmd_fs_cat(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    path = flags.get("")
+    if not path:
+        raise ShellError("usage: fs.cat <file>")
+    status, _, body = env.filer_read(path)
+    if status != 200:
+        raise ShellError(f"{path}: {status}")
+    return body.decode("utf-8", "replace")
+
+
+@command("fs.rm", "[-r] <path> — delete a file or directory tree")
+def cmd_fs_rm(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    path = flags.get("")
+    if not path:
+        raise ShellError("usage: fs.rm [-r] <path>")
+    url = f"{env.require_filer()}{path}"
+    if "r" in flags:
+        url += "?recursive=true"
+    status, _, body = http_request("DELETE", url)
+    if status >= 400:
+        raise ShellError(f"rm {path}: {status} {body[:100]!r}")
+    return f"removed {path}"
+
+
+@command("fs.mkdir", "<dir> — create a directory")
+def cmd_fs_mkdir(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    path = flags.get("")
+    status, _, _ = http_request(
+        "POST", f"{env.require_filer()}{path}?mkdir=true", b""
+    )
+    if status >= 400:
+        raise ShellError(f"mkdir {path}: {status}")
+    return f"created {path}"
+
+
+@command("fs.mv", "<src> <dst> — move/rename within the filer")
+def cmd_fs_mv(env: CommandEnv, args: list[str]) -> str:
+    positional = [a for a in args if not a.startswith("-")]
+    if len(positional) != 2:
+        raise ShellError("usage: fs.mv <src> <dst>")
+    src, dst = positional
+    status, _, body = http_request(
+        "POST", f"{env.require_filer()}{dst}?mv.from={src}", b""
+    )
+    if status >= 400:
+        raise ShellError(f"mv: {status} {body[:200]!r}")
+    return f"moved {src} -> {dst}"
+
+
+@command("fs.meta.save", "-o <file.json> [dir] — dump filer metadata "
+         "(ref command_fs_meta_save.go; JSON-lines instead of protobuf)")
+def cmd_fs_meta_save(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    root = flags.get("", "/")
+    out_path = flags.get("o", "filer_meta.jsonl")
+    count = 0
+    with open(out_path, "w") as f:
+        for e in _walk(env, root):
+            status, _, body = env.filer_read(e["FullPath"], "metadata=true")
+            if status != 200:
+                continue
+            f.write(json.dumps(json.loads(body)) + "\n")
+            count += 1
+    return f"saved {count} entries to {out_path}"
+
+
+@command("fs.meta.load", "<file.json> — restore filer metadata entries")
+def cmd_fs_meta_load(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    in_path = flags.get("")
+    if not in_path:
+        raise ShellError("usage: fs.meta.load <file.jsonl>")
+    count = 0
+    with open(in_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            entry = json.loads(line)
+            path = entry["full_path"]
+            if entry.get("is_directory"):
+                http_request("POST", f"{env.require_filer()}{path}?mkdir=true", b"")
+            else:
+                # restore the metadata record (chunks point at existing blobs)
+                http_request(
+                    "POST",
+                    f"{env.require_filer()}{path}?meta.entry=true",
+                    json.dumps(entry).encode(),
+                    {"Content-Type": "application/json"},
+                )
+            count += 1
+    return f"loaded {count} entries"
+
+
+@command("fs.verify", "[dir] — check every chunk of every file is readable "
+         "(ref command_fs_verify.go)")
+def cmd_fs_verify(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    root = flags.get("", "/")
+    ok = bad = 0
+    lines = []
+    for e in _walk(env, root):
+        if e["IsDirectory"]:
+            continue
+        status, _, _ = env.filer_read(e["FullPath"])
+        if status == 200:
+            ok += 1
+        else:
+            bad += 1
+            lines.append(f"UNREADABLE {e['FullPath']} ({status})")
+    lines.append(f"verified {ok + bad} files: {ok} ok, {bad} broken")
+    return "\n".join(lines)

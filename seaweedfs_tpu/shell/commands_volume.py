@@ -1,0 +1,253 @@
+"""volume.* commands (reference `weed/shell/command_volume_balance.go`,
+`command_volume_fix_replication.go:58`, `command_volume_move.go`,
+`command_volume_fsck.go`, `command_volume_check_disk.go`,
+`command_volume_server_evacuate.go`)."""
+
+from __future__ import annotations
+
+from seaweedfs_tpu.server.httpd import http_request
+
+from .env import CommandEnv, ServerView, ShellError
+from .registry import command, parse_flags
+
+
+def _find_server(servers: list[ServerView], node_id: str) -> ServerView:
+    for sv in servers:
+        if sv.id == node_id or sv.url == node_id:
+            return sv
+    raise ShellError(f"volume server {node_id!r} not found")
+
+
+def _move_volume(env: CommandEnv, vid: int, src: ServerView, dst: ServerView) -> None:
+    """copy to dst, then delete from src (`command_volume_move.go` — live
+    moves tail writes; we mark readonly during the copy like evacuate does)."""
+    env.post(f"{src.http}/admin/volume/readonly", {"volume": vid, "readonly": True})
+    try:
+        env.post(
+            f"{dst.http}/admin/volume/copy",
+            {"volume": vid, "source": src.http},
+        )
+    except Exception:
+        env.post(
+            f"{src.http}/admin/volume/readonly", {"volume": vid, "readonly": False}
+        )
+        raise
+    env.post(f"{src.http}/admin/delete_volume", {"volume": vid})
+    env.post(f"{dst.http}/admin/volume/readonly", {"volume": vid, "readonly": False})
+
+
+@command("volume.move", "-volumeId <n> -source <host:port> -target <host:port>",
+         needs_lock=True)
+def cmd_volume_move(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    servers = env.servers()
+    src = _find_server(servers, flags["source"])
+    dst = _find_server(servers, flags["target"])
+    _move_volume(env, vid, src, dst)
+    return f"moved volume {vid} from {src.id} to {dst.id}"
+
+
+@command("volume.copy", "-volumeId <n> -source <host:port> -target <host:port>",
+         needs_lock=True)
+def cmd_volume_copy(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    servers = env.servers()
+    src = _find_server(servers, flags["source"])
+    dst = _find_server(servers, flags["target"])
+    out = env.post(
+        f"{dst.http}/admin/volume/copy", {"volume": vid, "source": src.http}
+    )
+    return f"copied volume {vid} to {dst.id} ({out['size']} bytes)"
+
+
+@command("volume.delete", "-volumeId <n> -node <host:port>", needs_lock=True)
+def cmd_volume_delete(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    sv = _find_server(env.servers(), flags["node"])
+    env.post(f"{sv.http}/admin/delete_volume", {"volume": vid})
+    return f"deleted volume {vid} on {sv.id}"
+
+
+@command("volume.mark", "-volumeId <n> -node <host:port> [-writable|-readonly]")
+def cmd_volume_mark(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    sv = _find_server(env.servers(), flags["node"])
+    readonly = "writable" not in flags
+    env.post(
+        f"{sv.http}/admin/volume/readonly", {"volume": vid, "readonly": readonly}
+    )
+    return f"volume {vid} on {sv.id} marked {'readonly' if readonly else 'writable'}"
+
+
+@command("volume.vacuum", "[-garbageThreshold 0.3] [-volumeId n] — compact garbage")
+def cmd_volume_vacuum(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = flags.get("volumeId")
+    done = []
+    for sv in env.servers():
+        for v in sv.volumes.values():
+            if vid is not None and v["id"] != int(vid):
+                continue
+            threshold = float(flags.get("garbageThreshold", 0.3))
+            if vid is None and (
+                v["size"] == 0 or v["garbage"] / max(v["size"], 1) < threshold
+            ):
+                continue
+            env.post(f"{sv.http}/admin/vacuum", {"volume": v["id"]})
+            done.append(f"{v['id']}@{sv.id}")
+    return "vacuumed: " + (", ".join(done) if done else "nothing to do")
+
+
+@command("volume.fsck", "[-volumeId n] — CRC-verify every needle on every volume")
+def cmd_volume_fsck(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = flags.get("volumeId")
+    lines = []
+    bad = 0
+    for sv in env.servers():
+        for v in sv.volumes.values():
+            if vid is not None and v["id"] != int(vid):
+                continue
+            out = env.get(f"{sv.http}/admin/fsck?volume={v['id']}", timeout=600)
+            status = "ok" if out["ok"] else f"{len(out['errors'])} ERRORS"
+            bad += len(out["errors"])
+            lines.append(f"volume {v['id']}@{sv.id}: {out['checked']} needles {status}")
+    lines.append("fsck: clean" if bad == 0 else f"fsck: {bad} corrupt needles")
+    return "\n".join(lines)
+
+
+@command("volume.check.disk", "sync needle differences between replicas "
+         "(ref command_volume_check_disk.go)", needs_lock=True)
+def cmd_volume_check_disk(env: CommandEnv, args: list[str]) -> str:
+    lines = []
+    for vid, holders in sorted(env.volume_replicas().items()):
+        if len(holders) < 2:
+            continue
+        needle_sets = {}
+        for sv in holders:
+            out = env.get(f"{sv.http}/admin/volume/needles?volume={vid}", timeout=300)
+            needle_sets[sv.id] = {n["id"]: n for n in out["needles"]}
+        union: dict[int, tuple[ServerView, dict]] = {}
+        for sv in holders:
+            for nid, meta in needle_sets[sv.id].items():
+                union.setdefault(nid, (sv, meta))
+        for sv in holders:
+            missing = [nid for nid in union if nid not in needle_sets[sv.id]]
+            for nid in missing:
+                src, meta = union[nid]
+                blob_status, _, blob = http_request(
+                    "GET",
+                    f"{src.http}/admin/volume/needle_blob?volume={vid}"
+                    f"&offset={meta['offset']}&size={meta['size']}",
+                )
+                if blob_status != 200:
+                    lines.append(f"volume {vid}: read {nid} from {src.id} failed")
+                    continue
+                st, _, _ = http_request(
+                    "POST",
+                    f"{sv.http}/admin/volume/write_needle_blob?volume={vid}"
+                    f"&size={meta['size']}",
+                    blob,
+                )
+                if st < 300:
+                    lines.append(f"volume {vid}: copied needle {nid} "
+                                 f"{src.id} -> {sv.id}")
+                else:
+                    lines.append(f"volume {vid}: write {nid} to {sv.id} failed")
+    return "\n".join(lines) if lines else "all replicas are in sync"
+
+
+@command("volume.fix.replication", "re-replicate under-replicated volumes "
+         "(ref command_volume_fix_replication.go:58)", needs_lock=True)
+def cmd_volume_fix_replication(env: CommandEnv, args: list[str]) -> str:
+    servers = env.servers()
+    lines = []
+    for vid, holders in sorted(env.volume_replicas().items()):
+        info = holders[0].volumes[vid]
+        rp = info.get("replica_placement", 0)
+        want = (rp // 100) + (rp // 10) % 10 + rp % 10 + 1
+        if len(holders) >= want:
+            continue
+        holder_ids = {sv.id for sv in holders}
+        holder_racks = {(sv.dc, sv.rack) for sv in holders}
+        # prefer a different rack, then any server with free slots
+        candidates = sorted(
+            (sv for sv in servers if sv.id not in holder_ids and sv.free_slots() > 0),
+            key=lambda sv: ((sv.dc, sv.rack) in holder_racks, -sv.free_slots()),
+        )
+        for _ in range(want - len(holders)):
+            if not candidates:
+                lines.append(f"volume {vid}: no candidate server")
+                break
+            dst = candidates.pop(0)
+            env.post(
+                f"{dst.http}/admin/volume/copy",
+                {"volume": vid, "source": holders[0].http},
+            )
+            lines.append(f"volume {vid}: replicated to {dst.id}")
+    return "\n".join(lines) if lines else "all volumes sufficiently replicated"
+
+
+@command("volume.balance", "even out volume counts across servers "
+         "(ref command_volume_balance.go)", needs_lock=True)
+def cmd_volume_balance(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    collection = flags.get("collection")
+    servers = env.servers()
+    if len(servers) < 2:
+        return "nothing to balance (fewer than 2 servers)"
+    moved = []
+    for _ in range(100):  # converge
+        def count(sv: ServerView) -> int:
+            return sum(
+                1 for v in sv.volumes.values()
+                if collection is None or v.get("collection", "") == collection
+            )
+
+        servers.sort(key=count)
+        low, high = servers[0], servers[-1]
+        if count(high) - count(low) <= 1:
+            break
+        # move the smallest eligible volume whose replicas aren't already on low
+        movable = [
+            v for v in high.volumes.values()
+            if (collection is None or v.get("collection", "") == collection)
+            and v["id"] not in low.volumes
+        ]
+        if not movable:
+            break
+        pick = min(movable, key=lambda v: v["size"])
+        _move_volume(env, pick["id"], high, low)
+        moved.append(f"{pick['id']}: {high.id} -> {low.id}")
+        servers = env.servers()  # refresh
+    return "\n".join(moved) if moved else "already balanced"
+
+
+@command("volume.server.evacuate", "-node <host:port> — move all volumes off a "
+         "server (ref command_volume_server_evacuate.go)", needs_lock=True)
+def cmd_volume_server_evacuate(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    servers = env.servers()
+    src = _find_server(servers, flags["node"])
+    targets = [sv for sv in servers if sv.id != src.id and sv.free_slots() > 0]
+    if not targets:
+        raise ShellError("no target servers with free slots")
+    moved = []
+    for i, vid in enumerate(sorted(src.volumes)):
+        # round-robin over targets, skipping ones already holding a replica
+        ranked = sorted(
+            (sv for sv in targets if vid not in sv.volumes),
+            key=lambda sv: -sv.free_slots(),
+        )
+        if not ranked:
+            moved.append(f"{vid}: NO TARGET")
+            continue
+        dst = ranked[i % len(ranked)]
+        _move_volume(env, vid, src, dst)
+        dst.volumes[vid] = src.volumes[vid]  # keep local view fresh
+        moved.append(f"{vid} -> {dst.id}")
+    return "\n".join(moved) if moved else "server holds no volumes"

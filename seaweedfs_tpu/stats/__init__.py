@@ -1,0 +1,11 @@
+"""Prometheus-compatible metrics (`weed/stats/metrics.go:33-400`)."""
+
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+)
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "default_registry"]

@@ -1,0 +1,249 @@
+"""Minimal Prometheus client: counters, gauges, histograms with labels,
+text exposition format, and a per-process default registry.
+
+Mirrors the reference's metric families (`weed/stats/metrics.go:33-400`):
+`SeaweedFS_{master,volume,filer,s3}_request_total`, `*_request_seconds`
+histograms, volume/disk gauges. Exposed on each server's `/metrics`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable
+
+DEFAULT_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _fmt_labels(label_names: tuple, label_values: tuple, extra: str = "") -> str:
+    pairs = [f'{k}="{v}"' for k, v in zip(label_names, label_values)]
+    if extra:
+        pairs.append(extra)
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str, label_names: Iterable[str] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_text="", label_names=()):
+        super().__init__(name, help_text, label_names)
+        self._values: dict[tuple, float] = {}
+
+    def labels(self, *values) -> "_CounterChild":
+        return _CounterChild(self, tuple(str(v) for v in values))
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def _add(self, key: tuple, amount: float) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, val in items:
+            out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {val:g}")
+        return out
+
+
+class _CounterChild:
+    def __init__(self, parent: Counter, key: tuple):
+        self._parent = parent
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._parent._add(self._key, amount)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_text="", label_names=()):
+        super().__init__(name, help_text, label_names)
+        self._values: dict[tuple, float] = {}
+        self._fns: dict[tuple, callable] = {}
+
+    def labels(self, *values) -> "_GaugeChild":
+        return _GaugeChild(self, tuple(str(v) for v in values))
+
+    def set(self, value: float) -> None:
+        self.labels().set(value)
+
+    def set_function(self, fn, *label_values) -> None:
+        """Sample a callable at scrape time (for live gauges like disk free)."""
+        with self._lock:
+            self._fns[tuple(str(v) for v in label_values)] = fn
+
+    def _set(self, key: tuple, value: float) -> None:
+        with self._lock:
+            self._values[key] = value
+
+    def _add(self, key: tuple, amount: float) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            merged = dict(self._values)
+            for key, fn in self._fns.items():
+                try:
+                    merged[key] = float(fn())
+                except Exception:
+                    pass
+            items = sorted(merged.items())
+        for key, val in items:
+            out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {val:g}")
+        return out
+
+
+class _GaugeChild:
+    def __init__(self, parent: Gauge, key: tuple):
+        self._parent = parent
+        self._key = key
+
+    def set(self, value: float) -> None:
+        self._parent._set(self._key, value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._parent._add(self._key, amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._parent._add(self._key, -amount)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_text="", label_names=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def labels(self, *values) -> "_HistogramChild":
+        return _HistogramChild(self, tuple(str(v) for v in values))
+
+    def observe(self, value: float) -> None:
+        self.labels().observe(value)
+
+    def _observe(self, key: tuple, value: float) -> None:
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+            totals = dict(self._totals)
+        for key, counts in items:
+            for ub, c in zip(self.buckets, counts):
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(self.label_names, key, f'le=\"{ub:g}\"')} {c}"
+                )
+            out.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels(self.label_names, key, 'le=\"+Inf\"')} {totals[key]}"
+            )
+            out.append(
+                f"{self.name}_sum{_fmt_labels(self.label_names, key)} {sums[key]:g}"
+            )
+            out.append(
+                f"{self.name}_count{_fmt_labels(self.label_names, key)} {totals[key]}"
+            )
+        return out
+
+
+class _HistogramChild:
+    def __init__(self, parent: Histogram, key: tuple):
+        self._parent = parent
+        self._key = key
+
+    def observe(self, value: float) -> None:
+        self._parent._observe(self._key, value)
+
+    def time(self):
+        return _Timer(self)
+
+
+class _Timer:
+    def __init__(self, child: _HistogramChild):
+        self._child = child
+
+    def __enter__(self):
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._child.observe(time.monotonic() - self._start)
+        return False
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name, help_text="", label_names=()) -> Counter:
+        return self._get_or_create(Counter, name, help_text, label_names)
+
+    def gauge(self, name, help_text="", label_names=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_text, label_names)
+
+    def histogram(
+        self, name, help_text="", label_names=(), buckets=DEFAULT_BUCKETS
+    ) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_text, label_names, buckets)
+                self._metrics[name] = m
+            return m
+
+    def _get_or_create(self, cls, name, help_text, label_names):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_text, label_names)
+                self._metrics[name] = m
+            if not isinstance(m, cls):
+                raise TypeError(f"{name} already registered as {type(m).__name__}")
+            return m
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+_default = Registry()
+
+
+def default_registry() -> Registry:
+    return _default

@@ -75,6 +75,11 @@ class EcVolume:
         self.small_block_size = small_block_size
         self._ecj_lock = threading.Lock()
 
+        # optional remote sourcing hook, set by the server layer:
+        # (shard_id, offset, size) -> bytes | None. Mirrors the remote half
+        # of `store_ec.go` (readRemoteEcShardInterval).
+        self.shard_fetcher = None
+
         self.data_base = ec_shard_file_name(collection, self.dir, volume_id)
         self.index_base = ec_shard_file_name(collection, self.dir_idx, volume_id)
         if not os.path.exists(self.index_base + ".ecx"):
@@ -152,19 +157,35 @@ class EcVolume:
             return None
         return data
 
+    def _fetch_remote(self, shard_id: int, off: int, size: int) -> bytes | None:
+        if self.shard_fetcher is None:
+            return None
+        try:
+            data = self.shard_fetcher(shard_id, off, size)
+        except Exception:
+            return None
+        if data is not None and len(data) != size:
+            return None
+        return data
+
     def _read_interval(self, interval: Interval) -> bytes:
+        """local shard -> remote shard -> reconstruct, the `store_ec.go`
+        readOneEcShardInterval ladder."""
         shard_id, off = interval.to_shard_id_and_offset(
             self.large_block_size, self.small_block_size
         )
         data = self._pread_shard(shard_id, off, interval.size)
         if data is not None:
             return data
+        data = self._fetch_remote(shard_id, off, interval.size)
+        if data is not None:
+            return data
         return self._recover_interval(shard_id, off, interval.size)
 
     def _recover_interval(self, missing_shard: int, off: int, size: int) -> bytes:
-        """Reconstruct one interval from >= 10 surviving local shards
-        (`store_ec.go:339-395` does this with remote fetches; the server layer
-        adds remote sourcing on top of this method)."""
+        """Reconstruct one interval from >= 10 surviving shards, local first
+        then remote fan-in (`store_ec.go:339-395`
+        recoverOneRemoteEcShardInterval)."""
         present: dict[int, np.ndarray] = {}
         for shard_id in self.shards:
             if shard_id == missing_shard:
@@ -175,6 +196,16 @@ class EcVolume:
             present[shard_id] = np.frombuffer(data, dtype=np.uint8)
             if len(present) >= DATA_SHARDS_COUNT:
                 break
+        if len(present) < DATA_SHARDS_COUNT:
+            for shard_id in range(TOTAL_SHARDS_COUNT):
+                if shard_id == missing_shard or shard_id in present:
+                    continue
+                data = self._fetch_remote(shard_id, off, size)
+                if data is None:
+                    continue
+                present[shard_id] = np.frombuffer(data, dtype=np.uint8)
+                if len(present) >= DATA_SHARDS_COUNT:
+                    break
         if len(present) < DATA_SHARDS_COUNT:
             raise IOError(
                 f"cannot recover shard {missing_shard}: only {len(present)} present"

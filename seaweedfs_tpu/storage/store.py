@@ -184,6 +184,31 @@ class Store:
             raise VolumeError(f"volume {vid} not found")
         return v.delete_needle(n)
 
+    def mount_volume(self, vid: int, collection: str = "") -> Volume:
+        """Load an existing .dat/.idx pair that arrived out-of-band (volume
+        copy) into the store (`volume_grpc_admin.go VolumeMount`)."""
+        with self._lock:
+            if self.has_volume(vid):
+                raise VolumeError(f"volume {vid} already mounted")
+            for loc in self.locations:
+                if os.path.exists(
+                    volume_file_name(loc.directory, collection, vid) + ".dat"
+                ):
+                    v = Volume(loc.directory, collection, vid)
+                    loc.volumes[vid] = v
+                    return v
+        raise VolumeError(f"no local .dat for volume {vid}")
+
+    def unmount_volume(self, vid: int) -> None:
+        """Close + forget, keeping files on disk (`VolumeUnmount`)."""
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    v.close()
+                    return
+        raise VolumeError(f"volume {vid} not found")
+
     # --- EC shard hosting -----------------------------------------------------
     def mount_ec_volume(self, vid: int, collection: str = "") -> EcVolume:
         for loc in self.locations:

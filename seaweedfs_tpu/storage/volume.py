@@ -370,7 +370,14 @@ class Volume:
 
     def destroy(self) -> None:
         self.close()
-        for ext in (".dat", ".idx", ".cpd", ".cpx", ".vif"):
+        exts = [".dat", ".idx", ".cpd", ".cpx"]
+        # keep the .vif when EC shards share this base name — the EC volume
+        # still needs it after `ec.encode` deletes the source volume
+        if not any(
+            os.path.exists(self.base_name + f".ec{i:02d}") for i in range(14)
+        ) and not os.path.exists(self.base_name + ".ecx"):
+            exts.append(".vif")
+        for ext in exts:
             p = self.base_name + ext
             if os.path.exists(p):
                 os.remove(p)

@@ -262,6 +262,28 @@ class Topology:
                                     "volumes": len(n.volumes),
                                     "ec_volumes": len(n.ec_shards),
                                     "max_volume_count": n.max_volume_count,
+                                    "volume_infos": [
+                                        {
+                                            "id": v.id,
+                                            "collection": v.collection,
+                                            "size": v.size,
+                                            "file_count": v.file_count,
+                                            "delete_count": v.delete_count,
+                                            "garbage": v.deleted_byte_count,
+                                            "read_only": v.read_only,
+                                            "replica_placement": v.replica_placement,
+                                            "ttl": v.ttl,
+                                        }
+                                        for v in n.volumes.values()
+                                    ],
+                                    "ec_shard_infos": [
+                                        {
+                                            "id": s.id,
+                                            "collection": s.collection,
+                                            "shards": s.shard_ids(),
+                                        }
+                                        for s in n.ec_shards.values()
+                                    ],
                                 }
                                 for n in rack.nodes.values()
                             ],
