@@ -7,10 +7,14 @@ Reference: `weed/filer/filer.go:37`, `filer_delete_entry.go`,
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Callable
 
+from seaweedfs_tpu.util.log_buffer import LogBuffer
+
+from . import filer_notify
 from .entry import Attributes, Entry, FileChunk
 from .filerstore import FilerStore, MemoryStore
 
@@ -30,11 +34,27 @@ def normalize(path: str) -> str:
 
 
 class MetaEvent:
-    def __init__(self, directory: str, old: Entry | None, new: Entry | None) -> None:
+    def __init__(
+        self,
+        directory: str,
+        old: Entry | None,
+        new: Entry | None,
+        ts_ns: int = 0,
+        signatures: list[int] | None = None,
+    ) -> None:
         self.directory = directory
         self.old_entry = old
         self.new_entry = new
-        self.ts_ns = time.time_ns()
+        self.ts_ns = ts_ns or time.time_ns()
+        self.signatures = signatures or []
+
+    @staticmethod
+    def from_payload(payload: bytes) -> "MetaEvent":
+        d = filer_notify.deserialize_event(payload)
+        return MetaEvent(
+            d["directory"], d["old_entry"], d["new_entry"],
+            d["ts_ns"], d.get("signatures", []),
+        )
 
 
 class Filer:
@@ -42,7 +62,12 @@ class Filer:
         self.store = store or MemoryStore()
         self._lock = threading.RLock()
         self._subscribers: list[Callable[[MetaEvent], None]] = []
-        self._log: list[MetaEvent] = []
+        # per-filer signature: events carry the signatures of every filer they
+        # passed through — filer.sync uses this to break replication loops
+        # (`weed/filer/meta_aggregator.go`, `filer_sync.go:119`)
+        self.signature = random.SystemRandom().randrange(1, 1 << 31)
+        self._persister = filer_notify.MetaLogPersister(self)
+        self.log_buffer = LogBuffer(flush_fn=self._persister.flush)
         root = self.store.find_entry("/")
         if root is None:
             self.store.insert_entry(
@@ -54,14 +79,50 @@ class Filer:
     def subscribe(self, fn: Callable[[MetaEvent], None]) -> None:
         self._subscribers.append(fn)
 
-    def events_since(self, ts_ns: int) -> list[MetaEvent]:
-        return [e for e in self._log if e.ts_ns > ts_ns]
+    def events_since(self, ts_ns: int, limit: int = 1 << 31) -> list[MetaEvent]:
+        return [MetaEvent.from_payload(p) for _, p in
+                self.event_payloads_since(ts_ns, limit)]
 
-    def _notify(self, directory: str, old: Entry | None, new: Entry | None) -> None:
-        ev = MetaEvent(directory, old, new)
-        self._log.append(ev)
-        if len(self._log) > 100_000:
-            del self._log[:50_000]
+    def event_payloads_since(
+        self, ts_ns: int, limit: int = 1 << 31, wait: float = 0.0
+    ) -> list[tuple[int, bytes]]:
+        """Raw (ts_ns, json payload) stream: flushed segments first, then the
+        in-memory buffer (`filer_grpc_server_sub_meta.go` catch-up protocol)."""
+        batch, resumable = self.log_buffer.read_since(ts_ns, limit)
+        if not resumable:
+            old = self._persister.read_since(ts_ns, limit)
+            # top up from the in-memory window past the segment cursor so a
+            # single call doesn't silently drop the newest unflushed events
+            cursor = old[-1][0] if old else ts_ns
+            tail, ok = self.log_buffer.read_since(cursor, limit - len(old))
+            return old + (tail if ok else [])
+        if not batch and wait > 0:
+            batch, _ = self.log_buffer.wait_since(ts_ns, wait, limit)
+        return batch
+
+    def _insert_quiet(self, entry: Entry) -> None:
+        """Insert without generating events (meta-log segment writes)."""
+        with self._lock:
+            self._ensure_parents(entry.full_path, quiet=True)
+            self.store.insert_entry(entry)
+
+    def _notify(
+        self,
+        directory: str,
+        old: Entry | None,
+        new: Entry | None,
+        signatures: list[int] | None = None,
+    ) -> None:
+        path = (new or old).full_path if (new or old) else directory
+        if path.startswith(filer_notify.SYSTEM_LOG_DIR):
+            return
+        sigs = list(signatures or [])
+        if self.signature not in sigs:
+            sigs.append(self.signature)
+        ts = self.log_buffer.append_with(
+            lambda t: filer_notify.serialize_event(directory, old, new, t, sigs)
+        )
+        ev = MetaEvent(directory, old, new, ts, sigs)
         for fn in list(self._subscribers):
             try:
                 fn(ev)
@@ -69,18 +130,19 @@ class Filer:
                 pass
 
     # --- core ops ---------------------------------------------------------------
-    def _ensure_parents(self, path: str) -> None:
+    def _ensure_parents(self, path: str, quiet: bool = False) -> None:
         parent = path.rsplit("/", 1)[0] or "/"
         if parent == path:
             return
         if self.store.find_entry(parent) is None:
-            self._ensure_parents(parent)
+            self._ensure_parents(parent, quiet)
             e = Entry(full_path=parent, is_directory=True,
                       attributes=Attributes(mode=0o755))
             self.store.insert_entry(e)
-            self._notify(e.parent, None, e)
+            if not quiet:
+                self._notify(e.parent, None, e)
 
-    def create_entry(self, entry: Entry) -> None:
+    def create_entry(self, entry: Entry, signatures: list[int] | None = None) -> None:
         entry.full_path = normalize(entry.full_path)
         with self._lock:
             existing = self.store.find_entry(entry.full_path)
@@ -91,19 +153,20 @@ class Filer:
                 )
             self._ensure_parents(entry.full_path)
             self.store.insert_entry(entry)
-            self._notify(entry.parent, existing, entry)
+            self._notify(entry.parent, existing, entry, signatures)
 
     def find_entry(self, path: str) -> Entry | None:
         return self.store.find_entry(normalize(path))
 
-    def update_entry(self, entry: Entry) -> None:
+    def update_entry(self, entry: Entry, signatures: list[int] | None = None) -> None:
         with self._lock:
             old = self.store.find_entry(entry.full_path)
             self.store.update_entry(entry)
-            self._notify(entry.parent, old, entry)
+            self._notify(entry.parent, old, entry, signatures)
 
     def delete_entry(
-        self, path: str, recursive: bool = False
+        self, path: str, recursive: bool = False,
+        signatures: list[int] | None = None,
     ) -> list[FileChunk]:
         """Delete; returns the chunks whose blobs should be reclaimed
         (`filer_delete_entry.go`)."""
@@ -118,11 +181,19 @@ class Filer:
                 if children and not recursive:
                     raise FilerError(f"{path} is not empty")
                 for child in children:
-                    collected.extend(self.delete_entry(child.full_path, recursive=True))
+                    collected.extend(
+                        self.delete_entry(
+                            child.full_path, recursive=True, signatures=signatures
+                        )
+                    )
             collected.extend(entry.chunks)
             self.store.delete_entry(path)
-            self._notify(entry.parent, entry, None)
+            self._notify(entry.parent, entry, None, signatures)
             return collected
+
+    def close(self) -> None:
+        self.log_buffer.close()
+        self.store.close()
 
     def list_entries(
         self, dir_path: str, start_from: str = "", inclusive: bool = False,

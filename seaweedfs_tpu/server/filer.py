@@ -78,7 +78,7 @@ class FilerServer:
         self.service.stop()
         if self.metrics_service is not None:
             self.metrics_service.stop()
-        self.filer.store.close()
+        self.filer.close()
 
     @property
     def url(self) -> str:
@@ -130,6 +130,31 @@ class FilerServer:
     def _routes(self) -> None:
         svc = self.service
         path_re = r"(/.*)"
+
+        # metadata subscription (must register before the catch-all namespace):
+        # long-poll equivalent of gRPC SubscribeMetadata
+        # (`weed/server/filer_grpc_server_sub_meta.go`)
+        @svc.route("GET", r"/__meta__/events")
+        def meta_events(req: Request) -> Response:
+            since = int(req.query.get("since_ns", 0))
+            limit = int(req.query.get("limit", 1024))
+            wait = float(req.query.get("wait", 0))
+            batch = self.filer.event_payloads_since(since, limit, wait=min(wait, 30.0))
+            events = [json.loads(p) for _, p in batch]
+            next_ts = batch[-1][0] if batch else since
+            return Response(
+                {"events": events, "next_ts_ns": next_ts,
+                 "signature": self.filer.signature}
+            )
+
+        @svc.route("GET", r"/__meta__/info")
+        def meta_info(req: Request) -> Response:
+            return Response(
+                {
+                    "signature": self.filer.signature,
+                    "latest_ts_ns": self.filer.log_buffer.latest_ts_ns,
+                }
+            )
 
         @svc.route("GET", path_re)
         def read(req: Request) -> Response:

@@ -1,0 +1,152 @@
+"""Metadata event log: LogBuffer, persisted segments, HTTP subscription."""
+
+import pytest
+
+from seaweedfs_tpu.filer import Entry, Filer
+from seaweedfs_tpu.filer.filer_notify import SYSTEM_LOG_DIR
+from seaweedfs_tpu.filer.meta_aggregator import MetaSubscriber
+from seaweedfs_tpu.util.log_buffer import LogBuffer
+
+
+class TestLogBuffer:
+    def test_append_read(self):
+        lb = LogBuffer()
+        t1 = lb.append(b"one")
+        t2 = lb.append(b"two")
+        assert t2 > t1
+        batch, ok = lb.read_since(0)
+        assert ok and [p for _, p in batch] == [b"one", b"two"]
+        batch, ok = lb.read_since(t1)
+        assert [p for _, p in batch] == [b"two"]
+
+    def test_flush_and_window_fallback(self):
+        flushed = []
+        lb = LogBuffer(
+            flush_fn=lambda s, e, b: flushed.extend(b),
+            flush_bytes=1,
+            flush_interval=0,
+            keep=2,
+        )
+        for i in range(10):
+            lb.append(f"m{i}".encode())
+        assert len(flushed) == 10
+        # reader starting before the trimmed window is told to go to segments
+        _, ok = lb.read_since(0)
+        assert not ok
+        # reader inside the kept tail still works
+        tail_ts = flushed[-2][0] - 1
+        batch, ok = lb.read_since(tail_ts)
+        assert ok and len(batch) == 2
+
+    def test_wait_since_times_out(self):
+        lb = LogBuffer()
+        batch, ok = lb.wait_since(0, timeout=0.05)
+        assert ok and batch == []
+
+
+class TestFilerMetaLog:
+    def test_events_since_and_segments(self):
+        f = Filer()
+        f.create_entry(Entry(full_path="/a/1.txt"))
+        f.create_entry(Entry(full_path="/a/2.txt"))
+        evs = f.events_since(0)
+        paths = [e.new_entry.full_path for e in evs if e.new_entry]
+        assert "/a/1.txt" in paths and "/a/2.txt" in paths
+        # every event carries this filer's signature
+        assert all(f.signature in e.signatures for e in evs)
+        # flush persists segments into the filer's own namespace, without
+        # generating further events
+        n_before = len(f.events_since(0))
+        f.log_buffer.flush()
+        days = f.list_entries(SYSTEM_LOG_DIR)
+        assert days, "expected a dated segment directory"
+        segs = f.list_entries(days[0].full_path)
+        assert segs and segs[0].content
+        assert len(f.events_since(0)) == n_before
+
+    def test_replay_from_segments_after_trim(self):
+        f = Filer()
+        f.log_buffer._flush_bytes = 1
+        f.log_buffer._keep = 1
+        for i in range(20):
+            f.create_entry(Entry(full_path=f"/bulk/f{i}"))
+        # in-memory window now holds only the tail; reading from 0 must
+        # replay the flushed segments
+        evs = f.events_since(0)
+        paths = {e.new_entry.full_path for e in evs if e.new_entry}
+        assert "/bulk/f0" in paths
+
+    def test_concurrent_writers_with_aggressive_flusher(self):
+        """Writers (Filer._lock -> LogBuffer) and the flusher (LogBuffer ->
+        Filer._lock via segment writes) must not deadlock."""
+        import threading
+
+        f = Filer()
+        f.log_buffer._flush_bytes = 64  # flush on nearly every append
+        errs = []
+
+        def writer(k):
+            try:
+                for i in range(50):
+                    f.create_entry(Entry(full_path=f"/c{k}/f{i}"))
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "deadlock: writers stuck"
+        assert not errs
+
+    def test_incremental_cursor(self):
+        f = Filer()
+        f.create_entry(Entry(full_path="/x/a"))
+        evs = f.events_since(0)
+        cursor = evs[-1].ts_ns
+        f.create_entry(Entry(full_path="/x/b"))
+        newer = f.events_since(cursor)
+        new_paths = [e.new_entry.full_path for e in newer if e.new_entry]
+        assert "/x/b" in new_paths and "/x/a" not in new_paths
+
+
+class TestHTTPSubscription:
+    @pytest.fixture()
+    def filer_server(self):
+        from seaweedfs_tpu.server.filer import FilerServer
+
+        # master_url unused for metadata-only operations
+        srv = FilerServer("http://127.0.0.1:1", port=0)
+        srv.start()
+        yield srv
+        srv.stop()
+
+    def test_poll_events(self, filer_server):
+        from seaweedfs_tpu.server.httpd import get_json, http_request
+
+        http_request("PUT", f"{filer_server.url}/s/one.txt", b"x")
+        out = get_json(f"{filer_server.url}/__meta__/events?since_ns=0")
+        assert out["signature"] == filer_server.filer.signature
+        paths = [
+            e["new_entry"]["full_path"] for e in out["events"] if e.get("new_entry")
+        ]
+        assert "/s/one.txt" in paths
+        # cursor advances
+        out2 = get_json(
+            f"{filer_server.url}/__meta__/events?since_ns={out['next_ts_ns']}"
+        )
+        assert out2["events"] == []
+
+    def test_meta_subscriber_drain(self, filer_server):
+        from seaweedfs_tpu.server.httpd import http_request
+
+        http_request("PUT", f"{filer_server.url}/sub/a.txt", b"1")
+        http_request("PUT", f"{filer_server.url}/sub/b.txt", b"2")
+        seen = []
+        sub = MetaSubscriber(filer_server.url, seen.append, path_prefix="/sub")
+        n = sub.drain()
+        assert n >= 2
+        paths = [e["new_entry"]["full_path"] for e in seen if e.get("new_entry")]
+        assert "/sub/a.txt" in paths and "/sub/b.txt" in paths
+        assert sub.peer_signature == filer_server.filer.signature
