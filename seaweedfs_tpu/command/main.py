@@ -28,6 +28,10 @@ COMMANDS: dict[str, tuple[str, str, str]] = {
         "seaweedfs_tpu.command.server_cmds", "run_server",
         "start master + volume server (+ -filer, -s3) in one process",
     ),
+    "s3": (
+        "seaweedfs_tpu.command.server_cmds", "run_s3",
+        "start the S3 gateway against a filer",
+    ),
     "shell": (
         "seaweedfs_tpu.shell.shell", "run",
         "interactive admin shell (ec.*, volume.*, fs.*)",

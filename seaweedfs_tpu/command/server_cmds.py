@@ -121,6 +121,8 @@ def run_server(args: list[str]) -> int:
     p.add_argument("-defaultReplication", default="000")
     p.add_argument("-filer.store", dest="filer_store", default="memory")
     p.add_argument("-filer.storePath", dest="filer_store_path", default=None)
+    p.add_argument("-s3.config", dest="s3_config", default=None,
+                   help="identities json (s3.json)")
     opts = p.parse_args(args)
 
     from seaweedfs_tpu.server.master import MasterServer
@@ -152,9 +154,41 @@ def run_server(args: list[str]) -> int:
         f.start()
         print(f"filer listening at {f.url}")
         if opts.s3:
-            from seaweedfs_tpu.s3.server import S3Server
+            import json as _json
 
-            s3 = S3Server(f, host=opts.ip, port=opts.s3_port)
+            from seaweedfs_tpu.s3api import S3Server
+
+            config = None
+            if opts.s3_config:
+                with open(opts.s3_config) as fh:
+                    config = _json.load(fh)
+            s3 = S3Server(f.url, host=opts.ip, port=opts.s3_port, config=config)
             s3.start()
             print(f"s3 gateway listening at {s3.url}")
+    return _wait_forever()
+
+
+def run_s3(args: list[str]) -> int:
+    """Standalone S3 gateway against a running filer
+    (`weed/command/s3.go`)."""
+    p = argparse.ArgumentParser(prog="weed-tpu s3")
+    p.add_argument("-port", type=int, default=8333)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-config", default=None, help="identities json (s3.json)")
+    opts = p.parse_args(args)
+    import json as _json
+
+    from seaweedfs_tpu.s3api import S3Server
+
+    config = None
+    if opts.config:
+        with open(opts.config) as fh:
+            config = _json.load(fh)
+    filer = opts.filer
+    if not filer.startswith("http"):
+        filer = f"http://{filer}"
+    s3 = S3Server(filer, host=opts.ip, port=opts.port, config=config)
+    s3.start()
+    print(f"s3 gateway listening at {s3.url}")
     return _wait_forever()

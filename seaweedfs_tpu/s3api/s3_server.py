@@ -1,0 +1,876 @@
+"""S3 REST gateway server.
+
+Router and handlers for bucket CRUD, object CRUD + copy, ListObjects V1/V2,
+batch delete, multipart uploads (assembled by filer chunk concatenation),
+object/bucket tagging, ACL/versioning/lifecycle stubs, SigV4 auth with
+per-identity actions, and a concurrency circuit breaker.
+
+Reference: `weed/s3api/s3api_server.go:110-290` (router),
+`s3api_object_handlers*.go`, `s3api_bucket_handlers.go`,
+`filer_multipart.go` (chunk-concatenation completion).
+
+Objects live in the filer under `/buckets/<bucket>/<key>`; multipart parts
+stage under `/buckets/<bucket>/.uploads/<uploadId>/`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+from xml.sax.saxutils import escape
+
+from seaweedfs_tpu.filer.filer_client import FilerClient
+from seaweedfs_tpu.server.httpd import HTTPService, Request, Response
+
+from .auth import (
+    ACTION_ADMIN,
+    ACTION_LIST,
+    ACTION_READ,
+    ACTION_TAGGING,
+    ACTION_WRITE,
+    IdentityAccessManagement,
+    S3ApiError,
+    deframe_streaming_body,
+    err,
+)
+from .circuit_breaker import CircuitBreaker
+
+BUCKETS_DIR = "/buckets"
+UPLOADS_FOLDER = ".uploads"
+TAG_PREFIX = "X-Amz-Tagging-"
+AMZ_META_PREFIX = "x-amz-meta-"
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def xml_response(tag: str, inner: str, status: int = 200) -> Response:
+    body = (
+        f'<?xml version="1.0" encoding="UTF-8"?>'
+        f'<{tag} xmlns="{XMLNS}">{inner}</{tag}>'
+    ).encode()
+    return Response(body, status, {"Content-Type": "application/xml"})
+
+
+def error_response(e: S3ApiError, resource: str = "") -> Response:
+    inner = (
+        f"<Code>{e.code}</Code><Message>{escape(e.message)}</Message>"
+        f"<Resource>{escape(resource)}</Resource>"
+    )
+    body = f'<?xml version="1.0" encoding="UTF-8"?><Error>{inner}</Error>'.encode()
+    return Response(body, e.status, {"Content-Type": "application/xml"})
+
+
+def amz_time(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
+
+
+class S3Server:
+    def __init__(
+        self,
+        filer_url: str,
+        host: str = "127.0.0.1",
+        port: int = 8333,
+        config: dict | None = None,
+        circuit_breaker: CircuitBreaker | None = None,
+    ) -> None:
+        self.fc = FilerClient(filer_url)
+        self.iam = IdentityAccessManagement()
+        if config:
+            self.iam.load_config(config)
+        self.cb = circuit_breaker or CircuitBreaker()
+        self.service = HTTPService(host, port)
+        self.service.enable_metrics("s3", serve_route=False)
+        self._iam_subscriber = None
+        self._routes()
+
+    def start(self) -> None:
+        self.service.start()
+        try:
+            self.fc.mkdir(BUCKETS_DIR)
+        except IOError:
+            pass
+        self._load_iam_from_filer()
+        self._watch_iam()
+
+    def stop(self) -> None:
+        if self._iam_subscriber is not None:
+            self._iam_subscriber.stop()
+        self.service.stop()
+
+    @property
+    def url(self) -> str:
+        return self.service.url
+
+    # --- IAM config hot reload (`auth_credentials_subscribe.go`) ---------------
+    IAM_CONFIG_PATH = "/etc/iam/identity.json"
+
+    def _load_iam_from_filer(self) -> None:
+        try:
+            status, _, body = self.fc.get(self.IAM_CONFIG_PATH)
+            if status == 200 and body:
+                self.iam.load_json(body)
+        except Exception:
+            pass
+
+    def _watch_iam(self) -> None:
+        from seaweedfs_tpu.filer.meta_aggregator import MetaSubscriber
+
+        def on_event(ev: dict) -> None:
+            e = ev.get("new_entry")
+            if e and e.get("full_path") == self.IAM_CONFIG_PATH:
+                self._load_iam_from_filer()
+
+        try:
+            sub = MetaSubscriber(
+                self.fc.filer_url, on_event, path_prefix="/etc/iam",
+                since_ns=time.time_ns(),
+            )
+            sub.start()
+            self._iam_subscriber = sub
+        except Exception:
+            self._iam_subscriber = None
+
+    # --- routing ----------------------------------------------------------------
+    def _routes(self) -> None:
+        svc = self.service
+
+        @svc.route("GET", r"/")
+        def list_buckets(req: Request) -> Response:
+            return self._dispatch(req, "", "")
+
+        for method in ("GET", "PUT", "POST", "DELETE", "HEAD"):
+            @svc.route(method, r"/([^/]+)")
+            def bucket_level(req: Request) -> Response:
+                return self._dispatch(req, req.match.group(1), "")
+
+            @svc.route(method, r"/([^/]+)/(.*)")
+            def object_level(req: Request) -> Response:
+                return self._dispatch(
+                    req, req.match.group(1), req.match.group(2)
+                )
+
+    def _query_pairs(self, req: Request) -> list[tuple[str, str]]:
+        # S3 subresources are empty-valued query keys ("?uploads"); the
+        # default Request.query drops them, so re-parse keeping blanks
+        parsed = urllib.parse.urlparse(req.handler.path)
+        return urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
+
+    def _dispatch(self, req: Request, bucket: str, key: str) -> Response:
+        pairs = self._query_pairs(req)
+        q = dict(pairs)
+        resource = f"/{bucket}/{key}" if key else f"/{bucket}"
+        try:
+            body = req.body
+            ident = self.iam.authenticate(
+                req.method,
+                urllib.parse.unquote(urllib.parse.urlparse(req.handler.path).path),
+                pairs,
+                dict(req.headers),
+                body,
+            )
+            action = self._required_action(req.method, bucket, key, q)
+            if not ident.can_do(action, bucket, key):
+                raise err("AccessDenied", f"{ident.name} cannot {action} {resource}")
+            # CopyObject also reads the source object — authorize both sides
+            copy_source = req.headers.get("x-amz-copy-source")
+            if req.method == "PUT" and key and copy_source:
+                src = urllib.parse.unquote(copy_source).lstrip("/")
+                src_bucket, _, src_key = src.partition("/")
+                if not ident.can_do(ACTION_READ, src_bucket, src_key):
+                    raise err(
+                        "AccessDenied", f"{ident.name} cannot Read /{src}"
+                    )
+            with self.cb.limit(action, bucket):
+                return self._handle(req, bucket, urllib.parse.unquote(key), q, ident)
+        except S3ApiError as e:
+            return error_response(e, resource)
+        except Exception as e:  # any internal failure → S3 XML error surface
+            return error_response(err("InternalError", str(e)), resource)
+
+    @staticmethod
+    def _required_action(method: str, bucket: str, key: str, q: dict) -> str:
+        if "tagging" in q:
+            return ACTION_TAGGING
+        if not bucket:
+            return ACTION_LIST  # ListBuckets (filtered per identity)
+        if not key:
+            if method in ("PUT", "DELETE"):
+                return ACTION_ADMIN  # create/delete bucket
+            if method == "POST":
+                return ACTION_WRITE  # batch delete
+            return ACTION_LIST
+        if method in ("GET", "HEAD"):
+            return ACTION_READ
+        return ACTION_WRITE
+
+    def _handle(
+        self, req: Request, bucket: str, key: str, q: dict, ident
+    ) -> Response:
+        m = req.method
+        if not bucket:
+            return self._list_buckets(ident)
+        if not key:
+            if "tagging" in q:  # before bucket CRUD — a Tagging-only identity
+                path = self._bucket_path(bucket)  # must never create/delete
+                if m == "GET":
+                    return self._get_tagging(path)
+                if m == "PUT":
+                    return self._put_tagging(path, req.body)
+                if m == "DELETE":
+                    return self._delete_tagging(path)
+            if m == "PUT":
+                return self._put_bucket(bucket)
+            if m == "DELETE":
+                return self._delete_bucket(bucket)
+            if m == "HEAD":
+                return self._head_bucket(bucket)
+            if m == "POST" and "delete" in q:
+                return self._delete_objects(req, bucket)
+            if m == "GET":
+                if "uploads" in q:
+                    return self._list_multipart_uploads(bucket)
+                if "location" in q:
+                    return xml_response("LocationConstraint", "")
+                if "versioning" in q:
+                    return xml_response("VersioningConfiguration", "")
+                if "lifecycle" in q:
+                    raise err("NoSuchTagSet", "no lifecycle configuration")
+                if "acl" in q:
+                    return self._canned_acl(ident)
+                return self._list_objects(req, bucket, q)
+        else:
+            if "uploadId" in q:
+                if m == "PUT":
+                    return self._upload_part(req, bucket, key, q)
+                if m == "POST":
+                    return self._complete_multipart(req, bucket, key, q)
+                if m == "DELETE":
+                    return self._abort_multipart(bucket, key, q)
+                if m == "GET":
+                    return self._list_parts(bucket, key, q)
+            if m == "POST" and "uploads" in q:
+                return self._create_multipart(req, bucket, key)
+            if "tagging" in q:
+                path = self._object_path(bucket, key)
+                if m == "GET":
+                    return self._get_tagging(path)
+                if m == "PUT":
+                    return self._put_tagging(path, req.body)
+                if m == "DELETE":
+                    return self._delete_tagging(path)
+            if m == "PUT":
+                if req.headers.get("x-amz-copy-source"):
+                    return self._copy_object(req, bucket, key)
+                return self._put_object(req, bucket, key)
+            if m in ("GET", "HEAD"):
+                return self._get_object(req, bucket, key, head=(m == "HEAD"))
+            if m == "DELETE":
+                return self._delete_object(bucket, key)
+        raise err("NotImplemented", f"{m} {req.path}?{urllib.parse.urlencode(q)}")
+
+    # --- path helpers -----------------------------------------------------------
+    @staticmethod
+    def _bucket_path(bucket: str) -> str:
+        if not bucket or "/" in bucket or bucket.startswith("."):
+            raise err("InvalidBucketName", bucket)
+        return f"{BUCKETS_DIR}/{bucket}"
+
+    def _object_path(self, bucket: str, key: str) -> str:
+        return f"{self._bucket_path(bucket)}/{key}"
+
+    def _require_bucket(self, bucket: str) -> dict:
+        entry = self.fc.get_entry(self._bucket_path(bucket))
+        if entry is None or not entry.get("is_directory"):
+            raise err("NoSuchBucket", bucket)
+        return entry
+
+    # --- bucket handlers --------------------------------------------------------
+    def _list_buckets(self, ident) -> Response:
+        listing = self.fc.list(BUCKETS_DIR, limit=10_000)
+        inner = ""
+        for e in listing.get("Entries", []):
+            if not e.get("IsDirectory"):
+                continue
+            name = e["FullPath"].rsplit("/", 1)[-1]
+            if name.startswith("."):
+                continue
+            if not (
+                ident.can_do(ACTION_LIST, name) or ident.can_do(ACTION_READ, name)
+            ):
+                continue
+            inner += (
+                f"<Bucket><Name>{escape(name)}</Name>"
+                f"<CreationDate>{amz_time(e.get('Mtime', 0))}</CreationDate>"
+                f"</Bucket>"
+            )
+        owner = (
+            f"<Owner><ID>{escape(ident.account_id)}</ID>"
+            f"<DisplayName>{escape(ident.name)}</DisplayName></Owner>"
+        )
+        return xml_response(
+            "ListAllMyBucketsResult", f"{owner}<Buckets>{inner}</Buckets>"
+        )
+
+    def _put_bucket(self, bucket: str) -> Response:
+        path = self._bucket_path(bucket)
+        if self.fc.exists(path):
+            raise err("BucketAlreadyExists", bucket)
+        self.fc.mkdir(path)
+        return Response(b"", 200, {"Location": f"/{bucket}"})
+
+    def _delete_bucket(self, bucket: str) -> Response:
+        self._require_bucket(bucket)
+        listing = self.fc.list(self._bucket_path(bucket), limit=2)
+        entries = [
+            e for e in listing.get("Entries", [])
+            if e["FullPath"].rsplit("/", 1)[-1] != UPLOADS_FOLDER
+        ]
+        if entries:
+            raise err("BucketNotEmpty", bucket)
+        self.fc.delete(self._bucket_path(bucket), recursive=True)
+        return Response(b"", 204)
+
+    def _head_bucket(self, bucket: str) -> Response:
+        self._require_bucket(bucket)
+        return Response(b"", 200)
+
+    def _canned_acl(self, ident) -> Response:
+        owner = (
+            f"<Owner><ID>{escape(ident.account_id)}</ID></Owner>"
+            "<AccessControlList><Grant><Grantee "
+            'xmlns:xsi="http://www.w3.org/2001/XMLSchema-instance" '
+            'xsi:type="CanonicalUser">'
+            f"<ID>{escape(ident.account_id)}</ID></Grantee>"
+            "<Permission>FULL_CONTROL</Permission></Grant></AccessControlList>"
+        )
+        return xml_response("AccessControlPolicy", owner)
+
+    # --- object handlers --------------------------------------------------------
+    def _put_object(self, req: Request, bucket: str, key: str) -> Response:
+        self._require_bucket(bucket)
+        body = req.body
+        sha_hdr = req.headers.get("x-amz-content-sha256", "")
+        if sha_hdr.startswith("STREAMING-"):
+            body = deframe_streaming_body(body)
+        if key.endswith("/"):
+            self.fc.mkdir(self._object_path(bucket, key.rstrip("/")))
+            return Response(b"", 200, {"ETag": '"d41d8cd98f00b204e9800998ecf8427e"'})
+        etag = hashlib.md5(body).hexdigest()
+        content_type = req.headers.get("Content-Type", "")
+        self.fc.put(self._object_path(bucket, key), body, content_type)
+        # x-amz-meta-* headers persist as extended attributes
+        meta = {
+            k.lower()[len(AMZ_META_PREFIX):]: v
+            for k, v in req.headers.items()
+            if k.lower().startswith(AMZ_META_PREFIX)
+        }
+        if meta:
+            path = self._object_path(bucket, key)
+            entry = self.fc.get_entry(path)
+            if entry is not None:
+                entry.setdefault("extended", {}).update(
+                    {f"{AMZ_META_PREFIX}{k}": v for k, v in meta.items()}
+                )
+                self.fc.put_entry(path, entry)
+        return Response(b"", 200, {"ETag": f'"{etag}"'})
+
+    def _copy_object(self, req: Request, bucket: str, key: str) -> Response:
+        self._require_bucket(bucket)
+        src = urllib.parse.unquote(req.headers["x-amz-copy-source"]).lstrip("/")
+        src_bucket, _, src_key = src.partition("/")
+        src_entry = self.fc.get_entry(self._object_path(src_bucket, src_key))
+        if src_entry is None or src_entry.get("is_directory"):
+            raise err("NoSuchKey", src)
+        # replicate metadata + chunk list; the blobs are shared until the
+        # source is deleted and reclaimed, so materialize the data instead
+        data = self.fc.read(self._object_path(src_bucket, src_key))
+        self.fc.put(
+            self._object_path(bucket, key),
+            data,
+            src_entry.get("attributes", {}).get("mime", ""),
+        )
+        etag = hashlib.md5(data).hexdigest()
+        inner = (
+            f"<ETag>\"{etag}\"</ETag>"
+            f"<LastModified>{amz_time(time.time())}</LastModified>"
+        )
+        return xml_response("CopyObjectResult", inner)
+
+    def _get_object(
+        self, req: Request, bucket: str, key: str, head: bool
+    ) -> Response:
+        self._require_bucket(bucket)
+        path = self._object_path(bucket, key)
+        entry = self.fc.get_entry(path)
+        if entry is None or entry.get("is_directory"):
+            raise err("NoSuchKey", key)
+        attrs = entry.get("attributes", {})
+        headers = {
+            "ETag": f'"{attrs.get("md5") or ""}"',
+            "Last-Modified": time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(attrs.get("mtime", 0))
+            ),
+            "Accept-Ranges": "bytes",
+        }
+        if attrs.get("mime"):
+            headers["Content-Type"] = attrs["mime"]
+        for k, v in (entry.get("extended") or {}).items():
+            if k.startswith(AMZ_META_PREFIX):
+                headers[k] = v
+        size = attrs.get("file_size", 0) or sum(
+            c["size"] for c in entry.get("chunks", [])
+        )
+        if entry.get("content"):
+            size = len(entry["content"]) // 2  # hex-encoded
+        if head:
+            headers["Content-Length"] = str(size)
+            return Response(b"", 200, headers)
+        status, fh, body = self.fc.get(path, req.headers.get("Range"))
+        if status >= 400:
+            raise err("NoSuchKey", key)
+        if "Content-Range" in fh:
+            headers["Content-Range"] = fh["Content-Range"]
+        return Response(body, status, headers)
+
+    def _delete_object(self, bucket: str, key: str) -> Response:
+        self._require_bucket(bucket)
+        self.fc.delete(self._object_path(bucket, key), recursive=True)
+        return Response(b"", 204)
+
+    def _delete_objects(self, req: Request, bucket: str) -> Response:
+        self._require_bucket(bucket)
+        try:
+            root = ET.fromstring(req.body)
+        except ET.ParseError:
+            raise err("MalformedXML", "bad Delete document")
+        deleted, errors = [], []
+        for obj in root.iter():
+            if not obj.tag.endswith("Object"):
+                continue
+            key_el = next(
+                (c for c in obj if c.tag.endswith("Key")), None
+            )
+            if key_el is None or not key_el.text:
+                continue
+            k = key_el.text
+            try:
+                self.fc.delete(self._object_path(bucket, k), recursive=True)
+                deleted.append(k)
+            except Exception as e:
+                errors.append((k, str(e)))
+        inner = "".join(
+            f"<Deleted><Key>{escape(k)}</Key></Deleted>" for k in deleted
+        ) + "".join(
+            f"<Error><Key>{escape(k)}</Key><Code>InternalError</Code>"
+            f"<Message>{escape(msg)}</Message></Error>"
+            for k, msg in errors
+        )
+        return xml_response("DeleteResult", inner)
+
+    # --- listing ----------------------------------------------------------------
+    def _list_objects(self, req: Request, bucket: str, q: dict) -> Response:
+        self._require_bucket(bucket)
+        v2 = q.get("list-type") == "2"
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        try:
+            max_keys = min(int(q.get("max-keys", "1000") or 1000), 1000)
+        except ValueError:
+            raise err("InvalidArgument", "bad max-keys")
+        marker = (
+            q.get("continuation-token") or q.get("start-after", "")
+            if v2
+            else q.get("marker", "")
+        )
+        contents, prefixes, truncated, next_marker = self._walk(
+            bucket, prefix, delimiter, marker, max_keys
+        )
+        inner = (
+            f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
+            f"<MaxKeys>{max_keys}</MaxKeys>"
+            f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
+        )
+        if delimiter:
+            inner += f"<Delimiter>{escape(delimiter)}</Delimiter>"
+        for item in contents:
+            inner += (
+                "<Contents>"
+                f"<Key>{escape(item['key'])}</Key>"
+                f"<LastModified>{amz_time(item['mtime'])}</LastModified>"
+                f"<ETag>\"{item['etag']}\"</ETag>"
+                f"<Size>{item['size']}</Size>"
+                "<StorageClass>STANDARD</StorageClass>"
+                "</Contents>"
+            )
+        for p in prefixes:
+            inner += f"<CommonPrefixes><Prefix>{escape(p)}</Prefix></CommonPrefixes>"
+        if v2:
+            inner += f"<KeyCount>{len(contents) + len(prefixes)}</KeyCount>"
+            if truncated:
+                inner += (
+                    f"<NextContinuationToken>{escape(next_marker)}"
+                    "</NextContinuationToken>"
+                )
+            return xml_response("ListBucketResult", inner)
+        if truncated:
+            inner += f"<NextMarker>{escape(next_marker)}</NextMarker>"
+        return xml_response("ListBucketResult", inner)
+
+    def _iter_bucket(self, bucket: str, prefix: str, marker: str, delimiter: str):
+        """Depth-first walk yielding ("key", dict) / ("prefix", str) items in
+        S3 lexicographic KEY order (`s3api_object_handlers_list.go`).
+
+        Ordering subtlety: the filer sorts a directory's children by name,
+        but S3 sorts by full key — so directory "a" (whose keys start "a/")
+        must sort as "a/", AFTER file "a.txt" ('.' < '/'). Each directory
+        page is therefore re-sorted by effective key before descending.
+        When delimiter is "/", a qualifying subtree rolls up into a single
+        prefix item without being descended."""
+        base = self._bucket_path(bucket)
+
+        def walk_dir(dir_rel: str):
+            dir_abs = f"{base}/{dir_rel}".rstrip("/")
+            entries: list[dict] = []
+            last = ""
+            while True:
+                page = self.fc.list(dir_abs, last_file_name=last, limit=1024).get(
+                    "Entries", []
+                )
+                entries.extend(page)
+                if len(page) < 1024:
+                    break
+                last = page[-1]["FullPath"].rsplit("/", 1)[-1]
+
+            def eff_key(e: dict) -> str:
+                name = e["FullPath"].rsplit("/", 1)[-1]
+                return name + "/" if e.get("IsDirectory") else name
+
+            for e in sorted(entries, key=eff_key):
+                name = e["FullPath"].rsplit("/", 1)[-1]
+                rel = dir_rel + name
+                if not dir_rel and name == UPLOADS_FOLDER:
+                    continue
+                if e.get("IsDirectory"):
+                    sub = rel + "/"
+                    # prune subtrees that can't contain the prefix, or whose
+                    # entire key range precedes the marker
+                    if prefix and not (
+                        sub.startswith(prefix) or prefix.startswith(sub)
+                    ):
+                        continue
+                    if marker and sub < marker and not marker.startswith(sub):
+                        continue
+                    if (
+                        delimiter == "/"
+                        and sub.startswith(prefix)
+                        and len(sub) > len(prefix)
+                    ):
+                        yield ("prefix", sub)
+                        continue
+                    yield from walk_dir(sub)
+                else:
+                    if not rel.startswith(prefix):
+                        continue
+                    if marker and rel <= marker:
+                        continue
+                    yield (
+                        "key",
+                        {
+                            "key": rel,
+                            "size": e.get("FileSize", 0),
+                            "mtime": e.get("Mtime", 0),
+                            "etag": e.get("Md5", "") or "",
+                        },
+                    )
+
+        yield from walk_dir("")
+
+    def _walk(
+        self, bucket: str, prefix: str, delimiter: str, marker: str, max_keys: int
+    ) -> tuple[list[dict], list[str], bool, str]:
+        """Apply delimiter grouping + max-keys truncation over the ordered
+        key stream. Arbitrary delimiters group at the first occurrence after
+        the prefix; "/" additionally benefits from subtree rollup in
+        _iter_bucket."""
+        contents: list[dict] = []
+        prefixes: list[str] = []
+        last_emitted = ""
+        for kind, item in self._iter_bucket(bucket, prefix, marker, delimiter):
+            if kind == "key" and delimiter and delimiter != "/":
+                key = item["key"]
+                idx = key.find(delimiter, len(prefix))
+                if idx >= 0:
+                    group = key[: idx + len(delimiter)]
+                    if marker and (group <= marker or marker.startswith(group)):
+                        continue
+                    if prefixes and prefixes[-1] == group:
+                        continue  # groups are contiguous in key order
+                    kind, item = "prefix", group
+            if len(contents) + len(prefixes) >= max_keys:
+                return contents, prefixes, True, last_emitted
+            if kind == "prefix":
+                prefixes.append(item)  # type: ignore[arg-type]
+                last_emitted = item  # type: ignore[assignment]
+            else:
+                contents.append(item)  # type: ignore[arg-type]
+                last_emitted = item["key"]  # type: ignore[index]
+        return contents, prefixes, False, last_emitted
+
+    # --- multipart --------------------------------------------------------------
+    def _uploads_dir(self, bucket: str, upload_id: str = "") -> str:
+        d = f"{self._bucket_path(bucket)}/{UPLOADS_FOLDER}"
+        return f"{d}/{upload_id}" if upload_id else d
+
+    def _create_multipart(self, req: Request, bucket: str, key: str) -> Response:
+        self._require_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        staging = self._uploads_dir(bucket, upload_id)
+        self.fc.mkdir(staging)
+        manifest = {
+            "key": key,
+            "content_type": req.headers.get("Content-Type", ""),
+            "meta": {
+                k.lower()[len(AMZ_META_PREFIX):]: v
+                for k, v in req.headers.items()
+                if k.lower().startswith(AMZ_META_PREFIX)
+            },
+        }
+        self.fc.put(f"{staging}/upload.json", json.dumps(manifest).encode())
+        inner = (
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+            f"<UploadId>{upload_id}</UploadId>"
+        )
+        return xml_response("InitiateMultipartUploadResult", inner)
+
+    def _get_upload_manifest(self, bucket: str, upload_id: str) -> dict:
+        staging = self._uploads_dir(bucket, upload_id)
+        status, _, body = self.fc.get(f"{staging}/upload.json")
+        if status != 200:
+            raise err("NoSuchUpload", upload_id)
+        return json.loads(body)
+
+    def _upload_part(self, req: Request, bucket: str, key: str, q: dict) -> Response:
+        upload_id = q["uploadId"]
+        self._get_upload_manifest(bucket, upload_id)
+        try:
+            part_num = int(q.get("partNumber", "0"))
+        except ValueError:
+            raise err("InvalidArgument", "bad partNumber")
+        if not 1 <= part_num <= 10_000:
+            raise err("InvalidArgument", f"partNumber {part_num} out of range")
+        body = req.body
+        if req.headers.get("x-amz-content-sha256", "").startswith("STREAMING-"):
+            body = deframe_streaming_body(body)
+        etag = hashlib.md5(body).hexdigest()
+        staging = self._uploads_dir(bucket, upload_id)
+        self.fc.put(f"{staging}/{part_num:05d}.part", body)
+        return Response(b"", 200, {"ETag": f'"{etag}"'})
+
+    def _complete_multipart(
+        self, req: Request, bucket: str, key: str, q: dict
+    ) -> Response:
+        upload_id = q["uploadId"]
+        manifest = self._get_upload_manifest(bucket, upload_id)
+        staging = self._uploads_dir(bucket, upload_id)
+        try:
+            root = ET.fromstring(req.body)
+        except ET.ParseError:
+            raise err("MalformedXML", "bad CompleteMultipartUpload document")
+        parts: list[tuple[int, str]] = []
+        for p in root.iter():
+            if not p.tag.endswith("Part"):
+                continue
+            num = next((c.text for c in p if c.tag.endswith("PartNumber")), None)
+            etag = next((c.text for c in p if c.tag.endswith("ETag")), "")
+            if num is None:
+                raise err("MalformedXML", "Part missing PartNumber")
+            parts.append((int(num), (etag or "").strip('"')))
+        if parts != sorted(parts, key=lambda x: x[0]) or len(parts) != len(
+            {n for n, _ in parts}
+        ):
+            raise err("InvalidPartOrder", "parts must be ascending and unique")
+        if not parts:
+            raise err("MalformedXML", "no parts")
+
+        # collect part entries; assemble by chunk concatenation
+        # (`filer_multipart.go` CompleteMultipartUpload)
+        chunks: list[dict] = []
+        offset = 0
+        md5s = b""
+        part_entries: dict[int, dict] = {}
+        any_inline = False
+        for num, etag in parts:
+            part_path = f"{staging}/{num:05d}.part"
+            entry = self.fc.get_entry(part_path)
+            if entry is None:
+                raise err("InvalidPart", f"part {num} not uploaded")
+            part_entries[num] = entry
+            md5s += bytes.fromhex(entry["attributes"].get("md5", "") or "")
+            if entry.get("content"):
+                any_inline = True
+        if any_inline:
+            # small parts were inlined by the filer — materialize the whole
+            # object and store it as a regular put (tiny total by construction)
+            data = b"".join(
+                self.fc.read(f"{staging}/{num:05d}.part") for num, _ in parts
+            )
+            self.fc.put(
+                self._object_path(bucket, manifest["key"]),
+                data,
+                manifest.get("content_type", ""),
+            )
+            final_size = len(data)
+        else:
+            for num, etag in parts:
+                entry = part_entries[num]
+                part_size = entry["attributes"].get("file_size", 0)
+                for c in sorted(entry.get("chunks", []), key=lambda c: c["offset"]):
+                    chunks.append(
+                        {
+                            "file_id": c["file_id"],
+                            "offset": offset + c["offset"],
+                            "size": c["size"],
+                            "modified_ts_ns": time.time_ns(),
+                            "etag": c.get("etag", ""),
+                            "is_chunk_manifest": c.get("is_chunk_manifest", False),
+                        }
+                    )
+                offset += part_size
+            final_size = offset
+            final_entry = {
+                "full_path": self._object_path(bucket, manifest["key"]),
+                "is_directory": False,
+                "attributes": {
+                    "mtime": time.time(),
+                    "mode": 0o644,
+                    "mime": manifest.get("content_type", ""),
+                    "file_size": final_size,
+                    "md5": "",
+                },
+                "chunks": chunks,
+                "extended": {
+                    f"{AMZ_META_PREFIX}{k}": v
+                    for k, v in manifest.get("meta", {}).items()
+                },
+                "content": "",
+            }
+            self.fc.put_entry(final_entry["full_path"], final_entry)
+            # drop the part entries WITHOUT reclaiming blobs (the final entry
+            # owns them now): rewrite each part to chunkless, then delete
+            for num, _ in parts:
+                entry = part_entries[num]
+                entry["chunks"] = []
+                self.fc.put_entry(f"{staging}/{num:05d}.part", entry)
+        multipart_etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
+        self.fc.delete(staging, recursive=True)
+        inner = (
+            f"<Location>/{escape(bucket)}/{escape(manifest['key'])}</Location>"
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(manifest['key'])}</Key>"
+            f"<ETag>\"{multipart_etag}\"</ETag>"
+        )
+        return xml_response("CompleteMultipartUploadResult", inner)
+
+    def _abort_multipart(self, bucket: str, key: str, q: dict) -> Response:
+        upload_id = q["uploadId"]
+        self._get_upload_manifest(bucket, upload_id)
+        self.fc.delete(self._uploads_dir(bucket, upload_id), recursive=True)
+        return Response(b"", 204)
+
+    def _list_parts(self, bucket: str, key: str, q: dict) -> Response:
+        upload_id = q["uploadId"]
+        manifest = self._get_upload_manifest(bucket, upload_id)
+        staging = self._uploads_dir(bucket, upload_id)
+        listing = self.fc.list(staging, limit=10_001)
+        inner = (
+            f"<Bucket>{escape(bucket)}</Bucket>"
+            f"<Key>{escape(manifest['key'])}</Key>"
+            f"<UploadId>{upload_id}</UploadId>"
+        )
+        for e in listing.get("Entries", []):
+            name = e["FullPath"].rsplit("/", 1)[-1]
+            if not name.endswith(".part"):
+                continue
+            inner += (
+                "<Part>"
+                f"<PartNumber>{int(name[:-5])}</PartNumber>"
+                f"<LastModified>{amz_time(e.get('Mtime', 0))}</LastModified>"
+                f"<ETag>\"{e.get('Md5', '')}\"</ETag>"
+                f"<Size>{e.get('FileSize', 0)}</Size>"
+                "</Part>"
+            )
+        return xml_response("ListPartsResult", inner)
+
+    def _list_multipart_uploads(self, bucket: str) -> Response:
+        self._require_bucket(bucket)
+        listing = self.fc.list(self._uploads_dir(bucket), limit=1000)
+        inner = f"<Bucket>{escape(bucket)}</Bucket>"
+        for e in listing.get("Entries", []):
+            if not e.get("IsDirectory"):
+                continue
+            upload_id = e["FullPath"].rsplit("/", 1)[-1]
+            try:
+                manifest = self._get_upload_manifest(bucket, upload_id)
+            except S3ApiError:
+                continue
+            inner += (
+                "<Upload>"
+                f"<Key>{escape(manifest['key'])}</Key>"
+                f"<UploadId>{upload_id}</UploadId>"
+                f"<Initiated>{amz_time(e.get('Mtime', 0))}</Initiated>"
+                "</Upload>"
+            )
+        return xml_response("ListMultipartUploadsResult", inner)
+
+    # --- tagging ----------------------------------------------------------------
+    def _get_tagging(self, path: str) -> Response:
+        entry = self.fc.get_entry(path)
+        if entry is None:
+            raise err("NoSuchKey", path)
+        tags = {
+            k[len(TAG_PREFIX):]: v
+            for k, v in (entry.get("extended") or {}).items()
+            if k.startswith(TAG_PREFIX)
+        }
+        inner = "<TagSet>" + "".join(
+            f"<Tag><Key>{escape(k)}</Key><Value>{escape(v)}</Value></Tag>"
+            for k, v in sorted(tags.items())
+        ) + "</TagSet>"
+        return xml_response("Tagging", inner)
+
+    def _put_tagging(self, path: str, body: bytes) -> Response:
+        entry = self.fc.get_entry(path)
+        if entry is None:
+            raise err("NoSuchKey", path)
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise err("MalformedXML", "bad Tagging document")
+        tags = {}
+        for tag_el in root.iter():
+            if not tag_el.tag.endswith("}Tag") and tag_el.tag != "Tag":
+                continue
+            k = next((c.text for c in tag_el if c.tag.endswith("Key")), None)
+            v = next((c.text for c in tag_el if c.tag.endswith("Value")), "")
+            if k:
+                tags[k] = v or ""
+        ext = entry.setdefault("extended", {})
+        for k in [k for k in ext if k.startswith(TAG_PREFIX)]:
+            del ext[k]
+        for k, v in tags.items():
+            ext[f"{TAG_PREFIX}{k}"] = v
+        self.fc.put_entry(path, entry)
+        return Response(b"", 200)
+
+    def _delete_tagging(self, path: str) -> Response:
+        entry = self.fc.get_entry(path)
+        if entry is None:
+            raise err("NoSuchKey", path)
+        ext = entry.get("extended") or {}
+        entry["extended"] = {
+            k: v for k, v in ext.items() if not k.startswith(TAG_PREFIX)
+        }
+        self.fc.put_entry(path, entry)
+        return Response(b"", 204)

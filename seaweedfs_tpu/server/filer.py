@@ -291,6 +291,7 @@ class FilerServer:
             headers["Content-Range"] = f"bytes {start}-{end}/{size}"
         if head:
             headers["X-File-Size"] = str(size)
+            headers["Content-Length"] = str(size)
             return Response(b"", 200 if status == 200 else status, headers)
         body = self._read_range(entry, start, end - start + 1)
         return Response(body, status, headers)

@@ -176,7 +176,10 @@ class HTTPService:
         try:
             handler.send_response(resp.status)
             body = resp.body
-            handler.send_header("Content-Length", str(len(body)))
+            # a handler may pre-set Content-Length (HEAD responses advertise
+            # the entity size while sending no body)
+            if "Content-Length" not in resp.headers:
+                handler.send_header("Content-Length", str(len(body)))
             for k, v in resp.headers.items():
                 handler.send_header(k, v)
             handler.end_headers()
