@@ -82,7 +82,9 @@ def run_filer(args: list[str]) -> int:
     p.add_argument("-port", type=int, default=8888)
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-master", default="http://127.0.0.1:9333")
-    p.add_argument("-store", default="memory", choices=["memory", "sqlite"])
+    p.add_argument(
+        "-store", default="memory", choices=["memory", "sqlite", "leveldb"]
+    )
     p.add_argument("-storePath", default=None)
     p.add_argument("-maxMB", type=int, default=4, help="chunk size")
     p.add_argument("-collection", default="")
@@ -165,6 +167,24 @@ def run_server(args: list[str]) -> int:
             s3 = S3Server(f.url, host=opts.ip, port=opts.s3_port, config=config)
             s3.start()
             print(f"s3 gateway listening at {s3.url}")
+    return _wait_forever()
+
+
+def run_iam(args: list[str]) -> int:
+    """Standalone IAM API against a running filer (`weed/command/iam.go`)."""
+    p = argparse.ArgumentParser(prog="weed-tpu iam")
+    p.add_argument("-port", type=int, default=8111)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.iamapi import IamServer
+
+    filer = opts.filer
+    if not filer.startswith("http"):
+        filer = f"http://{filer}"
+    srv = IamServer(filer, host=opts.ip, port=opts.port)
+    srv.start()
+    print(f"iam api listening at {srv.url}")
     return _wait_forever()
 
 

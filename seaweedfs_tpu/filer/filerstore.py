@@ -198,4 +198,10 @@ def make_store(kind: str, path: str | None = None) -> FilerStore:
         if not path:
             raise ValueError("sqlite store needs a path")
         return SqliteStore(path)
+    if kind == "leveldb":
+        if not path:
+            raise ValueError("leveldb store needs a directory path")
+        from .kvstore import LocalKVStore
+
+        return LocalKVStore(path)
     raise ValueError(f"unknown filer store {kind!r}")

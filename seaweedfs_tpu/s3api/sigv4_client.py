@@ -44,11 +44,13 @@ class S3Client:
         access_key: str = "",
         secret_key: str = "",
         region: str = "us-east-1",
+        service: str = "s3",
     ) -> None:
         self.endpoint = endpoint.rstrip("/")
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
+        self.service = service
 
     # --- signing ----------------------------------------------------------------
     def _signed_headers(
@@ -71,9 +73,9 @@ class S3Client:
         canon = canonical_request(
             method, path, query_pairs, headers, signed, payload_hash
         )
-        scope = f"{date}/{self.region}/s3/aws4_request"
+        scope = f"{date}/{self.region}/{self.service}/aws4_request"
         sts = string_to_sign(amz_date, scope, canon)
-        key = signing_key(self.secret_key, date, self.region, "s3")
+        key = signing_key(self.secret_key, date, self.region, self.service)
         sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
         headers["Authorization"] = (
             f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
@@ -89,7 +91,7 @@ class S3Client:
         now = time.gmtime()
         amz_date = time.strftime("%Y%m%dT%H%M%SZ", now)
         date = time.strftime("%Y%m%d", now)
-        scope = f"{date}/{self.region}/s3/aws4_request"
+        scope = f"{date}/{self.region}/{self.service}/aws4_request"
         path = urllib.parse.quote(f"/{bucket}/{key}", safe="/-_.~")
         pairs = [
             ("X-Amz-Algorithm", "AWS4-HMAC-SHA256"),
@@ -102,7 +104,7 @@ class S3Client:
             method, path, pairs, {"host": host}, ["host"], "UNSIGNED-PAYLOAD"
         )
         sts = string_to_sign(amz_date, scope, canon)
-        key_bytes = signing_key(self.secret_key, date, self.region, "s3")
+        key_bytes = signing_key(self.secret_key, date, self.region, self.service)
         sig = hmac.new(key_bytes, sts.encode(), hashlib.sha256).hexdigest()
         pairs.append(("X-Amz-Signature", sig))
         return f"{self.endpoint}{path}?{urllib.parse.urlencode(pairs)}"
