@@ -89,6 +89,12 @@ def run_filer(args: list[str]) -> int:
     p.add_argument("-maxMB", type=int, default=4, help="chunk size")
     p.add_argument("-collection", default="")
     p.add_argument("-defaultReplicaPlacement", default="")
+    p.add_argument("-encryptVolumeData", action="store_true",
+                   help="AES-GCM encrypt chunk data on volume servers")
+    p.add_argument("-compressData", default="true", choices=["true", "false"],
+                   help="gzip-compress compressible chunks")
+    p.add_argument("-chunkCacheDir", default=None,
+                   help="on-disk tiered chunk cache directory")
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.filer import FilerServer
 
@@ -101,6 +107,9 @@ def run_filer(args: list[str]) -> int:
         chunk_size_mb=opts.maxMB,
         default_replication=opts.defaultReplicaPlacement,
         collection=opts.collection,
+        cipher=opts.encryptVolumeData,
+        compress=opts.compressData == "true",
+        chunk_cache_dir=opts.chunkCacheDir,
     )
     f.start()
     print(f"filer listening at {f.url}")
@@ -123,6 +132,10 @@ def run_server(args: list[str]) -> int:
     p.add_argument("-defaultReplication", default="000")
     p.add_argument("-filer.store", dest="filer_store", default="memory")
     p.add_argument("-filer.storePath", dest="filer_store_path", default=None)
+    p.add_argument("-filer.encryptVolumeData", dest="filer_cipher",
+                   action="store_true")
+    p.add_argument("-filer.compressData", dest="filer_compress",
+                   default="true", choices=["true", "false"])
     p.add_argument("-s3.config", dest="s3_config", default=None,
                    help="identities json (s3.json)")
     opts = p.parse_args(args)
@@ -152,6 +165,8 @@ def run_server(args: list[str]) -> int:
             port=opts.filer_port,
             store_kind=opts.filer_store,
             store_path=opts.filer_store_path,
+            cipher=opts.filer_cipher,
+            compress=opts.filer_compress == "true",
         )
         f.start()
         print(f"filer listening at {f.url}")

@@ -16,9 +16,11 @@ class FileChunk:
     modified_ts_ns: int = 0
     etag: str = ""
     is_chunk_manifest: bool = False
+    cipher_key: str = ""  # base64 AES-256 key; empty = plaintext
+    is_compressed: bool = False
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "file_id": self.file_id,
             "offset": self.offset,
             "size": self.size,
@@ -26,6 +28,11 @@ class FileChunk:
             "etag": self.etag,
             "is_chunk_manifest": self.is_chunk_manifest,
         }
+        if self.cipher_key:
+            d["cipher_key"] = self.cipher_key
+        if self.is_compressed:
+            d["is_compressed"] = True
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "FileChunk":
@@ -36,6 +43,8 @@ class FileChunk:
             modified_ts_ns=int(d.get("modified_ts_ns", 0)),
             etag=d.get("etag", ""),
             is_chunk_manifest=bool(d.get("is_chunk_manifest", False)),
+            cipher_key=d.get("cipher_key", ""),
+            is_compressed=bool(d.get("is_compressed", False)),
         )
 
 

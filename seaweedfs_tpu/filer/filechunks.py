@@ -150,13 +150,15 @@ def has_chunk_manifest(chunks: list[FileChunk]) -> bool:
 
 
 def resolve_chunk_manifest(fetch_fn, chunks: list[FileChunk]) -> list[FileChunk]:
-    """Expand manifest chunks recursively; fetch_fn(file_id) -> bytes."""
+    """Expand manifest chunks recursively; fetch_fn(chunk) -> decoded bytes
+    (the chunk is passed whole so ciphered manifest blobs can be decrypted
+    with their per-chunk key)."""
     out: list[FileChunk] = []
     for c in chunks:
         if not c.is_chunk_manifest:
             out.append(c)
             continue
-        nested = unpack_manifest(fetch_fn(c.file_id))
+        nested = unpack_manifest(fetch_fn(c))
         out.extend(resolve_chunk_manifest(fetch_fn, nested))
     return out
 

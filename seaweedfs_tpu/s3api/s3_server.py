@@ -728,16 +728,12 @@ class S3Server:
                 entry = part_entries[num]
                 part_size = entry["attributes"].get("file_size", 0)
                 for c in sorted(entry.get("chunks", []), key=lambda c: c["offset"]):
-                    chunks.append(
-                        {
-                            "file_id": c["file_id"],
-                            "offset": offset + c["offset"],
-                            "size": c["size"],
-                            "modified_ts_ns": time.time_ns(),
-                            "etag": c.get("etag", ""),
-                            "is_chunk_manifest": c.get("is_chunk_manifest", False),
-                        }
-                    )
+                    # carry every chunk field (incl. cipher_key/is_compressed)
+                    # — dropping them would leave ciphered parts unreadable
+                    nc = dict(c)
+                    nc["offset"] = offset + c["offset"]
+                    nc["modified_ts_ns"] = time.time_ns()
+                    chunks.append(nc)
                 offset += part_size
             final_size = offset
             final_entry = {

@@ -12,10 +12,15 @@ native path otherwise — never pure Python (SURVEY.md §2.2).
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import json
+import os
 import time
 import urllib.parse
+
+from seaweedfs_tpu.util import cipher as cipher_util
+from seaweedfs_tpu.util.compression import decompress_data, maybe_compress_data
 
 from seaweedfs_tpu.filer import Attributes, Entry, FileChunk, Filer
 from seaweedfs_tpu.filer.filechunks import (
@@ -46,6 +51,9 @@ class FilerServer:
         collection: str = "",
         security=None,
         metrics_port: int = -1,
+        cipher: bool = False,
+        compress: bool = True,
+        chunk_cache_dir: str | None = None,
     ) -> None:
         from seaweedfs_tpu.security import Guard, SecurityConfig
 
@@ -67,6 +75,12 @@ class FilerServer:
         self.metrics_service = (
             MetricsService(host, max(metrics_port, 0)) if metrics_port != 0 else None
         )
+        # -encryptVolumeData / compression defaults (`weed/command/filer.go`)
+        self.cipher = cipher
+        self.compress = compress
+        from seaweedfs_tpu.util.chunk_cache import TieredChunkCache
+
+        self.chunk_cache = TieredChunkCache(disk_dir=chunk_cache_dir)
         self._routes()
 
     def start(self) -> None:
@@ -86,42 +100,66 @@ class FilerServer:
 
     # --- upload pipeline --------------------------------------------------------
     def _upload_chunks(
-        self, data: bytes, ttl: str, collection: str, replication: str
+        self, data: bytes, ttl: str, collection: str, replication: str,
+        mime: str = "", filename: str = "",
     ) -> tuple[list[FileChunk], str]:
         """Split into chunks, upload each, tee a whole-stream MD5
-        (`filer_server_handlers_write_upload.go:30`)."""
+        (`filer_server_handlers_write_upload.go:30`). Each chunk is
+        independently maybe-compressed (mime heuristic) and AES-GCM
+        encrypted when the filer runs ciphered (`upload_content.go`)."""
+        ext = os.path.splitext(filename)[1]
         md5 = hashlib.md5()
         chunks: list[FileChunk] = []
         offset = 0
         while offset < len(data):
             piece = data[offset : offset + self.chunk_size]
             md5.update(piece)
+            logical_size = len(piece)
+            payload, compressed = (
+                maybe_compress_data(piece, mime, ext) if self.compress
+                else (piece, False)
+            )
+            key_b64 = ""
+            if self.cipher:
+                payload, key = cipher_util.encrypt(payload)
+                key_b64 = base64.b64encode(key).decode()
             out = self.client.upload(
-                piece, replication=replication, collection=collection, ttl=ttl
+                payload, replication=replication, collection=collection, ttl=ttl
             )
             chunks.append(
                 FileChunk(
                     file_id=out["fid"],
                     offset=offset,
-                    size=len(piece),
+                    size=logical_size,
                     modified_ts_ns=time.time_ns(),
                     etag=out.get("eTag", ""),
+                    cipher_key=key_b64,
+                    is_compressed=compressed,
                 )
             )
-            offset += len(piece)
+            offset += logical_size
         if not data:
             md5.update(b"")
         return chunks, md5.hexdigest()
 
     def _save_manifest_blob(self, blob: bytes) -> FileChunk:
+        # manifests carry every per-chunk AES key — on a ciphered filer they
+        # must be as opaque to volume servers as the data itself
+        key_b64 = ""
+        if self.cipher:
+            blob, key = cipher_util.encrypt(blob)
+            key_b64 = base64.b64encode(key).decode()
         out = self.client.upload(blob, collection=self.collection)
         return FileChunk(
             file_id=out["fid"], offset=0, size=len(blob),
-            modified_ts_ns=time.time_ns(),
+            modified_ts_ns=time.time_ns(), cipher_key=key_b64,
         )
 
-    def _fetch_chunk(self, file_id: str) -> bytes:
-        return self.client.fetch(file_id)
+    def _fetch_chunk(self, chunk: FileChunk) -> bytes:
+        raw = self.client.fetch(chunk.file_id)
+        if chunk.cipher_key:
+            raw = cipher_util.decrypt(raw, base64.b64decode(chunk.cipher_key))
+        return raw
 
     def _resolved_chunks(self, entry: Entry) -> list[FileChunk]:
         return resolve_chunk_manifest(self._fetch_chunk, entry.chunks)
@@ -223,7 +261,9 @@ class FilerServer:
             entry.content = data
             entry.attributes.md5 = hashlib.md5(data).hexdigest()
         else:
-            chunks, md5_hex = self._upload_chunks(data, ttl, collection, replication)
+            chunks, md5_hex = self._upload_chunks(
+                data, ttl, collection, replication, mime=mime, filename=filename
+            )
             entry.chunks = maybe_manifestize(self._save_manifest_blob, chunks)
             entry.attributes.md5 = md5_hex
         old_entry = self.filer.find_entry(path)
@@ -302,17 +342,48 @@ class FilerServer:
         if entry.content:
             return entry.content[offset : offset + size]
         chunks = self._resolved_chunks(entry)
+        by_fid = {c.file_id: c for c in chunks}
         views = view_from_chunks(chunks, offset, size)
         buf = bytearray(size)
         for view in views:
-            rng = (
-                f"bytes={view.offset_in_chunk}-"
-                f"{view.offset_in_chunk + view.size - 1}"
-            )
-            piece = self.client.fetch(view.file_id, range_header=rng)
+            chunk = by_fid.get(view.file_id)
+            if chunk is not None and (chunk.cipher_key or chunk.is_compressed):
+                # transformed chunks can't be range-read on the volume
+                # server; fetch whole via the tiered cache, decode, slice
+                # (`filer/stream.go` fetchChunkRange → ReaderCache)
+                piece = self._fetch_whole_chunk(chunk)[
+                    view.offset_in_chunk : view.offset_in_chunk + view.size
+                ]
+            else:
+                rng = (
+                    f"bytes={view.offset_in_chunk}-"
+                    f"{view.offset_in_chunk + view.size - 1}"
+                )
+                piece = self.client.fetch(view.file_id, range_header=rng)
             dst = view.view_offset - offset
             buf[dst : dst + len(piece)] = piece
         return bytes(buf)
+
+    def _fetch_whole_chunk(self, chunk: FileChunk) -> bytes:
+        """Whole-chunk fetch + decrypt + decompress. Decoded ciphertext is
+        cached in memory only — the disk tiers must never hold plaintext of
+        encrypted chunks (the reference's ReaderCache is mem-only too)."""
+        cached = (
+            self.chunk_cache.mem.get(chunk.file_id) if chunk.cipher_key
+            else self.chunk_cache.get_chunk(chunk.file_id)
+        )
+        if cached is not None:
+            return cached
+        raw = self.client.fetch(chunk.file_id)
+        if chunk.cipher_key:
+            raw = cipher_util.decrypt(raw, base64.b64decode(chunk.cipher_key))
+        if chunk.is_compressed:
+            raw = decompress_data(raw)
+        if chunk.cipher_key:
+            self.chunk_cache.mem.set(chunk.file_id, raw)
+        else:
+            self.chunk_cache.set_chunk(chunk.file_id, raw)
+        return raw
 
     def _list_dir(self, req: Request, entry: Entry) -> Response:
         limit = int(req.query.get("limit", 1024))

@@ -1,0 +1,39 @@
+"""AES-256-GCM chunk encryption (`weed/util/cipher.go`).
+
+The reference encrypts each chunk with a fresh random key when the filer
+runs with `-encryptVolumeData`; the per-chunk key lives only in filer
+metadata (FileChunk.cipher_key), so volume servers store ciphertext they
+cannot read. Same layout here: 12-byte nonce || ciphertext || 16-byte tag,
+key is 32 random bytes. Hardware AES stays on CPU — not a TPU target
+(SURVEY.md §2.2 item 5).
+"""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+KEY_SIZE = 32
+NONCE_SIZE = 12
+
+
+def gen_cipher_key() -> bytes:
+    return os.urandom(KEY_SIZE)
+
+
+def encrypt(data: bytes, key: bytes | None = None) -> tuple[bytes, bytes]:
+    """Returns (nonce||ciphertext||tag, key). Fresh key per chunk when none
+    given (`Encrypt` cipher.go)."""
+    if key is None:
+        key = gen_cipher_key()
+    nonce = os.urandom(NONCE_SIZE)
+    ct = AESGCM(key).encrypt(nonce, data, None)
+    return nonce + ct, key
+
+
+def decrypt(payload: bytes, key: bytes) -> bytes:
+    if len(payload) < NONCE_SIZE:
+        raise ValueError("cipher payload too short")
+    nonce, ct = payload[:NONCE_SIZE], payload[NONCE_SIZE:]
+    return AESGCM(key).decrypt(nonce, ct, None)

@@ -106,7 +106,7 @@ class TestManifest:
         out = maybe_manifestize(save, chunks, batch=1000)
         assert len(out) < len(chunks)
         assert any(c.is_chunk_manifest for c in out)
-        resolved = resolve_chunk_manifest(lambda fid: stored[fid], out)
+        resolved = resolve_chunk_manifest(lambda c: stored[c.file_id], out)
         assert sorted(c.file_id for c in resolved) == sorted(c.file_id for c in chunks)
         # resolution preserves the logical layout
         assert total_size(resolved) == total_size(chunks)
