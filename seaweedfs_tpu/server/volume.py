@@ -237,6 +237,59 @@ class VolumeServer:
             self.store.mark_readonly(int(p["volume"]), bool(p.get("readonly", True)))
             return Response({"ok": True})
 
+        # --- tiering (volume_grpc_tier_upload.go / _download.go) ---
+        @svc.route("POST", r"/admin/backend/configure")
+        def backend_configure(req: Request) -> Response:
+            from seaweedfs_tpu.storage.backend import BackendError, configure_backend
+
+            p = req.json()
+            try:
+                configure_backend(p["id"], p["kind"],
+                                  **p.get("options", {}))
+            except (BackendError, KeyError) as e:
+                return Response({"error": str(e)}, 400)
+            return Response({"ok": True})
+
+        @svc.route("POST", r"/admin/volume/tier_upload")
+        def tier_upload(req: Request) -> Response:
+            from seaweedfs_tpu.storage.backend import BackendError
+
+            p = req.json()
+            vid = int(p["volume"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            try:
+                size = v.tier_to_remote(
+                    p["backend"], keep_local=bool(p.get("keepLocal", False))
+                )
+            except (VolumeError, BackendError) as e:
+                return Response({"error": str(e)}, 409)
+            return Response({"ok": True, "size": size})
+
+        @svc.route("POST", r"/admin/volume/tier_download")
+        def tier_download(req: Request) -> Response:
+            from seaweedfs_tpu.storage.backend import BackendError
+
+            p = req.json()
+            vid = int(p["volume"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            try:
+                v.tier_to_local()
+            except (VolumeError, BackendError) as e:
+                return Response({"error": str(e)}, 409)
+            return Response({"ok": True})
+
+        @svc.route("GET", r"/admin/volume/tier_info")
+        def tier_info(req: Request) -> Response:
+            vid = int(req.query["volume"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            return Response({"volume": vid, "remote": v.tier_info()})
+
         # --- EC verbs (volume_grpc_erasure_coding.go) ---
         @svc.route("POST", r"/admin/ec/generate")
         def ec_generate(req: Request) -> Response:

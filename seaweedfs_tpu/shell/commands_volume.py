@@ -251,3 +251,73 @@ def cmd_volume_server_evacuate(env: CommandEnv, args: list[str]) -> str:
         dst.volumes[vid] = src.volumes[vid]  # keep local view fresh
         moved.append(f"{vid} -> {dst.id}")
     return "\n".join(moved) if moved else "server holds no volumes"
+
+
+# --- tiering (`weed/shell/command_volume_tier_upload.go`, `_download.go`,
+# `_move.go`) -----------------------------------------------------------------
+def _server_holding(env: CommandEnv, vid: int, node: str | None) -> ServerView:
+    servers = env.servers()
+    if node:
+        return _find_server(servers, node)
+    for sv in servers:
+        if vid in sv.volumes:
+            return sv
+    raise ShellError(f"no server holds volume {vid}")
+
+
+@command("volume.tier.configure",
+         "-backend <id> -kind local|s3 [-root dir] [-bucket b] — register a "
+         "tier backend on every volume server", needs_lock=True)
+def cmd_volume_tier_configure(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    backend = flags["backend"]
+    kind = flags.get("kind", "local")
+    options = {}
+    for k in ("root", "bucket", "region", "endpoint"):
+        if k in flags:
+            options[k] = flags[k]
+    done = []
+    for sv in env.servers():
+        env.post(f"{sv.http}/admin/backend/configure",
+                 {"id": backend, "kind": kind, "options": options})
+        done.append(sv.id)
+    return f"backend {backend!r} ({kind}) configured on: " + ", ".join(done)
+
+
+@command("volume.tier.upload",
+         "-volumeId <n> -dest <backend-id> [-node host:port] [-keepLocal] — "
+         "move a readonly volume's .dat into an object backend",
+         needs_lock=True)
+def cmd_volume_tier_upload(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    sv = _server_holding(env, vid, flags.get("node"))
+    env.post(f"{sv.http}/admin/volume/readonly", {"volume": vid, "readonly": True})
+    out = env.post(
+        f"{sv.http}/admin/volume/tier_upload",
+        {"volume": vid, "backend": flags["dest"],
+         "keepLocal": flags.get("keepLocal") == "true"},
+    )
+    return f"volume {vid} tiered to {flags['dest']} ({out['size']} bytes)"
+
+
+@command("volume.tier.download",
+         "-volumeId <n> [-node host:port] — bring a tiered volume's .dat "
+         "back to local disk", needs_lock=True)
+def cmd_volume_tier_download(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    sv = _server_holding(env, vid, flags.get("node"))
+    env.post(f"{sv.http}/admin/volume/tier_download", {"volume": vid})
+    return f"volume {vid} downloaded back to {sv.id}"
+
+
+@command("volume.tier.info", "-volumeId <n> [-node host:port]")
+def cmd_volume_tier_info(env: CommandEnv, args: list[str]) -> str:
+    import json as _json
+
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    sv = _server_holding(env, vid, flags.get("node"))
+    out = env.get(f"{sv.http}/admin/volume/tier_info?volume={vid}")
+    return _json.dumps(out, indent=2)

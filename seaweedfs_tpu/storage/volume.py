@@ -24,6 +24,7 @@ import time
 
 from . import crc as crc_mod
 from . import idx as idx_mod
+from .backend import DiskFile, RemoteFile, get_backend
 from .needle import (
     CURRENT_VERSION,
     Needle,
@@ -75,22 +76,33 @@ class Volume:
         self.last_append_at_ns = 0
 
         dat_path = self.base_name + ".dat"
-        is_new = not os.path.exists(dat_path)
-        if is_new:
-            self.super_block = SuperBlock(
-                version=version,
-                replica_placement=replica_placement or ReplicaPlacement(),
-                ttl=ttl or TTL(),
+        tier = self._load_tier_info()
+        if tier is not None:
+            # `.vif` says the .dat lives in a remote backend
+            # (`volume_tier.go:14-79` LoadRemoteFile): proxy reads, readonly
+            self._dat: DiskFile | RemoteFile = RemoteFile(
+                get_backend(tier["backend_id"]), tier["key"],
+                int(tier["file_size"]),
             )
-            with open(dat_path, "wb") as f:
-                f.write(self.super_block.to_bytes())
-        self._fd = os.open(dat_path, os.O_RDWR)
+            self.readonly = True
+            is_new = False
+        else:
+            is_new = not os.path.exists(dat_path)
+            if is_new:
+                self.super_block = SuperBlock(
+                    version=version,
+                    replica_placement=replica_placement or ReplicaPlacement(),
+                    ttl=ttl or TTL(),
+                )
+                with open(dat_path, "wb") as f:
+                    f.write(self.super_block.to_bytes())
+            self._dat = DiskFile(dat_path)
         if not is_new:
-            header = os.pread(self._fd, SUPER_BLOCK_SIZE, 0)
+            header = self._dat.read_at(SUPER_BLOCK_SIZE, 0)
             self.super_block = SuperBlock.from_bytes(header)
         self.nm = NeedleMap(self.base_name + ".idx")
-        self._size = os.path.getsize(dat_path)
-        if not is_new:
+        self._size = self._dat.file_size()
+        if not is_new and tier is None:
             self._check_idx_integrity()
             self._load_last_append_at_ns()
 
@@ -109,9 +121,7 @@ class Volume:
         key, offset, esize = last
         if offset == 0 or not size_is_valid(esize):
             return
-        blob = os.pread(
-            self._fd, get_actual_size(esize, self.version()), offset
-        )
+        blob = self._dat.read_at(get_actual_size(esize, self.version()), offset)
         n = Needle.from_bytes(blob, size=esize, version=self.version())
         if n.id != key:
             raise VolumeError(
@@ -130,7 +140,7 @@ class Volume:
         _, offset, size = entry
         version = self.version()
         if version == 3:
-            blob = os.pread(self._fd, get_actual_size(size, version), offset)
+            blob = self._dat.read_at(get_actual_size(size, version), offset)
             if len(blob) >= get_actual_size(size, version):
                 ts_off = NEEDLE_HEADER_SIZE + size + 4
                 self.last_append_at_ns = get_u64(blob, ts_off)
@@ -140,7 +150,7 @@ class Volume:
 
     def close(self) -> None:
         self.nm.close()
-        os.close(self._fd)
+        self._dat.close()
 
     # --- stats ---------------------------------------------------------------
     def size(self) -> int:
@@ -208,7 +218,7 @@ class Volume:
         if offset % NEEDLE_PADDING_SIZE != 0:
             offset += NEEDLE_PADDING_SIZE - offset % NEEDLE_PADDING_SIZE
         blob = n.to_bytes(self.version())
-        os.pwrite(self._fd, blob, offset)
+        self._dat.write_at(blob, offset)
         self._size = offset + len(blob)
         return offset
 
@@ -231,7 +241,7 @@ class Volume:
     # --- read path -----------------------------------------------------------
     def _read_at(self, offset: int, size: int) -> Needle:
         total = get_actual_size(size, self.version())
-        blob = os.pread(self._fd, total, offset)
+        blob = self._dat.read_at(total, offset)
         if len(blob) < total:
             raise VolumeError(
                 f"volume {self.id}: short read {len(blob)} < {total} at {offset}"
@@ -252,7 +262,7 @@ class Volume:
         return n
 
     def read_needle_blob(self, offset: int, size: int) -> bytes:
-        return os.pread(self._fd, get_actual_size(size, self.version()), offset)
+        return self._dat.read_at(get_actual_size(size, self.version()), offset)
 
     # --- vacuum --------------------------------------------------------------
     def compact(self) -> None:
@@ -295,11 +305,11 @@ class Volume:
         with self._write_lock:
             self._makeup_diff(dst_dat, dst_idx)
             self.nm.close()
-            os.close(self._fd)
+            self._dat.close()
             os.replace(dst_dat, self.base_name + ".dat")
             os.replace(dst_idx, self.base_name + ".idx")
-            self._fd = os.open(self.base_name + ".dat", os.O_RDWR)
-            header = os.pread(self._fd, SUPER_BLOCK_SIZE, 0)
+            self._dat = DiskFile(self.base_name + ".dat")
+            header = self._dat.read_at(SUPER_BLOCK_SIZE, 0)
             self.super_block = SuperBlock.from_bytes(header)
             self.nm = NeedleMap(self.base_name + ".idx")
             self._size = os.path.getsize(self.base_name + ".dat")
@@ -360,7 +370,7 @@ class Volume:
         while lo < hi:
             mid = (lo + hi) // 2
             off, size = entries[mid]
-            blob = os.pread(self._fd, get_actual_size(size, version), off)
+            blob = self._dat.read_at(get_actual_size(size, version), off)
             ts = get_u64(blob, NEEDLE_HEADER_SIZE + size + 4)
             if ts > since_ns:
                 hi = mid
@@ -368,7 +378,94 @@ class Volume:
                 lo = mid + 1
         return entries[lo][0] if lo < len(entries) else self._size
 
+    # --- tiering -------------------------------------------------------------
+    # (`weed/storage/volume_tier.go:14-79` + `volume_grpc_tier_upload.go`)
+    def _load_tier_info(self) -> dict | None:
+        """Remote-file record from the `.vif`, if this volume is tiered."""
+        import json
+
+        vif = self.base_name + ".vif"
+        if not os.path.exists(vif):
+            return None
+        try:
+            with open(vif) as f:
+                info = json.load(f)
+        except (OSError, ValueError):
+            return None
+        files = info.get("files") or []
+        return files[0] if files else None
+
+    def _update_vif(self, files: list[dict]) -> None:
+        import json
+
+        vif = self.base_name + ".vif"
+        info = {}
+        if os.path.exists(vif):
+            try:
+                with open(vif) as f:
+                    info = json.load(f)
+            except (OSError, ValueError):
+                info = {}
+        info.setdefault("version", self.version())
+        if files:
+            info["files"] = files
+        else:
+            info.pop("files", None)
+        with open(vif, "w") as f:
+            json.dump(info, f)
+
+    def tier_to_remote(self, backend_id: str, keep_local: bool = False) -> int:
+        """Move the whole `.dat` into an object backend; `.vif` records where
+        and reads start proxying. Requires readonly (the reference refuses to
+        tier writable volumes). Returns the uploaded size."""
+        if not self.readonly:
+            raise VolumeError(f"volume {self.id} must be readonly to tier")
+        if isinstance(self._dat, RemoteFile):
+            raise VolumeError(f"volume {self.id} already tiered")
+        backend = get_backend(backend_id)
+        key = f"{self.collection or 'default'}_{self.id}.dat"
+        dat_path = self.base_name + ".dat"
+        with self._write_lock:
+            self._dat.sync()
+            size = backend.upload_file(dat_path, key)
+            self._update_vif([
+                {
+                    "backend_id": backend_id,
+                    "key": key,
+                    "file_size": size,
+                    "modified_ts": int(time.time()),
+                }
+            ])
+            self._dat.close()
+            self._dat = RemoteFile(backend, key, size)
+            if not keep_local:
+                os.remove(dat_path)
+        return size
+
+    def tier_to_local(self) -> None:
+        """Download the `.dat` back from the backend and drop the remote
+        record (`volume_grpc_tier_download.go`)."""
+        tier = self._load_tier_info()
+        if tier is None or not isinstance(self._dat, RemoteFile):
+            raise VolumeError(f"volume {self.id} is not tiered")
+        backend = get_backend(tier["backend_id"])
+        dat_path = self.base_name + ".dat"
+        with self._write_lock:
+            backend.download_file(tier["key"], dat_path)
+            self._dat = DiskFile(dat_path)
+            self._update_vif([])
+            backend.delete_file(tier["key"])
+
+    def tier_info(self) -> dict | None:
+        return self._load_tier_info()
+
     def destroy(self) -> None:
+        tier = self._load_tier_info()
+        if tier is not None:
+            try:
+                get_backend(tier["backend_id"]).delete_file(tier["key"])
+            except Exception:
+                pass
         self.close()
         exts = [".dat", ".idx", ".cpd", ".cpx"]
         # keep the .vif when EC shards share this base name — the EC volume

@@ -1,0 +1,212 @@
+"""Volume tiering: backend SPI, whole-.dat remote moves, read-through proxy,
+volume server admin plane + volume.tier.* shell commands."""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage.backend import (
+    BackendError,
+    DiskFile,
+    LocalObjectBackend,
+    MemoryFile,
+    configure_backend,
+    get_backend,
+)
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume, VolumeError
+
+
+def make_needle(key, data, cookie=0x1234):
+    return Needle(cookie=cookie, id=key, data=data)
+
+
+class TestBackendSPI:
+    def test_disk_file(self, tmp_path):
+        f = DiskFile(str(tmp_path / "x.dat"), create=True)
+        f.write_at(b"hello world", 0)
+        f.write_at(b"!!", 5)
+        assert f.read_at(5, 0) == b"hello"
+        assert f.read_at(2, 5) == b"!!"
+        assert f.file_size() == 11
+        f.truncate(5)
+        assert f.file_size() == 5
+        f.close()
+
+    def test_memory_file(self):
+        f = MemoryFile()
+        f.write_at(b"abc", 10)  # sparse write zero-fills
+        assert f.file_size() == 13
+        assert f.read_at(3, 10) == b"abc"
+        assert f.read_at(5, 0) == b"\0" * 5
+
+    def test_local_object_backend(self, tmp_path):
+        src = tmp_path / "blob.bin"
+        src.write_bytes(os.urandom(100000))
+        b = LocalObjectBackend("t1", str(tmp_path / "cloud"))
+        size = b.upload_file(str(src), "c_5.dat")
+        assert size == 100000
+        assert b.object_size("c_5.dat") == 100000
+        data = src.read_bytes()
+        assert b.read_range("c_5.dat", 500, 100) == data[500:600]
+        dst = tmp_path / "back.bin"
+        b.download_file("c_5.dat", str(dst))
+        assert dst.read_bytes() == data
+        b.delete_file("c_5.dat")
+        with pytest.raises(FileNotFoundError):
+            b.read_range("c_5.dat", 0, 1)
+
+    def test_registry(self, tmp_path):
+        configure_backend("reg1", "local", root=str(tmp_path / "r"))
+        assert get_backend("reg1").kind == "local"
+        with pytest.raises(BackendError):
+            get_backend("nope-" + os.urandom(2).hex())
+
+
+class TestVolumeTiering:
+    def test_tier_roundtrip(self, tmp_path):
+        configure_backend("cloudA", "local", root=str(tmp_path / "cloud"))
+        v = Volume(str(tmp_path), "", 7)
+        blobs = {k: os.urandom(200 + k) for k in range(1, 30)}
+        for k, b in blobs.items():
+            v.write_needle(make_needle(k, b))
+
+        # must be readonly first (reference refuses otherwise)
+        with pytest.raises(VolumeError):
+            v.tier_to_remote("cloudA")
+        v.readonly = True
+        size = v.tier_to_remote("cloudA")
+        assert size > 0
+        assert not os.path.exists(str(tmp_path / "7.dat"))  # local gone
+        # reads proxy to the backend
+        for k, b in blobs.items():
+            assert v.read_needle(k).data == b
+        # writes refused
+        with pytest.raises(VolumeError):
+            v.write_needle(make_needle(999, b"x"))
+        v.close()
+
+        # reload from disk: .vif routes straight to the remote backend
+        v2 = Volume(str(tmp_path), "", 7)
+        assert v2.readonly
+        assert v2.tier_info() is not None
+        for k, b in blobs.items():
+            assert v2.read_needle(k).data == b
+
+        # bring it back local
+        v2.tier_to_local()
+        assert os.path.exists(str(tmp_path / "7.dat"))
+        assert v2.tier_info() is None
+        for k, b in blobs.items():
+            assert v2.read_needle(k).data == b
+        v2.close()
+        # remote copy was deleted on download
+        v3 = Volume(str(tmp_path), "", 7)
+        assert v3.tier_info() is None
+        v3.close()
+
+    def test_double_tier_refused(self, tmp_path):
+        configure_backend("cloudB", "local", root=str(tmp_path / "cloud"))
+        v = Volume(str(tmp_path), "", 8)
+        v.write_needle(make_needle(1, b"data"))
+        v.readonly = True
+        v.tier_to_remote("cloudB")
+        with pytest.raises(VolumeError):
+            v.tier_to_remote("cloudB")
+        v.close()
+
+
+class TestTieringE2E:
+    @pytest.fixture()
+    def cluster(self, tmp_path):
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume import VolumeServer
+
+        master = MasterServer(port=0)
+        master.start()
+        vol = VolumeServer([str(tmp_path / "v")], master_url=master.url, port=0)
+        vol.start()
+        vol.heartbeat_once()
+        yield master, vol, tmp_path
+        vol.stop()
+        master.stop()
+
+    def test_admin_tier_flow(self, cluster):
+        from seaweedfs_tpu.server.httpd import get_json, http_request
+
+        master, vol, tmp_path = cluster
+        # upload a blob -> creates volume
+        import json as _json
+
+        status, _, body = http_request("GET", master.url + "/dir/assign")
+        out = _json.loads(body)
+        fid, vurl = out["fid"], "http://" + out["url"]
+        payload = os.urandom(5000)
+        status, _, _ = http_request("POST", f"{vurl}/{fid}", body=payload)
+        assert status == 201
+
+        vid = int(fid.split(",")[0])
+        for url, p in [
+            (f"{vurl}/admin/backend/configure",
+             {"id": "shed", "kind": "local",
+              "options": {"root": str(tmp_path / "shed")}}),
+            (f"{vurl}/admin/volume/readonly", {"volume": vid}),
+            (f"{vurl}/admin/volume/tier_upload",
+             {"volume": vid, "backend": "shed"}),
+        ]:
+            status, _, body = http_request(
+                "POST", url, body=_json.dumps(p).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            assert status == 200, body
+
+        # data still readable through the volume server (remote proxy)
+        status, _, got = http_request("GET", f"{vurl}/{fid}")
+        assert status == 200 and got == payload
+        status, _, body = http_request(
+            "GET", f"{vurl}/admin/volume/tier_info?volume={vid}"
+        )
+        assert _json.loads(body)["remote"]["backend_id"] == "shed"
+
+        # download back
+        status, _, _ = http_request(
+            "POST", f"{vurl}/admin/volume/tier_download",
+            body=_json.dumps({"volume": vid}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        assert status == 200
+        status, _, got = http_request("GET", f"{vurl}/{fid}")
+        assert status == 200 and got == payload
+
+    def test_shell_tier_commands(self, cluster):
+        from seaweedfs_tpu.shell.env import CommandEnv
+        from seaweedfs_tpu.shell.registry import run_command
+        from seaweedfs_tpu.server.httpd import http_request
+
+        master, vol, tmp_path = cluster
+        import json as _json
+
+        status, _, body = http_request("GET", master.url + "/dir/assign")
+        out = _json.loads(body)
+        fid, vurl = out["fid"], "http://" + out["url"]
+        payload = os.urandom(3000)
+        http_request("POST", f"{vurl}/{fid}", body=payload)
+        vid = int(fid.split(",")[0])
+
+        env = CommandEnv(master.url)
+        run_command(env, "lock")
+        run_command(
+            env,
+            f"volume.tier.configure -backend barn -kind local "
+            f"-root {tmp_path / 'barn'}",
+        )
+        out1 = run_command(env, f"volume.tier.upload -volumeId {vid} -dest barn")
+        assert "tiered" in out1
+        status, _, got = http_request("GET", f"{vurl}/{fid}")
+        assert status == 200 and got == payload
+        info = run_command(env, f"volume.tier.info -volumeId {vid}")
+        assert "barn" in info
+        out2 = run_command(env, f"volume.tier.download -volumeId {vid}")
+        assert "downloaded" in out2
+        status, _, got = http_request("GET", f"{vurl}/{fid}")
+        assert status == 200 and got == payload
