@@ -50,6 +50,18 @@ COMMANDS: dict[str, tuple[str, str, str]] = {
         "seaweedfs_tpu.command.fix", "run",
         "rebuild a volume .idx from its .dat",
     ),
+    "filer.sync": (
+        "seaweedfs_tpu.command.filer_sync", "run_filer_sync",
+        "continuous bidirectional sync between two filers",
+    ),
+    "filer.replicate": (
+        "seaweedfs_tpu.command.filer_sync", "run_filer_replicate",
+        "consume a notification spool and replicate to a sink",
+    ),
+    "filer.backup": (
+        "seaweedfs_tpu.command.filer_sync", "run_filer_backup",
+        "mirror a filer tree into a local directory and follow changes",
+    ),
 }
 
 

@@ -95,8 +95,17 @@ def run_filer(args: list[str]) -> int:
                    help="gzip-compress compressible chunks")
     p.add_argument("-chunkCacheDir", default=None,
                    help="on-disk tiered chunk cache directory")
+    p.add_argument("-notification.spool", dest="notification_spool",
+                   default=None,
+                   help="publish metadata events to this file-queue spool dir")
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.filer import FilerServer
+
+    queue = None
+    if opts.notification_spool:
+        from seaweedfs_tpu.notification import FileQueue
+
+        queue = FileQueue(opts.notification_spool)
 
     f = FilerServer(
         opts.master,
@@ -110,6 +119,7 @@ def run_filer(args: list[str]) -> int:
         cipher=opts.encryptVolumeData,
         compress=opts.compressData == "true",
         chunk_cache_dir=opts.chunkCacheDir,
+        notification_queue=queue,
     )
     f.start()
     print(f"filer listening at {f.url}")

@@ -66,6 +66,7 @@ class Filer:
         # passed through — filer.sync uses this to break replication loops
         # (`weed/filer/meta_aggregator.go`, `filer_sync.go:119`)
         self.signature = random.SystemRandom().randrange(1, 1 << 31)
+        self.notification_queue = None  # optional external bus (weed/notification)
         self._persister = filer_notify.MetaLogPersister(self)
         self.log_buffer = LogBuffer(flush_fn=self._persister.flush)
         root = self.store.find_entry("/")
@@ -126,6 +127,21 @@ class Filer:
         for fn in list(self._subscribers):
             try:
                 fn(ev)
+            except Exception:
+                pass
+        if self.notification_queue is not None:
+            # external bus (`filer_notify.go` Notify → notification.Queue)
+            try:
+                self.notification_queue.send_message(
+                    path,
+                    {
+                        "directory": directory,
+                        "old_entry": old.to_dict() if old else None,
+                        "new_entry": new.to_dict() if new else None,
+                        "ts_ns": ts,
+                        "signatures": sigs,
+                    },
+                )
             except Exception:
                 pass
 

