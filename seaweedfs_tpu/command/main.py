@@ -50,6 +50,10 @@ COMMANDS: dict[str, tuple[str, str, str]] = {
         "seaweedfs_tpu.command.fix", "run",
         "rebuild a volume .idx from its .dat",
     ),
+    "webdav": (
+        "seaweedfs_tpu.command.server_cmds", "run_webdav",
+        "start the WebDAV gateway against a filer",
+    ),
     "filer.sync": (
         "seaweedfs_tpu.command.filer_sync", "run_filer_sync",
         "continuous bidirectional sync between two filers",

@@ -237,3 +237,23 @@ def run_s3(args: list[str]) -> int:
     s3.start()
     print(f"s3 gateway listening at {s3.url}")
     return _wait_forever()
+
+
+def run_webdav(args: list[str]) -> int:
+    """WebDAV gateway against a running filer (`weed/command/webdav.go`)."""
+    p = argparse.ArgumentParser(prog="weed-tpu webdav")
+    p.add_argument("-port", type=int, default=7333)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-readOnly", action="store_true")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.server.webdav import WebDavServer
+
+    filer = opts.filer
+    if not filer.startswith("http"):
+        filer = f"http://{filer}"
+    srv = WebDavServer(filer, host=opts.ip, port=opts.port,
+                       read_only=opts.readOnly)
+    srv.start()
+    print(f"webdav listening at {srv.url}")
+    return _wait_forever()

@@ -201,6 +201,9 @@ class HTTPService:
                 service._dispatch(self)
 
             do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _handle
+            # WebDAV verbs (webdav_server.go surface)
+            do_OPTIONS = do_PROPFIND = do_PROPPATCH = do_MKCOL = _handle
+            do_MOVE = do_COPY = do_LOCK = do_UNLOCK = _handle
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]

@@ -1,0 +1,151 @@
+"""WebDAV gateway: PROPFIND/MKCOL/PUT/GET/MOVE/COPY/DELETE/LOCK over a live
+filer cluster."""
+
+import os
+from xml.etree import ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.server.httpd import http_request
+
+
+@pytest.fixture(scope="module")
+def dav(tmp_path_factory):
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.server.webdav import WebDavServer
+
+    tmp = tmp_path_factory.mktemp("dav")
+    master = MasterServer(port=0)
+    master.start()
+    vol = VolumeServer([str(tmp / "v")], master_url=master.url, port=0)
+    vol.start()
+    vol.heartbeat_once()
+    filer = FilerServer(master_url=master.url, port=0)
+    filer.start()
+    srv = WebDavServer(filer.url, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+NS = {"D": "DAV:"}
+
+
+def test_options_advertises_dav(dav):
+    status, headers, _ = http_request("OPTIONS", dav.url + "/")
+    assert status == 200
+    assert "1, 2" in headers.get("DAV", "")
+    assert "PROPFIND" in headers.get("Allow", "")
+
+
+def test_mkcol_put_get_propfind(dav):
+    status, _, _ = http_request("MKCOL", dav.url + "/work")
+    assert status == 201
+    payload = os.urandom(4000)
+    status, _, _ = http_request(
+        "PUT", dav.url + "/work/file.bin", body=payload,
+        headers={"Content-Type": "application/octet-stream"},
+    )
+    assert status == 201
+    status, _, body = http_request("GET", dav.url + "/work/file.bin")
+    assert status == 200 and body == payload
+    # ranged read
+    status, _, body = http_request(
+        "GET", dav.url + "/work/file.bin", headers={"Range": "bytes=100-199"}
+    )
+    assert status == 206 and body == payload[100:200]
+
+    status, _, body = http_request(
+        "PROPFIND", dav.url + "/work", headers={"Depth": "1"}
+    )
+    assert status == 207
+    root = ET.fromstring(body)
+    hrefs = [r.find("D:href", NS).text for r in root.findall("D:response", NS)]
+    assert any(h.rstrip("/").endswith("/work") for h in hrefs)
+    assert any(h.endswith("/work/file.bin") for h in hrefs)
+    # file response carries a content length
+    for r in root.findall("D:response", NS):
+        if r.find("D:href", NS).text.endswith("file.bin"):
+            length = r.find(".//D:getcontentlength", NS)
+            assert length is not None and int(length.text) == 4000
+
+
+def test_propfind_depth_zero(dav):
+    status, _, body = http_request(
+        "PROPFIND", dav.url + "/", headers={"Depth": "0"}
+    )
+    assert status == 207
+    root = ET.fromstring(body)
+    assert len(root.findall("D:response", NS)) == 1
+
+
+def test_move_and_copy(dav):
+    http_request("MKCOL", dav.url + "/mv")
+    http_request("PUT", dav.url + "/mv/a.txt", body=b"move me")
+    status, _, _ = http_request(
+        "MOVE", dav.url + "/mv/a.txt",
+        headers={"Destination": dav.url + "/mv/b.txt"},
+    )
+    assert status in (201, 204)
+    assert http_request("GET", dav.url + "/mv/a.txt")[0] == 404
+    assert http_request("GET", dav.url + "/mv/b.txt")[2] == b"move me"
+
+    status, _, _ = http_request(
+        "COPY", dav.url + "/mv/b.txt",
+        headers={"Destination": dav.url + "/mv/c.txt"},
+    )
+    assert status in (201, 204)
+    assert http_request("GET", dav.url + "/mv/b.txt")[2] == b"move me"
+    assert http_request("GET", dav.url + "/mv/c.txt")[2] == b"move me"
+    # Overwrite: F refuses
+    status, _, _ = http_request(
+        "COPY", dav.url + "/mv/b.txt",
+        headers={"Destination": dav.url + "/mv/c.txt", "Overwrite": "F"},
+    )
+    assert status == 412
+
+
+def test_delete_collection(dav):
+    http_request("MKCOL", dav.url + "/gone")
+    http_request("PUT", dav.url + "/gone/x.txt", body=b"x")
+    status, _, _ = http_request("DELETE", dav.url + "/gone")
+    assert status == 204
+    assert http_request("GET", dav.url + "/gone/x.txt")[0] == 404
+
+
+def test_lock_unlock(dav):
+    http_request("PUT", dav.url + "/locked.txt", body=b"v")
+    status, headers, body = http_request(
+        "LOCK", dav.url + "/locked.txt",
+        body=b'<?xml version="1.0"?><D:lockinfo xmlns:D="DAV:">'
+             b"<D:lockscope><D:exclusive/></D:lockscope>"
+             b"<D:locktype><D:write/></D:locktype></D:lockinfo>",
+    )
+    assert status == 200
+    token = headers.get("Lock-Token", "")
+    assert token.startswith("<opaquelocktoken:")
+    assert b"lockdiscovery" in body
+    status, _, _ = http_request(
+        "UNLOCK", dav.url + "/locked.txt", headers={"Lock-Token": token}
+    )
+    assert status == 204
+
+
+def test_read_only_mode(dav):
+    from seaweedfs_tpu.server.webdav import WebDavServer
+
+    ro = WebDavServer(dav.fc.filer_url if hasattr(dav.fc, "filer_url")
+                      else dav.fc._base, port=0, read_only=True)
+    ro.start()
+    try:
+        status, _, _ = http_request("PUT", ro.url + "/nope.txt", body=b"x")
+        assert status == 403
+        status, _, _ = http_request("MKCOL", ro.url + "/nope")
+        assert status == 403
+    finally:
+        ro.stop()
