@@ -176,6 +176,7 @@ class VolumeServer:
     # --- routes -------------------------------------------------------------------
     def _routes(self) -> None:
         svc = self.service
+        self._register_query_route(svc)
 
         @svc.route("GET", FID_RE)
         def read(req: Request) -> Response:
@@ -662,8 +663,50 @@ class VolumeServer:
                 start = SUPER_BLOCK_SIZE
             import os
 
-            data = os.pread(v._fd, v.size() - start, start)
+            data = v._dat.read_at(v.size() - start, start)
             return Response(data, content_type="application/octet-stream")
+
+    def _register_query_route(self, svc) -> None:
+        """S3-Select-ish content filtering (`volume_grpc_query.go:12`)."""
+
+        @svc.route("POST", r"/query")
+        def query(req: Request) -> Response:
+            from seaweedfs_tpu.query import query_csv, query_json_lines
+
+            p = req.json()
+            fid = p.get("fid", "")
+            try:
+                vid_s, _, rest = fid.partition(",")
+                vid = int(vid_s)
+                key, cookie = parse_key_hash_with_delta(rest)
+            except (ValueError, AttributeError):
+                return Response({"error": f"bad fid {fid!r}"}, 400)
+            try:
+                n = self.store.read(vid, key, cookie=cookie)
+            except (NotFound, VolumeError) as e:
+                return Response({"error": str(e)}, 404)
+            data = n.data
+            if n.is_compressed():
+                from seaweedfs_tpu.util.compression import decompress_data
+
+                data = decompress_data(data)
+            kind = p.get("type", "json")
+            select = p.get("select")
+            where = p.get("where")
+            limit = int(p.get("limit", 0))
+            try:
+                if kind == "csv":
+                    rows = query_csv(
+                        data, select, where,
+                        has_header=bool(p.get("header", True)),
+                        delimiter=p.get("delimiter", ","),
+                        limit=limit,
+                    )
+                else:
+                    rows = query_json_lines(data, select, where, limit=limit)
+            except ValueError as e:
+                return Response({"error": str(e)}, 400)
+            return Response({"rows": rows, "count": len(rows)})
 
     def _pull_file(
         self, source: str, vid: int, collection: str, ext: str, dest: str,
@@ -723,6 +766,28 @@ class VolumeServer:
         if n.is_compressed():
             headers["Content-Encoding"] = "gzip"
         data = n.data
+        # on-read resize/crop hook (`volume_server_handlers_read.go:310-370`)
+        if not n.is_compressed() and (
+            "width" in req.query or "height" in req.query
+        ):
+            from seaweedfs_tpu.images import RESIZABLE_MIME, resized
+
+            guessed = mime
+            if guessed == "application/octet-stream" and n.has_name() and n.name:
+                ext = n.name.decode("utf-8", "replace").rsplit(".", 1)[-1].lower()
+                guessed = {"jpg": "image/jpeg", "jpeg": "image/jpeg",
+                           "png": "image/png", "gif": "image/gif",
+                           "webp": "image/webp"}.get(ext, guessed)
+            if guessed in RESIZABLE_MIME:
+                def _int(qk):
+                    try:
+                        return int(req.query.get(qk, "") or 0) or None
+                    except ValueError:
+                        return None
+
+                data = resized(data, guessed, _int("width"), _int("height"),
+                               req.query.get("mode", ""))
+                mime = guessed
         # range support
         rng = req.headers.get("Range")
         status = 200
@@ -771,6 +836,16 @@ class VolumeServer:
             mime = req.headers.get("Content-Type", "")
             if mime in ("application/json", "application/x-www-form-urlencoded"):
                 mime = ""
+        # EXIF orientation fix on upload (`needle.go:101-106`: .jpg only,
+        # and only when the client isn't asking for raw bytes back)
+        is_jpg = (
+            mime == "image/jpeg"
+            or filename.lower().endswith((".jpg", ".jpeg"))
+        )
+        if is_jpg and not is_replicate:
+            from seaweedfs_tpu.images import fix_jpg_orientation
+
+            data = fix_jpg_orientation(data)
         n = Needle(cookie=cookie, id=key, data=data)
         if filename:
             n.name = filename.encode()

@@ -1,0 +1,231 @@
+"""Query/select predicate machinery + /query endpoint; image resize/crop
+and EXIF orientation hooks."""
+
+import io
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.query import (
+    get_path,
+    matches,
+    query_csv,
+    query_json_lines,
+)
+
+
+class TestQueryEngine:
+    DOCS = b"\n".join(
+        json.dumps(d).encode()
+        for d in [
+            {"name": "alice", "age": 31, "address": {"city": "sf"}},
+            {"name": "bob", "age": 25, "address": {"city": "nyc"}},
+            {"name": "carol", "age": 41, "address": {"city": "sf"}},
+        ]
+    )
+
+    def test_get_path_nested(self):
+        d = {"a": {"b": [{"c": 5}]}}
+        assert get_path(d, "a.b.0.c") == 5
+        assert get_path(d, "a.x") is None
+
+    def test_where_ops(self):
+        d = {"age": 30, "name": "zed"}
+        assert matches(d, {"field": "age", "op": ">", "value": 21})
+        assert not matches(d, {"field": "age", "op": "<", "value": 21})
+        assert matches(d, {"field": "name", "op": "like", "value": "%ze%"})
+        assert matches(d, {"and": [
+            {"field": "age", "op": ">=", "value": 30},
+            {"field": "name", "op": "=", "value": "zed"},
+        ]})
+        assert matches(d, {"or": [
+            {"field": "age", "op": "=", "value": 1},
+            {"field": "name", "op": "=", "value": "zed"},
+        ]})
+        assert matches(d, {"not": {"field": "age", "op": "=", "value": 1}})
+
+    def test_json_lines_select_where(self):
+        rows = query_json_lines(
+            self.DOCS, select=["name"],
+            where={"field": "address.city", "op": "=", "value": "sf"},
+        )
+        assert rows == [{"name": "alice"}, {"name": "carol"}]
+
+    def test_json_array_input(self):
+        arr = json.dumps([{"x": 1}, {"x": 2}]).encode()
+        assert query_json_lines(arr, where={"field": "x", "op": ">", "value": 1}) \
+            == [{"x": 2}]
+
+    def test_numeric_string_coercion(self):
+        rows = query_json_lines(
+            self.DOCS, where={"field": "age", "op": ">", "value": "30"}
+        )
+        assert {r["name"] for r in rows} == {"alice", "carol"}
+
+    def test_csv(self):
+        data = b"name,qty\nwidget,5\ngadget,12\n"
+        rows = query_csv(data, select=["name"],
+                         where={"field": "qty", "op": ">", "value": 10})
+        assert rows == [{"name": "gadget"}]
+        rows2 = query_csv(b"a;b\n1;2\n", delimiter=";")
+        assert rows2 == [{"a": "1", "b": "2"}]
+        rows3 = query_csv(b"7,8\n", has_header=False)
+        assert rows3 == [{"_1": "7", "_2": "8"}]
+
+    def test_limit(self):
+        rows = query_json_lines(self.DOCS, limit=2)
+        assert len(rows) == 2
+
+
+def _png(w, h, color=(200, 30, 30)):
+    from PIL import Image
+
+    img = Image.new("RGB", (w, h), color)
+    buf = io.BytesIO()
+    img.save(buf, "PNG")
+    return buf.getvalue()
+
+
+def _jpg(w, h, orientation=None):
+    from PIL import Image
+
+    img = Image.new("RGB", (w, h), (10, 120, 10))
+    buf = io.BytesIO()
+    if orientation:
+        exif = Image.Exif()
+        exif[274] = orientation
+        img.save(buf, "JPEG", exif=exif.tobytes())
+    else:
+        img.save(buf, "JPEG")
+    return buf.getvalue()
+
+
+class TestImages:
+    def test_resize_proportional(self):
+        from PIL import Image
+
+        from seaweedfs_tpu.images import resized
+
+        out = resized(_png(400, 200), "image/png", 100, None)
+        img = Image.open(io.BytesIO(out))
+        assert img.size == (100, 50)
+
+    def test_resize_fill_crops(self):
+        from PIL import Image
+
+        from seaweedfs_tpu.images import resized
+
+        out = resized(_png(400, 200), "image/png", 100, 100, mode="fill")
+        assert Image.open(io.BytesIO(out)).size == (100, 100)
+
+    def test_resize_fit_letterboxes(self):
+        from PIL import Image
+
+        from seaweedfs_tpu.images import resized
+
+        out = resized(_png(400, 200), "image/png", 100, 100, mode="fit")
+        assert Image.open(io.BytesIO(out)).size == (100, 100)
+
+    def test_non_image_passthrough(self):
+        from seaweedfs_tpu.images import resized
+
+        blob = b"not an image"
+        assert resized(blob, "text/plain", 10, 10) == blob
+        assert resized(blob, "image/png", 10, 10) == blob  # decode fails
+
+    def test_orientation_fix(self):
+        from PIL import Image
+
+        from seaweedfs_tpu.images import fix_jpg_orientation
+
+        rotated = _jpg(80, 40, orientation=6)  # stored rotated 90cw
+        fixed = fix_jpg_orientation(rotated)
+        img = Image.open(io.BytesIO(fixed))
+        # 6 = needs 270 rotation -> dimensions swap
+        assert img.size == (40, 80)
+        assert img.getexif().get(274, 1) == 1
+        # idempotent
+        assert len(fix_jpg_orientation(fixed)) == len(fixed)
+
+    def test_orientation_noop_when_upright(self):
+        from seaweedfs_tpu.images import fix_jpg_orientation
+
+        plain = _jpg(50, 50)
+        assert fix_jpg_orientation(plain) == plain
+
+
+class TestVolumeServerHooks:
+    @pytest.fixture(scope="class")
+    def cluster(self, tmp_path_factory):
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume import VolumeServer
+
+        tmp = tmp_path_factory.mktemp("qi")
+        master = MasterServer(port=0)
+        master.start()
+        vol = VolumeServer([str(tmp / "v")], master_url=master.url, port=0)
+        vol.start()
+        vol.heartbeat_once()
+        yield master, vol
+        vol.stop()
+        master.stop()
+
+    def _put(self, master, name, payload, mime):
+        from seaweedfs_tpu.server.httpd import http_request
+
+        status, _, body = http_request("GET", master.url + "/dir/assign")
+        out = json.loads(body)
+        fid, vurl = out["fid"], "http://" + out["url"]
+        status, _, _ = http_request(
+            "POST", f"{vurl}/{fid}", body=payload,
+            headers={"Content-Type": mime, "X-File-Name": name},
+        )
+        assert status == 201
+        return fid, vurl
+
+    def test_query_endpoint(self, cluster):
+        from seaweedfs_tpu.server.httpd import http_request
+
+        master, vol = cluster
+        docs = b'{"kind":"a","v":1}\n{"kind":"b","v":2}\n{"kind":"a","v":3}\n'
+        fid, vurl = self._put(master, "data.jsonl", docs, "application/json")
+        status, _, body = http_request(
+            "POST", f"{vurl}/query",
+            body=json.dumps({
+                "fid": fid,
+                "select": ["v"],
+                "where": {"field": "kind", "op": "=", "value": "a"},
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        assert status == 200
+        out = json.loads(body)
+        assert out["count"] == 2 and out["rows"] == [{"v": 1}, {"v": 3}]
+
+    def test_read_resize_hook(self, cluster):
+        from PIL import Image
+
+        from seaweedfs_tpu.server.httpd import http_request
+
+        master, vol = cluster
+        fid, vurl = self._put(master, "pic.png", _png(300, 150), "image/png")
+        status, _, body = http_request("GET", f"{vurl}/{fid}?width=60")
+        assert status == 200
+        assert Image.open(io.BytesIO(body)).size == (60, 30)
+        # untouched without query
+        status, _, body = http_request("GET", f"{vurl}/{fid}")
+        assert Image.open(io.BytesIO(body)).size == (300, 150)
+
+    def test_upload_orientation_hook(self, cluster):
+        from PIL import Image
+
+        from seaweedfs_tpu.server.httpd import http_request
+
+        master, vol = cluster
+        fid, vurl = self._put(
+            master, "cam.jpg", _jpg(90, 30, orientation=6), "image/jpeg"
+        )
+        status, _, body = http_request("GET", f"{vurl}/{fid}")
+        img = Image.open(io.BytesIO(body))
+        assert img.size == (30, 90)  # stored upright
