@@ -98,6 +98,8 @@ def run_filer(args: list[str]) -> int:
     p.add_argument("-notification.spool", dest="notification_spool",
                    default=None,
                    help="publish metadata events to this file-queue spool dir")
+    p.add_argument("-peers", default="",
+                   help="comma-separated peer filer urls (lock ring + meta sync)")
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.filer import FilerServer
 
@@ -120,6 +122,8 @@ def run_filer(args: list[str]) -> int:
         compress=opts.compressData == "true",
         chunk_cache_dir=opts.chunkCacheDir,
         notification_queue=queue,
+        peers=[u if u.startswith("http") else f"http://{u}"
+               for u in opts.peers.split(",") if u],
     )
     f.start()
     print(f"filer listening at {f.url}")

@@ -55,6 +55,7 @@ class FilerServer:
         compress: bool = True,
         chunk_cache_dir: str | None = None,
         notification_queue=None,
+        peers: list[str] | None = None,
     ) -> None:
         from seaweedfs_tpu.security import Guard, SecurityConfig
 
@@ -83,14 +84,50 @@ class FilerServer:
         from seaweedfs_tpu.util.chunk_cache import TieredChunkCache
 
         self.chunk_cache = TieredChunkCache(disk_dir=chunk_cache_dir)
+        # distributed lock manager hosted on the filer group (weed/cluster)
+        from seaweedfs_tpu.cluster import DistributedLockManager, LockRing
+
+        self.lock_ring = LockRing()
+        self.dlm = DistributedLockManager()
+        self._static_peers = list(peers or [])
+        self._register_stop = __import__("threading").Event()
         self._routes()
 
     def start(self) -> None:
+        import threading
+
         self.service.start()
         if self.metrics_service is not None:
             self.metrics_service.start()
+        self.dlm.host = self.url
+        self.lock_ring.set_servers(self._static_peers + [self.url])
+        self._register_once()
+        t = threading.Thread(target=self._register_loop, daemon=True)
+        t.start()
+
+    def _register_once(self) -> None:
+        """Announce to the master's cluster membership (`cluster.go` rides
+        KeepConnected; here the equivalent periodic POST)."""
+        try:
+            from seaweedfs_tpu.server.httpd import http_request
+
+            http_request(
+                "POST", self.client.master_url + "/cluster/register",
+                body=json.dumps(
+                    {"type": "filer", "address": self.url}
+                ).encode(),
+                headers={"Content-Type": "application/json"}, timeout=5,
+            )
+        except Exception:
+            pass
+
+    def _register_loop(self) -> None:
+        while not self._register_stop.wait(5.0):
+            self._register_once()
+            self.dlm.sweep()
 
     def stop(self) -> None:
+        self._register_stop.set()
         self.service.stop()
         if self.metrics_service is not None:
             self.metrics_service.stop()
@@ -186,6 +223,49 @@ class FilerServer:
                 {"events": events, "next_ts_ns": next_ts,
                  "signature": self.filer.signature}
             )
+
+        # --- distributed lock manager (weed/cluster/lock_manager) ---
+        @svc.route("POST", r"/__dlm__/lock")
+        def dlm_lock(req: Request) -> Response:
+            from seaweedfs_tpu.cluster import LockedError
+
+            p = req.json()
+            key = p["key"]
+            target = self.lock_ring.server_for(key)
+            if target and target != self.url:
+                return Response({"moved_to": target}, 307)
+            try:
+                token, expires = self.dlm.lock(
+                    key, p.get("owner", "?"), float(p.get("ttl_sec", 30)),
+                    token=p.get("token", ""),
+                )
+            except LockedError as e:
+                return Response({"error": str(e), "owner": e.owner}, 409)
+            return Response(
+                {"ok": True, "token": token, "expires_at": expires}
+            )
+
+        @svc.route("POST", r"/__dlm__/unlock")
+        def dlm_unlock(req: Request) -> Response:
+            from seaweedfs_tpu.cluster import LockedError
+
+            p = req.json()
+            key = p["key"]
+            target = self.lock_ring.server_for(key)
+            if target and target != self.url:
+                return Response({"moved_to": target}, 307)
+            try:
+                self.dlm.unlock(key, p.get("token", ""))
+            except LockedError as e:
+                return Response({"error": str(e), "owner": e.owner}, 409)
+            return Response({"ok": True})
+
+        @svc.route("GET", r"/__dlm__/status")
+        def dlm_status(req: Request) -> Response:
+            return Response({
+                "ring": self.lock_ring.servers(),
+                "host": self.url,
+            })
 
         @svc.route("GET", r"/__meta__/info")
         def meta_info(req: Request) -> Response:

@@ -270,12 +270,30 @@ class MasterServer:
             """Filers/brokers announce themselves (the reference rides this on
             the KeepConnected stream, `weed/cluster/cluster.go`)."""
             p = req.json()
+            prev = self._members.get(p["address"])
             self._members[p["address"]] = {
                 "type": p.get("type", "filer"),
                 "address": p["address"],
                 "last_seen": time.time(),
+                # first-seen decides group leadership (`cluster.go` — the
+                # longest-lived member leads its group)
+                "created_ts": prev["created_ts"] if prev else time.time(),
             }
             return Response({"ok": True, "leader": self.url})
+
+        @svc.route("GET", r"/cluster/leader")
+        def cluster_leader(req: Request) -> Response:
+            kind = req.query.get("type", "filer")
+            now = time.time()
+            live = [
+                m for m in self._members.values()
+                if m["type"] == kind
+                and now - m["last_seen"] < 3 * max(self.topo.pulse_seconds, 5)
+            ]
+            if not live:
+                return Response({"error": f"no live {kind} members"}, 404)
+            leader = min(live, key=lambda m: (m["created_ts"], m["address"]))
+            return Response({"leader": leader["address"], "type": kind})
 
         @svc.route("GET", r"/cluster/ps")
         def cluster_ps(req: Request) -> Response:

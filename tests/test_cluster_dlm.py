@@ -1,0 +1,148 @@
+"""Cluster membership (register/ps/leader) + distributed lock manager
+(ring assignment, TTL locks, renew tokens, redirects)."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster import (
+    DistributedLockManager,
+    LockClient,
+    LockedError,
+    LockRing,
+)
+
+
+class TestLockRing:
+    def test_deterministic_assignment(self):
+        ring = LockRing(["http://a:1", "http://b:2", "http://c:3"])
+        owner1 = ring.server_for("some/key")
+        assert owner1 == ring.server_for("some/key")
+        # keys spread over servers
+        owners = {ring.server_for(f"k{i}") for i in range(64)}
+        assert len(owners) >= 2
+
+    def test_stability_under_member_add(self):
+        ring = LockRing(["http://a:1", "http://b:2"])
+        before = {f"k{i}": ring.server_for(f"k{i}") for i in range(100)}
+        ring.set_servers(["http://a:1", "http://b:2", "http://c:3"])
+        moved = sum(
+            1 for k, v in before.items() if ring.server_for(k) != v
+        )
+        # rendezvous hashing: only ~1/3 of keys may move
+        assert moved < 60
+
+    def test_empty_ring(self):
+        assert LockRing().server_for("x") is None
+
+
+class TestDLM:
+    def test_lock_conflict_and_expiry(self):
+        dlm = DistributedLockManager()
+        token, _ = dlm.lock("job", "alice", ttl_sec=0.2)
+        with pytest.raises(LockedError):
+            dlm.lock("job", "bob", ttl_sec=1)
+        time.sleep(0.25)
+        token2, _ = dlm.lock("job", "bob", ttl_sec=1)  # expired -> ok
+        assert token2 != token
+        assert dlm.owner_of("job") == "bob"
+
+    def test_renew_with_token(self):
+        dlm = DistributedLockManager()
+        token, exp1 = dlm.lock("r", "alice", ttl_sec=0.5)
+        time.sleep(0.1)
+        token2, exp2 = dlm.lock("r", "alice", ttl_sec=0.5, token=token)
+        assert token2 == token and exp2 > exp1
+
+    def test_unlock_requires_token(self):
+        dlm = DistributedLockManager()
+        token, _ = dlm.lock("u", "alice", ttl_sec=5)
+        with pytest.raises(LockedError):
+            dlm.unlock("u", "wrong-token")
+        assert dlm.unlock("u", token)
+        assert dlm.owner_of("u") is None
+
+    def test_sweep(self):
+        dlm = DistributedLockManager()
+        dlm.lock("s1", "a", ttl_sec=0.05)
+        dlm.lock("s2", "a", ttl_sec=60)
+        time.sleep(0.1)
+        assert dlm.sweep() == 1
+        assert dlm.owner_of("s2") == "a"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    tmp = tmp_path_factory.mktemp("dlm")
+    master = MasterServer(port=0)
+    master.start()
+    vol = VolumeServer([str(tmp / "v")], master_url=master.url, port=0)
+    vol.start()
+    vol.heartbeat_once()
+    f1 = FilerServer(master_url=master.url, port=0)
+    f1.start()
+    f2 = FilerServer(master_url=master.url, port=0, peers=[f1.url])
+    f2.start()
+    # let f1 know about f2 (static peers both ways, like -peers flags)
+    f1.lock_ring.set_servers([f1.url, f2.url])
+    yield master, f1, f2
+    f2.stop()
+    f1.stop()
+    vol.stop()
+    master.stop()
+
+
+class TestClusterMembership:
+    def test_register_ps_leader(self, cluster):
+        from seaweedfs_tpu.server.httpd import http_request
+
+        master, f1, f2 = cluster
+        status, _, body = http_request("GET", master.url + "/cluster/ps")
+        ps = json.loads(body)
+        addrs = {m["address"] for m in ps["filers"]}
+        assert f1.url in addrs and f2.url in addrs
+        status, _, body = http_request("GET", master.url + "/cluster/leader?type=filer")
+        assert status == 200
+        leader = json.loads(body)["leader"]
+        assert leader in (f1.url, f2.url)
+        # leadership is stable across calls
+        status, _, body2 = http_request(
+            "GET", master.url + "/cluster/leader?type=filer"
+        )
+        assert json.loads(body2)["leader"] == leader
+
+    def test_no_leader_for_unknown_type(self, cluster):
+        from seaweedfs_tpu.server.httpd import http_request
+
+        master, _, _ = cluster
+        status, _, _ = http_request(
+            "GET", master.url + "/cluster/leader?type=broker"
+        )
+        assert status == 404
+
+
+class TestDLMOverHTTP:
+    def test_lock_follows_ring_and_conflicts(self, cluster):
+        _, f1, f2 = cluster
+        alice = LockClient(f1.url, "alice")
+        bob = LockClient(f2.url, "bob")  # enters via the other filer
+        url, token = alice.lock("/buckets/demo", ttl_sec=5)
+        with pytest.raises(LockedError):
+            bob.lock("/buckets/demo", ttl_sec=5)
+        alice.unlock("/buckets/demo", token, url=url)
+        url2, token2 = bob.lock("/buckets/demo", ttl_sec=5)
+        assert url2 == url  # ring assigns the key to one filer consistently
+        bob.unlock("/buckets/demo", token2, url=url2)
+
+    def test_renew_via_token(self, cluster):
+        _, f1, _ = cluster
+        c = LockClient(f1.url, "renewer")
+        url, token = c.lock("renew/key", ttl_sec=1)
+        url2, token2 = c.lock("renew/key", ttl_sec=5, token=token)
+        assert token2 == token
+        c.unlock("renew/key", token, url=url)
