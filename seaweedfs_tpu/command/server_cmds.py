@@ -30,6 +30,8 @@ def run_master(args: list[str]) -> int:
     p.add_argument("-defaultReplication", default="000")
     p.add_argument("-garbageThreshold", type=float, default=0.3)
     p.add_argument("-pulseSeconds", type=int, default=5)
+    p.add_argument("-peers", default="",
+                   help="comma-separated master urls (raft HA; include self)")
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.master import MasterServer
 
@@ -41,6 +43,9 @@ def run_master(args: list[str]) -> int:
         default_replication=opts.defaultReplication,
         meta_dir=opts.mdir,
         garbage_threshold=opts.garbageThreshold,
+        peers=[u if u.startswith("http") else f"http://{u}"
+               for u in opts.peers.split(",") if u],
+        raft_dir=opts.mdir,
     )
     m.start()
     print(f"master listening at {m.url}")

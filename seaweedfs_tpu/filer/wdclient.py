@@ -19,7 +19,13 @@ class WeedClient:
     def __init__(
         self, master_url: str, cache_ttl: float = 30.0, jwt_key: str = ""
     ) -> None:
-        self.master_url = master_url.rstrip("/")
+        # comma-separated master list; requests follow raft leader hints
+        # (`wdclient/masterclient.go` leader failover)
+        self.masters = [
+            (u if u.startswith("http") else f"http://{u}").rstrip("/")
+            for u in master_url.split(",") if u
+        ]
+        self.master_url = self.masters[0]
         self.cache_ttl = cache_ttl
         self.jwt_key = jwt_key  # shared security.toml signing key
         self._vid_cache: dict[int, tuple[float, list[str]]] = {}
@@ -43,7 +49,37 @@ class WeedClient:
             qs += f"&ttl={ttl}"
         if data_center:
             qs += f"&dataCenter={data_center}"
-        return get_json(f"{self.master_url}/dir/assign?{qs}")
+        return self._master_get(f"/dir/assign?{qs}")
+
+    def _master_get(self, path_qs: str) -> dict:
+        """GET against the current master, following `raft.not.leader`
+        hints and rotating through the configured master list."""
+        import json as _json
+
+        from seaweedfs_tpu.server.httpd import http_request
+
+        rotation = [u for u in self.masters if u != self.master_url]
+        last_err: Exception | None = None
+        for _ in range(len(self.masters) + 2):
+            try:
+                status, _, body = http_request(
+                    "GET", self.master_url + path_qs, timeout=30
+                )
+                data = _json.loads(body) if body else {}
+            except Exception as e:
+                last_err = e
+                if rotation:
+                    self.master_url = rotation.pop(0)
+                    continue
+                raise
+            if status < 400:
+                return data
+            leader = data.get("leader")
+            if data.get("error") == "raft.not.leader" and leader:
+                self.master_url = leader.rstrip("/")
+                continue
+            raise IOError(f"GET {path_qs} -> {status}: {data}")
+        raise last_err or IOError(f"GET {path_qs}: no master reachable")
 
     # --- lookup -----------------------------------------------------------------
     def lookup(self, vid: int) -> list[str]:
@@ -52,7 +88,7 @@ class WeedClient:
             hit = self._vid_cache.get(vid)
             if hit and hit[0] > now:
                 return hit[1]
-        info = get_json(f"{self.master_url}/dir/lookup?volumeId={vid}")
+        info = self._master_get(f"/dir/lookup?volumeId={vid}")
         urls = [loc["publicUrl"] or loc["url"] for loc in info.get("locations", [])]
         if not urls:
             raise IOError(f"volume {vid} has no locations")

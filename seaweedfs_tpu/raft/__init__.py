@@ -1,0 +1,397 @@
+"""Raft consensus for master HA.
+
+Behavioral port of the reference's master replication layer
+(`weed/server/raft_server.go`, `raft_hashicorp.go`,
+`master_grpc_server_raft.go`): masters elect a leader; the leader owns
+volume-id allocation and the file-id sequence; followers redirect clients
+to the leader; on failover the replicated state machine (max volume id +
+sequence ceiling) carries over so ids are never reused.
+
+This is a compact, standard Raft (election + log replication + persistence
++ commit/apply), transported over the masters' existing HTTP plane
+(`POST /raft/request_vote`, `POST /raft/append_entries`). Log compaction is
+not needed at master-state volumes (two tiny command types); the log is
+periodically checkpointed into `state.json` instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Callable
+
+
+class NotLeader(Exception):
+    def __init__(self, leader: str | None) -> None:
+        super().__init__(f"not leader; leader={leader}")
+        self.leader = leader
+
+
+def _default_rpc(peer: str, method: str, payload: dict,
+                 timeout: float = 1.0) -> dict:
+    import json as _json
+
+    from seaweedfs_tpu.server.httpd import http_request
+
+    status, _, body = http_request(
+        "POST", f"{peer}/raft/{method}", body=_json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, timeout=timeout,
+    )
+    if status != 200:
+        raise IOError(f"raft rpc {method} -> {status}")
+    return _json.loads(body)
+
+
+class RaftNode:
+    def __init__(
+        self,
+        node_id: str,
+        peers: list[str],
+        apply_fn: Callable[[dict], object],
+        state_dir: str | None = None,
+        heartbeat_interval: float = 0.08,
+        election_timeout: tuple[float, float] = (0.3, 0.6),
+        rpc: Callable[..., dict] | None = None,
+    ) -> None:
+        self.id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.apply_fn = apply_fn
+        self.state_dir = state_dir
+        self.heartbeat_interval = heartbeat_interval
+        self.election_timeout = election_timeout
+        self.rpc = rpc or _default_rpc
+
+        self.mu = threading.RLock()
+        self.role = "follower"
+        self.current_term = 0
+        self.voted_for: str | None = None
+        self.log: list[dict] = []  # entries {term, index, command}; 1-indexed
+        self.commit_index = 0
+        self.last_applied = 0
+        self.leader_id: str | None = None
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        self._apply_results: dict[int, object] = {}
+        self._commit_cv = threading.Condition(self.mu)
+        self._last_heartbeat = time.monotonic()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._load()
+
+    # --- persistence ---------------------------------------------------------
+    def _state_path(self) -> str | None:
+        return os.path.join(self.state_dir, "raft_state.json") \
+            if self.state_dir else None
+
+    def _load(self) -> None:
+        p = self._state_path()
+        if p and os.path.exists(p):
+            with open(p) as f:
+                st = json.load(f)
+            self.current_term = st.get("term", 0)
+            self.voted_for = st.get("voted_for")
+            self.log = st.get("log", [])
+            self.commit_index = st.get("commit_index", 0)
+
+    def _persist(self) -> None:
+        p = self._state_path()
+        if not p:
+            return
+        os.makedirs(self.state_dir, exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "term": self.current_term,
+                "voted_for": self.voted_for,
+                "log": self.log,
+                "commit_index": self.commit_index,
+            }, f)
+        os.replace(tmp, p)
+
+    # --- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        self._stop.clear()
+        t = threading.Thread(target=self._ticker, daemon=True)
+        t.start()
+        self._threads.append(t)
+        # replay committed-but-unapplied state after restart
+        with self.mu:
+            self._apply_committed()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # --- helpers (callers hold mu) --------------------------------------------
+    def _last_log(self) -> tuple[int, int]:
+        if not self.log:
+            return 0, 0
+        e = self.log[-1]
+        return e["index"], e["term"]
+
+    def _entry(self, index: int) -> dict | None:
+        if 1 <= index <= len(self.log):
+            return self.log[index - 1]
+        return None
+
+    def _become_follower(self, term: int, leader: str | None = None) -> None:
+        self.role = "follower"
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+        if leader:
+            self.leader_id = leader
+        self._persist()
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            e = self._entry(self.last_applied)
+            if e is not None:
+                try:
+                    self._apply_results[self.last_applied] = \
+                        self.apply_fn(e["command"])
+                except Exception as exc:  # state machine must not kill raft
+                    self._apply_results[self.last_applied] = exc
+        self._commit_cv.notify_all()
+
+    # --- election ------------------------------------------------------------
+    def _ticker(self) -> None:
+        while not self._stop.is_set():
+            timeout = random.uniform(*self.election_timeout)
+            time.sleep(self.heartbeat_interval / 2)
+            with self.mu:
+                role = self.role
+                since = time.monotonic() - self._last_heartbeat
+            if role == "leader":
+                self._broadcast_heartbeats()
+                time.sleep(self.heartbeat_interval / 2)
+            elif since > timeout:
+                self._run_election()
+
+    def _run_election(self) -> None:
+        with self.mu:
+            self.role = "candidate"
+            self.current_term += 1
+            term = self.current_term
+            self.voted_for = self.id
+            self._last_heartbeat = time.monotonic()
+            self._persist()
+            last_index, last_term = self._last_log()
+            peers = list(self.peers)
+        votes = [1]  # self
+        done = threading.Event()
+
+        def ask(peer: str) -> None:
+            try:
+                out = self.rpc(peer, "request_vote", {
+                    "term": term, "candidate_id": self.id,
+                    "last_log_index": last_index, "last_log_term": last_term,
+                })
+            except Exception:
+                return
+            with self.mu:
+                if out.get("term", 0) > self.current_term:
+                    self._become_follower(out["term"])
+                    done.set()
+                    return
+                if out.get("vote_granted") and self.role == "candidate" \
+                        and self.current_term == term:
+                    votes[0] += 1
+                    if votes[0] * 2 > len(peers) + 1:
+                        self._become_leader_locked()
+                        done.set()
+
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+                   for p in peers]
+        for t in threads:
+            t.start()
+        if not peers:
+            with self.mu:
+                self._become_leader_locked()
+            return
+        done.wait(self.election_timeout[0])
+
+    def _become_leader_locked(self) -> None:
+        if self.role != "candidate":
+            return
+        self.role = "leader"
+        self.leader_id = self.id
+        last_index, _ = self._last_log()
+        self.next_index = {p: last_index + 1 for p in self.peers}
+        self.match_index = {p: 0 for p in self.peers}
+        # announce immediately — followers are near their election timeout
+        threading.Thread(
+            target=self._broadcast_heartbeats, daemon=True
+        ).start()
+
+    # --- replication ----------------------------------------------------------
+    def _broadcast_heartbeats(self) -> None:
+        for peer in self.peers:
+            threading.Thread(
+                target=self._replicate_to, args=(peer,), daemon=True
+            ).start()
+
+    def _replicate_to(self, peer: str) -> None:
+        with self.mu:
+            if self.role != "leader":
+                return
+            term = self.current_term
+            ni = self.next_index.get(peer, 1)
+            prev_index = ni - 1
+            prev_entry = self._entry(prev_index)
+            prev_term = prev_entry["term"] if prev_entry else 0
+            entries = self.log[ni - 1:]
+            commit = self.commit_index
+        try:
+            out = self.rpc(peer, "append_entries", {
+                "term": term, "leader_id": self.id,
+                "prev_log_index": prev_index, "prev_log_term": prev_term,
+                "entries": entries, "leader_commit": commit,
+            })
+        except Exception:
+            return
+        with self.mu:
+            if out.get("term", 0) > self.current_term:
+                self._become_follower(out["term"])
+                return
+            if self.role != "leader" or self.current_term != term:
+                return
+            if out.get("success"):
+                match = prev_index + len(entries)
+                self.match_index[peer] = max(
+                    self.match_index.get(peer, 0), match
+                )
+                self.next_index[peer] = self.match_index[peer] + 1
+                self._advance_commit()
+            else:
+                self.next_index[peer] = max(1, ni - 1)
+
+    def _advance_commit(self) -> None:
+        last_index, _ = self._last_log()
+        for n in range(last_index, self.commit_index, -1):
+            e = self._entry(n)
+            if e is None or e["term"] != self.current_term:
+                continue
+            count = 1 + sum(
+                1 for p in self.peers if self.match_index.get(p, 0) >= n
+            )
+            if count * 2 > len(self.peers) + 1:
+                self.commit_index = n
+                self._persist()
+                self._apply_committed()
+                break
+
+    # --- rpc handlers ---------------------------------------------------------
+    def handle_request_vote(self, p: dict) -> dict:
+        with self.mu:
+            # leader-lease check (hashicorp/raft CheckQuorum semantics): a
+            # node that heard from a live leader recently refuses to join a
+            # disruptive election — prevents term-inflation leadership flap
+            if (
+                p["term"] > self.current_term
+                and self.role == "follower"
+                and self.leader_id is not None
+                and time.monotonic() - self._last_heartbeat
+                < self.election_timeout[0]
+            ):
+                return {"term": self.current_term, "vote_granted": False}
+            if p["term"] > self.current_term:
+                self._become_follower(p["term"])
+            granted = False
+            if p["term"] == self.current_term and \
+                    self.voted_for in (None, p["candidate_id"]):
+                my_index, my_term = self._last_log()
+                up_to_date = (
+                    p["last_log_term"] > my_term
+                    or (p["last_log_term"] == my_term
+                        and p["last_log_index"] >= my_index)
+                )
+                if up_to_date:
+                    granted = True
+                    self.voted_for = p["candidate_id"]
+                    self._last_heartbeat = time.monotonic()
+                    self._persist()
+            return {"term": self.current_term, "vote_granted": granted}
+
+    def handle_append_entries(self, p: dict) -> dict:
+        with self.mu:
+            if p["term"] < self.current_term:
+                return {"term": self.current_term, "success": False}
+            self._last_heartbeat = time.monotonic()
+            if p["term"] > self.current_term or self.role != "follower":
+                self._become_follower(p["term"], p.get("leader_id"))
+            self.leader_id = p.get("leader_id")
+            prev_index = p["prev_log_index"]
+            if prev_index > 0:
+                e = self._entry(prev_index)
+                if e is None or e["term"] != p["prev_log_term"]:
+                    return {"term": self.current_term, "success": False}
+            # append, truncating conflicts
+            for entry in p["entries"]:
+                existing = self._entry(entry["index"])
+                if existing is not None and existing["term"] != entry["term"]:
+                    del self.log[entry["index"] - 1:]
+                    existing = None
+                if existing is None:
+                    self.log.append(entry)
+            if p["entries"]:
+                self._persist()
+            if p["leader_commit"] > self.commit_index:
+                last_index, _ = self._last_log()
+                self.commit_index = min(p["leader_commit"], last_index)
+                self._apply_committed()
+            return {"term": self.current_term, "success": True}
+
+    # --- client API -----------------------------------------------------------
+    def is_leader(self) -> bool:
+        with self.mu:
+            return self.role == "leader"
+
+    def leader(self) -> str | None:
+        with self.mu:
+            return self.leader_id if self.role != "leader" else self.id
+
+    def propose(self, command: dict, timeout: float = 5.0):
+        """Append via the leader; blocks until committed+applied; returns the
+        apply_fn result. Raises NotLeader elsewhere."""
+        with self.mu:
+            if self.role != "leader":
+                raise NotLeader(self.leader_id)
+            index = self._last_log()[0] + 1
+            self.log.append({
+                "term": self.current_term, "index": index, "command": command,
+            })
+            self._persist()
+            if not self.peers:  # single node: commit immediately
+                self.commit_index = index
+                self._persist()
+                self._apply_committed()
+        self._broadcast_heartbeats()
+        deadline = time.monotonic() + timeout
+        with self.mu:
+            while self.last_applied < index:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    raise TimeoutError(f"propose not committed in {timeout}s")
+                if self.role != "leader":
+                    raise NotLeader(self.leader_id)
+                self._commit_cv.wait(min(remain, 0.05))
+            result = self._apply_results.pop(index, None)
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    def status(self) -> dict:
+        with self.mu:
+            return {
+                "id": self.id,
+                "role": self.role,
+                "term": self.current_term,
+                "leader": self.leader_id if self.role != "leader" else self.id,
+                "commit_index": self.commit_index,
+                "log_length": len(self.log),
+                "peers": self.peers,
+            }

@@ -33,6 +33,8 @@ class MasterServer:
         meta_dir: str | None = None,
         garbage_threshold: float = 0.3,
         security: SecurityConfig | None = None,
+        peers: list[str] | None = None,
+        raft_dir: str | None = None,
     ) -> None:
         seq = MemorySequencer(f"{meta_dir}/sequence.json" if meta_dir else None)
         self.topo = Topology(
@@ -52,15 +54,80 @@ class MasterServer:
         # cluster membership (filers/brokers announce themselves) + admin lock
         self._members: dict[str, dict] = {}
         self._admin_lock: tuple[str, float] | None = None  # (holder, expiry)
+        # raft HA (weed/server/raft_server.go): created at start() once the
+        # listen port is known; None = single-master mode
+        self.raft = None
+        self._peer_config = list(peers or [])
+        self._raft_dir = raft_dir
+        self._seq_ceiling = 0
+        self._seq_synced = False  # leader synced sequencer past the ceiling
         self._routes()
 
     # --- lifecycle -------------------------------------------------------------
     def start(self) -> None:
         self.service.start()
+        if self._peer_config:
+            self.enable_raft(
+                [p.rstrip("/") for p in self._peer_config
+                 if p.rstrip("/") != self.url]
+            )
         threading.Thread(target=self._maintenance_loop, daemon=True).start()
+
+    def enable_raft(self, peer_urls: list[str]) -> None:
+        from seaweedfs_tpu.raft import RaftNode
+
+        self.raft = RaftNode(
+            self.url, peer_urls, self._raft_apply, state_dir=self._raft_dir
+        )
+        self.topo.vid_allocator = lambda: self.raft.propose(
+            {"type": "next_volume_id"}
+        )
+        self.raft.start()
+
+    def _raft_apply(self, command: dict):
+        """Replicated master state machine: volume-id counter + file-id
+        sequence ceiling (the two pieces the reference raft-persists)."""
+        kind = command.get("type")
+        if kind == "next_volume_id":
+            return self.topo._next_volume_id_raw()
+        if kind == "sequence_ceiling":
+            self._seq_ceiling = max(self._seq_ceiling, int(command["value"]))
+            return self._seq_ceiling
+        return None
+
+    def _is_leader(self) -> bool:
+        return self.raft is None or self.raft.is_leader()
+
+    def leader_url(self) -> str:
+        if self.raft is None or self.raft.is_leader():
+            return self.url
+        return self.raft.leader() or self.url
+
+    def _not_leader_response(self):
+        return Response(
+            {"error": "raft.not.leader", "leader": self.leader_url()}, 409
+        )
+
+    def _ensure_sequence_lease(self, count: int) -> None:
+        """Leader-side sequence lease (`sequence raft SetMax`): ids are only
+        handed out below the committed ceiling; a new leader fast-forwards
+        its counter to the ceiling so ids never repeat across failover."""
+        if self.raft is None:
+            return
+        seq = self.topo.sequencer
+        if not self._seq_synced:
+            seq.set_max(self._seq_ceiling)
+            self._seq_synced = True
+        while seq.peek() + count >= self._seq_ceiling:
+            self.raft.propose({
+                "type": "sequence_ceiling",
+                "value": seq.peek() + count + 10000,
+            })
 
     def stop(self) -> None:
         self._stop.set()
+        if self.raft is not None:
+            self.raft.stop()
         self.service.stop()
 
     @property
@@ -142,16 +209,44 @@ class MasterServer:
 
         @svc.route("POST", r"/heartbeat")
         def heartbeat(req: Request) -> Response:
+            if not self._is_leader():
+                # volume servers re-target to the leader (KeepConnected
+                # redirect semantics, `master_grpc_server.go`)
+                return self._not_leader_response()
             hb = req.json()
             self.topo.sync_heartbeat(hb)
             return Response(
                 {
                     "volume_size_limit": self.topo.volume_size_limit,
-                    "leader": self.url,
+                    "leader": self.leader_url(),
                 }
             )
 
+        # --- raft plane (`weed/server/raft_server.go` transport) ---
+        @svc.route("POST", r"/raft/request_vote")
+        def raft_request_vote(req: Request) -> Response:
+            if self.raft is None:
+                return Response({"error": "raft disabled"}, 503)
+            return Response(self.raft.handle_request_vote(req.json()))
+
+        @svc.route("POST", r"/raft/append_entries")
+        def raft_append_entries(req: Request) -> Response:
+            if self.raft is None:
+                return Response({"error": "raft disabled"}, 503)
+            return Response(self.raft.handle_append_entries(req.json()))
+
+        @svc.route("GET", r"/raft/status")
+        def raft_status(req: Request) -> Response:
+            if self.raft is None:
+                return Response({"enabled": False, "leader": self.url})
+            out = self.raft.status()
+            out["enabled"] = True
+            return Response(out)
+
         def do_assign(req: Request) -> Response:
+            if not self._is_leader():
+                self._seq_synced = False  # re-sync lease if re-elected later
+                return self._not_leader_response()
             count = int(req.query.get("count", 1))
             replication = req.query.get("replication") or self.default_replication
             collection = req.query.get("collection", "")
@@ -159,16 +254,25 @@ class MasterServer:
             dc = req.query.get("dataCenter", "")
             rp = ReplicaPlacement.parse(replication)
             ttl_u32 = TTL.parse(ttl).to_u32()
+            from seaweedfs_tpu.raft import NotLeader
+
             lo = self.topo.layout(collection, rp, ttl_u32)
             if lo.active_volume_count(dc) == 0:
                 try:
                     self._grow_volumes(collection, rp, ttl_u32, dc)
+                except NotLeader:
+                    self._seq_synced = False
+                    return self._not_leader_response()
                 except Exception as e:
                     return Response({"error": f"cannot grow volumes: {e}"}, 500)
             try:
+                self._ensure_sequence_lease(count)
                 fid, cnt, nodes = self.topo.pick_for_write(
                     count, replication, ttl, collection, dc
                 )
+            except NotLeader:
+                self._seq_synced = False
+                return self._not_leader_response()
             except NoWritableVolume:
                 # raced with a full/readonly transition: grow then retry once
                 try:
@@ -176,6 +280,9 @@ class MasterServer:
                     fid, cnt, nodes = self.topo.pick_for_write(
                         count, replication, ttl, collection, dc
                     )
+                except NotLeader:
+                    self._seq_synced = False
+                    return self._not_leader_response()
                 except (NoWritableVolume, Exception) as e:
                     return Response({"error": str(e)}, 404)
             main = nodes[0]
@@ -247,7 +354,8 @@ class MasterServer:
         @svc.route("GET", r"/cluster/status")
         def cluster_status(req: Request) -> Response:
             return Response(
-                {"IsLeader": True, "Leader": self.url, "MaxVolumeId": self.topo._max_volume_id}
+                {"IsLeader": self._is_leader(), "Leader": self.leader_url(),
+                 "MaxVolumeId": self.topo._max_volume_id}
             )
 
         @svc.route("GET", r"/vol/status")

@@ -43,7 +43,14 @@ class VolumeServer:
         max_volume_count: int = 100,
         security: SecurityConfig | None = None,
     ) -> None:
-        self.master_url = master_url.rstrip("/")
+        # -mserver may list several masters; heartbeats follow the raft
+        # leader hint (`volume_grpc_client_to_master.go` re-dial on redirect)
+        self.master_urls = [
+            u if u.startswith("http") else f"http://{u}"
+            for u in master_url.split(",") if u
+        ]
+        self.master_urls = [u.rstrip("/") for u in self.master_urls]
+        self.master_url = self.master_urls[0]
         self.security = security or SecurityConfig()
         self.service = HTTPService(host, port)
         if self.security.white_list:
@@ -89,17 +96,41 @@ class VolumeServer:
 
     # --- heartbeat --------------------------------------------------------------
     def heartbeat_once(self) -> None:
+        import json as _json
+
         hb = self.store.collect_heartbeat()
         hb["data_center"] = self.data_center
         hb["rack"] = self.rack
         hb["max_volume_count"] = self.max_volume_count
-        try:
-            resp = post_json(f"{self.master_url}/heartbeat", hb, timeout=10)
-            self.volume_size_limit = int(
-                resp.get("volume_size_limit", self.volume_size_limit)
-            )
-        except Exception:
-            pass
+        body = _json.dumps(hb).encode()
+        tried = 0
+        rotation = [u for u in self.master_urls if u != self.master_url]
+        while tried <= len(rotation) + 1:
+            tried += 1
+            try:
+                status, _, out = http_request(
+                    "POST", f"{self.master_url}/heartbeat", body=body,
+                    headers={"Content-Type": "application/json"}, timeout=10,
+                )
+                data = _json.loads(out) if out else {}
+            except Exception:
+                if rotation:
+                    self.master_url = rotation.pop(0)
+                    continue
+                return
+            if status == 200:
+                self.volume_size_limit = int(
+                    data.get("volume_size_limit", self.volume_size_limit)
+                )
+                return
+            leader = data.get("leader")
+            if data.get("error") == "raft.not.leader" and leader:
+                self.master_url = leader.rstrip("/")
+                continue
+            if rotation:
+                self.master_url = rotation.pop(0)
+                continue
+            return
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.pulse_seconds):

@@ -32,6 +32,7 @@ class Topology:
         self.sequencer = sequencer or MemorySequencer()
         self._layouts: dict[tuple[str, int, int], VolumeLayout] = {}
         self._max_volume_id = 0
+        self.vid_allocator = None  # raft propose hook (set by MasterServer)
         self._lock = threading.Lock()
         # ec shard map: vid -> {shard_id -> [DataNode]}
         self.ec_shards: dict[int, dict[int, list[DataNode]]] = {}
@@ -172,6 +173,18 @@ class Topology:
 
     # --- assign / lookup --------------------------------------------------------
     def next_volume_id(self) -> int:
+        # under raft the id allocation is a replicated command so every
+        # master agrees (`master_grpc_server_raft.go`); vid_allocator is the
+        # leader's propose hook, and the raft apply path calls
+        # _next_volume_id_raw on every node
+        if self.vid_allocator is not None:
+            vid = self.vid_allocator()
+            with self._lock:
+                self._max_volume_id = max(self._max_volume_id, vid)
+            return vid
+        return self._next_volume_id_raw()
+
+    def _next_volume_id_raw(self) -> int:
         with self._lock:
             self._max_volume_id += 1
             return self._max_volume_id

@@ -1,0 +1,253 @@
+"""Raft consensus: in-proc 3-node cluster (election, replication, failover,
+persistence) + 3-master HA with leader redirect, volume-id and sequence
+continuity across failover."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.raft import NotLeader, RaftNode
+
+
+class InProcTransport:
+    """rpc(peer, method, payload) routed to local RaftNode objects, with a
+    togglable partition set."""
+
+    def __init__(self) -> None:
+        self.nodes: dict[str, RaftNode] = {}
+        self.down: set[str] = set()
+
+    def rpc(self, peer: str, method: str, payload: dict, timeout: float = 1.0):
+        if peer in self.down or payload.get("leader_id") in self.down \
+                or payload.get("candidate_id") in self.down:
+            raise IOError("partitioned")
+        node = self.nodes[peer]
+        if method == "request_vote":
+            return node.handle_request_vote(payload)
+        if method == "append_entries":
+            return node.handle_append_entries(payload)
+        raise ValueError(method)
+
+
+def make_cluster(n=3, state_dirs=None):
+    tr = InProcTransport()
+    ids = [f"node{i}" for i in range(n)]
+    applied = {i: [] for i in ids}
+    nodes = []
+    for i, nid in enumerate(ids):
+        def apply_fn(cmd, nid=nid):
+            applied[nid].append(cmd)
+            return cmd.get("value")
+
+        node = RaftNode(
+            nid, [x for x in ids], apply_fn,
+            state_dir=state_dirs[i] if state_dirs else None,
+            heartbeat_interval=0.03, election_timeout=(0.1, 0.2),
+            rpc=tr.rpc,
+        )
+        tr.nodes[nid] = node
+        nodes.append(node)
+    return tr, nodes, applied
+
+
+def wait_leader(nodes, timeout=5.0, exclude=()):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [n for n in nodes
+                   if n.is_leader() and n.id not in exclude]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.02)
+    raise AssertionError("no single leader elected")
+
+
+class TestRaftCore:
+    def test_single_node_self_elects_and_commits(self):
+        tr, nodes, applied = make_cluster(1)
+        nodes[0].start()
+        try:
+            leader = wait_leader(nodes)
+            assert leader.propose({"type": "x", "value": 42}) == 42
+            assert applied["node0"] == [{"type": "x", "value": 42}]
+        finally:
+            nodes[0].stop()
+
+    def test_three_node_election_and_replication(self):
+        tr, nodes, applied = make_cluster(3)
+        for n in nodes:
+            n.start()
+        try:
+            leader = wait_leader(nodes)
+            for i in range(5):
+                leader.propose({"type": "set", "value": i})
+            time.sleep(0.3)  # followers catch up via heartbeats
+            for nid, cmds in applied.items():
+                assert [c["value"] for c in cmds] == [0, 1, 2, 3, 4], nid
+            # non-leader refuses proposals and names the leader
+            follower = next(n for n in nodes if not n.is_leader())
+            with pytest.raises(NotLeader) as ei:
+                follower.propose({"type": "set", "value": 9})
+            assert ei.value.leader == leader.id
+        finally:
+            for n in nodes:
+                n.stop()
+
+    def test_leader_failover_preserves_log(self):
+        tr, nodes, applied = make_cluster(3)
+        for n in nodes:
+            n.start()
+        try:
+            leader = wait_leader(nodes)
+            leader.propose({"type": "set", "value": "before"})
+            time.sleep(0.2)
+            tr.down.add(leader.id)  # partition the leader away
+            new_leader = wait_leader(nodes, exclude={leader.id})
+            assert new_leader.id != leader.id
+            new_leader.propose({"type": "set", "value": "after"})
+            time.sleep(0.2)
+            survivors = [n.id for n in nodes
+                         if n.id not in tr.down]
+            for nid in survivors:
+                vals = [c["value"] for c in applied[nid]]
+                assert vals == ["before", "after"], (nid, vals)
+        finally:
+            for n in nodes:
+                n.stop()
+
+    def test_persistence_restart(self, tmp_path):
+        dirs = [str(tmp_path / f"n{i}") for i in range(1)]
+        tr, nodes, applied = make_cluster(1, state_dirs=dirs)
+        nodes[0].start()
+        leader = wait_leader(nodes)
+        leader.propose({"type": "set", "value": 7})
+        nodes[0].stop()
+        # restart from disk: log + term survive, state machine replays
+        tr2, nodes2, applied2 = make_cluster(1, state_dirs=dirs)
+        nodes2[0].start()
+        try:
+            wait_leader(nodes2)
+            time.sleep(0.1)
+            assert [c["value"] for c in applied2["node0"]] == [7]
+            assert nodes2[0].current_term >= 1
+        finally:
+            nodes2[0].stop()
+
+
+class TestMasterHA:
+    @pytest.fixture()
+    def three_masters(self, tmp_path):
+        from seaweedfs_tpu.server.master import MasterServer
+
+        masters = [MasterServer(port=0) for _ in range(3)]
+        for m in masters:
+            m.service.start()  # listen first so urls are known
+        urls = [m.url for m in masters]
+        for m in masters:
+            m.enable_raft([u for u in urls if u != m.url])
+            # elections fast enough for tests but tolerant of pytest-load
+            # scheduling hiccups (flapping leadership is a test artifact)
+            m.raft.heartbeat_interval = 0.05
+            m.raft.election_timeout = (0.4, 0.7)
+        yield masters
+        for m in masters:
+            m.stop()
+
+    def _leader_of(self, masters, timeout=5.0, exclude=()):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            leaders = [m for m in masters
+                       if m.raft.is_leader() and m.url not in exclude]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.02)
+        raise AssertionError("no master leader")
+
+    def test_assign_redirects_to_leader(self, three_masters, tmp_path):
+        import json
+
+        from seaweedfs_tpu.server.httpd import http_request
+        from seaweedfs_tpu.server.volume import VolumeServer
+
+        leader = self._leader_of(three_masters)
+        follower = next(m for m in three_masters if m is not leader)
+
+        vol = VolumeServer(
+            [str(tmp_path / "v")],
+            ",".join(m.url for m in three_masters), port=0,
+        )
+        vol.start()
+        vol.heartbeat_once()
+        try:
+            # follower names the leader
+            status, _, body = http_request(
+                "GET", follower.url + "/dir/assign"
+            )
+            assert status == 409
+            assert json.loads(body)["leader"] == leader.url
+            # leader assigns (follow hints in case of re-election under load)
+            from seaweedfs_tpu.filer.wdclient import WeedClient
+
+            out = WeedClient(",".join(m.url for m in three_masters)).assign()
+            assert out["fid"]
+        finally:
+            vol.stop()
+
+    def test_wdclient_follows_leader(self, three_masters, tmp_path):
+        from seaweedfs_tpu.filer.wdclient import WeedClient
+        from seaweedfs_tpu.server.volume import VolumeServer
+
+        leader = self._leader_of(three_masters)
+        vol = VolumeServer(
+            [str(tmp_path / "v")],
+            ",".join(m.url for m in three_masters), port=0,
+        )
+        vol.start()
+        vol.heartbeat_once()
+        try:
+            follower_first = [m.url for m in three_masters if m is not leader] \
+                + [leader.url]
+            client = WeedClient(",".join(follower_first))
+            out = client.assign()
+            assert out["fid"]
+        finally:
+            vol.stop()
+
+    def test_failover_keeps_ids_unique(self, three_masters, tmp_path):
+        import json
+
+        from seaweedfs_tpu.server.httpd import http_request
+        from seaweedfs_tpu.server.volume import VolumeServer
+
+        leader = self._leader_of(three_masters)
+        vol = VolumeServer(
+            [str(tmp_path / "v")],
+            ",".join(m.url for m in three_masters), port=0,
+        )
+        vol.start()
+        vol.heartbeat_once()
+        from seaweedfs_tpu.filer.wdclient import WeedClient
+
+        fids = set()
+        try:
+            client = WeedClient(",".join(m.url for m in three_masters))
+            for _ in range(3):
+                fids.add(client.assign()["fid"])
+            old_vid_max = max(m.topo._max_volume_id for m in three_masters)
+
+            # stop the leader outright; a survivor takes over
+            leader.raft.stop()
+            leader.service.stop()
+            survivors = [m for m in three_masters if m is not leader]
+            new_leader = self._leader_of(survivors, exclude={leader.url})
+            vol.heartbeat_once()  # re-register volumes with the new leader
+
+            client2 = WeedClient(",".join(m.url for m in survivors))
+            for _ in range(3):
+                fid = client2.assign()["fid"]
+                assert fid not in fids  # never reuse a file id
+                fids.add(fid)
+            # volume ids continue past the old max (replicated counter)
+            assert new_leader.topo._max_volume_id >= old_vid_max
+        finally:
+            vol.stop()
