@@ -137,3 +137,98 @@ def run_filer_backup(args: list[str]) -> int:
         except Exception as e:
             print(f"backup follow error: {e}")
             time.sleep(opts.interval)
+
+
+def run_filer_remote_sync(args: list[str]) -> int:
+    """`weed-tpu filer.remote.sync`: follow a mounted directory's metadata
+    stream and write local changes back to the remote store
+    (`weed/command/filer_remote_sync.go`). Cache-fill updates echo one
+    idempotent write per object; stub creations (no chunks/content) are
+    skipped."""
+    p = argparse.ArgumentParser(prog="weed-tpu filer.remote.sync")
+    p.add_argument("-filer", required=True)
+    p.add_argument("-dir", required=True, help="mounted directory")
+    p.add_argument("-interval", type=float, default=1.0)
+    p.add_argument("-once", action="store_true")
+    p.add_argument("-timeAgo", type=float, default=0.0,
+                   help="start from this many seconds in the past")
+    opts = p.parse_args(args)
+
+    import json as _json
+
+    from seaweedfs_tpu.filer.filer_client import FilerClient
+    from seaweedfs_tpu.remote_storage import REMOTE_KEY, make_remote_client
+    from seaweedfs_tpu.server.httpd import http_request
+
+    filer_url = opts.filer.rstrip("/")
+    client = FilerClient(filer_url)
+    mount_dir = opts.dir.rstrip("/")
+
+    status, _, body = http_request("GET", f"{filer_url}/__remote__/mounts")
+    mounts = _json.loads(body)["mounts"]
+    if mount_dir not in mounts:
+        print(f"{mount_dir} is not remote-mounted on {filer_url}")
+        return 1
+    mount = mounts[mount_dir]
+    status, _, body = http_request("GET", f"{filer_url}/{mount_dir.strip('/')}")
+
+    # conf lives on the filer; fetch it via the configure listing
+    status, _, body = http_request(
+        "GET", f"{filer_url}/etc/remote/remote.conf"
+    )
+    confs = _json.loads(body)
+    remote = make_remote_client(confs[mount["config"]])
+    base = mount.get("path", "").strip("/")
+
+    def remote_key(full_path: str) -> str:
+        rel = full_path[len(mount_dir):].lstrip("/")
+        return f"{base}/{rel}".lstrip("/") if base else rel
+
+    cursor = time.time_ns() - int(opts.timeAgo * 1e9)
+
+    def run_once(wait: float = 0.0) -> int:
+        nonlocal cursor
+        status, _, body = http_request(
+            "GET",
+            f"{filer_url}/__meta__/events?since_ns={cursor}&wait={wait}",
+            timeout=wait + 30,
+        )
+        out = _json.loads(body)
+        applied = 0
+        for ev in out["events"]:
+            new, old = ev.get("new_entry"), ev.get("old_entry")
+            if new is not None:
+                path = new["full_path"]
+                if not path.startswith(mount_dir + "/"):
+                    continue
+                if new.get("is_directory"):
+                    continue
+                if not new.get("chunks") and not new.get("content"):
+                    continue  # remote stub, nothing local to push
+                try:
+                    data = client.read(path)
+                except OSError:
+                    continue  # deleted/overwritten since the event was logged
+                remote.write_file(remote_key(path), data)
+                applied += 1
+            elif old is not None:
+                path = old["full_path"]
+                if not path.startswith(mount_dir + "/"):
+                    continue
+                if old.get("is_directory"):
+                    continue
+                remote.delete_file(remote_key(path))
+                applied += 1
+        cursor = out["next_ts_ns"]
+        return applied
+
+    print(f"write-back {mount_dir} -> {mount['config']}")
+    if opts.once:
+        run_once()
+        return 0
+    while True:
+        try:
+            run_once(wait=opts.interval)
+        except Exception as e:
+            print(f"remote sync error: {e}")
+            time.sleep(opts.interval)

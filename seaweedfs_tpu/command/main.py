@@ -62,6 +62,10 @@ COMMANDS: dict[str, tuple[str, str, str]] = {
         "seaweedfs_tpu.command.filer_sync", "run_filer_replicate",
         "consume a notification spool and replicate to a sink",
     ),
+    "filer.remote.sync": (
+        "seaweedfs_tpu.command.filer_sync", "run_filer_remote_sync",
+        "write back changes under a remote-mounted directory",
+    ),
     "filer.backup": (
         "seaweedfs_tpu.command.filer_sync", "run_filer_backup",
         "mirror a filer tree into a local directory and follow changes",

@@ -81,6 +81,16 @@ class CommandEnv:
 
     def require_filer(self) -> str:
         if not self.filer_url:
+            # auto-discover from cluster membership (filers register with
+            # the master — weed/cluster)
+            try:
+                ps = self.get(f"{self.master_url}/cluster/ps")
+                filers = ps.get("filers") or []
+                if filers:
+                    self.filer_url = filers[0]["address"]
+            except Exception:
+                pass
+        if not self.filer_url:
             raise ShellError("this command needs a filer (pass filer_url)")
         return self.filer_url
 

@@ -1,0 +1,165 @@
+"""Remote storage tiering: client SPI, mount + read-through caching,
+cache/uncache/meta.sync shell commands, filer.remote.sync write-back."""
+
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.remote_storage import (
+    REMOTE_KEY,
+    LocalRemoteStorage,
+    make_remote_client,
+)
+
+
+class TestLocalRemoteStorage:
+    def test_crud_and_traverse(self, tmp_path):
+        r = LocalRemoteStorage(str(tmp_path / "cloud"))
+        r.write_file("a/b.txt", b"beta")
+        r.write_file("a/c/d.bin", b"delta")
+        r.write_file("top.txt", b"top")
+        found = {rel: size for rel, size, _ in r.traverse("")}
+        assert found == {"a/b.txt": 4, "a/c/d.bin": 5, "top.txt": 3}
+        assert r.read_file("a/b.txt") == b"beta"
+        sub = {rel for rel, _, _ in r.traverse("a")}
+        assert sub == {"b.txt", "c/d.bin"}
+        r.delete_file("a/b.txt")
+        assert "a/b.txt" not in {rel for rel, _, _ in r.traverse("")}
+
+    def test_factory(self, tmp_path):
+        c = make_remote_client({"kind": "local", "root": str(tmp_path / "x")})
+        assert c.kind == "local"
+        with pytest.raises(ValueError):
+            make_remote_client({"kind": "martian"})
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    master = MasterServer(port=0)
+    master.start()
+    vol = VolumeServer([str(tmp_path / "v")], master_url=master.url, port=0)
+    vol.start()
+    vol.heartbeat_once()
+    filer = FilerServer(master_url=master.url, port=0, chunk_size_mb=1)
+    filer.start()
+    yield master, vol, filer, tmp_path
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+class TestRemoteMountE2E:
+    def _setup_remote(self, tmp_path):
+        remote_root = str(tmp_path / "cloud")
+        r = LocalRemoteStorage(remote_root)
+        r.write_file("photos/small.txt", b"tiny remote file")
+        r.write_file("photos/big.bin", os.urandom(3 * 1024 * 1024))
+        return remote_root, r
+
+    def _shell(self, master, filer):
+        from seaweedfs_tpu.shell.env import CommandEnv
+        from seaweedfs_tpu.shell.registry import run_command
+
+        env = CommandEnv(master.url, filer_url=filer.url)
+        return env, run_command
+
+    def test_mount_readthrough_uncache_cache(self, cluster):
+        from seaweedfs_tpu.server.httpd import http_request
+
+        master, vol, filer, tmp_path = cluster
+        remote_root, r = self._setup_remote(tmp_path)
+        env, sh = self._shell(master, filer)
+
+        sh(env, f"remote.configure -name cloudy -kind local -root {remote_root}")
+        out = sh(env, "remote.mount -dir /data -config cloudy -path photos")
+        assert "2 entries synced" in out
+
+        # stub entries exist without chunks
+        status, _, body = http_request(
+            "GET", filer.url + "/data/big.bin?metadata=true"
+        )
+        meta = json.loads(body)
+        assert meta["extended"][REMOTE_KEY] == "photos/big.bin"
+        assert not meta["chunks"]
+
+        # read-through caches on first GET
+        big = r.read_file("photos/big.bin")
+        status, _, got = http_request("GET", filer.url + "/data/big.bin")
+        assert status == 200 and got == big
+        status, _, body = http_request(
+            "GET", filer.url + "/data/big.bin?metadata=true"
+        )
+        assert json.loads(body)["chunks"]  # now cached
+
+        # uncache drops chunks but keeps remote info; re-read still works
+        out = sh(env, "remote.uncache -dir /data")
+        assert "uncached 1" in out
+        status, _, body = http_request(
+            "GET", filer.url + "/data/big.bin?metadata=true"
+        )
+        assert not json.loads(body)["chunks"]
+        status, _, got = http_request("GET", filer.url + "/data/big.bin")
+        assert got == big
+
+        # prefetch via remote.cache
+        sh(env, "remote.uncache -dir /data")
+        out = sh(env, "remote.cache -dir /data")
+        assert "cached" in out
+
+    def test_meta_sync_picks_up_new_files(self, cluster):
+        from seaweedfs_tpu.server.httpd import http_request
+
+        master, vol, filer, tmp_path = cluster
+        remote_root, r = self._setup_remote(tmp_path)
+        env, sh = self._shell(master, filer)
+        sh(env, f"remote.configure -name cloudy -kind local -root {remote_root}")
+        sh(env, "remote.mount -dir /data -config cloudy -path photos")
+
+        r.write_file("photos/new.txt", b"appeared later")
+        out = sh(env, "remote.meta.sync -dir /data")
+        assert "synced 1" in out
+        status, _, got = http_request("GET", filer.url + "/data/new.txt")
+        assert got == b"appeared later"
+
+    def test_unmount(self, cluster):
+        master, vol, filer, tmp_path = cluster
+        remote_root, _ = self._setup_remote(tmp_path)
+        env, sh = self._shell(master, filer)
+        sh(env, f"remote.configure -name cloudy -kind local -root {remote_root}")
+        sh(env, "remote.mount -dir /data -config cloudy -path photos")
+        assert "unmounted" in sh(env, "remote.unmount -dir /data")
+        from seaweedfs_tpu.shell.env import ShellError
+
+        with pytest.raises(Exception):
+            sh(env, "remote.meta.sync -dir /data")
+
+    def test_remote_sync_writeback(self, cluster):
+        from seaweedfs_tpu.command.filer_sync import run_filer_remote_sync
+        from seaweedfs_tpu.filer.filer_client import FilerClient
+        from seaweedfs_tpu.server.httpd import http_request
+
+        master, vol, filer, tmp_path = cluster
+        remote_root, r = self._setup_remote(tmp_path)
+        env, sh = self._shell(master, filer)
+        sh(env, f"remote.configure -name cloudy -kind local -root {remote_root}")
+        sh(env, "remote.mount -dir /data -config cloudy -path photos")
+
+        fc = FilerClient(filer.url)
+        fc.put("/data/local_new.txt", b"written locally")
+        rc = run_filer_remote_sync(
+            ["-filer", filer.url, "-dir", "/data", "-once", "-timeAgo", "30"]
+        )
+        assert rc in (0, None)
+        assert r.read_file("photos/local_new.txt") == b"written locally"
+        # deletes propagate too
+        fc.delete("/data/local_new.txt")
+        run_filer_remote_sync(
+            ["-filer", filer.url, "-dir", "/data", "-once", "-timeAgo", "5"]
+        )
+        with pytest.raises(FileNotFoundError):
+            r.read_file("photos/local_new.txt")
