@@ -266,3 +266,27 @@ def run_webdav(args: list[str]) -> int:
     srv.start()
     print(f"webdav listening at {srv.url}")
     return _wait_forever()
+
+
+def run_mq_broker(args: list[str]) -> int:
+    """MQ broker against a running filer (`weed/command/mq_broker.go`)."""
+    p = argparse.ArgumentParser(prog="weed-tpu mq.broker")
+    p.add_argument("-port", type=int, default=17777)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-master", default="http://127.0.0.1:9333")
+    p.add_argument("-peers", default="", help="comma-separated peer broker urls")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.mq import BrokerServer
+
+    filer = opts.filer
+    if not filer.startswith("http"):
+        filer = f"http://{filer}"
+    srv = BrokerServer(
+        filer, master_url=opts.master, host=opts.ip, port=opts.port,
+        peers=[u if u.startswith("http") else f"http://{u}"
+               for u in opts.peers.split(",") if u],
+    )
+    srv.start()
+    print(f"mq broker listening at {srv.url}")
+    return _wait_forever()

@@ -1,0 +1,330 @@
+"""MQ broker server (`weed/mq/broker/broker_server.go:53`).
+
+HTTP surface (the reference speaks gRPC `SeaweedMessaging`; verbs match):
+  POST /topics/create   {namespace, topic, partition_count}
+  GET  /topics/list
+  GET  /topics/describe?namespace=&topic=
+  POST /publish         {namespace, topic, key, value[, partition]}
+  GET  /subscribe       ?namespace=&topic=&partition=&offset=&limit=&wait=
+  POST /offsets/commit  {namespace, topic, group, partition, offset}
+  GET  /offsets         ?namespace=&topic=&group=
+  POST /flush           (force segment flush — tests/shutdown)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+
+from seaweedfs_tpu.cluster import LockRing
+from seaweedfs_tpu.filer.filer_client import FilerClient
+from seaweedfs_tpu.server.httpd import HTTPService, Request, Response
+
+TOPICS_DIR = "/topics"
+SEGMENT_FLUSH_COUNT = 512  # messages buffered per partition before flush
+
+
+class TopicPartition:
+    """In-memory tail of one partition; segments hold the flushed prefix."""
+
+    def __init__(self, base_dir: str, fc: FilerClient) -> None:
+        self.base_dir = base_dir  # /topics/<ns>/<topic>/p<k>
+        self.fc = fc
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.tail: list[dict] = []  # unflushed messages
+        self.tail_start = 0  # offset of tail[0]
+        self._load_flushed_extent()
+
+    def _segments(self) -> list[tuple[int, int, str]]:
+        out = []
+        listing = self.fc.list(self.base_dir)
+        for e in listing.get("Entries") or []:
+            name = e["FullPath"].rsplit("/", 1)[-1]
+            if not name.endswith(".log"):
+                continue
+            try:
+                start_s, end_s = name[:-4].split("-")
+                out.append((int(start_s), int(end_s), e["FullPath"]))
+            except ValueError:
+                continue
+        out.sort()
+        return out
+
+    def _load_flushed_extent(self) -> None:
+        try:
+            segs = self._segments()
+        except Exception:
+            segs = []
+        self.tail_start = segs[-1][1] + 1 if segs else 0
+
+    def append(self, key: str, value, ts_ns: int | None = None) -> int:
+        with self.cond:
+            offset = self.tail_start + len(self.tail)
+            self.tail.append({
+                "offset": offset, "key": key, "value": value,
+                "ts_ns": ts_ns or time.time_ns(),
+            })
+            self.cond.notify_all()
+            need_flush = len(self.tail) >= SEGMENT_FLUSH_COUNT
+        if need_flush:
+            self.flush()
+        return offset
+
+    def flush(self) -> int:
+        """Persist the in-memory tail as one segment file."""
+        with self.lock:
+            if not self.tail:
+                return 0
+            batch, self.tail = self.tail, []
+            start = self.tail_start
+            end = start + len(batch) - 1
+            self.tail_start = end + 1
+        body = "\n".join(json.dumps(m) for m in batch).encode()
+        self.fc.put(f"{self.base_dir}/{start:020d}-{end:020d}.log", body,
+                    content_type="application/json")
+        return len(batch)
+
+    def read(self, offset: int, limit: int = 1024,
+             wait: float = 0.0) -> list[dict]:
+        out: list[dict] = []
+        with self.lock:
+            tail_start = self.tail_start
+        if offset < tail_start:
+            # serve the flushed prefix from segments
+            for start, end, path in self._segments():
+                if end < offset or len(out) >= limit:
+                    continue
+                body = self.fc.read(path)
+                for line in body.decode().splitlines():
+                    m = json.loads(line)
+                    if m["offset"] >= offset and len(out) < limit:
+                        out.append(m)
+        with self.cond:
+            if not out and wait > 0 and offset >= self.tail_start + len(self.tail):
+                self.cond.wait(wait)
+            for m in self.tail:
+                if m["offset"] >= offset and len(out) < limit:
+                    out.append(m)
+        return out
+
+    def high_water_mark(self) -> int:
+        with self.lock:
+            return self.tail_start + len(self.tail)
+
+
+class BrokerServer:
+    def __init__(self, filer_url: str, master_url: str = "",
+                 host: str = "127.0.0.1", port: int = 17777,
+                 peers: list[str] | None = None) -> None:
+        self.fc = FilerClient(filer_url)
+        self.master_url = master_url.rstrip("/") if master_url else ""
+        self.service = HTTPService(host, port)
+        self.ring = LockRing()
+        self._static_peers = list(peers or [])
+        self._partitions: dict[str, TopicPartition] = {}
+        self._plock = threading.Lock()
+        self._stop = threading.Event()
+        self._routes()
+
+    def start(self) -> None:
+        self.service.start()
+        self.ring.set_servers(self._static_peers + [self.url])
+        if self.master_url:
+            self._register_once()
+            threading.Thread(target=self._register_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.flush_all()
+        self.service.stop()
+
+    @property
+    def url(self) -> str:
+        return self.service.url
+
+    # --- membership -----------------------------------------------------------
+    def _register_once(self) -> None:
+        try:
+            from seaweedfs_tpu.server.httpd import post_json
+
+            post_json(f"{self.master_url}/cluster/register",
+                      {"type": "broker", "address": self.url}, timeout=5)
+        except Exception:
+            pass
+
+    def _register_loop(self) -> None:
+        while not self._stop.wait(5.0):
+            self._register_once()
+
+    # --- topic/partition helpers ----------------------------------------------
+    @staticmethod
+    def _topic_dir(ns: str, topic: str) -> str:
+        return f"{TOPICS_DIR}/{ns}/{topic}"
+
+    def _topic_conf(self, ns: str, topic: str) -> dict | None:
+        e = self.fc.get_entry(f"{self._topic_dir(ns, topic)}/topic.conf")
+        if e is None:
+            return None
+        raw = e.get("content", "")
+        try:
+            return json.loads(bytes.fromhex(raw)) if raw else None
+        except ValueError:
+            return None
+
+    def _partition(self, ns: str, topic: str, k: int) -> TopicPartition:
+        key = f"{ns}/{topic}/p{k:04d}"
+        with self._plock:
+            tp = self._partitions.get(key)
+            if tp is None:
+                tp = TopicPartition(
+                    f"{self._topic_dir(ns, topic)}/p{k:04d}", self.fc
+                )
+                self._partitions[key] = tp
+            return tp
+
+    def _owner_of(self, ns: str, topic: str, k: int) -> str | None:
+        return self.ring.server_for(f"{ns}/{topic}/p{k}")
+
+    def flush_all(self) -> None:
+        with self._plock:
+            parts = list(self._partitions.values())
+        for tp in parts:
+            try:
+                tp.flush()
+            except Exception:
+                pass
+
+    # --- routes ----------------------------------------------------------------
+    def _routes(self) -> None:
+        svc = self.service
+
+        @svc.route("POST", r"/topics/create")
+        def topics_create(req: Request) -> Response:
+            p = req.json()
+            ns, topic = p.get("namespace", "default"), p["topic"]
+            count = int(p.get("partition_count", 4))
+            conf_path = f"{self._topic_dir(ns, topic)}/topic.conf"
+            if self.fc.get_entry(conf_path) is not None:
+                return Response({"error": f"{ns}/{topic} exists"}, 409)
+            self.fc.put(conf_path, json.dumps({
+                "namespace": ns, "topic": topic, "partition_count": count,
+                "created_ts": time.time(),
+            }).encode(), content_type="application/json")
+            return Response({"ok": True, "partition_count": count}, 201)
+
+        @svc.route("GET", r"/topics/list")
+        def topics_list(req: Request) -> Response:
+            topics = []
+            for ns_e in self.fc.list(TOPICS_DIR).get("Entries") or []:
+                if not ns_e["IsDirectory"]:
+                    continue
+                ns = ns_e["FullPath"].rsplit("/", 1)[-1]
+                if ns.startswith("."):
+                    continue  # .system metadata log
+                for t_e in self.fc.list(ns_e["FullPath"]).get("Entries") or []:
+                    if t_e["IsDirectory"]:
+                        topics.append(
+                            {"namespace": ns,
+                             "topic": t_e["FullPath"].rsplit("/", 1)[-1]}
+                        )
+            return Response({"topics": topics})
+
+        @svc.route("GET", r"/topics/describe")
+        def topics_describe(req: Request) -> Response:
+            ns = req.query.get("namespace", "default")
+            topic = req.query["topic"]
+            conf = self._topic_conf(ns, topic)
+            if conf is None:
+                return Response({"error": f"{ns}/{topic} not found"}, 404)
+            parts = []
+            for k in range(conf["partition_count"]):
+                tp = self._partition(ns, topic, k)
+                parts.append({
+                    "partition": k,
+                    "high_water_mark": tp.high_water_mark(),
+                    "owner": self._owner_of(ns, topic, k),
+                })
+            conf["partitions"] = parts
+            return Response(conf)
+
+        @svc.route("POST", r"/publish")
+        def publish(req: Request) -> Response:
+            p = req.json()
+            ns, topic = p.get("namespace", "default"), p["topic"]
+            conf = self._topic_conf(ns, topic)
+            if conf is None:
+                return Response({"error": f"{ns}/{topic} not found"}, 404)
+            count = conf["partition_count"]
+            key = p.get("key", "")
+            if "partition" in p:
+                k = int(p["partition"]) % count
+            else:
+                digest = hashlib.sha1(key.encode()).digest()
+                k = int.from_bytes(digest[:4], "big") % count
+            owner = self._owner_of(ns, topic, k)
+            if owner and owner != self.url:
+                return Response({"moved_to": owner, "partition": k}, 307)
+            offset = self._partition(ns, topic, k).append(key, p.get("value"))
+            return Response({"ok": True, "partition": k, "offset": offset})
+
+        @svc.route("GET", r"/subscribe")
+        def subscribe(req: Request) -> Response:
+            ns = req.query.get("namespace", "default")
+            topic = req.query["topic"]
+            k = int(req.query.get("partition", 0))
+            offset = int(req.query.get("offset", 0))
+            limit = int(req.query.get("limit", 1024))
+            wait = min(float(req.query.get("wait", 0)), 30.0)
+            conf = self._topic_conf(ns, topic)
+            if conf is None:
+                return Response({"error": f"{ns}/{topic} not found"}, 404)
+            owner = self._owner_of(ns, topic, k)
+            if owner and owner != self.url:
+                return Response({"moved_to": owner}, 307)
+            tp = self._partition(ns, topic, k)
+            msgs = tp.read(offset, limit, wait)
+            return Response({
+                "messages": msgs,
+                "next_offset": msgs[-1]["offset"] + 1 if msgs else offset,
+                "high_water_mark": tp.high_water_mark(),
+            })
+
+        @svc.route("POST", r"/offsets/commit")
+        def offsets_commit(req: Request) -> Response:
+            p = req.json()
+            ns, topic = p.get("namespace", "default"), p["topic"]
+            path = (f"{self._topic_dir(ns, topic)}/offsets/"
+                    f"{p['group']}.json")
+            e = self.fc.get_entry(path)
+            cur = {}
+            if e is not None and e.get("content"):
+                try:
+                    cur = json.loads(bytes.fromhex(e["content"]))
+                except ValueError:
+                    cur = {}
+            cur[str(int(p["partition"]))] = int(p["offset"])
+            self.fc.put(path, json.dumps(cur).encode(),
+                        content_type="application/json")
+            return Response({"ok": True, "offsets": cur})
+
+        @svc.route("GET", r"/offsets")
+        def offsets_get(req: Request) -> Response:
+            ns = req.query.get("namespace", "default")
+            topic = req.query["topic"]
+            group = req.query["group"]
+            e = self.fc.get_entry(
+                f"{self._topic_dir(ns, topic)}/offsets/{group}.json"
+            )
+            if e is None or not e.get("content"):
+                return Response({"offsets": {}})
+            return Response(
+                {"offsets": json.loads(bytes.fromhex(e["content"]))}
+            )
+
+        @svc.route("POST", r"/flush")
+        def flush(req: Request) -> Response:
+            self.flush_all()
+            return Response({"ok": True})

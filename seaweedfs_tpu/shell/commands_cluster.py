@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import json
 
-from .env import CommandEnv
+from .env import CommandEnv, ShellError
 from .registry import command, parse_flags
 
 
@@ -107,3 +107,45 @@ def cmd_volume_status(env: CommandEnv, args: list[str]) -> str:
         if vid in sv.volumes:
             out.append(json.dumps({"server": sv.id, **sv.volumes[vid]}))
     return "\n".join(out) if out else f"volume {vid} not found"
+
+
+# --- mq.* (`weed/shell/command_mq_topic_list.go` etc.) -----------------------
+def _broker_url(env) -> str:
+    ps = env.get(f"{env.master_url}/cluster/ps")
+    brokers = ps.get("brokers") or []
+    if not brokers:
+        raise ShellError("no live mq brokers registered")
+    return brokers[0]["address"]
+
+
+@command("mq.topic.list", "list message-queue topics")
+def cmd_mq_topic_list(env: CommandEnv, args: list[str]) -> str:
+    import json as _json
+
+    out = env.get(f"{_broker_url(env)}/topics/list")
+    return _json.dumps(out["topics"], indent=2)
+
+
+@command("mq.topic.create",
+         "-topic <name> [-namespace default] [-partitionCount 4]")
+def cmd_mq_topic_create(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    out = env.post(f"{_broker_url(env)}/topics/create", {
+        "namespace": flags.get("namespace", "default"),
+        "topic": flags["topic"],
+        "partition_count": int(flags.get("partitionCount", 4)),
+    })
+    return f"created topic {flags['topic']} ({out['partition_count']} partitions)"
+
+
+@command("mq.topic.describe", "-topic <name> [-namespace default]")
+def cmd_mq_topic_describe(env: CommandEnv, args: list[str]) -> str:
+    import json as _json
+
+    flags = parse_flags(args)
+    ns = flags.get("namespace", "default")
+    out = env.get(
+        f"{_broker_url(env)}/topics/describe?namespace={ns}"
+        f"&topic={flags['topic']}"
+    )
+    return _json.dumps(out, indent=2)

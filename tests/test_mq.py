@@ -1,0 +1,200 @@
+"""Message queue: topics, partitioned publish/subscribe, consumer-group
+offsets, broker restart durability (filer-backed), 2-broker partition
+ownership redirects."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.server.httpd import http_request
+
+
+def _post(url, payload):
+    status, _, body = http_request(
+        "POST", url, body=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return status, json.loads(body) if body else {}
+
+
+def _get(url):
+    status, _, body = http_request("GET", url)
+    return status, json.loads(body) if body else {}
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from seaweedfs_tpu.mq import BrokerServer
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    tmp = tmp_path_factory.mktemp("mq")
+    master = MasterServer(port=0)
+    master.start()
+    vol = VolumeServer([str(tmp / "v")], master_url=master.url, port=0)
+    vol.start()
+    vol.heartbeat_once()
+    filer = FilerServer(master_url=master.url, port=0)
+    filer.start()
+    broker = BrokerServer(filer.url, master_url=master.url, port=0)
+    broker.start()
+    yield master, filer, broker
+    broker.stop()
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+class TestTopics:
+    def test_create_list_describe(self, stack):
+        master, filer, broker = stack
+        status, out = _post(broker.url + "/topics/create",
+                            {"topic": "events", "partition_count": 3})
+        assert status == 201
+        status, out = _post(broker.url + "/topics/create",
+                            {"topic": "events"})
+        assert status == 409  # duplicate
+        status, out = _get(broker.url + "/topics/list")
+        assert {"namespace": "default", "topic": "events"} in out["topics"]
+        status, out = _get(
+            broker.url + "/topics/describe?topic=events"
+        )
+        assert out["partition_count"] == 3
+        assert len(out["partitions"]) == 3
+
+
+class TestPubSub:
+    def test_publish_subscribe_ordering(self, stack):
+        master, filer, broker = stack
+        _post(broker.url + "/topics/create",
+              {"topic": "orders", "partition_count": 2})
+        # same key -> same partition, ordered offsets
+        offsets = []
+        for i in range(10):
+            status, out = _post(broker.url + "/publish", {
+                "topic": "orders", "key": "customer-7",
+                "value": {"seq": i},
+            })
+            assert status == 200, out
+            offsets.append((out["partition"], out["offset"]))
+        parts = {p for p, _ in offsets}
+        assert len(parts) == 1
+        k = parts.pop()
+        assert [o for _, o in offsets] == list(range(10))
+
+        status, out = _get(
+            broker.url +
+            f"/subscribe?topic=orders&partition={k}&offset=0"
+        )
+        assert [m["value"]["seq"] for m in out["messages"]] == list(range(10))
+        assert out["next_offset"] == 10
+
+        # resume mid-stream
+        status, out = _get(
+            broker.url + f"/subscribe?topic=orders&partition={k}&offset=6"
+        )
+        assert [m["value"]["seq"] for m in out["messages"]] == [6, 7, 8, 9]
+
+    def test_long_poll_wakeup(self, stack):
+        master, filer, broker = stack
+        _post(broker.url + "/topics/create",
+              {"topic": "poll", "partition_count": 1})
+        got = {}
+
+        def consume():
+            status, out = _get(
+                broker.url + "/subscribe?topic=poll&partition=0&offset=0&wait=5"
+            )
+            got["messages"] = out["messages"]
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.3)
+        _post(broker.url + "/publish",
+              {"topic": "poll", "partition": 0, "value": "wake"})
+        t.join(timeout=10)
+        assert [m["value"] for m in got.get("messages", [])] == ["wake"]
+
+    def test_consumer_group_offsets(self, stack):
+        master, filer, broker = stack
+        _post(broker.url + "/topics/create",
+              {"topic": "grp", "partition_count": 1})
+        for i in range(5):
+            _post(broker.url + "/publish",
+                  {"topic": "grp", "partition": 0, "value": i})
+        _post(broker.url + "/offsets/commit",
+              {"topic": "grp", "group": "readers", "partition": 0,
+               "offset": 3})
+        status, out = _get(
+            broker.url + "/offsets?topic=grp&group=readers"
+        )
+        assert out["offsets"] == {"0": 3}
+        # resume from committed offset
+        status, out = _get(
+            broker.url + "/subscribe?topic=grp&partition=0&offset=3"
+        )
+        assert [m["value"] for m in out["messages"]] == [3, 4]
+
+
+class TestDurability:
+    def test_broker_restart_resumes_from_filer(self, stack):
+        from seaweedfs_tpu.mq import BrokerServer
+
+        master, filer, broker = stack
+        _post(broker.url + "/topics/create",
+              {"topic": "durable", "partition_count": 1})
+        for i in range(4):
+            _post(broker.url + "/publish",
+                  {"topic": "durable", "partition": 0, "value": i})
+        _post(broker.url + "/flush", {})
+
+        b2 = BrokerServer(filer.url, port=0)
+        b2.start()
+        try:
+            # continues numbering after the flushed extent
+            status, out = _post(b2.url + "/publish", {
+                "topic": "durable", "partition": 0, "value": 99,
+            })
+            assert out["offset"] == 4
+            status, out = _get(
+                b2.url + "/subscribe?topic=durable&partition=0&offset=0"
+            )
+            assert [m["value"] for m in out["messages"]] == [0, 1, 2, 3, 99]
+        finally:
+            b2.stop()
+
+
+class TestTwoBrokerOwnership:
+    def test_redirects_to_partition_owner(self, stack):
+        from seaweedfs_tpu.mq import BrokerServer
+
+        master, filer, broker = stack
+        b2 = BrokerServer(filer.url, master_url=master.url, port=0,
+                          peers=[broker.url])
+        b2.start()
+        broker.ring.set_servers([broker.url, b2.url])
+        try:
+            _post(broker.url + "/topics/create",
+                  {"topic": "sharded", "partition_count": 8})
+            statuses = set()
+            published = 0
+            for i in range(16):
+                url = broker.url
+                payload = {"topic": "sharded", "key": f"k{i}", "value": i}
+                for _ in range(3):  # follow moved_to
+                    status, out = _post(url + "/publish", payload)
+                    statuses.add(status)
+                    if status == 307:
+                        url = out["moved_to"]
+                        continue
+                    assert status == 200
+                    published += 1
+                    break
+            assert published == 16
+            assert 307 in statuses  # both brokers own some partitions
+        finally:
+            broker.ring.set_servers([broker.url])
+            b2.stop()
