@@ -50,6 +50,10 @@ COMMANDS: dict[str, tuple[str, str, str]] = {
         "seaweedfs_tpu.command.fix", "run",
         "rebuild a volume .idx from its .dat",
     ),
+    "mount": (
+        "seaweedfs_tpu.command.server_cmds", "run_mount",
+        "FUSE-mount a filer as a local filesystem",
+    ),
     "mq.broker": (
         "seaweedfs_tpu.command.server_cmds", "run_mq_broker",
         "start a message-queue broker against a filer",

@@ -290,3 +290,28 @@ def run_mq_broker(args: list[str]) -> int:
     srv.start()
     print(f"mq broker listening at {srv.url}")
     return _wait_forever()
+
+
+def run_mount(args: list[str]) -> int:
+    """FUSE-mount a filer path (`weed/command/mount.go`). Needs /dev/fuse +
+    CAP_SYS_ADMIN; otherwise explains and exits."""
+    p = argparse.ArgumentParser(prog="weed-tpu mount")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-dir", required=True, help="mountpoint")
+    p.add_argument("-readOnly", action="store_true")
+    p.add_argument("-chunkCacheDir", default=None)
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.mount import WFS, mount_fs
+
+    filer = opts.filer
+    if not filer.startswith("http"):
+        filer = f"http://{filer}"
+    wfs = WFS(filer, read_only=opts.readOnly,
+              chunk_cache_dir=opts.chunkCacheDir)
+    try:
+        print(f"mounting {filer} at {opts.dir}")
+        mount_fs(wfs, opts.dir)
+    except (PermissionError, FileNotFoundError) as e:
+        print(f"cannot mount: {e} (needs /dev/fuse and CAP_SYS_ADMIN)")
+        return 1
+    return 0

@@ -493,6 +493,8 @@ class FilerServer:
                 {
                     "signature": self.filer.signature,
                     "latest_ts_ns": self.filer.log_buffer.latest_ts_ns,
+                    "master": self.client.master_url,
+                    "chunk_size": self.chunk_size,
                 }
             )
 
