@@ -46,6 +46,22 @@ COMMANDS: dict[str, tuple[str, str, str]] = {
     ),
     "upload": ("seaweedfs_tpu.command.upload", "run", "upload files via assign+PUT"),
     "download": ("seaweedfs_tpu.command.upload", "run_download", "download a fid"),
+    "backup": (
+        "seaweedfs_tpu.command.volume_tools", "run_backup",
+        "incrementally back up a live volume to a local directory",
+    ),
+    "compact": (
+        "seaweedfs_tpu.command.volume_tools", "run_compact",
+        "offline-vacuum a local volume",
+    ),
+    "export": (
+        "seaweedfs_tpu.command.volume_tools", "run_export",
+        "list or extract a volume's needles (tar / directory)",
+    ),
+    "scaffold": (
+        "seaweedfs_tpu.command.scaffold", "run",
+        "print starter TOML configs (security/filer/master/...)",
+    ),
     "fix": (
         "seaweedfs_tpu.command.fix", "run",
         "rebuild a volume .idx from its .dat",

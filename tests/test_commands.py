@@ -1,0 +1,126 @@
+"""CLI tools: backup/compact/export/scaffold + TOML config discovery."""
+
+import json
+import os
+import tarfile
+
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+
+def make_volume(dir_, vid=3, n=20):
+    v = Volume(str(dir_), "", vid)
+    blobs = {}
+    for k in range(1, n + 1):
+        data = os.urandom(100 + k)
+        nd = Needle(cookie=0x99, id=k, data=data)
+        nd.name = f"file{k}.bin".encode()
+        nd.set_has_name()
+        v.write_needle(nd)
+        blobs[k] = data
+    return v, blobs
+
+
+class TestCompactExport:
+    def test_compact_cli(self, tmp_path, capsys):
+        from seaweedfs_tpu.command.volume_tools import run_compact
+
+        v, blobs = make_volume(tmp_path)
+        for k in range(1, 11):  # delete half -> garbage
+            v.delete_needle(Needle(cookie=0x99, id=k))
+        v.close()
+        assert run_compact(["-dir", str(tmp_path), "-volumeId", "3"]) == 0
+        out = capsys.readouterr().out
+        assert "->" in out
+        v2 = Volume(str(tmp_path), "", 3)
+        assert v2.file_count() == 10
+        for k in range(11, 21):
+            assert v2.read_needle(k).data == blobs[k]
+        v2.close()
+
+    def test_export_tar_and_dir(self, tmp_path, capsys):
+        from seaweedfs_tpu.command.volume_tools import run_export
+
+        v, blobs = make_volume(tmp_path, vid=4, n=5)
+        v.close()
+        tar_path = str(tmp_path / "out.tar")
+        assert run_export(["-dir", str(tmp_path), "-volumeId", "4",
+                           "-o", tar_path]) == 0
+        with tarfile.open(tar_path) as t:
+            names = t.getnames()
+            assert len(names) == 5
+            member = t.extractfile("vol4/file1.bin")
+            assert member.read() == blobs[1]
+        outdir = str(tmp_path / "exported")
+        assert run_export(["-dir", str(tmp_path), "-volumeId", "4",
+                           "-outputDir", outdir]) == 0
+        assert sorted(os.listdir(outdir)) == [f"file{k}.bin" for k in range(1, 6)]
+
+
+class TestBackup:
+    def test_full_then_incremental(self, tmp_path, capsys):
+        from seaweedfs_tpu.command.volume_tools import run_backup
+        from seaweedfs_tpu.server.httpd import http_request
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume import VolumeServer
+
+        master = MasterServer(port=0)
+        master.start()
+        vol = VolumeServer([str(tmp_path / "v")], master_url=master.url, port=0)
+        vol.start()
+        vol.heartbeat_once()
+        try:
+            status, _, body = http_request("GET", master.url + "/dir/assign")
+            fid = json.loads(body)["fid"]
+            vurl = "http://" + json.loads(body)["url"]
+            http_request("POST", f"{vurl}/{fid}", body=b"first blob")
+            vid = int(fid.split(",")[0])
+            bdir = str(tmp_path / "bk")
+            assert run_backup(["-server", vurl, "-volumeId", str(vid),
+                               "-dir", bdir]) == 0
+            v = Volume(bdir, "", vid)
+            count1 = v.file_count()
+            v.close()
+            assert count1 == 1
+            # write one more, incremental
+            status, _, body = http_request(
+                "GET", master.url + f"/dir/assign"
+            )
+            fid2 = json.loads(body)["fid"]
+            if int(fid2.split(",")[0]) == vid:
+                http_request("POST", f"{vurl}/{fid2}", body=b"second blob")
+                assert run_backup(["-server", vurl, "-volumeId", str(vid),
+                                   "-dir", bdir]) == 0
+                v = Volume(bdir, "", vid)
+                assert v.file_count() == 2
+                v.close()
+        finally:
+            vol.stop()
+            master.stop()
+
+
+class TestScaffoldConfig:
+    def test_scaffold_all_templates_parse(self, tmp_path, capsys):
+        import tomllib
+
+        from seaweedfs_tpu.command.scaffold import TEMPLATES, run
+
+        for name, body in TEMPLATES.items():
+            tomllib.loads(body)  # every template is valid TOML
+        assert run(["-config", "security"]) == 0
+        out = capsys.readouterr().out
+        assert "[jwt.signing]" in out
+        assert run(["-config", "master", "-output", str(tmp_path)]) == 0
+        assert (tmp_path / "master.toml").exists()
+
+    def test_load_configuration_search(self, tmp_path, monkeypatch):
+        from seaweedfs_tpu.util import config as cfg
+
+        (tmp_path / "demo.toml").write_text("[top]\nkey = 'v'\n")
+        monkeypatch.setattr(cfg, "SEARCH_DIRS", [str(tmp_path)])
+        assert cfg.load_configuration("demo") == {"top": {"key": "v"}}
+        assert cfg.load_configuration("absent") == {}
+        with pytest.raises(FileNotFoundError):
+            cfg.load_configuration("absent", required=True)
