@@ -1,10 +1,9 @@
 """Master server: assign/lookup HTTP API + heartbeat ingest + growth + vacuum.
 
 Reference: `weed/server/master_server.go`, `master_server_handlers.go:36,110`,
-`master_grpc_server.go:62`, `topology_vacuum.go:216`. Single-master build
-(the reference's Raft layer elects one leader that does exactly this role;
-multi-master HA rides on the same state machine and is tracked as a gap in
-ROADMAP.md).
+`master_grpc_server.go:62`, `topology_vacuum.go:216`. Multi-master HA rides
+on the Raft layer (seaweedfs_tpu/raft): followers redirect to the leader,
+and the volume-id counter + file-id sequence ceiling are replicated.
 """
 
 from __future__ import annotations
@@ -189,6 +188,8 @@ class MasterServer:
     def _vacuum_check(self) -> None:
         """Ask volume servers to compact garbage-heavy volumes
         (`topology_vacuum.go:216`)."""
+        if not getattr(self, "vacuum_enabled", True):
+            return
         for node in self.topo.all_nodes():
             for vid, info in list(node.volumes.items()):
                 if info.size == 0 or info.read_only:
@@ -488,6 +489,16 @@ class MasterServer:
                         except Exception:
                             pass
             return Response({"ok": True, "deleted": deleted})
+
+        @svc.route("POST", r"/vol/vacuum/disable")
+        def vacuum_disable(req: Request) -> Response:
+            self.vacuum_enabled = False
+            return Response({"ok": True, "vacuum": "disabled"})
+
+        @svc.route("POST", r"/vol/vacuum/enable")
+        def vacuum_enable(req: Request) -> Response:
+            self.vacuum_enabled = True
+            return Response({"ok": True, "vacuum": "enabled"})
 
         @svc.route("GET", r"/vol/vacuum")
         def vol_vacuum(req: Request) -> Response:

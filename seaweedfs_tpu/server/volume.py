@@ -134,6 +134,8 @@ class VolumeServer:
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.pulse_seconds):
+            if getattr(self, "_leaving", False):
+                continue  # volume.server.leave: stay up, stop heartbeating
             self.heartbeat_once()
 
     def _attach_shard_fetcher(self, ev) -> None:
@@ -267,6 +269,29 @@ class VolumeServer:
         def readonly(req: Request) -> Response:
             p = req.json()
             self.store.mark_readonly(int(p["volume"]), bool(p.get("readonly", True)))
+            return Response({"ok": True})
+
+        @svc.route("POST", r"/admin/volume/configure_replication")
+        def configure_replication(req: Request) -> Response:
+            from seaweedfs_tpu.storage.types import ReplicaPlacement
+
+            p = req.json()
+            vid = int(p["volume"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            try:
+                rp = ReplicaPlacement.parse(str(p["replication"]))
+            except (ValueError, KeyError) as e:
+                return Response({"error": str(e)}, 400)
+            v.configure_replication(rp)
+            return Response({"ok": True, "replication": str(rp)})
+
+        @svc.route("POST", r"/admin/leave")
+        def leave(req: Request) -> Response:
+            # stop heartbeating; the master expires this node
+            # (`command_volume_server_leave.go` VolumeServerLeave rpc)
+            self._leaving = True
             return Response({"ok": True})
 
         # --- tiering (volume_grpc_tier_upload.go / _download.go) ---

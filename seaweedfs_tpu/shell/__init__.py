@@ -16,5 +16,6 @@ from . import commands_volume  # noqa: E402,F401
 from . import commands_ec  # noqa: E402,F401
 from . import commands_fs  # noqa: E402,F401
 from . import commands_remote  # noqa: E402,F401
+from . import commands_s3  # noqa: E402,F401
 
 __all__ = ["CommandEnv", "ShellError", "COMMANDS", "run_command"]

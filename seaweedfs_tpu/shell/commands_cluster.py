@@ -149,3 +149,20 @@ def cmd_mq_topic_describe(env: CommandEnv, args: list[str]) -> str:
         f"&topic={flags['topic']}"
     )
     return _json.dumps(out, indent=2)
+
+
+@command("cluster.raft.ps", "show raft member status on the master(s)")
+def cmd_cluster_raft_ps(env: CommandEnv, args: list[str]) -> str:
+    out = env.get(f"{env.master_url}/raft/status")
+    if not out.get("enabled"):
+        return f"raft disabled (single master at {env.master_url})"
+    lines = [f"{out['id']}  role={out['role']} term={out['term']} "
+             f"commit={out['commit_index']}"]
+    for p in out.get("peers", []):
+        try:
+            ps = env.get(f"{p}/raft/status")
+            lines.append(f"{ps['id']}  role={ps['role']} term={ps['term']} "
+                         f"commit={ps['commit_index']}")
+        except Exception as e:
+            lines.append(f"{p}  unreachable ({e})")
+    return "\n".join(lines)

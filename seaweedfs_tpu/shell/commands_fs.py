@@ -184,3 +184,40 @@ def cmd_fs_verify(env: CommandEnv, args: list[str]) -> str:
             lines.append(f"UNREADABLE {e['FullPath']} ({status})")
     lines.append(f"verified {ok + bad} files: {ok} ok, {bad} broken")
     return "\n".join(lines)
+
+
+@command("fs.cd", "<dir> — change the shell's working directory")
+def cmd_fs_cd(env: CommandEnv, args: list[str]) -> str:
+    target = args[0] if args else "/"
+    if not target.startswith("/"):
+        target = env.cwd.rstrip("/") + "/" + target
+    target = target.rstrip("/") or "/"
+    status, _, body = env.filer_read(target, "metadata=true")
+    if status != 200:
+        raise ShellError(f"{target}: not found")
+    import json as _json
+
+    if not _json.loads(body).get("is_directory"):
+        raise ShellError(f"{target}: not a directory")
+    env.cwd = target
+    return target
+
+
+@command("fs.pwd", "print the shell's working directory")
+def cmd_fs_pwd(env: CommandEnv, args: list[str]) -> str:
+    return env.cwd
+
+
+@command("fs.meta.cat", "<path> — print one entry's raw metadata json")
+def cmd_fs_meta_cat(env: CommandEnv, args: list[str]) -> str:
+    import json as _json
+
+    if not args:
+        raise ShellError("usage: fs.meta.cat <path>")
+    path = args[0]
+    if not path.startswith("/"):
+        path = env.cwd.rstrip("/") + "/" + path
+    status, _, body = env.filer_read(path, "metadata=true")
+    if status != 200:
+        raise ShellError(f"{path}: not found")
+    return _json.dumps(_json.loads(body), indent=2)

@@ -321,3 +321,88 @@ def cmd_volume_tier_info(env: CommandEnv, args: list[str]) -> str:
     sv = _server_holding(env, vid, flags.get("node"))
     out = env.get(f"{sv.http}/admin/volume/tier_info?volume={vid}")
     return _json.dumps(out, indent=2)
+
+
+@command("volume.configure.replication",
+         "-volumeId <n> -replication <xyz> [-node host:port] — rewrite the "
+         "volume superblock's replica placement", needs_lock=True)
+def cmd_volume_configure_replication(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    applied = []
+    for sv in env.servers():
+        if flags.get("node") and sv.id != flags["node"] and sv.url != flags["node"]:
+            continue
+        if vid not in sv.volumes:
+            continue
+        env.post(f"{sv.http}/admin/volume/configure_replication",
+                 {"volume": vid, "replication": flags["replication"]})
+        applied.append(sv.id)
+    if not applied:
+        raise ShellError(f"no server holds volume {vid}")
+    return f"volume {vid} replication={flags['replication']} on: " + \
+        ", ".join(applied)
+
+
+@command("volume.delete.empty", "[-force] — delete volumes holding no live "
+         "files on every server", needs_lock=True)
+def cmd_volume_delete_empty(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    deleted = []
+    for sv in env.servers():
+        for vid, info in list(sv.volumes.items()):
+            if info.get("file_count", 0) - info.get("delete_count", 0) > 0:
+                continue
+            if info.get("size", 0) > 8 and flags.get("force") != "true":
+                continue  # has (deleted) data; demand -force
+            env.post(f"{sv.http}/admin/delete_volume", {"volume": vid})
+            deleted.append(f"{vid}@{sv.id}")
+    return "deleted: " + (", ".join(deleted) if deleted else "(none)")
+
+
+@command("volume.mount", "-volumeId <n> -node <host:port>", needs_lock=True)
+def cmd_volume_mount(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    sv = _find_server(env.servers(), flags["node"])
+    env.post(f"{sv.http}/admin/volume/mount", {"volume": vid})
+    return f"mounted volume {vid} on {sv.id}"
+
+
+@command("volume.unmount", "-volumeId <n> -node <host:port>", needs_lock=True)
+def cmd_volume_unmount(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    sv = _find_server(env.servers(), flags["node"])
+    env.post(f"{sv.http}/admin/volume/unmount", {"volume": vid})
+    return f"unmounted volume {vid} on {sv.id}"
+
+
+@command("volume.server.leave", "-node <host:port> — stop the server's "
+         "heartbeats so the master drops it", needs_lock=True)
+def cmd_volume_server_leave(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    sv = _find_server(env.servers(), flags["node"])
+    env.post(f"{sv.http}/admin/leave")
+    return f"{sv.id} left the cluster (heartbeats stopped)"
+
+
+@command("volume.tier.move",
+         "-volumeId <n> -dest <backend-id> [-keepLocal] — alias of "
+         "tier.upload after marking readonly", needs_lock=True)
+def cmd_volume_tier_move(env: CommandEnv, args: list[str]) -> str:
+    return cmd_volume_tier_upload(env, args)
+
+
+@command("volume.vacuum.disable", "suspend the master's automatic vacuum",
+         needs_lock=True)
+def cmd_volume_vacuum_disable(env: CommandEnv, args: list[str]) -> str:
+    env.post(f"{env.master_url}/vol/vacuum/disable")
+    return "automatic vacuum disabled"
+
+
+@command("volume.vacuum.enable", "resume the master's automatic vacuum",
+         needs_lock=True)
+def cmd_volume_vacuum_enable(env: CommandEnv, args: list[str]) -> str:
+    env.post(f"{env.master_url}/vol/vacuum/enable")
+    return "automatic vacuum enabled"

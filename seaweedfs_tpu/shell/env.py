@@ -38,6 +38,7 @@ class CommandEnv:
         self.filer_url = filer_url.rstrip("/") if filer_url else ""
         self.holder = holder
         self.locked = False
+        self.cwd = "/"  # fs.cd / fs.pwd working directory
 
     # --- cluster topology -----------------------------------------------------
     def topology(self) -> dict:

@@ -378,6 +378,13 @@ class Volume:
                 lo = mid + 1
         return entries[lo][0] if lo < len(entries) else self._size
 
+    def configure_replication(self, rp: ReplicaPlacement) -> None:
+        """Rewrite the superblock's replica-placement byte in place
+        (`volume_super_block.go` + shell volume.configure.replication)."""
+        with self._write_lock:
+            self.super_block.replica_placement = rp
+            self._dat.write_at(self.super_block.to_bytes()[:8], 0)
+
     # --- tiering -------------------------------------------------------------
     # (`weed/storage/volume_tier.go:14-79` + `volume_grpc_tier_upload.go`)
     def _load_tier_info(self) -> dict | None:

@@ -1,0 +1,153 @@
+"""Shell command parity additions: s3.*, fs.cd/pwd/meta.cat,
+volume.configure.replication/delete.empty/server.leave,
+volume.vacuum.enable/disable, cluster.raft.ps."""
+
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.shell.env import CommandEnv, ShellError
+from seaweedfs_tpu.shell.registry import COMMANDS, run_command
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    tmp = tmp_path_factory.mktemp("shx")
+    master = MasterServer(port=0)
+    master.start()
+    vol = VolumeServer([str(tmp / "v")], master_url=master.url, port=0)
+    vol.start()
+    vol.heartbeat_once()
+    filer = FilerServer(master_url=master.url, port=0)
+    filer.start()
+    yield master, vol, filer
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+@pytest.fixture()
+def env(cluster):
+    master, vol, filer = cluster
+    e = CommandEnv(master.url, filer_url=filer.url)
+    run_command(e, "lock")
+    yield e
+    try:
+        run_command(e, "unlock")
+    except Exception:
+        pass
+
+
+def test_command_count_parity():
+    # reference ships 60+ shell commands; we must not regress below that
+    assert len(COMMANDS) >= 60
+
+
+class TestS3Commands:
+    def test_bucket_lifecycle(self, env):
+        out = run_command(env, "s3.bucket.create -name photos")
+        assert "created" in out
+        assert "photos" in run_command(env, "s3.bucket.list")
+        out = run_command(env, "s3.bucket.quota -name photos -sizeMB 100")
+        assert "100MB" in out
+        assert "104857600" in run_command(env, "s3.bucket.quota -name photos")
+        out = run_command(env, "s3.bucket.delete -name photos")
+        assert "deleted" in out
+        assert "photos" not in run_command(env, "s3.bucket.list")
+        with pytest.raises(ShellError):
+            run_command(env, "s3.bucket.delete -name absent")
+
+    def test_s3_configure_identities(self, env):
+        out = run_command(
+            env,
+            "s3.configure -user alice -access_key AK1 -secret_key SK1 "
+            "-actions Read,Write",
+        )
+        assert "configured" in out
+        listing = run_command(env, "s3.configure")
+        cfg = json.loads(listing)
+        names = [i["name"] for i in cfg["identities"]]
+        assert "alice" in names
+        out = run_command(env, "s3.configure -user alice -delete")
+        assert "removed" in out
+
+    def test_clean_uploads(self, env, cluster):
+        master, vol, filer = cluster
+        run_command(env, "s3.bucket.create -name stage")
+        from seaweedfs_tpu.filer.filer_client import FilerClient
+
+        fc = FilerClient(filer.url)
+        fc.put("/buckets/stage/.uploads/upl1/00001.part", b"x" * 100)
+        out = run_command(env, "s3.clean.uploads -timeAgo 0s")
+        assert "removed 1" in out
+
+    def test_circuitbreaker(self, env):
+        out = run_command(env, "s3.circuitbreaker -global.readLimit 128")
+        assert json.loads(out)["global"]["readLimit"] == 128
+
+
+class TestFsNav:
+    def test_cd_pwd_meta_cat(self, env, cluster):
+        master, vol, filer = cluster
+        from seaweedfs_tpu.filer.filer_client import FilerClient
+
+        fc = FilerClient(filer.url)
+        fc.put("/nav/sub/file.txt", b"hello nav")
+        assert run_command(env, "fs.pwd") == "/"
+        assert run_command(env, "fs.cd /nav") == "/nav"
+        assert run_command(env, "fs.cd sub") == "/nav/sub"
+        assert run_command(env, "fs.pwd") == "/nav/sub"
+        meta = json.loads(run_command(env, "fs.meta.cat file.txt"))
+        assert meta["full_path"] == "/nav/sub/file.txt"
+        with pytest.raises(ShellError):
+            run_command(env, "fs.cd /nav/sub/file.txt")  # not a dir
+        env.cwd = "/"
+
+
+class TestVolumeExtra:
+    def _make_volume(self, master, vol):
+        from seaweedfs_tpu.server.httpd import http_request
+
+        status, _, body = http_request("GET", master.url + "/dir/assign")
+        out = json.loads(body)
+        http_request("POST", f"http://{out['url']}/{out['fid']}",
+                     body=b"some data")
+        vol.heartbeat_once()
+        return int(out["fid"].split(",")[0])
+
+    def test_configure_replication(self, env, cluster):
+        master, vol, filer = cluster
+        vid = self._make_volume(master, vol)
+        out = run_command(
+            env, f"volume.configure.replication -volumeId {vid} -replication 001"
+        )
+        assert "replication=001" in out
+        v = vol.store.get_volume(vid)
+        assert str(v.super_block.replica_placement) == "001"
+        run_command(
+            env, f"volume.configure.replication -volumeId {vid} -replication 000"
+        )
+
+    def test_vacuum_toggle(self, env, cluster):
+        master, _, _ = cluster
+        assert "disabled" in run_command(env, "volume.vacuum.disable")
+        assert master.vacuum_enabled is False
+        assert "enabled" in run_command(env, "volume.vacuum.enable")
+        assert master.vacuum_enabled is True
+
+    def test_raft_ps_single_master(self, env):
+        out = run_command(env, "cluster.raft.ps")
+        assert "raft disabled" in out
+
+    def test_delete_empty_skips_live(self, env, cluster):
+        master, vol, filer = cluster
+        vid = self._make_volume(master, vol)
+        out = run_command(env, "volume.delete.empty")
+        # the live volume holds data -> not deleted
+        assert f"{vid}@" not in out
+        assert vol.store.get_volume(vid) is not None
