@@ -32,6 +32,10 @@ COMMANDS: dict[str, tuple[str, str, str]] = {
         "seaweedfs_tpu.command.server_cmds", "run_s3",
         "start the S3 gateway against a filer",
     ),
+    "ftp": (
+        "seaweedfs_tpu.command.server_cmds", "run_ftp",
+        "start the FTP gateway against a filer",
+    ),
     "iam": (
         "seaweedfs_tpu.command.server_cmds", "run_iam",
         "start the IAM management API against a filer",

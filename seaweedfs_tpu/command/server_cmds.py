@@ -315,3 +315,25 @@ def run_mount(args: list[str]) -> int:
         print(f"cannot mount: {e} (needs /dev/fuse and CAP_SYS_ADMIN)")
         return 1
     return 0
+
+
+def run_ftp(args: list[str]) -> int:
+    """FTP gateway against a running filer (reference ships only a stub —
+    `weed/ftpd/ftp_server.go`; this one is wired)."""
+    p = argparse.ArgumentParser(prog="weed-tpu ftp")
+    p.add_argument("-port", type=int, default=2121)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-user", default="")
+    p.add_argument("-password", default="")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.ftpd import FtpServer
+
+    filer = opts.filer
+    if not filer.startswith("http"):
+        filer = f"http://{filer}"
+    srv = FtpServer(filer, host=opts.ip, port=opts.port,
+                    user=opts.user, password=opts.password)
+    srv.start()
+    print(f"ftp gateway listening at {opts.ip}:{srv.port}")
+    return _wait_forever()

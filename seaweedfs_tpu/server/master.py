@@ -348,6 +348,31 @@ class MasterServer:
                 }
             )
 
+        @svc.route("GET", r"/ui")
+        def ui(req: Request) -> Response:
+            # minimal HTML status page (`weed/server/master_ui/`)
+            rows = []
+            for node in self.topo.all_nodes():
+                rows.append(
+                    f"<tr><td>{node.id}</td><td>{node.dc_name()}</td>"
+                    f"<td>{node.rack_name()}</td>"
+                    f"<td>{len(node.volumes)}</td></tr>"
+                )
+            html = (
+                "<html><head><title>seaweedfs-tpu master</title></head><body>"
+                f"<h1>Master {self.url}</h1>"
+                f"<p>leader: {self.leader_url()} | max volume id: "
+                f"{self.topo._max_volume_id}</p>"
+                "<table border=1><tr><th>volume server</th><th>DC</th>"
+                "<th>rack</th><th>volumes</th></tr>"
+                + "".join(rows) + "</table>"
+                "<p><a href='/dir/status'>topology json</a> | "
+                "<a href='/cluster/ps'>cluster ps</a> | "
+                "<a href='/metrics'>metrics</a></p>"
+                "</body></html>"
+            ).encode()
+            return Response(html, content_type="text/html")
+
         @svc.route("GET", r"/dir/status")
         def dir_status(req: Request) -> Response:
             return Response({"Topology": self.topo.to_dict(), "Version": "seaweedfs-tpu"})

@@ -271,6 +271,34 @@ class VolumeServer:
             self.store.mark_readonly(int(p["volume"]), bool(p.get("readonly", True)))
             return Response({"ok": True})
 
+        @svc.route("GET", r"/ui")
+        def ui(req: Request) -> Response:
+            # minimal HTML status page (`weed/server/volume_server_ui/`)
+            rows = []
+            if self.store is not None:
+                for vid in self.store.volume_ids():
+                    v = self.store.get_volume(vid)
+                    if v is None:
+                        continue
+                    rows.append(
+                        f"<tr><td>{vid}</td><td>{v.collection or '(default)'}"
+                        f"</td><td>{v.size()}</td><td>{v.file_count()}</td>"
+                        f"<td>{v.garbage_level():.1%}</td>"
+                        f"<td>{'ro' if v.readonly else 'rw'}</td></tr>"
+                    )
+            html = (
+                "<html><head><title>seaweedfs-tpu volume</title></head><body>"
+                f"<h1>Volume server {self.url}</h1>"
+                f"<p>master: {self.master_url}</p>"
+                "<table border=1><tr><th>id</th><th>collection</th>"
+                "<th>size</th><th>files</th><th>garbage</th><th>mode</th></tr>"
+                + "".join(rows) + "</table>"
+                "<p><a href='/status'>status json</a> | "
+                "<a href='/metrics'>metrics</a></p>"
+                "</body></html>"
+            ).encode()
+            return Response(html, content_type="text/html")
+
         @svc.route("POST", r"/admin/volume/configure_replication")
         def configure_replication(req: Request) -> Response:
             from seaweedfs_tpu.storage.types import ReplicaPlacement

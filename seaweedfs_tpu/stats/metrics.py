@@ -247,3 +247,39 @@ _default = Registry()
 
 def default_registry() -> Registry:
     return _default
+
+
+def start_push_loop(push_url: str, role: str, instance: str,
+                    interval_sec: float = 15.0, stop_event=None):
+    """Background push of the registry to a Prometheus push gateway
+    (`weed/stats/metrics.go` LoopPushingMetric). Returns the thread."""
+    import threading
+    import time as _time
+    import urllib.parse
+    import urllib.request
+
+    reg = default_registry()
+    url = (f"{push_url.rstrip('/')}/metrics/job/{role}"
+           f"/instance/{urllib.parse.quote(instance, safe='')}")
+
+    def push_once():
+        body = reg.render().encode()
+        req = urllib.request.Request(url, data=body, method="PUT")
+        req.add_header("Content-Type", "text/plain")
+        urllib.request.urlopen(req, timeout=10).read()
+
+    def loop():
+        while True:
+            try:
+                push_once()
+            except Exception:
+                pass
+            if stop_event is not None:
+                if stop_event.wait(interval_sec):
+                    return
+            else:
+                _time.sleep(interval_sec)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
