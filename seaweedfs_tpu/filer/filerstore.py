@@ -204,4 +204,16 @@ def make_store(kind: str, path: str | None = None) -> FilerStore:
         from .kvstore import LocalKVStore
 
         return LocalKVStore(path)
+    if kind == "redis":
+        from .stores_gated import RedisStore
+
+        return RedisStore()
+    if kind == "mysql":
+        from .stores_gated import MysqlStore
+
+        return MysqlStore()
+    if kind == "postgres":
+        from .stores_gated import PostgresStore
+
+        return PostgresStore()
     raise ValueError(f"unknown filer store {kind!r}")

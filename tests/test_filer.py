@@ -15,10 +15,20 @@ from seaweedfs_tpu.server.master import MasterServer
 from seaweedfs_tpu.server.volume import VolumeServer
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "abstract_sql"])
 def store(request, tmp_path):
     if request.param == "memory":
         return MemoryStore()
+    if request.param == "abstract_sql":
+        # the shared SQL layer the gated mysql/postgres stores ride on,
+        # proven against sqlite3's DB-API
+        import sqlite3
+
+        from seaweedfs_tpu.filer.stores_gated import AbstractSqlStore
+
+        conn = sqlite3.connect(str(tmp_path / "abs.db"),
+                               check_same_thread=False)
+        return AbstractSqlStore(conn)
     return SqliteStore(str(tmp_path / "meta.db"))
 
 
@@ -178,3 +188,12 @@ class TestFilerHTTP:
         etag = headers["ETag"]
         status, _, body = http_request("GET", url, headers={"If-None-Match": etag})
         assert status == 304 and body == b""
+
+
+class TestGatedStores:
+    def test_gated_stores_raise_clear_errors(self):
+        from seaweedfs_tpu.filer.filerstore import make_store
+
+        for kind in ("redis", "mysql", "postgres"):
+            with pytest.raises(RuntimeError, match="requires"):
+                make_store(kind)
