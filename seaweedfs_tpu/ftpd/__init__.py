@@ -56,6 +56,7 @@ class FtpServer:
         fc = FilerClient(self.filer_url)
         cwd = "/"
         authed_user = ""
+        logged_in = False
         data_listener: socket.socket | None = None
 
         def send(line: str) -> None:
@@ -97,6 +98,7 @@ class FtpServer:
                     ):
                         send("530 login incorrect")
                     else:
+                        logged_in = True
                         send("230 logged in")
                 elif cmd in ("SYST",):
                     send("215 UNIX Type: L8")
@@ -109,6 +111,9 @@ class FtpServer:
                     send("200 type set")
                 elif cmd == "NOOP":
                     send("200 ok")
+                elif not logged_in:
+                    # every filesystem verb demands a completed login
+                    send("530 please login with USER and PASS")
                 elif cmd == "PWD":
                     send(f'257 "{cwd}"')
                 elif cmd == "CWD":

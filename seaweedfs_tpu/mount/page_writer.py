@@ -52,6 +52,7 @@ class UploadPipeline:
         self.upload_fn = upload_fn
         self.chunk_size = chunk_size
         self._writable: dict[int, PageChunk] = {}
+        self._sealed: list[PageChunk] = []  # uploading, still readable
         self._lock = threading.Lock()
         self._executor = LimitedConcurrentExecutor(concurrency)
         self._pending: list = []  # futures -> list[FileChunk]
@@ -79,10 +80,11 @@ class UploadPipeline:
 
     def read_back(self, offset: int, size: int) -> list[tuple[int, bytes]]:
         """Dirty spans overlapping [offset, offset+size) still buffered here
-        (readback-before-upload: reads must see unflushed writes)."""
+        — both writable chunks AND sealed chunks whose uploads haven't been
+        committed to the entry yet (readback-before-upload)."""
         out = []
         with self._lock:
-            chunks = list(self._writable.values())
+            chunks = self._sealed + list(self._writable.values())
         for pc in chunks:
             base = pc.index * self.chunk_size
             for s, data in pc.intervals():
@@ -97,6 +99,7 @@ class UploadPipeline:
 
     def _seal(self, pc: PageChunk) -> None:
         ts_ns = time.time_ns()
+        self._sealed.append(pc)  # caller holds _lock (or is single-owner)
 
         def do_upload():
             out = []
@@ -116,8 +119,8 @@ class UploadPipeline:
         with self._lock:
             leftovers = list(self._writable.values())
             self._writable.clear()
-        for pc in leftovers:
-            self._seal(pc)
+            for pc in leftovers:
+                self._seal(pc)
         chunks: list[FileChunk] = []
         pending, self._pending = self._pending, []
         errors = []
@@ -126,6 +129,10 @@ class UploadPipeline:
                 chunks.extend(fut.result(timeout=120))
             except Exception as e:  # surface on fsync like the reference
                 errors.append(e)
+        with self._lock:
+            # sealed buffers are committed (or failed) — reads now come
+            # from the entry's chunk list
+            self._sealed.clear()
         if errors:
             raise errors[0]
         chunks.sort(key=lambda c: c.offset)

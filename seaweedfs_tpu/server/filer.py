@@ -420,6 +420,14 @@ class FilerServer:
                 return Response({"error": f"{path} not found"}, 404)
             if entry.is_directory:
                 walk(path)
+            elif entry.extended.get(REMOTE_KEY) and (
+                entry.chunks or entry.content
+            ):
+                self._reclaim_chunks(entry.chunks)
+                entry.chunks = []
+                entry.content = b""
+                self.filer.update_entry(entry)
+                dropped = 1
             return Response({"ok": True, "uncached": dropped})
 
     # --- routes -----------------------------------------------------------------

@@ -308,6 +308,10 @@ class MasterServer:
         svc.route("POST", r"/dir/assign")(do_assign)
 
         def do_lookup(req: Request) -> Response:
+            if not self._is_leader():
+                # followers have empty topologies (heartbeats are
+                # leader-only) — redirect instead of a misleading 404
+                return self._not_leader_response()
             vid_s = req.query.get("volumeId", "")
             if "," in vid_s:
                 vid_s = vid_s.split(",")[0]

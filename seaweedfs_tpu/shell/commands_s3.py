@@ -127,15 +127,11 @@ def cmd_s3_configure(env: CommandEnv, args: list[str]) -> str:
     name = flags["user"]
     identities[:] = [i for i in identities if i.get("name") != name]
     if flags.get("delete") != "true":
-        actions = [
-            a if ":" in a or not flags.get("buckets")
-            else a  # plain action applies to all buckets
-            for a in (flags.get("actions", "Read,Write,List").split(","))
-        ]
+        actions = flags.get("actions", "Read,Write,List").split(",")
         if flags.get("buckets"):
             actions = [
                 f"{a}:{b}"
-                for a in flags.get("actions", "Read,Write,List").split(",")
+                for a in actions
                 for b in flags["buckets"].split(",")
             ]
         identities.append({
