@@ -50,6 +50,29 @@ class NativeLib:
             ctypes.c_size_t,
             ctypes.c_char_p,
         ]
+        self._lib.sw_gf256_matmul2d.restype = None
+        self._lib.sw_gf256_matmul2d.argtypes = [
+            ctypes.c_char_p,  # matrix rows*cols
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_void_p,  # in (cols, n) row-major
+            ctypes.c_void_p,  # out (rows, n) row-major
+            ctypes.c_size_t,
+        ]
+        self._lib.sw_gf256_has_gfni.restype = ctypes.c_int
+        self._lib.sw_gf256_has_gfni.argtypes = []
+        self._lib.sw_gf256_set_gfni.restype = ctypes.c_int
+        self._lib.sw_gf256_set_gfni.argtypes = [ctypes.c_int]
+        self._lib.sw_gf256_encode_rows.restype = None
+        self._lib.sw_gf256_encode_rows.argtypes = [
+            ctypes.c_char_p,  # matrix rows*cols
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_void_p,  # in: row_count rows of cols*block bytes
+            ctypes.c_size_t,  # block
+            ctypes.c_int,  # row_count
+            ctypes.c_void_p,  # out (rows, row_count*block)
+        ]
 
     def has(self, _name: str) -> bool:
         return True
@@ -69,6 +92,43 @@ class NativeLib:
         )
         self._lib.sw_gf256_matmul(matrix, rows, cols, in_arr, out_arr, out_len)
         return [o.raw for o in outs]
+
+    def gf256_matmul2d(self, matrix: bytes, data, out=None):
+        """Zero-copy variant: data is a C-contiguous uint8 numpy array
+        (cols, n); writes/returns (rows, n). No per-shard byte copies —
+        this is the pipeline hot path (ctypes releases the GIL)."""
+        import numpy as np
+
+        rows = len(matrix) // data.shape[0]
+        cols, n = data.shape
+        if out is None:
+            out = np.empty((rows, n), dtype=np.uint8)
+        self._lib.sw_gf256_matmul2d(
+            matrix, rows, cols,
+            data.ctypes.data, out.ctypes.data, n,
+        )
+        return out
+
+    def gf256_encode_rows(self, matrix: bytes, parity: int, cols: int,
+                          buf, block: int, row_count: int, out=None):
+        """Row-batched encode (see sw_gf256_encode_rows). buf is a
+        C-contiguous uint8 array of row_count*cols*block bytes; returns
+        (parity, row_count*block) uint8."""
+        import numpy as np
+
+        if out is None:
+            out = np.empty((parity, row_count * block), dtype=np.uint8)
+        self._lib.sw_gf256_encode_rows(
+            matrix, parity, cols, buf.ctypes.data, block, row_count,
+            out.ctypes.data,
+        )
+        return out
+
+    def has_gfni(self) -> bool:
+        return bool(self._lib.sw_gf256_has_gfni())
+
+    def set_gfni(self, enabled: bool) -> bool:
+        return bool(self._lib.sw_gf256_set_gfni(1 if enabled else 0))
 
     def md5_batch(self, blobs: bytes, n: int, blob_len: int) -> bytes:
         out = ctypes.create_string_buffer(n * 16)

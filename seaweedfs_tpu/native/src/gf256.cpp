@@ -1,20 +1,27 @@
 // GF(2^8) shard matmul: out[r] = sum_c M[r][c] * in[c] over the Rijndael-free
 // polynomial 0x11D field used by Backblaze/klauspost Reed-Solomon.
-// CPU stand-in for klauspost/reedsolomon's AVX2 kernels
-// (weed/storage/erasure_coding/ec_encoder.go:202). Table-driven with 64-bit
-// SWAR XOR accumulate; -march=native lets the compiler autovectorize.
+// CPU equivalent of klauspost/reedsolomon's vector kernels
+// (weed/storage/erasure_coding/ec_encoder.go:202): on GFNI+AVX512 hardware
+// each coefficient becomes an 8x8 GF(2) bit-matrix applied 64 bytes at a
+// time by VGF2P8AFFINEQB (klauspost's own fast path); otherwise a
+// table-driven SWAR loop. The GFNI path is verified against the table at
+// init and disabled on mismatch, so output is always byte-identical.
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
 #include <vector>
+
+#if defined(__GFNI__) && defined(__AVX512F__) && defined(__AVX512BW__)
+#include <immintrin.h>
+#define SW_HAVE_GFNI 1
+#endif
 
 namespace {
 
 uint8_t mul_table[256][256];
 bool gf_ready = false;
 
-void init_gf() {
-    if (gf_ready) return;
+void init_tables() {
     uint8_t exp_t[512];
     int log_t[256];
     int x = 1;
@@ -32,8 +39,105 @@ void init_gf() {
     for (int a = 1; a < 256; a++)
         for (int b = 1; b < 256; b++)
             mul_table[a][b] = exp_t[log_t[a] + log_t[b]];
+}
+
+#ifdef SW_HAVE_GFNI
+// 8x8 bit-matrix operand for GF2P8AFFINEQB so that affine(x, A, 0) == c*x
+// in GF(2^8)/0x11D. Result bit i = parity(A.byte[7-i] & x), so byte (7-i)
+// holds, per input bit k, bit i of c*2^k.
+uint64_t affine_matrix(uint8_t c) {
+    uint8_t p[8];
+    for (int k = 0; k < 8; k++) p[k] = mul_table[c][(uint8_t)(1u << k)];
+    uint64_t m = 0;
+    for (int i = 0; i < 8; i++) {
+        uint8_t row = 0;
+        for (int k = 0; k < 8; k++) row |= (uint8_t)(((p[k] >> i) & 1) << k);
+        m |= (uint64_t)row << (8 * (7 - i));
+    }
+    return m;
+}
+
+bool gfni_selftest() {
+    alignas(64) uint8_t src[64], dst[64];
+    for (int i = 0; i < 64; i++) src[i] = (uint8_t)(i * 7 + 3);
+    const uint8_t coefs[4] = {2, 0x1D, 0xFF, 7};
+    for (uint8_t c : coefs) {
+        __m512i a = _mm512_set1_epi64((long long)affine_matrix(c));
+        __m512i x = _mm512_loadu_si512((const void*)src);
+        _mm512_storeu_si512((void*)dst, _mm512_gf2p8affine_epi64_epi8(x, a, 0));
+        for (int i = 0; i < 64; i++)
+            if (dst[i] != mul_table[c][src[i]]) return false;
+    }
+    return true;
+}
+#endif
+
+bool gfni_ok = false;
+
+void init_gf() {
+    if (gf_ready) return;
+    init_tables();
+#ifdef SW_HAVE_GFNI
+    if (__builtin_cpu_supports("avx512f") && __builtin_cpu_supports("avx512bw") &&
+        __builtin_cpu_supports("gfni"))
+        gfni_ok = gfni_selftest();
+#endif
     gf_ready = true;
 }
+
+void matmul_table(const unsigned char* matrix, int rows, int cols,
+                  const unsigned char** inputs, unsigned char** outputs,
+                  size_t lo, size_t hi) {
+    for (int r = 0; r < rows; r++) {
+        unsigned char* out = outputs[r];
+        std::memset(out + lo, 0, hi - lo);
+        for (int c = 0; c < cols; c++) {
+            uint8_t coef = matrix[r * cols + c];
+            if (coef == 0) continue;
+            const uint8_t* row = mul_table[coef];
+            const unsigned char* in = inputs[c];
+            if (coef == 1) {
+                for (size_t i = lo; i < hi; i++) out[i] ^= in[i];
+            } else {
+                for (size_t i = lo; i < hi; i++) out[i] ^= row[in[i]];
+            }
+        }
+    }
+}
+
+#ifdef SW_HAVE_GFNI
+void matmul_gfni(const unsigned char* matrix, int rows, int cols,
+                 const unsigned char** inputs, unsigned char** outputs,
+                 size_t n) {
+    std::vector<__m512i> am((size_t)rows * cols);
+    for (int r = 0; r < rows; r++)
+        for (int c = 0; c < cols; c++)
+            am[(size_t)r * cols + c] =
+                _mm512_set1_epi64((long long)affine_matrix(matrix[r * cols + c]));
+    size_t vec_end = n & ~(size_t)63;
+    __m512i x[32];
+    for (size_t off = 0; off < vec_end; off += 64) {
+        for (int c = 0; c < cols; c++)
+            x[c] = _mm512_loadu_si512((const void*)(inputs[c] + off));
+        for (int r = 0; r < rows; r++) {
+            __m512i acc = _mm512_setzero_si512();
+            for (int c = 0; c < cols; c++) {
+                uint8_t coef = matrix[r * cols + c];
+                if (coef == 0) continue;
+                if (coef == 1)
+                    acc = _mm512_xor_si512(acc, x[c]);
+                else
+                    acc = _mm512_xor_si512(
+                        acc, _mm512_gf2p8affine_epi64_epi8(
+                                 x[c], am[(size_t)r * cols + c], 0));
+            }
+            _mm512_storeu_si512((void*)(outputs[r] + off), acc);
+        }
+    }
+    if (vec_end < n)
+        matmul_table(matrix, rows, cols, inputs, outputs, vec_end, n);
+}
+#endif
 
 } // namespace
 
@@ -41,19 +145,69 @@ extern "C" void sw_gf256_matmul(const unsigned char* matrix, int rows, int cols,
                                 const unsigned char** inputs,
                                 unsigned char** outputs, size_t n) {
     init_gf();
-    for (int r = 0; r < rows; r++) {
-        unsigned char* out = outputs[r];
-        std::memset(out, 0, n);
-        for (int c = 0; c < cols; c++) {
-            uint8_t coef = matrix[r * cols + c];
-            if (coef == 0) continue;
-            const uint8_t* row = mul_table[coef];
-            const unsigned char* in = inputs[c];
-            if (coef == 1) {
-                for (size_t i = 0; i < n; i++) out[i] ^= in[i];
-            } else {
-                for (size_t i = 0; i < n; i++) out[i] ^= row[in[i]];
-            }
-        }
+    if (rows <= 0 || cols <= 0) return;
+#ifdef SW_HAVE_GFNI
+    // the GFNI block loop keeps all inputs in registers and caps at 32
+    // shards; wider matrices take the (unbounded) table path
+    if (gfni_ok && n >= 64 && cols <= 32) {
+        matmul_gfni(matrix, rows, cols, inputs, outputs, n);
+        return;
     }
+#endif
+    matmul_table(matrix, rows, cols, inputs, outputs, 0, n);
+}
+
+// Contiguous-layout entry: in is (cols, n) row-major, out is (rows, n)
+// row-major — lets callers pass numpy buffers with zero copies.
+extern "C" void sw_gf256_matmul2d(const unsigned char* matrix, int rows,
+                                  int cols, const unsigned char* in,
+                                  unsigned char* out, size_t n) {
+    if (rows <= 0 || cols <= 0) return;
+    std::vector<const unsigned char*> ins(cols);
+    std::vector<unsigned char*> outs(rows);
+    for (int c = 0; c < cols; c++) ins[c] = in + (size_t)c * n;
+    for (int r = 0; r < rows; r++) outs[r] = out + (size_t)r * n;
+    sw_gf256_matmul(matrix, rows, cols, ins.data(), outs.data(), n);
+}
+
+// Row-batched EC encode over the reference's striped row layout
+// (`ec_encoder.go:198-235`): `in` holds row_count consecutive rows of
+// cols*block bytes straight from the .dat; parity lands as (rows,
+// row_count*block) with row r2's parity at columns [r2*block, (r2+1)*block).
+// One call per pipeline chunk keeps the GIL released for the whole batch.
+extern "C" void sw_gf256_encode_rows(const unsigned char* matrix, int rows,
+                                     int cols, const unsigned char* in,
+                                     size_t block, int row_count,
+                                     unsigned char* out) {
+    if (rows <= 0 || cols <= 0) return;
+    std::vector<const unsigned char*> ins(cols);
+    std::vector<unsigned char*> outs(rows);
+    size_t span = (size_t)row_count * block;
+    for (int r2 = 0; r2 < row_count; r2++) {
+        for (int c = 0; c < cols; c++)
+            ins[c] = in + ((size_t)r2 * cols + c) * block;
+        for (int r = 0; r < rows; r++)
+            outs[r] = out + (size_t)r * span + (size_t)r2 * block;
+        sw_gf256_matmul(matrix, rows, cols, ins.data(), outs.data(), block);
+    }
+}
+
+extern "C" int sw_gf256_has_gfni() {
+    init_gf();
+    return gfni_ok ? 1 : 0;
+}
+
+// Benchmark hook: force the scalar table path (the r1 baseline kernel) so
+// the GFNI speedup can be measured against it. Returns the previous state.
+extern "C" int sw_gf256_set_gfni(int enabled) {
+    init_gf();
+    int prev = gfni_ok ? 1 : 0;
+#ifdef SW_HAVE_GFNI
+    gfni_ok = enabled && __builtin_cpu_supports("avx512f") &&
+              __builtin_cpu_supports("avx512bw") &&
+              __builtin_cpu_supports("gfni") && gfni_selftest();
+#else
+    (void)enabled;
+#endif
+    return prev;
 }

@@ -2,71 +2,233 @@
 
 Produces byte-identical shard files to the reference's
 `WriteEcFiles`/`RebuildEcFiles` (`weed/storage/erasure_coding/ec_encoder.go`)
-but with a redesigned execution pipeline: instead of the reference's
-single-threaded 256KB read→encode→write loop (`ec_encoder.go:132-137`), rows
-are encoded in large batches through ops.rs_kernel.RSCodec so the GF(2^8)
-math runs as one bit-plane matmul per batch on the TPU (overlapping host IO
-with device compute via JAX's async dispatch).
+with a redesigned execution pipeline. The reference runs a single-threaded
+256KB read -> encode -> write loop (`ec_encoder.go:132-137`); here three
+stages overlap:
+
+    reader thread --(bounded queue)--> GF transform --(bounded queue)--> writer thread
+
+* the reader pre-fetches row batches from the .dat into a small ring of
+  reusable host buffers (positional pread, zero-padded past EOF);
+* the transform stage submits each batch to the RSCodec pipeline backend —
+  on the TPU that is chunked host->HBM puts feeding the Pallas bit-plane
+  matmul with async dispatch, on the CPU one GIL-released GFNI/AVX-512
+  call — and only PARITY ever crosses back from the device (4/14 of the
+  output bytes; data shards are written straight from the read buffer);
+* the writer thread blocks on each batch's parity and lays both data and
+  parity bytes into the 14 shard files with positional pwrite.
+
+The pipeline backend is chosen by measured end-to-end rate
+(ops.rs_kernel.pick_pipeline_backend), so a chip behind a slow relay loses
+to the host GFNI path instead of silently dragging the verb down.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
+import threading
 
 import numpy as np
 
-from seaweedfs_tpu.ops.rs_kernel import RSCodec
+from seaweedfs_tpu.ops.rs_kernel import RSCodec, pick_pipeline_backend
 from seaweedfs_tpu.storage import idx as idx_mod
 from seaweedfs_tpu.storage.types import size_is_valid
 
 from .geometry import (
     DATA_SHARDS_COUNT,
     LARGE_BLOCK_SIZE,
+    PARITY_SHARDS_COUNT,
     SMALL_BLOCK_SIZE,
     TOTAL_SHARDS_COUNT,
+    shard_file_size,
     to_ext,
 )
 
-# device batch per shard per step (columns of the bit-plane matmul)
-DEFAULT_BATCH = 4 * 1024 * 1024
+# Max bytes per shard per pipeline batch (= matmul columns per step),
+# per backend. The host path wants the whole (read buffer + parity) working
+# set resident in LLC — 1MB/shard = ~14MB touched per step, which measures
+# ~75% faster than 16MB batches on a 1-core/260MB-L3 host. The device path
+# wants large batches to amortize transfer/dispatch overhead instead.
+DEFAULT_BATCH_HOST = 1024 * 1024
+DEFAULT_BATCH_DEVICE = 32 * 1024 * 1024
+# Back-compat alias (tests/benches may import it)
+DEFAULT_BATCH = DEFAULT_BATCH_HOST
 
 
-def _read_block(f, offset: int, size: int) -> np.ndarray:
-    """pread with zero padding past EOF (reference encodeDataOneBatch:166-177)."""
-    f.seek(offset)
-    data = f.read(size)
-    buf = np.zeros(size, dtype=np.uint8)
-    if data:
-        buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
-    return buf
+def _default_batch(backend: str) -> int:
+    return DEFAULT_BATCH_DEVICE if backend == "jax" else DEFAULT_BATCH_HOST
+
+_QUEUE_DEPTH = 2
 
 
-def _encode_rows(
-    dat,
-    outputs,
-    codec: RSCodec,
-    start_offset: int,
-    block_size: int,
-    row_count: int,
-    batch: int,
-) -> None:
-    """Encode `row_count` rows of 10 x block_size starting at start_offset."""
-    for row in range(row_count):
-        row_off = start_offset + row * block_size * DATA_SHARDS_COUNT
+def _pread_padded(fd: int, offset: int, size: int, out: np.ndarray) -> None:
+    """Zero-copy positional read into out[:size] (preadv straight into the
+    numpy buffer), zero-filling past EOF (reference encodeDataOneBatch:166-177
+    pads the last batch the same way)."""
+    got = os.preadv(fd, [memoryview(out)[:size]], offset)
+    if got < size:
+        out[got:size] = 0
+
+
+def _schedule(total: int, large: int, small: int, batch: int):
+    """Yield pipeline work units covering the reference's row layout
+    (`ec_encoder.go:198-235`): large rows while more than one full large row
+    remains, then small rows (last one zero-padded).
+
+    ("rows", dat_off, shard_off, block, nrows): nrows whole rows read
+        contiguously from the .dat.
+    ("cols", dat_off, shard_off, block, done, width): a width-column slice
+        of one row whose block exceeds the batch budget; data shard c lives
+        at dat_off + c*block + done.
+    """
+    remaining = total
+    processed = 0
+    shard_off = 0
+
+    def _emit_cols(block: int):
+        nonlocal processed, shard_off
         done = 0
-        while done < block_size:
-            step = min(batch, block_size - done)
-            data = np.stack(
-                [
-                    _read_block(dat, row_off + i * block_size + done, step)
-                    for i in range(DATA_SHARDS_COUNT)
-                ]
-            )
-            shards = codec.encode_all(data)
-            for i in range(TOTAL_SHARDS_COUNT):
-                outputs[i].write(shards[i].tobytes())
-            done += step
+        while done < block:
+            width = min(batch, block - done)
+            yield ("cols", processed, shard_off, block, done, width)
+            done += width
+        processed += block * DATA_SHARDS_COUNT
+        shard_off += block
+
+    large_row = large * DATA_SHARDS_COUNT
+    while remaining > large_row:
+        if large <= batch:
+            nrows_possible = (remaining - 1) // large_row  # full large rows left
+            nrows = max(1, min(nrows_possible, batch // large))
+            yield ("rows", processed, shard_off, large, nrows)
+            processed += nrows * large_row
+            shard_off += nrows * large
+            remaining -= nrows * large_row
+        else:
+            yield from _emit_cols(large)
+            remaining -= large_row
+    small_row = small * DATA_SHARDS_COUNT
+    while remaining > 0:
+        if small <= batch:
+            rows_left = -(-remaining // small_row)  # ceil: last row is padded
+            nrows = max(1, min(rows_left, batch // small))
+            yield ("rows", processed, shard_off, small, nrows)
+            processed += nrows * small_row
+            shard_off += nrows * small
+            remaining -= nrows * small_row
+        else:
+            yield from _emit_cols(small)
+            remaining -= small_row
+
+
+class _ShardWriters:
+    """14 positional-write fds; existing files are overwritten in place
+    (tmpfs/page-cache overwrite is far cheaper than fresh allocation) and
+    truncated to the final shard size on close."""
+
+    def __init__(self, base: str, final_size: int, shard_ids=None) -> None:
+        self.fds: dict[int, int] = {}
+        self.final_size = final_size
+        for i in shard_ids if shard_ids is not None else range(TOTAL_SHARDS_COUNT):
+            path = base + to_ext(i)
+            self.fds[i] = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+
+    def pwrite(self, shard: int, data, offset: int) -> None:
+        os.pwrite(self.fds[shard], data, offset)
+
+    def pwritev(self, shard: int, views, offset: int) -> None:
+        """Scatter-gather write: one syscall, no host-side concat copy."""
+        os.pwritev(self.fds[shard], views, offset)
+
+    def close(self) -> None:
+        for fd in self.fds.values():
+            os.ftruncate(fd, self.final_size)
+            os.close(fd)
+        self.fds.clear()
+
+
+def _run_pipeline(jobs, read_job, encode_job, write_job) -> None:
+    """reader thread -> encode (caller thread) -> writer thread, with
+    bounded queues, a shared buffer freelist for backpressure, and a stop
+    flag so a failure in any stage unwinds the other two instead of
+    deadlocking on a full/empty queue."""
+    read_q: queue.Queue = queue.Queue(maxsize=_QUEUE_DEPTH)
+    write_q: queue.Queue = queue.Queue(maxsize=_QUEUE_DEPTH)
+    free: queue.Queue = queue.Queue()
+    for _ in range(_QUEUE_DEPTH + 2):
+        free.put(None)  # buffer slots; reader sizes/reuses lazily
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def _put(q: queue.Queue, item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def reader():
+        try:
+            for job in jobs:
+                if stop.is_set():
+                    return
+                slot = free.get()
+                buf = read_job(job, slot)
+                if not _put(read_q, (job, buf)):
+                    return
+        except BaseException as e:  # noqa: BLE001 - propagated below
+            errors.append(e)
+            stop.set()
+        finally:
+            _put(read_q, None) or read_q.put(None)
+
+    def writer():
+        try:
+            while True:
+                item = write_q.get()
+                if item is None:
+                    return
+                job, buf, handle = item
+                write_job(job, buf, handle)
+                free.put(buf)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            stop.set()
+            while True:  # drain + recycle buffers so reader/encode never block
+                item = write_q.get()
+                if item is None:
+                    return
+                free.put(item[1])
+
+    rt = threading.Thread(target=reader, name="ec-reader", daemon=True)
+    wt = threading.Thread(target=writer, name="ec-writer", daemon=True)
+    rt.start()
+    wt.start()
+    try:
+        while True:
+            item = read_q.get()
+            if item is None:
+                break
+            job, buf = item
+            write_q.put((job, buf, encode_job(job, buf)))
+    except BaseException as e:  # noqa: BLE001 - e.g. device error mid-encode
+        errors.append(e)
+        stop.set()
+        while True:  # unwedge the reader, then stop consuming
+            item = read_q.get()
+            if item is None:
+                break
+            free.put(item[1])
+    finally:
+        write_q.put(None)
+        rt.join()
+        wt.join()
+    if errors:
+        raise errors[0]
 
 
 def write_ec_files(
@@ -74,88 +236,174 @@ def write_ec_files(
     codec: RSCodec | None = None,
     large_block_size: int = LARGE_BLOCK_SIZE,
     small_block_size: int = SMALL_BLOCK_SIZE,
-    batch: int = DEFAULT_BATCH,
+    batch: int | None = None,
 ) -> None:
-    """Generate .ec00–.ec13 from .dat (`ec_encoder.go:57,198-235`)."""
-    codec = codec or RSCodec()
+    """Generate .ec00–.ec13 from .dat (`ec_encoder.go:57,198-235`),
+    pipelined (see module docstring)."""
+    codec = codec or RSCodec(backend=pick_pipeline_backend())
+    if batch is None:
+        batch = _default_batch(codec.backend)
     dat_path = base_file_name + ".dat"
     total = os.path.getsize(dat_path)
-    outputs = [open(base_file_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS_COUNT)]
+    shard_size = shard_file_size(total, large_block_size, small_block_size)
+    dat_fd = os.open(dat_path, os.O_RDONLY)
+    writers = _ShardWriters(base_file_name, shard_size)
     try:
-        with open(dat_path, "rb") as dat:
-            remaining = total
-            processed = 0
-            large_row = large_block_size * DATA_SHARDS_COUNT
-            while remaining > large_row:
-                _encode_rows(dat, outputs, codec, processed, large_block_size, 1, batch)
-                remaining -= large_row
-                processed += large_row
-            small_row = small_block_size * DATA_SHARDS_COUNT
-            while remaining > 0:
-                _encode_rows(dat, outputs, codec, processed, small_block_size, 1, batch)
-                remaining -= small_row
-                processed += small_row
+        jobs = _schedule(total, large_block_size, small_block_size, batch)
+
+        def read_job(job, buf):
+            if job[0] == "rows":
+                _, dat_off, _, block, nrows = job
+                need = nrows * block * DATA_SHARDS_COUNT
+                if not isinstance(buf, np.ndarray) or buf.nbytes < need:
+                    buf = np.empty(
+                        max(need, batch * DATA_SHARDS_COUNT), dtype=np.uint8
+                    )
+                _pread_padded(dat_fd, dat_off, need, buf)
+                return buf
+            _, dat_off, _, block, done, width = job
+            need = width * DATA_SHARDS_COUNT
+            if not isinstance(buf, np.ndarray) or buf.nbytes < need:
+                buf = np.empty(max(need, batch * DATA_SHARDS_COUNT), dtype=np.uint8)
+            view = buf[:need].reshape(DATA_SHARDS_COUNT, width)
+            for c in range(DATA_SHARDS_COUNT):
+                _pread_padded(dat_fd, dat_off + c * block + done, width, view[c])
+            return buf
+
+        def encode_job(job, buf):
+            if job[0] == "rows":
+                _, _, _, block, nrows = job
+                need = nrows * block * DATA_SHARDS_COUNT
+                return codec.encode_rows_async(buf[:need], block, nrows)
+            _, _, _, block, done, width = job
+            need = width * DATA_SHARDS_COUNT
+            return codec.encode2d_async(
+                buf[:need].reshape(DATA_SHARDS_COUNT, width)
+            )
+
+        def write_job(job, buf, handle):
+            parity = handle.result()
+            if job[0] == "rows":
+                _, _, shard_off, block, nrows = job
+                span = nrows * block
+                for p in range(PARITY_SHARDS_COUNT):
+                    writers.pwrite(
+                        DATA_SHARDS_COUNT + p, parity[p, :span], shard_off
+                    )
+                view = buf[: span * DATA_SHARDS_COUNT].reshape(
+                    nrows, DATA_SHARDS_COUNT, block
+                )
+                for c in range(DATA_SHARDS_COUNT):
+                    if nrows == 1:
+                        writers.pwrite(c, view[0, c], shard_off)
+                    else:
+                        writers.pwritev(
+                            c,
+                            [view[r, c] for r in range(nrows)],
+                            shard_off,
+                        )
+            else:
+                _, _, shard_off, block, done, width = job
+                view = buf[: width * DATA_SHARDS_COUNT].reshape(
+                    DATA_SHARDS_COUNT, width
+                )
+                for c in range(DATA_SHARDS_COUNT):
+                    writers.pwrite(c, view[c], shard_off + done)
+                for p in range(PARITY_SHARDS_COUNT):
+                    writers.pwrite(
+                        DATA_SHARDS_COUNT + p, parity[p, :width], shard_off + done
+                    )
+
+        _run_pipeline(jobs, read_job, encode_job, write_job)
     finally:
-        for f in outputs:
-            f.close()
+        os.close(dat_fd)
+        writers.close()
 
 
 def rebuild_ec_files(
     base_file_name: str,
     codec: RSCodec | None = None,
-    chunk: int = SMALL_BLOCK_SIZE,
+    chunk: int | None = None,
 ) -> list[int]:
     """Regenerate missing .ecXX files from the surviving >= 10
-    (`ec_encoder.go:61,237-291`). Returns the rebuilt shard ids."""
-    codec = codec or RSCodec()
-    present: dict[int, object] = {}
+    (`ec_encoder.go:61,237-291`), through the same three-stage pipeline —
+    the GF transform is the inverted-submatrix product on the pipeline
+    backend (BASELINE config 2). Returns the rebuilt shard ids."""
+    from seaweedfs_tpu.ops import gf256
+
+    codec = codec or RSCodec(backend=pick_pipeline_backend())
+    if chunk is None:
+        chunk = _default_batch(codec.backend)
+    present_fds: dict[int, int] = {}
     missing: list[int] = []
-    for shard_id in range(TOTAL_SHARDS_COUNT):
-        name = base_file_name + to_ext(shard_id)
-        if os.path.exists(name):
-            present[shard_id] = open(name, "rb")
-        else:
-            missing.append(shard_id)
-    if not missing:
-        for f in present.values():
-            f.close()
-        return []
     try:
-        if len(present) < DATA_SHARDS_COUNT:
+        for shard_id in range(TOTAL_SHARDS_COUNT):
+            name = base_file_name + to_ext(shard_id)
+            if os.path.exists(name):
+                present_fds[shard_id] = os.open(name, os.O_RDONLY)
+            else:
+                missing.append(shard_id)
+        if not missing:
+            return []
+        if len(present_fds) < DATA_SHARDS_COUNT:
             raise ValueError(
-                f"cannot rebuild: only {len(present)} shards present"
+                f"cannot rebuild: only {len(present_fds)} shards present"
             )
-        outs = {
-            i: open(base_file_name + to_ext(i), "wb") for i in missing
-        }
+        present = sorted(present_fds)
+        use = present[:DATA_SHARDS_COUNT]
+        matrix = gf256.decode_matrix(
+            codec.data_shards,
+            codec.parity_shards,
+            tuple(present),
+            tuple(missing),
+        )
+        shard_size = os.path.getsize(base_file_name + to_ext(use[0]))
+        writers = _ShardWriters(
+            base_file_name, shard_size, shard_ids=missing
+        )
         try:
-            shard_size = os.path.getsize(
-                base_file_name + to_ext(next(iter(present)))
-            )
-            # decode_matrix is lru-cached on (present, targets), so the
-            # Gauss-Jordan inversion runs once for the whole rebuild.
-            offset = 0
-            while offset < shard_size:
-                step = min(chunk, shard_size - offset)
-                shards = {}
-                for i, f in present.items():
-                    f.seek(offset)
-                    data = f.read(step)
-                    if len(data) != step:
+            jobs = [
+                (off, min(chunk, shard_size - off))
+                for off in range(0, shard_size, chunk)
+            ]
+
+            def read_job(job, buf):
+                off, width = job
+                need = width * DATA_SHARDS_COUNT
+                if not isinstance(buf, np.ndarray) or buf.nbytes < need:
+                    buf = np.empty(
+                        max(need, chunk * DATA_SHARDS_COUNT), dtype=np.uint8
+                    )
+                view = buf[:need].reshape(DATA_SHARDS_COUNT, width)
+                for i, sid in enumerate(use):
+                    data = os.pread(present_fds[sid], width, off)
+                    if len(data) != width:
                         raise IOError(
-                            f"ec shard {i} short read at {offset}: {len(data)} != {step}"
+                            f"ec shard {sid} short read at {off}:"
+                            f" {len(data)} != {width}"
                         )
-                    shards[i] = np.frombuffer(data, dtype=np.uint8)
-                recovered = codec.reconstruct(shards, targets=missing)
-                for i in missing:
-                    outs[i].write(recovered[i].tobytes())
-                offset += step
+                    view[i] = np.frombuffer(data, dtype=np.uint8)
+                return buf
+
+            def encode_job(job, buf):
+                _, width = job
+                need = width * DATA_SHARDS_COUNT
+                return codec.apply2d_async(
+                    matrix, buf[:need].reshape(DATA_SHARDS_COUNT, width)
+                )
+
+            def write_job(job, buf, handle):
+                off, width = job
+                out = handle.result()
+                for i, sid in enumerate(missing):
+                    writers.pwrite(sid, out[i, :width], off)
+
+            _run_pipeline(jobs, read_job, encode_job, write_job)
         finally:
-            for f in outs.values():
-                f.close()
+            writers.close()
     finally:
-        for f in present.values():
-            f.close()
+        for fd in present_fds.values():
+            os.close(fd)
     return missing
 
 
