@@ -326,6 +326,8 @@ def run_ftp(args: list[str]) -> int:
     p.add_argument("-filer", default="http://127.0.0.1:8888")
     p.add_argument("-user", default="")
     p.add_argument("-password", default="")
+    p.add_argument("-anonymous", action="store_true",
+                   help="explicitly allow login without credentials")
     opts = p.parse_args(args)
     from seaweedfs_tpu.ftpd import FtpServer
 
@@ -333,7 +335,8 @@ def run_ftp(args: list[str]) -> int:
     if not filer.startswith("http"):
         filer = f"http://{filer}"
     srv = FtpServer(filer, host=opts.ip, port=opts.port,
-                    user=opts.user, password=opts.password)
+                    user=opts.user, password=opts.password,
+                    anonymous=opts.anonymous)
     srv.start()
     print(f"ftp gateway listening at {opts.ip}:{srv.port}")
     return _wait_forever()

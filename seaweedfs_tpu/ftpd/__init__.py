@@ -20,11 +20,17 @@ from seaweedfs_tpu.filer.filer_client import FilerClient
 
 class FtpServer:
     def __init__(self, filer_url: str, host: str = "127.0.0.1",
-                 port: int = 2121, user: str = "", password: str = "") -> None:
+                 port: int = 2121, user: str = "", password: str = "",
+                 anonymous: bool = False) -> None:
+        """With no user/password configured the gateway REFUSES logins unless
+        `anonymous=True` is passed explicitly — an unconfigured server must
+        not silently expose the whole filer namespace read-write (advisor r1
+        finding #5)."""
         self.filer_url = filer_url
         self.host = host
         self.user = user
         self.password = password
+        self.anonymous = anonymous
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -63,13 +69,24 @@ class FtpServer:
             h.wfile.write((line + "\r\n").encode())
 
         def resolve(arg: str) -> str:
+            """Absolute/relative resolution with '.'/'..' canonicalization so
+            no un-normalized dot segments ever reach the filer."""
             if not arg or arg == ".":
                 return cwd
             if arg.startswith("/"):
                 path = arg
             else:
                 path = cwd.rstrip("/") + "/" + arg
-            return path.rstrip("/") or "/"
+            parts: list[str] = []
+            for seg in path.split("/"):
+                if seg in ("", "."):
+                    continue
+                if seg == "..":
+                    if parts:
+                        parts.pop()
+                    continue
+                parts.append(seg)
+            return "/" + "/".join(parts) if parts else "/"
 
         def open_data() -> socket.socket | None:
             nonlocal data_listener
@@ -93,13 +110,15 @@ class FtpServer:
                     authed_user = arg
                     send("331 password please")
                 elif cmd == "PASS":
-                    if self.user and (
-                        authed_user != self.user or arg != self.password
-                    ):
-                        send("530 login incorrect")
+                    if self.user:
+                        ok = authed_user == self.user and arg == self.password
                     else:
+                        ok = self.anonymous  # accept-all needs explicit opt-in
+                    if ok:
                         logged_in = True
                         send("230 logged in")
+                    else:
+                        send("530 login incorrect")
                 elif cmd in ("SYST",):
                     send("215 UNIX Type: L8")
                 elif cmd == "FEAT":

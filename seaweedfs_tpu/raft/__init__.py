@@ -8,10 +8,13 @@ to the leader; on failover the replicated state machine (max volume id +
 sequence ceiling) carries over so ids are never reused.
 
 This is a compact, standard Raft (election + log replication + persistence
-+ commit/apply), transported over the masters' existing HTTP plane
-(`POST /raft/request_vote`, `POST /raft/append_entries`). Log compaction is
-not needed at master-state volumes (two tiny command types); the log is
-periodically checkpointed into `state.json` instead.
++ commit/apply + snapshot/compaction), transported over the masters'
+existing HTTP plane (`POST /raft/request_vote`, `/raft/append_entries`,
+`/raft/install_snapshot`). Once the log exceeds `compact_threshold` applied
+entries, the state machine is snapshotted via `snapshot_fn` and the log
+prefix truncated; followers that fall behind the snapshot receive it via
+InstallSnapshot and restore through `restore_fn` — so persistence cost per
+write and memory stay bounded regardless of uptime.
 """
 
 from __future__ import annotations
@@ -55,6 +58,9 @@ class RaftNode:
         heartbeat_interval: float = 0.08,
         election_timeout: tuple[float, float] = (0.3, 0.6),
         rpc: Callable[..., dict] | None = None,
+        snapshot_fn: Callable[[], dict] | None = None,
+        restore_fn: Callable[[dict], None] | None = None,
+        compact_threshold: int = 256,
     ) -> None:
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
@@ -63,12 +69,20 @@ class RaftNode:
         self.heartbeat_interval = heartbeat_interval
         self.election_timeout = election_timeout
         self.rpc = rpc or _default_rpc
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.compact_threshold = compact_threshold
 
         self.mu = threading.RLock()
         self.role = "follower"
         self.current_term = 0
         self.voted_for: str | None = None
         self.log: list[dict] = []  # entries {term, index, command}; 1-indexed
+        # log compaction state: entries <= snap_index live only in the
+        # snapshot; self.log[0] (if any) has index snap_index + 1
+        self.snap_index = 0
+        self.snap_term = 0
+        self.snap_state: dict | None = None
         self.commit_index = 0
         self.last_applied = 0
         self.leader_id: str | None = None
@@ -95,6 +109,12 @@ class RaftNode:
             self.voted_for = st.get("voted_for")
             self.log = st.get("log", [])
             self.commit_index = st.get("commit_index", 0)
+            self.snap_index = st.get("snap_index", 0)
+            self.snap_term = st.get("snap_term", 0)
+            self.snap_state = st.get("snap_state")
+            if self.snap_state is not None and self.restore_fn is not None:
+                self.restore_fn(self.snap_state)
+            self.last_applied = self.snap_index
 
     def _persist(self) -> None:
         p = self._state_path()
@@ -108,6 +128,9 @@ class RaftNode:
                 "voted_for": self.voted_for,
                 "log": self.log,
                 "commit_index": self.commit_index,
+                "snap_index": self.snap_index,
+                "snap_term": self.snap_term,
+                "snap_state": self.snap_state,
             }, f)
         os.replace(tmp, p)
 
@@ -127,14 +150,43 @@ class RaftNode:
     # --- helpers (callers hold mu) --------------------------------------------
     def _last_log(self) -> tuple[int, int]:
         if not self.log:
-            return 0, 0
+            return self.snap_index, self.snap_term
         e = self.log[-1]
         return e["index"], e["term"]
 
     def _entry(self, index: int) -> dict | None:
-        if 1 <= index <= len(self.log):
-            return self.log[index - 1]
+        pos = index - self.snap_index - 1
+        if 0 <= pos < len(self.log):
+            return self.log[pos]
         return None
+
+    def _term_at(self, index: int) -> int:
+        if index == self.snap_index:
+            return self.snap_term
+        e = self._entry(index)
+        return e["term"] if e else 0
+
+    def _maybe_compact(self) -> None:
+        """Snapshot the applied state machine and truncate the log prefix
+        once it outgrows compact_threshold (the checkpoint the r1 docstring
+        promised; advisor finding #2)."""
+        if self.snapshot_fn is None:
+            return
+        if self.last_applied - self.snap_index < self.compact_threshold:
+            return
+        cut = self.last_applied
+        cut_term = self._term_at(cut)
+        state = self.snapshot_fn()
+        del self.log[: cut - self.snap_index]
+        self.snap_index = cut
+        self.snap_term = cut_term
+        self.snap_state = state
+        # prune stale results; keep a threshold-wide margin so an in-flight
+        # propose() waiter racing this compaction can still claim its result
+        stale = cut - self.compact_threshold
+        for idx in [i for i in self._apply_results if i <= stale]:
+            self._apply_results.pop(idx, None)
+        self._persist()
 
     def _become_follower(self, term: int, leader: str | None = None) -> None:
         self.role = "follower"
@@ -151,10 +203,14 @@ class RaftNode:
             e = self._entry(self.last_applied)
             if e is not None:
                 try:
-                    self._apply_results[self.last_applied] = \
-                        self.apply_fn(e["command"])
+                    result = self.apply_fn(e["command"])
                 except Exception as exc:  # state machine must not kill raft
-                    self._apply_results[self.last_applied] = exc
+                    result = exc
+                # only a leader has propose() waiters that will claim the
+                # result; followers storing them forever is a leak
+                if self.role == "leader":
+                    self._apply_results[self.last_applied] = result
+        self._maybe_compact()
         self._commit_cv.notify_all()
 
     # --- election ------------------------------------------------------------
@@ -239,11 +295,44 @@ class RaftNode:
             if self.role != "leader":
                 return
             term = self.current_term
-            ni = self.next_index.get(peer, 1)
+            ni = self.next_index.get(peer, self.snap_index + 1)
+            if ni <= self.snap_index and self.snap_index > 0:
+                # follower is behind the compacted prefix: ship the snapshot
+                payload = {
+                    "term": term, "leader_id": self.id,
+                    "last_included_index": self.snap_index,
+                    "last_included_term": self.snap_term,
+                    "state": self.snap_state,
+                }
+                snap_index = self.snap_index
+            else:
+                payload = None
+        if payload is not None:
+            try:
+                out = self.rpc(peer, "install_snapshot", payload)
+            except Exception:
+                return
+            with self.mu:
+                if out.get("term", 0) > self.current_term:
+                    self._become_follower(out["term"])
+                    return
+                if self.role != "leader" or self.current_term != term:
+                    return
+                if out.get("success"):
+                    self.match_index[peer] = max(
+                        self.match_index.get(peer, 0), snap_index
+                    )
+                    self.next_index[peer] = snap_index + 1
+            return
+        with self.mu:
+            if self.role != "leader" or self.current_term != term:
+                return
+            ni = max(self.next_index.get(peer, self.snap_index + 1), 1)
+            if ni <= self.snap_index:
+                return  # compacted meanwhile; next tick ships the snapshot
             prev_index = ni - 1
-            prev_entry = self._entry(prev_index)
-            prev_term = prev_entry["term"] if prev_entry else 0
-            entries = self.log[ni - 1:]
+            prev_term = self._term_at(prev_index)
+            entries = self.log[ni - self.snap_index - 1:]
             commit = self.commit_index
         try:
             out = self.rpc(peer, "append_entries", {
@@ -267,7 +356,11 @@ class RaftNode:
                 self.next_index[peer] = self.match_index[peer] + 1
                 self._advance_commit()
             else:
-                self.next_index[peer] = max(1, ni - 1)
+                # back off; once next_index falls to the snapshot boundary
+                # the next round ships InstallSnapshot instead
+                self.next_index[peer] = max(
+                    self.snap_index if self.snap_index > 0 else 1, ni - 1
+                )
 
     def _advance_commit(self) -> None:
         last_index, _ = self._last_log()
@@ -325,19 +418,23 @@ class RaftNode:
                 self._become_follower(p["term"], p.get("leader_id"))
             self.leader_id = p.get("leader_id")
             prev_index = p["prev_log_index"]
-            if prev_index > 0:
-                e = self._entry(prev_index)
-                if e is None or e["term"] != p["prev_log_term"]:
-                    return {"term": self.current_term, "success": False}
+            entries = p["entries"]
+            if prev_index < self.snap_index:
+                # our snapshot already covers part of this batch; everything
+                # at or below snap_index is committed state, skip it
+                entries = [e for e in entries if e["index"] > self.snap_index]
+                prev_index = self.snap_index
+            elif prev_index > 0 and self._term_at(prev_index) != p["prev_log_term"]:
+                return {"term": self.current_term, "success": False}
             # append, truncating conflicts
-            for entry in p["entries"]:
+            for entry in entries:
                 existing = self._entry(entry["index"])
                 if existing is not None and existing["term"] != entry["term"]:
-                    del self.log[entry["index"] - 1:]
+                    del self.log[entry["index"] - self.snap_index - 1:]
                     existing = None
                 if existing is None:
                     self.log.append(entry)
-            if p["entries"]:
+            if entries:
                 self._persist()
             if p["leader_commit"] > self.commit_index:
                 last_index, _ = self._last_log()
@@ -345,10 +442,44 @@ class RaftNode:
                 self._apply_committed()
             return {"term": self.current_term, "success": True}
 
+    def handle_install_snapshot(self, p: dict) -> dict:
+        """Install a leader snapshot on a follower whose log is behind the
+        leader's compacted prefix (raft InstallSnapshot RPC)."""
+        with self.mu:
+            if p["term"] < self.current_term:
+                return {"term": self.current_term, "success": False}
+            self._last_heartbeat = time.monotonic()
+            if p["term"] > self.current_term or self.role != "follower":
+                self._become_follower(p["term"], p.get("leader_id"))
+            self.leader_id = p.get("leader_id")
+            incl = p["last_included_index"]
+            if incl <= self.last_applied:
+                # already at or past this point; never rewind the state machine
+                return {"term": self.current_term, "success": True}
+            # retain any log suffix consistent with the snapshot, else discard
+            if self._term_at(incl) == p["last_included_term"]:
+                self.log = [e for e in self.log if e["index"] > incl]
+            else:
+                self.log = []
+            self.snap_index = incl
+            self.snap_term = p["last_included_term"]
+            self.snap_state = p.get("state")
+            if self.snap_state is not None and self.restore_fn is not None:
+                self.restore_fn(self.snap_state)
+            self.last_applied = incl
+            self.commit_index = max(self.commit_index, incl)
+            self._persist()
+            self._apply_committed()
+            return {"term": self.current_term, "success": True}
+
     # --- client API -----------------------------------------------------------
     def is_leader(self) -> bool:
         with self.mu:
             return self.role == "leader"
+
+    def term(self) -> int:
+        with self.mu:
+            return self.current_term
 
     def leader(self) -> str | None:
         with self.mu:
@@ -371,6 +502,7 @@ class RaftNode:
                 self._apply_committed()
         self._broadcast_heartbeats()
         deadline = time.monotonic() + timeout
+        missing = object()
         with self.mu:
             while self.last_applied < index:
                 remain = deadline - time.monotonic()
@@ -379,7 +511,13 @@ class RaftNode:
                 if self.role != "leader":
                     raise NotLeader(self.leader_id)
                 self._commit_cv.wait(min(remain, 0.05))
-            result = self._apply_results.pop(index, None)
+            result = self._apply_results.pop(index, missing)
+        if result is missing:
+            # stepped down between append and apply: the entry may have
+            # committed under the new leader, but its result was discarded
+            # (followers don't retain results) — surface the demotion rather
+            # than returning a bogus None
+            raise NotLeader(self.leader())
         if isinstance(result, Exception):
             raise result
         return result

@@ -59,7 +59,11 @@ class MasterServer:
         self._peer_config = list(peers or [])
         self._raft_dir = raft_dir
         self._seq_ceiling = 0
-        self._seq_synced = False  # leader synced sequencer past the ceiling
+        # raft term the sequencer lease was last synced in: any term change
+        # (i.e. any possible leadership handoff, even one this node never
+        # observed via a request) forces a re-sync against the replicated
+        # ceiling before ids are handed out (advisor r1 finding #1)
+        self._seq_synced_term = -1
         self._routes()
 
     # --- lifecycle -------------------------------------------------------------
@@ -76,12 +80,28 @@ class MasterServer:
         from seaweedfs_tpu.raft import RaftNode
 
         self.raft = RaftNode(
-            self.url, peer_urls, self._raft_apply, state_dir=self._raft_dir
+            self.url, peer_urls, self._raft_apply, state_dir=self._raft_dir,
+            snapshot_fn=self._raft_snapshot, restore_fn=self._raft_restore,
         )
         self.topo.vid_allocator = lambda: self.raft.propose(
             {"type": "next_volume_id"}
         )
         self.raft.start()
+
+    def _raft_snapshot(self) -> dict:
+        """Applied master state for log compaction (`-master.resumeState`)."""
+        return {
+            "max_volume_id": self.topo._max_volume_id,
+            "seq_ceiling": self._seq_ceiling,
+        }
+
+    def _raft_restore(self, state: dict) -> None:
+        self.topo._max_volume_id = max(
+            self.topo._max_volume_id, int(state.get("max_volume_id", 0))
+        )
+        self._seq_ceiling = max(
+            self._seq_ceiling, int(state.get("seq_ceiling", 0))
+        )
 
     def _raft_apply(self, command: dict):
         """Replicated master state machine: volume-id counter + file-id
@@ -109,14 +129,22 @@ class MasterServer:
 
     def _ensure_sequence_lease(self, count: int) -> None:
         """Leader-side sequence lease (`sequence raft SetMax`): ids are only
-        handed out below the committed ceiling; a new leader fast-forwards
-        its counter to the ceiling so ids never repeat across failover."""
+        handed out below the committed ceiling; whenever the raft term moved
+        since the last sync (any election, observed or not), the counter is
+        fast-forwarded to the replicated ceiling first so ids never repeat
+        across failover."""
         if self.raft is None:
             return
         seq = self.topo.sequencer
-        if not self._seq_synced:
+        term = self.raft.term()
+        if self._seq_synced_term != term:
+            # Commit a no-op barrier first: committing it forces every
+            # ceiling entry from prior terms to be APPLIED on this node, so
+            # the set_max below sees grants the old leader made that were
+            # still unapplied here (committed-but-not-applied window).
+            self.raft.propose({"type": "sequence_ceiling", "value": 0})
             seq.set_max(self._seq_ceiling)
-            self._seq_synced = True
+            self._seq_synced_term = term
         while seq.peek() + count >= self._seq_ceiling:
             self.raft.propose({
                 "type": "sequence_ceiling",
@@ -236,6 +264,12 @@ class MasterServer:
                 return Response({"error": "raft disabled"}, 503)
             return Response(self.raft.handle_append_entries(req.json()))
 
+        @svc.route("POST", r"/raft/install_snapshot")
+        def raft_install_snapshot(req: Request) -> Response:
+            if self.raft is None:
+                return Response({"error": "raft disabled"}, 503)
+            return Response(self.raft.handle_install_snapshot(req.json()))
+
         @svc.route("GET", r"/raft/status")
         def raft_status(req: Request) -> Response:
             if self.raft is None:
@@ -246,7 +280,6 @@ class MasterServer:
 
         def do_assign(req: Request) -> Response:
             if not self._is_leader():
-                self._seq_synced = False  # re-sync lease if re-elected later
                 return self._not_leader_response()
             count = int(req.query.get("count", 1))
             replication = req.query.get("replication") or self.default_replication
@@ -262,7 +295,6 @@ class MasterServer:
                 try:
                     self._grow_volumes(collection, rp, ttl_u32, dc)
                 except NotLeader:
-                    self._seq_synced = False
                     return self._not_leader_response()
                 except Exception as e:
                     return Response({"error": f"cannot grow volumes: {e}"}, 500)
@@ -272,7 +304,6 @@ class MasterServer:
                     count, replication, ttl, collection, dc
                 )
             except NotLeader:
-                self._seq_synced = False
                 return self._not_leader_response()
             except NoWritableVolume:
                 # raced with a full/readonly transition: grow then retry once
@@ -282,7 +313,6 @@ class MasterServer:
                         count, replication, ttl, collection, dc
                     )
                 except NotLeader:
-                    self._seq_synced = False
                     return self._not_leader_response()
                 except (NoWritableVolume, Exception) as e:
                     return Response({"error": str(e)}, 404)

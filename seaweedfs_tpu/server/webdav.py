@@ -40,7 +40,12 @@ class WebDavServer:
         self.fc = FilerClient(filer_url)
         self.read_only = read_only
         self.service = HTTPService(host, port)
-        self._locks: dict[str, str] = {}  # path -> token
+        # path -> (token, expiry). Locks are actually enforced: mutations on
+        # a locked path demand the token via the If header, LOCK on a live
+        # lock is refused (423), and entries expire at the advertised
+        # timeout (advisor r1 finding #3).
+        self._locks: dict[str, tuple[str, float]] = {}
+        self.lock_timeout = 3600.0
         self._routes()
 
     def start(self) -> None:
@@ -99,6 +104,28 @@ class WebDavServer:
         ).encode()
         return Response(body, 207,
                         {"Content-Type": 'application/xml; charset="utf-8"'})
+
+    # --- locking -------------------------------------------------------------
+    def _live_lock(self, path: str) -> str | None:
+        """Current unexpired token for path, dropping expired entries."""
+        held = self._locks.get(path)
+        if held is None:
+            return None
+        token, expiry = held
+        if time.time() >= expiry:
+            self._locks.pop(path, None)
+            return None
+        return token
+
+    def _lock_conflict(self, req: Request, path: str) -> Response | None:
+        """423 unless the request carries the live lock token in its If
+        header (RFC 4918 §6; clients send `If: (<token>)`)."""
+        token = self._live_lock(path)
+        if token is None:
+            return None
+        if token in req.headers.get("If", ""):
+            return None
+        return Response({"error": "locked"}, 423)
 
     # --- routes --------------------------------------------------------------
     def _routes(self) -> None:
@@ -164,6 +191,9 @@ class WebDavServer:
             if self.read_only:
                 return Response({"error": "read-only"}, 403)
             path = self._norm(req.path)
+            conflict = self._lock_conflict(req, path)
+            if conflict is not None:
+                return conflict
             mime = req.headers.get("Content-Type", "")
             try:
                 self.fc.put(path, req.body, content_type=mime)
@@ -176,6 +206,9 @@ class WebDavServer:
             if self.read_only:
                 return Response({"error": "read-only"}, 403)
             path = self._norm(req.path)
+            conflict = self._lock_conflict(req, path)
+            if conflict is not None:
+                return conflict
             if self._entry(path) is None:
                 return Response({"error": "not found"}, 404)
             self.fc.delete(path, recursive=True)
@@ -187,6 +220,9 @@ class WebDavServer:
             if self.read_only:
                 return Response({"error": "read-only"}, 403)
             path = self._norm(req.path)
+            conflict = self._lock_conflict(req, path)
+            if conflict is not None:
+                return conflict
             if self._entry(path) is not None:
                 return Response({"error": "exists"}, 405)
             self.fc.mkdir(path)
@@ -203,8 +239,16 @@ class WebDavServer:
         @svc.route("LOCK", any_path)
         def lock(req: Request) -> Response:
             path = self._norm(req.path)
-            token = f"opaquelocktoken:{uuid.uuid4()}"
-            self._locks[path] = token
+            held = self._live_lock(path)
+            if held is not None:
+                if held in req.headers.get("If", ""):  # refresh own lock
+                    self._locks[path] = (held, time.time() + self.lock_timeout)
+                    token = held
+                else:
+                    return Response({"error": "locked"}, 423)
+            else:
+                token = f"opaquelocktoken:{uuid.uuid4()}"
+                self._locks[path] = (token, time.time() + self.lock_timeout)
             owner = ""
             if req.body:
                 try:
@@ -233,6 +277,10 @@ class WebDavServer:
         @svc.route("UNLOCK", any_path)
         def unlock(req: Request) -> Response:
             path = self._norm(req.path)
+            token = self._live_lock(path)
+            if token is not None and \
+                    token not in req.headers.get("Lock-Token", ""):
+                return Response({"error": "wrong lock token"}, 409)
             self._locks.pop(path, None)
             return Response(b"", 204)
 
@@ -269,6 +317,13 @@ class WebDavServer:
         if not dest_header:
             return Response({"error": "missing Destination"}, 400)
         dst = self._norm(urllib.parse.urlparse(dest_header).path)
+        if is_move:  # COPY does not mutate the source
+            conflict = self._lock_conflict(req, src)
+            if conflict is not None:
+                return conflict
+        conflict = self._lock_conflict(req, dst)
+        if conflict is not None:
+            return conflict
         entry = self._entry(src)
         if entry is None:
             return Response({"error": "not found"}, 404)

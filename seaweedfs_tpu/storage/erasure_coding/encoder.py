@@ -63,6 +63,14 @@ def _default_batch(backend: str) -> int:
 _QUEUE_DEPTH = 2
 
 
+def _ensure_buf(buf, need: int, cap: int) -> np.ndarray:
+    """Reuse the freelist slot when it is big enough, else (re)allocate to
+    max(need, cap) so the slot converges on one steady-state size."""
+    if not isinstance(buf, np.ndarray) or buf.nbytes < need:
+        buf = np.empty(max(need, cap), dtype=np.uint8)
+    return buf
+
+
 def _pread_padded(fd: int, offset: int, size: int, out: np.ndarray) -> None:
     """Zero-copy positional read into out[:size] (preadv straight into the
     numpy buffer), zero-filling past EOF (reference encodeDataOneBatch:166-177
@@ -255,16 +263,12 @@ def write_ec_files(
             if job[0] == "rows":
                 _, dat_off, _, block, nrows = job
                 need = nrows * block * DATA_SHARDS_COUNT
-                if not isinstance(buf, np.ndarray) or buf.nbytes < need:
-                    buf = np.empty(
-                        max(need, batch * DATA_SHARDS_COUNT), dtype=np.uint8
-                    )
+                buf = _ensure_buf(buf, need, batch * DATA_SHARDS_COUNT)
                 _pread_padded(dat_fd, dat_off, need, buf)
                 return buf
             _, dat_off, _, block, done, width = job
             need = width * DATA_SHARDS_COUNT
-            if not isinstance(buf, np.ndarray) or buf.nbytes < need:
-                buf = np.empty(max(need, batch * DATA_SHARDS_COUNT), dtype=np.uint8)
+            buf = _ensure_buf(buf, need, batch * DATA_SHARDS_COUNT)
             view = buf[:need].reshape(DATA_SHARDS_COUNT, width)
             for c in range(DATA_SHARDS_COUNT):
                 _pread_padded(dat_fd, dat_off + c * block + done, width, view[c])
@@ -370,10 +374,7 @@ def rebuild_ec_files(
             def read_job(job, buf):
                 off, width = job
                 need = width * DATA_SHARDS_COUNT
-                if not isinstance(buf, np.ndarray) or buf.nbytes < need:
-                    buf = np.empty(
-                        max(need, chunk * DATA_SHARDS_COUNT), dtype=np.uint8
-                    )
+                buf = _ensure_buf(buf, need, chunk * DATA_SHARDS_COUNT)
                 view = buf[:need].reshape(DATA_SHARDS_COUNT, width)
                 for i, sid in enumerate(use):
                     data = os.pread(present_fds[sid], width, off)

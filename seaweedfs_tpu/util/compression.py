@@ -22,9 +22,11 @@ except Exception:  # pragma: no cover - zstd is baked into the image
 GZIP_MAGIC = b"\x1f\x8b"
 ZSTD_MAGIC = b"\x28\xb5\x2f\xfd"
 
-# mirror of compression.go:60-90's switch tables
-_COMPRESSABLE_EXT = {
-    ".zip", ".rar", ".gz", ".bz2", ".xz", ".zst", ".br",  # already compressed → False
+# mirror of compression.go:60-90's switch tables; .pdf counts as
+# compressable both by extension and by mime, matching the reference's
+# IsCompressableFileType (compression.go:121)
+_UNCOMPRESSABLE_EXT = {
+    ".zip", ".rar", ".gz", ".bz2", ".xz", ".zst", ".br",  # already compressed
 }
 _TEXT_EXT = {
     ".csv", ".txt", ".json", ".xml", ".html", ".htm", ".css", ".js", ".log",
@@ -34,8 +36,12 @@ _TEXT_EXT = {
 _UNCOMPRESSABLE_MIME_PREFIX = ("video/", "audio/", "image/")
 _UNCOMPRESSABLE_MIME = {
     "application/zip", "application/gzip", "application/x-gzip",
-    "application/zstd", "application/x-rar-compressed", "application/pdf",
+    "application/zstd", "application/x-rar-compressed",
     "application/x-7z-compressed", "application/x-xz",
+}
+_COMPRESSABLE_MIME = {
+    "application/json", "application/xml", "application/javascript",
+    "application/x-javascript", "application/toml", "application/pdf",
 }
 
 
@@ -56,7 +62,7 @@ def is_compressable_file_type(ext: str, mime: str) -> bool:
     skip media and archive formats."""
     ext = ext.lower()
     mime = mime.split(";")[0].strip().lower()
-    if ext in _COMPRESSABLE_EXT:
+    if ext in _UNCOMPRESSABLE_EXT:
         return False
     if mime in _UNCOMPRESSABLE_MIME:
         return False
@@ -66,10 +72,7 @@ def is_compressable_file_type(ext: str, mime: str) -> bool:
         return True
     if mime.startswith("text/"):
         return True
-    if mime in ("application/json", "application/xml", "application/javascript",
-                "application/x-javascript", "application/toml"):
-        return True
-    return False
+    return mime in _COMPRESSABLE_MIME
 
 
 def gzip_data(data: bytes) -> bytes:

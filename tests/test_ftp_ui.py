@@ -33,7 +33,7 @@ class TestFtp:
     @pytest.fixture(scope="class")
     def ftp_srv(self, cluster):
         master, vol, filer = cluster
-        srv = FtpServer(filer.url, port=0)
+        srv = FtpServer(filer.url, port=0, anonymous=True)
         srv.start()
         yield srv
         srv.stop()

@@ -136,6 +136,61 @@ def test_lock_unlock(dav):
     assert status == 204
 
 
+def test_locks_are_enforced(dav):
+    """Class-2 semantics for real: second LOCK is 423, mutations without
+    the token are 423, the token-holder may write, UNLOCK needs the token."""
+    lockinfo = (b'<?xml version="1.0"?><D:lockinfo xmlns:D="DAV:">'
+                b"<D:lockscope><D:exclusive/></D:lockscope>"
+                b"<D:locktype><D:write/></D:locktype></D:lockinfo>")
+    http_request("PUT", dav.url + "/guarded.txt", body=b"v1")
+    status, headers, _ = http_request(
+        "LOCK", dav.url + "/guarded.txt", body=lockinfo)
+    assert status == 200
+    token = headers.get("Lock-Token", "").strip("<>")
+
+    # a second client cannot steal the lock
+    status, _, _ = http_request("LOCK", dav.url + "/guarded.txt", body=lockinfo)
+    assert status == 423
+    # mutations without the token are refused
+    for method, extra in (("PUT", {}), ("DELETE", {}),
+                          ("MOVE", {"Destination": dav.url + "/moved.txt"})):
+        status, _, _ = http_request(
+            method, dav.url + "/guarded.txt", body=b"v2", headers=extra)
+        assert status == 423, method
+    # the holder (If header carries the token) may write
+    status, _, _ = http_request(
+        "PUT", dav.url + "/guarded.txt", body=b"v2",
+        headers={"If": f"(<{token}>)"})
+    assert status == 201
+    # UNLOCK with a bogus token refused; with the real one succeeds
+    status, _, _ = http_request(
+        "UNLOCK", dav.url + "/guarded.txt",
+        headers={"Lock-Token": "<opaquelocktoken:bogus>"})
+    assert status == 409
+    status, _, _ = http_request(
+        "UNLOCK", dav.url + "/guarded.txt",
+        headers={"Lock-Token": f"<{token}>"})
+    assert status == 204
+    # lock gone: plain PUT allowed again
+    status, _, _ = http_request("PUT", dav.url + "/guarded.txt", body=b"v3")
+    assert status == 201
+
+
+def test_lock_expiry(dav):
+    lockinfo = (b'<?xml version="1.0"?><D:lockinfo xmlns:D="DAV:">'
+                b"<D:lockscope><D:exclusive/></D:lockscope>"
+                b"<D:locktype><D:write/></D:locktype></D:lockinfo>")
+    http_request("PUT", dav.url + "/expiring.txt", body=b"v1")
+    dav.lock_timeout = 0.05
+    status, _, _ = http_request("LOCK", dav.url + "/expiring.txt", body=lockinfo)
+    assert status == 200
+    import time as _t
+    _t.sleep(0.1)
+    status, _, _ = http_request("PUT", dav.url + "/expiring.txt", body=b"v2")
+    assert status == 201  # expired lock no longer blocks
+    dav.lock_timeout = 3600.0
+
+
 def test_read_only_mode(dav):
     from seaweedfs_tpu.server.webdav import WebDavServer
 
