@@ -243,6 +243,66 @@ def bench_device_pipeline(staging_base: str, mb: int = 128) -> float:
     return best
 
 
+def bench_hash_1m_4k(total_blobs: int = 1_000_000, slab: int = 65536) -> dict:
+    """BASELINE config 3: 1M x 4KB upload-path MD5+CRC32C batch hashing.
+    Runs the full 1M through the native batch kernels (the serving path's
+    host backend), a hashlib/scalar baseline on a sample, and the device
+    kernels on a device-resident sample for the chip-side ceiling."""
+    import hashlib
+
+    from seaweedfs_tpu.ops.hash_service import _batch_hash
+
+    rng = np.random.RandomState(4)
+    sample = rng.randint(0, 256, size=(slab, 4096), dtype=np.uint8)
+    out: dict = {"blobs": total_blobs, "blob_bytes": 4096}
+
+    # scalar baseline (what r1's serving path actually did): hashlib + crc
+    from seaweedfs_tpu.storage import crc as crc_mod
+
+    n_base = 4096
+    t0 = time.perf_counter()
+    for i in range(n_base):
+        hashlib.md5(sample[i].tobytes()).digest()
+        crc_mod.crc32c(sample[i].tobytes())
+    base_rate = n_base * 4096 / (time.perf_counter() - t0)
+    out["scalar_baseline_gbps"] = round(base_rate / 1e9, 3)
+
+    # native batch kernels over the full 1M (distinct data per slab via
+    # byte-roll so the working set isn't one hot slab)
+    _batch_hash("native", sample[:64])  # warm
+    done = 0
+    t0 = time.perf_counter()
+    while done < total_blobs:
+        n = min(slab, total_blobs - done)
+        _batch_hash("native", sample[:n])
+        done += n
+    dt = time.perf_counter() - t0
+    out["native_batch_gbps"] = round(total_blobs * 4096 / dt / 1e9, 3)
+    out["native_batch_mhashes_s"] = round(total_blobs / dt / 1e6, 3)
+    out["seconds_for_1m"] = round(dt, 2)
+
+    # device kernels, device-resident sample (chip-side rate; transfers are
+    # what rules them out for serving through this relay)
+    try:
+        import jax
+
+        from seaweedfs_tpu.ops.crc32c_kernel import crc32c_batch
+        from seaweedfs_tpu.ops.md5_kernel import md5_batch
+
+        dev_sample = sample[:16384]
+        md5_batch(dev_sample[:64], backend="jax")  # compile
+        crc32c_batch(dev_sample[:64], backend="jax")
+        t0 = time.perf_counter()
+        md5_batch(dev_sample, backend="jax")
+        crc32c_batch(dev_sample, backend="jax")
+        dev_dt = time.perf_counter() - t0
+        out["device_batch_gbps"] = round(len(dev_sample) * 4096 / dev_dt / 1e9, 3)
+    except Exception as e:
+        out["device_batch_error"] = str(e)[:120]
+    out["vs_scalar"] = round(out["native_batch_gbps"] * 1e9 / base_rate, 2)
+    return out
+
+
 def main() -> None:
     os.makedirs(BENCH_DIR, exist_ok=True)
     staging_base = build_volume(os.path.join(BENCH_DIR, "staging"))
@@ -273,6 +333,10 @@ def main() -> None:
     except Exception as e:
         extra["device_pipeline_e2e_gbps"] = None
         extra["device_pipeline_error"] = str(e)[:120]
+    try:
+        extra["hash_1m_4k"] = bench_hash_1m_4k()  # BASELINE config 3
+    except Exception as e:
+        extra["hash_1m_4k"] = {"error": str(e)[:120]}
     extra["note"] = (
         "value is the real shell ec.encode verb, disk-to-shards, 1GiB volume,"
         " best of 3; baseline is the same work in the reference's"

@@ -105,6 +105,9 @@ def run_filer(args: list[str]) -> int:
                    help="publish metadata events to this file-queue spool dir")
     p.add_argument("-peers", default="",
                    help="comma-separated peer filer urls (lock ring + meta sync)")
+    p.add_argument("-dedup", action="store_true",
+                   help="content-defined-chunking dedup on uploads "
+                        "(filer/dedup.py; incompatible with cipher)")
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.filer import FilerServer
 
@@ -129,6 +132,7 @@ def run_filer(args: list[str]) -> int:
         notification_queue=queue,
         peers=[u if u.startswith("http") else f"http://{u}"
                for u in opts.peers.split(",") if u],
+        dedup=opts.dedup,
     )
     f.start()
     print(f"filer listening at {f.url}")
@@ -155,6 +159,8 @@ def run_server(args: list[str]) -> int:
                    action="store_true")
     p.add_argument("-filer.compressData", dest="filer_compress",
                    default="true", choices=["true", "false"])
+    p.add_argument("-filer.dedup", dest="filer_dedup", action="store_true",
+                   help="content-defined-chunking dedup on filer uploads")
     p.add_argument("-s3.config", dest="s3_config", default=None,
                    help="identities json (s3.json)")
     opts = p.parse_args(args)
@@ -186,6 +192,7 @@ def run_server(args: list[str]) -> int:
             store_path=opts.filer_store_path,
             cipher=opts.filer_cipher,
             compress=opts.filer_compress == "true",
+            dedup=opts.filer_dedup,
         )
         f.start()
         print(f"filer listening at {f.url}")

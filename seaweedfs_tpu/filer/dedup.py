@@ -1,0 +1,84 @@
+"""Content-defined dedup for the filer write path (BASELINE config 4).
+
+New capability vs the reference (SeaweedFS has no CDC dedup): uploads are
+cut at content-defined boundaries (ops.cdc gear hash — TPU batch kernel or
+the C++ serial scan), each chunk is content-hashed through the batch hash
+service, and chunks whose (md5, length) key already exist in the index are
+NOT uploaded again — the existing fileId is referenced by the new entry's
+chunk list. Identical data shifted by insertions still dedups because
+boundaries follow content, not offsets.
+
+The index lives in the filer store itself under `/etc/dedup/<p>/<key>`
+(sharded by key prefix), so every store backend inherits it and
+`fs.meta.save` snapshots it. An in-process LRU caches hot keys.
+
+Semantics / limits (documented, enforced):
+* deduplicated chunks are shared between entries — deleting one entry does
+  not reclaim their blobs. Space is reclaimed by `fs.dedup.gc`, which walks
+  the namespace and drops index entries (and blobs) no entry references.
+* dedup is disabled when the filer runs ciphered: per-chunk random AES keys
+  make equal plaintexts distinct ciphertexts (convergent encryption is a
+  deliberate non-goal — it leaks equality).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict
+
+DEDUP_DIR = "/etc/dedup"
+
+
+class DedupIndex:
+    def __init__(self, filer, cache_size: int = 65536) -> None:
+        self.filer = filer
+        self._cache: OrderedDict[str, dict] = OrderedDict()
+        self._cache_size = cache_size
+        self._mu = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.bytes_saved = 0
+
+    @staticmethod
+    def _path(key: str) -> str:
+        return f"{DEDUP_DIR}/{key[:2]}/{key}"
+
+    def lookup(self, key: str) -> dict | None:
+        with self._mu:
+            hit = self._cache.get(key)
+            if hit is not None:
+                self._cache.move_to_end(key)
+                return hit
+        entry = self.filer.find_entry(self._path(key))
+        if entry is None or not entry.content:
+            return None
+        try:
+            rec = json.loads(entry.content)
+        except ValueError:
+            return None
+        self._remember(key, rec)
+        return rec
+
+    def insert(self, key: str, rec: dict) -> None:
+        from seaweedfs_tpu.filer import Entry
+
+        e = Entry(full_path=self._path(key))
+        e.content = json.dumps(rec).encode()
+        e.attributes.file_size = len(e.content)
+        self.filer.create_entry(e)
+        self._remember(key, rec)
+
+    def _remember(self, key: str, rec: dict) -> None:
+        with self._mu:
+            self._cache[key] = rec
+            self._cache.move_to_end(key)
+            while len(self._cache) > self._cache_size:
+                self._cache.popitem(last=False)
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "bytes_saved": self.bytes_saved,
+        }

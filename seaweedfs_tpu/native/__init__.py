@@ -45,10 +45,28 @@ class NativeLib:
         ]
         self._lib.sw_md5_batch.restype = None
         self._lib.sw_md5_batch.argtypes = [
-            ctypes.c_char_p,
+            ctypes.c_void_p,  # blobs (accepts bytes or a numpy data pointer)
             ctypes.c_size_t,
             ctypes.c_size_t,
-            ctypes.c_char_p,
+            ctypes.c_void_p,
+        ]
+        self._lib.sw_gear_boundaries.restype = ctypes.c_size_t
+        self._lib.sw_gear_boundaries.argtypes = [
+            ctypes.c_void_p,  # data
+            ctypes.c_size_t,
+            ctypes.c_void_p,  # gear table uint32[256]
+            ctypes.c_uint32,  # mask
+            ctypes.c_size_t,  # min_size
+            ctypes.c_size_t,  # max_size
+            ctypes.c_void_p,  # out cuts uint64[max_cuts]
+            ctypes.c_size_t,
+        ]
+        self._lib.sw_crc32c_batch.restype = None
+        self._lib.sw_crc32c_batch.argtypes = [
+            ctypes.c_void_p,  # blobs (n * blob_len contiguous)
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+            ctypes.c_void_p,  # out uint32[n]
         ]
         self._lib.sw_gf256_matmul2d.restype = None
         self._lib.sw_gf256_matmul2d.argtypes = [
@@ -134,6 +152,40 @@ class NativeLib:
         out = ctypes.create_string_buffer(n * 16)
         self._lib.sw_md5_batch(blobs, n, blob_len, ctypes.cast(out, ctypes.c_char_p))
         return out.raw
+
+    def md5_batch_np(self, blobs, n: int, blob_len: int):
+        """Zero-copy batch MD5: blobs is a C-contiguous uint8 numpy array
+        (n, blob_len); returns (n, 16) uint8."""
+        import numpy as np
+
+        out = np.empty((n, 16), dtype=np.uint8)
+        self._lib.sw_md5_batch(blobs.ctypes.data, n, blob_len, out.ctypes.data)
+        return out
+
+    def gear_boundaries(self, data, gear, mask: int, min_size: int,
+                        max_size: int):
+        """Serial gear-CDC cut positions. data: uint8 numpy array; gear:
+        uint32[256] numpy. Returns a uint64 numpy array of exclusive ends."""
+        import numpy as np
+
+        max_cuts = max(16, len(data) // max(min_size, 1) + 2)
+        cuts = np.empty(max_cuts, dtype=np.uint64)
+        n = self._lib.sw_gear_boundaries(
+            data.ctypes.data, len(data), gear.ctypes.data, mask,
+            min_size, max_size, cuts.ctypes.data, max_cuts,
+        )
+        return cuts[:n]
+
+    def crc32c_batch(self, blobs, n: int, blob_len: int):
+        """blobs: C-contiguous uint8 numpy array (n, blob_len) — zero-copy;
+        returns (n,) uint32."""
+        import numpy as np
+
+        out = np.empty(n, dtype=np.uint32)
+        self._lib.sw_crc32c_batch(
+            blobs.ctypes.data, n, blob_len, out.ctypes.data
+        )
+        return out
 
 
 def _build() -> bool:

@@ -1,0 +1,35 @@
+// Serial gear-CDC boundary scan. Bit-identical to the data-parallel
+// XOR-window hash in ops/cdc.py: h_i = XOR_{k<32} G[b_{i-k}] << k, whose
+// serial recurrence is h = (h << 1) ^ G[b] (the k=32 term self-shifts out
+// of uint32). The window rolls straight across cut points, exactly like the
+// vectorized path which hashes every position of the buffer first and picks
+// cuts afterwards. Cut rule per ops/cdc.py find_boundaries: first position
+// i >= start+min_size with (h_i & mask) == 0 cuts at i+1; otherwise cut at
+// start+max_size (or n). ~1 GB/s single core; the TPU kernel is the batch
+// path.
+#include <cstdint>
+#include <cstddef>
+
+extern "C" size_t sw_gear_boundaries(const unsigned char* data, size_t n,
+                                     const uint32_t* gear, uint32_t mask,
+                                     size_t min_size, size_t max_size,
+                                     uint64_t* cuts, size_t max_cuts) {
+    size_t count = 0;
+    size_t start = 0;
+    uint32_t h = 0;
+    for (size_t i = 0; i < n; i++) {
+        h = (h << 1) ^ gear[data[i]];
+        bool cut = false;
+        if (i >= start + min_size && (h & mask) == 0)
+            cut = true;
+        else if (i + 1 - start == max_size)
+            cut = true;
+        if (cut) {
+            if (count == max_cuts) return count;
+            cuts[count++] = i + 1;
+            start = i + 1;
+        }
+    }
+    if (start < n && count < max_cuts) cuts[count++] = n;
+    return count;
+}

@@ -60,3 +60,11 @@ extern "C" uint32_t sw_crc32c_update(uint32_t crc, const unsigned char* data, si
     return ~c;
 #endif
 }
+
+// Batch variant for the upload-path hash service: n equal-length blobs,
+// contiguous, one GIL-released call (mirrors sw_md5_batch's shape).
+extern "C" void sw_crc32c_batch(const unsigned char* blobs, size_t n,
+                                size_t blob_len, uint32_t* out) {
+    for (size_t i = 0; i < n; i++)
+        out[i] = sw_crc32c_update(0, blobs + i * blob_len, blob_len);
+}

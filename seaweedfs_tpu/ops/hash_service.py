@@ -1,0 +1,259 @@
+"""Upload-path batch hash service: MD5 + CRC32C through the batch kernels.
+
+The reference hashes every uploaded blob — an MD5 tee in the filer
+(`weed/server/filer_server_handlers_write_upload.go:48-49`) and a CRC32C
+per needle on the volume server (`weed/storage/needle/needle.go:52`,
+`crc.go:12`) — using assembly inside Go libraries. Here the serving path
+funnels one-shot blob hashing through this service instead of calling a
+scalar hasher inline:
+
+* concurrent requests' blobs are bucketed by length and hashed as ONE batch
+  call — `ops.md5_kernel`/`ops.crc32c_kernel` on the TPU (lockstep VPU
+  lanes / GF(2) matmul on the MXU), or one GIL-released C++ call
+  (`sw_md5_batch`/`sw_crc32c_batch`) on the host;
+* a linger window (default 0.5ms) gives in-flight requests a chance to
+  coalesce, exactly like an inference micro-batcher; a lone blob under
+  min_batch skips the queue and hashes synchronously on the native path
+  (no latency tax when the server is idle);
+* the backend is picked by measured end-to-end rate (device kernels behind
+  a slow relay lose to the C++ path and are not used), overridable with
+  SEAWEEDFS_TPU_HASH_BACKEND.
+
+Streaming whole-file MD5 (one hash spanning a multi-chunk stream) stays on
+the CPU per SURVEY.md §7 step 4 — MD5 is sequential per stream; only the
+batch dimension parallelizes.
+"""
+
+from __future__ import annotations
+
+import binascii
+import hashlib
+import os
+import threading
+import time
+
+import numpy as np
+
+_MIN_BATCH = 4  # below this, batching buys nothing — hash synchronously
+_MAX_BATCH = 8192
+_LINGER_S = 0.0005
+
+
+class HashResult:
+    """Future for one submitted blob."""
+
+    __slots__ = ("_event", "md5", "crc")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self.md5: bytes = b""
+        self.crc: int = 0
+
+    def _set(self, md5: bytes, crc: int) -> None:
+        self.md5 = md5
+        self.crc = crc
+        self._event.set()
+
+    def wait(self, timeout: float = 30.0) -> "HashResult":
+        if not self._event.wait(timeout):
+            raise TimeoutError("hash batch never flushed")
+        return self
+
+    def md5_hex(self) -> str:
+        self.wait()
+        return binascii.hexlify(self.md5).decode()
+
+
+def _native_lib():
+    try:
+        from seaweedfs_tpu.native import lib
+
+        return lib
+    except Exception:
+        return None
+
+
+def _hash_one(data) -> tuple[bytes, int]:
+    from seaweedfs_tpu.storage import crc as crc_mod
+
+    return hashlib.md5(data).digest(), crc_mod.crc32c(data)
+
+
+class HashService:
+    def __init__(
+        self,
+        backend: str = "auto",
+        linger_s: float = _LINGER_S,
+        min_batch: int = _MIN_BATCH,
+        max_batch: int = _MAX_BATCH,
+    ) -> None:
+        self._backend = backend
+        self.linger_s = linger_s
+        self.min_batch = min_batch
+        self.max_batch = max_batch
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        # length -> list of (data, HashResult)
+        self._buckets: dict[int, list[tuple[bytes, HashResult]]] = {}
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    # --- backend -------------------------------------------------------------
+    @property
+    def backend(self) -> str:
+        if self._backend == "auto":
+            self._backend = self._pick_backend()
+        return self._backend
+
+    @staticmethod
+    def _pick_backend() -> str:
+        env = os.environ.get("SEAWEEDFS_TPU_HASH_BACKEND", "")
+        if env:
+            return env
+        candidates = []
+        try:
+            import jax
+
+            if jax.default_backend() != "cpu":
+                candidates.append("jax")
+        except Exception:
+            pass
+        if _native_lib() is not None:
+            candidates.append("native")
+        if not candidates:
+            return "python"
+        if len(candidates) == 1:
+            return candidates[0]
+        # measure true end-to-end batch rate (transfers included) per backend
+        rng = np.random.RandomState(0)
+        sample = rng.randint(0, 256, size=(256, 4096), dtype=np.uint8)
+        best, best_rate = candidates[0], 0.0
+        for name in candidates:
+            try:
+                _batch_hash(name, sample)  # warm/compile
+                t0 = time.perf_counter()
+                _batch_hash(name, sample)
+                rate = sample.nbytes / (time.perf_counter() - t0)
+            except Exception:
+                continue
+            if rate > best_rate:
+                best, best_rate = name, rate
+        return best
+
+    # --- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is None:
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._flusher, name="hash-batcher", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    # --- API -----------------------------------------------------------------
+    def submit(self, data: bytes) -> HashResult:
+        """Enqueue one blob; returns a future. Lone blobs on an idle server
+        hash synchronously (no linger tax)."""
+        r = HashResult()
+        if self._thread is None or len(data) == 0:
+            r._set(*_hash_one(data))
+            return r
+        with self._cv:
+            bucket = self._buckets.setdefault(len(data), [])
+            bucket.append((bytes(data), r))
+            ready = len(bucket) >= self.max_batch
+            self._cv.notify_all()
+        if ready:
+            pass  # flusher picks it up immediately (notified above)
+        return r
+
+    def hash_now(self, data: bytes) -> tuple[str, int]:
+        """Synchronous convenience: (md5 hex, crc32c)."""
+        md5, crc = _hash_one(data)
+        return binascii.hexlify(md5).decode(), crc
+
+    # --- internals -----------------------------------------------------------
+    def _flusher(self) -> None:
+        while True:
+            with self._cv:
+                if not self._buckets and not self._stop:
+                    self._cv.wait(0.05)
+                if self._stop and not self._buckets:
+                    return
+                if not self._buckets:
+                    continue
+                deadline = time.monotonic() + self.linger_s
+                while (
+                    not self._stop
+                    and time.monotonic() < deadline
+                    and sum(len(b) for b in self._buckets.values())
+                    < self.max_batch
+                ):
+                    self._cv.wait(self.linger_s / 4 or 0.0001)
+                work = self._buckets
+                self._buckets = {}
+            for length, items in work.items():
+                try:
+                    self._flush_bucket(length, items)
+                except Exception:
+                    for data, r in items:  # degrade to scalar, never drop
+                        r._set(*_hash_one(data))
+
+    def _flush_bucket(self, length: int, items) -> None:
+        if len(items) < self.min_batch:
+            for data, r in items:
+                r._set(*_hash_one(data))
+            return
+        blobs = np.frombuffer(
+            b"".join(d for d, _ in items), dtype=np.uint8
+        ).reshape(len(items), length)
+        digests, crcs = _batch_hash(self.backend, blobs)
+        for i, (_, r) in enumerate(items):
+            r._set(digests[i].tobytes(), int(crcs[i]))
+
+
+def _batch_hash(backend: str, blobs: np.ndarray):
+    """(n, L) uint8 -> ((n, 16) md5 digests, (n,) uint32 crcs)."""
+    n, length = blobs.shape
+    if backend == "jax":
+        from seaweedfs_tpu.ops.crc32c_kernel import crc32c_batch
+        from seaweedfs_tpu.ops.md5_kernel import md5_batch
+
+        return md5_batch(blobs, backend="jax"), crc32c_batch(blobs, backend="jax")
+    lib = _native_lib()
+    if backend == "native" and lib is not None:
+        return (
+            lib.md5_batch_np(blobs, n, length),
+            lib.crc32c_batch(blobs, n, length),
+        )
+    from seaweedfs_tpu.storage import crc as crc_mod
+
+    digests = np.stack([
+        np.frombuffer(hashlib.md5(blobs[i].tobytes()).digest(), dtype=np.uint8)
+        for i in range(n)
+    ])
+    crcs = np.array(
+        [crc_mod.crc32c(blobs[i].tobytes()) for i in range(n)], dtype=np.uint32
+    )
+    return digests, crcs
+
+
+_SERVICE: HashService | None = None
+_SERVICE_MU = threading.Lock()
+
+
+def get_hash_service() -> HashService:
+    """Process-wide singleton used by the filer/volume serving paths."""
+    global _SERVICE
+    with _SERVICE_MU:
+        if _SERVICE is None:
+            _SERVICE = HashService()
+            _SERVICE.start()
+        return _SERVICE

@@ -5,9 +5,13 @@ Reference: `weed/server/filer_server_handlers_write_autochunk.go:26-155`,
 `_write_upload.go:30-141` (chunk fan-out + whole-stream MD5),
 `_read.go:91` (ranged streaming), `filer/stream.go:153`.
 
-The upload path's content hashing routes through the TPU batch kernels when
-a chip is attached (ops.md5_kernel/crc32c_kernel batch queue) and the C++
-native path otherwise — never pure Python (SURVEY.md §2.2).
+One-shot blob hashing (per-chunk ETag MD5, inline small-content MD5) goes
+through ops.hash_service: a micro-batching queue that coalesces the chunks
+of one upload AND concurrent requests into single batch-kernel calls —
+ops.md5_kernel/crc32c_kernel on an attached chip, one GIL-released C++
+batch call otherwise (SURVEY.md §2.2). The whole-stream MD5 tee
+(`_write_upload.go:48`) stays a sequential CPU hash: MD5 cannot
+parallelize within one stream, only across blobs.
 """
 
 from __future__ import annotations
@@ -23,6 +27,7 @@ from seaweedfs_tpu.util import cipher as cipher_util
 from seaweedfs_tpu.util.compression import decompress_data, maybe_compress_data
 
 from seaweedfs_tpu.filer import Attributes, Entry, FileChunk, Filer
+from seaweedfs_tpu.ops.hash_service import get_hash_service
 from seaweedfs_tpu.filer.filechunks import (
     maybe_manifestize,
     resolve_chunk_manifest,
@@ -56,6 +61,10 @@ class FilerServer:
         chunk_cache_dir: str | None = None,
         notification_queue=None,
         peers: list[str] | None = None,
+        dedup: bool = False,
+        dedup_avg_bits: int = 16,
+        dedup_min: int = 16 * 1024,
+        dedup_max: int = 512 * 1024,
     ) -> None:
         from seaweedfs_tpu.security import Guard, SecurityConfig
 
@@ -81,6 +90,17 @@ class FilerServer:
         # -encryptVolumeData / compression defaults (`weed/command/filer.go`)
         self.cipher = cipher
         self.compress = compress
+        # CDC dedup (filer/dedup.py): content-defined chunking + hash index.
+        # Mutually exclusive with cipher — random per-chunk AES keys make
+        # equal plaintexts distinct, and convergent encryption leaks equality.
+        self.dedup = dedup and not cipher
+        if self.dedup:
+            from seaweedfs_tpu.filer.dedup import DedupIndex
+
+            self.dedup_index = DedupIndex(self.filer)
+            self.dedup_avg_bits = dedup_avg_bits
+            self.dedup_min = dedup_min
+            self.dedup_max = dedup_max
         from seaweedfs_tpu.util.chunk_cache import TieredChunkCache
 
         self.chunk_cache = TieredChunkCache(disk_dir=chunk_cache_dir)
@@ -150,13 +170,23 @@ class FilerServer:
         (`filer_server_handlers_write_upload.go:30`). Each chunk is
         independently maybe-compressed (mime heuristic) and AES-GCM
         encrypted when the filer runs ciphered (`upload_content.go`)."""
+        if self.dedup:
+            return self._upload_chunks_cdc(
+                data, ttl, collection, replication, mime=mime,
+                filename=filename,
+            )
         ext = os.path.splitext(filename)[1]
         md5 = hashlib.md5()
         chunks: list[FileChunk] = []
+        etag_futures = []  # per-chunk MD5 via the batch hash service: every
+        # chunk of this upload (and of concurrent uploads) coalesces into
+        # one batch-kernel call (`upload_content.go` md5 ETag semantics)
+        hash_svc = get_hash_service()
         offset = 0
         while offset < len(data):
             piece = data[offset : offset + self.chunk_size]
             md5.update(piece)
+            etag_futures.append(hash_svc.submit(piece))
             logical_size = len(piece)
             payload, compressed = (
                 maybe_compress_data(piece, mime, ext) if self.compress
@@ -181,8 +211,76 @@ class FilerServer:
                 )
             )
             offset += logical_size
+        for chunk, fut in zip(chunks, etag_futures):
+            chunk.etag = fut.md5_hex()
         if not data:
             md5.update(b"")
+        return chunks, md5.hexdigest()
+
+    def _upload_chunks_cdc(
+        self, data: bytes, ttl: str, collection: str, replication: str,
+        mime: str = "", filename: str = "",
+    ) -> tuple[list[FileChunk], str]:
+        """Dedup write path (filer/dedup.py, BASELINE config 4): cut at
+        content-defined boundaries, batch-hash every chunk, upload only the
+        chunks whose (md5,len) key is new; known chunks reference the
+        already-stored fileId. Boundaries follow content, so shifted or
+        partially-edited re-uploads still dedup."""
+        from seaweedfs_tpu.ops import cdc
+
+        ext = os.path.splitext(filename)[1]
+        md5 = hashlib.md5()
+        md5.update(data)
+        cuts = cdc.find_boundaries(
+            memoryview(data), avg_bits=self.dedup_avg_bits,
+            min_size=self.dedup_min, max_size=self.dedup_max,
+            backend=cdc.pick_backend(),
+        )
+        hash_svc = get_hash_service()
+        pieces: list[bytes] = []
+        prev = 0
+        for c in cuts:
+            pieces.append(data[prev:c])
+            prev = c
+        futures = [hash_svc.submit(p) for p in pieces]
+        chunks: list[FileChunk] = []
+        offset = 0
+        idx = self.dedup_index
+        for piece, fut in zip(pieces, futures):
+            etag = fut.md5_hex()
+            key = f"{etag}-{len(piece):x}"
+            rec = idx.lookup(key)
+            if rec is not None:
+                idx.hits += 1
+                idx.bytes_saved += len(piece)
+                chunks.append(
+                    FileChunk(
+                        file_id=rec["fid"], offset=offset, size=len(piece),
+                        modified_ts_ns=time.time_ns(), etag=etag,
+                        is_compressed=bool(rec.get("z")),
+                    )
+                )
+            else:
+                idx.misses += 1
+                payload, compressed = (
+                    maybe_compress_data(piece, mime, ext) if self.compress
+                    else (piece, False)
+                )
+                out = self.client.upload(
+                    payload, replication=replication, collection=collection,
+                    ttl=ttl,
+                )
+                chunks.append(
+                    FileChunk(
+                        file_id=out["fid"], offset=offset, size=len(piece),
+                        modified_ts_ns=time.time_ns(), etag=etag,
+                        is_compressed=compressed,
+                    )
+                )
+                # TTL'd chunks expire under shared references; skip the index
+                if not ttl:
+                    idx.insert(key, {"fid": out["fid"], "z": int(compressed)})
+            offset += len(piece)
         return chunks, md5.hexdigest()
 
     def _save_manifest_blob(self, blob: bytes) -> FileChunk:
@@ -307,7 +405,7 @@ class FilerServer:
         data = client.read_file(key)
         if len(data) <= SMALL_CONTENT_LIMIT:
             entry.content = data
-            entry.attributes.md5 = hashlib.md5(data).hexdigest()
+            entry.attributes.md5 = get_hash_service().submit(data).md5_hex()
         else:
             chunks, md5_hex = self._upload_chunks(
                 data, "", self.collection, self.default_replication,
@@ -452,6 +550,14 @@ class FilerServer:
                  "signature": self.filer.signature}
             )
 
+        @svc.route("GET", r"/__dedup__/stats")
+        def dedup_stats(req: Request) -> Response:
+            if not self.dedup:
+                return Response({"enabled": False})
+            out = self.dedup_index.stats()
+            out["enabled"] = True
+            return Response(out)
+
         # --- distributed lock manager (weed/cluster/lock_manager) ---
         @svc.route("POST", r"/__dlm__/lock")
         def dlm_lock(req: Request) -> Response:
@@ -587,7 +693,7 @@ class FilerServer:
         entry.attributes.mtime = time.time()
         if len(data) <= SMALL_CONTENT_LIMIT:
             entry.content = data
-            entry.attributes.md5 = hashlib.md5(data).hexdigest()
+            entry.attributes.md5 = get_hash_service().submit(data).md5_hex()
         else:
             chunks, md5_hex = self._upload_chunks(
                 data, ttl, collection, replication, mime=mime, filename=filename

@@ -118,3 +118,64 @@ class TestCDC:
         assert cdc.find_boundaries(data, backend="numpy") == cdc.find_boundaries(
             data, backend="numpy"
         )
+
+
+class TestHashService:
+    """ops.hash_service: the upload-path micro-batcher (VERDICT r1 next #2)."""
+
+    def test_results_bit_identical_across_backends(self):
+        import hashlib
+
+        import numpy as np
+
+        from seaweedfs_tpu.ops.hash_service import _batch_hash
+        from seaweedfs_tpu.storage import crc as crc_mod
+
+        rng = np.random.RandomState(3)
+        blobs = rng.randint(0, 256, size=(32, 4096), dtype=np.uint8)
+        want_md5 = [hashlib.md5(blobs[i].tobytes()).digest() for i in range(32)]
+        want_crc = [crc_mod.crc32c(blobs[i].tobytes()) for i in range(32)]
+        for backend in ("native", "python"):
+            d, c = _batch_hash(backend, blobs)
+            assert [d[i].tobytes() for i in range(32)] == want_md5, backend
+            assert list(c) == want_crc, backend
+
+    def test_service_batches_concurrent_submits(self):
+        import hashlib
+        import threading
+
+        from seaweedfs_tpu.ops.hash_service import HashService
+
+        svc = HashService(backend="native", linger_s=0.005)
+        svc.start()
+        try:
+            blobs = [bytes([i % 256]) * 4096 for i in range(64)]
+            results = [None] * 64
+
+            def work(i):
+                results[i] = svc.submit(blobs[i])
+
+            threads = [threading.Thread(target=work, args=(i,)) for i in range(64)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for i, r in enumerate(results):
+                assert r.md5_hex() == hashlib.md5(blobs[i]).hexdigest()
+        finally:
+            svc.stop()
+
+    def test_mixed_lengths_and_empty(self):
+        import hashlib
+
+        from seaweedfs_tpu.ops.hash_service import HashService
+
+        svc = HashService(backend="native", linger_s=0.001)
+        svc.start()
+        try:
+            payloads = [b"", b"x", b"hello" * 100, b"z" * 10000]
+            futs = [svc.submit(p) for p in payloads]
+            for p, f in zip(payloads, futs):
+                assert f.md5_hex() == hashlib.md5(p).hexdigest()
+        finally:
+            svc.stop()
