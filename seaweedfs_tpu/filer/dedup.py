@@ -14,8 +14,10 @@ The index lives in the filer store itself under `/etc/dedup/<p>/<key>`
 
 Semantics / limits (documented, enforced):
 * deduplicated chunks are shared between entries — deleting one entry does
-  not reclaim their blobs. Space is reclaimed by `fs.dedup.gc`, which walks
-  the namespace and drops index entries (and blobs) no entry references.
+  not reclaim their blobs: the filer's reclaim path skips any fid the index
+  still maps (FilerServer._reclaim_chunks). Space is reclaimed by
+  `fs.dedup.gc` (shell) / POST `/__dedup__/gc`, which walks the namespace,
+  and deletes the blobs + index entries no entry references.
 * dedup is disabled when the filer runs ciphered: per-chunk random AES keys
   make equal plaintexts distinct ciphertexts (convergent encryption is a
   deliberate non-goal — it leaks equality).
@@ -68,6 +70,31 @@ class DedupIndex:
         e.attributes.file_size = len(e.content)
         self.filer.create_entry(e)
         self._remember(key, rec)
+
+    def remove(self, key: str) -> None:
+        """Drop an index entry (gc path); the blob itself is the caller's
+        responsibility."""
+        with self._mu:
+            self._cache.pop(key, None)
+        self.filer.delete_entry(self._path(key))
+
+    def iter_records(self):
+        """Yield (key, rec) for every persisted index entry — walks the
+        sharded `/etc/dedup/<p>/` directories in the filer store."""
+        root = self.filer.find_entry(DEDUP_DIR)
+        if root is None:
+            return
+        for shard in self.filer.list_entries(DEDUP_DIR, limit=1 << 31):
+            if not shard.is_directory:
+                continue
+            for e in self.filer.list_entries(shard.full_path, limit=1 << 31):
+                if e.is_directory or not e.content:
+                    continue
+                try:
+                    rec = json.loads(e.content)
+                except ValueError:
+                    continue
+                yield e.full_path.rsplit("/", 1)[-1], rec
 
     def _remember(self, key: str, rec: dict) -> None:
         with self._mu:

@@ -95,6 +95,7 @@ class HashService:
         self._cv = threading.Condition(self._mu)
         # length -> list of (data, HashResult)
         self._buckets: dict[int, list[tuple[bytes, HashResult]]] = {}
+        self._active_sync = 0  # submits hashing on the caller's thread
         self._stop = False
         self._thread: threading.Thread | None = None
 
@@ -159,20 +160,52 @@ class HashService:
 
     # --- API -----------------------------------------------------------------
     def submit(self, data: bytes) -> HashResult:
-        """Enqueue one blob; returns a future. Lone blobs on an idle server
-        hash synchronously (no linger tax)."""
+        """Enqueue one blob; returns a future. A lone blob on an idle server
+        (nothing queued, no other submit in flight) hashes synchronously on
+        the caller's thread — no linger/wakeup tax; the queue engages only
+        under genuinely concurrent load."""
         r = HashResult()
         if self._thread is None or len(data) == 0:
             r._set(*_hash_one(data))
             return r
         with self._cv:
-            bucket = self._buckets.setdefault(len(data), [])
-            bucket.append((bytes(data), r))
-            ready = len(bucket) >= self.max_batch
-            self._cv.notify_all()
-        if ready:
-            pass  # flusher picks it up immediately (notified above)
+            idle = not self._buckets and self._active_sync == 0
+            if idle:
+                self._active_sync += 1
+            else:
+                # callers hand over immutable bytes slices; only copy when
+                # given a mutable view (bench path passes bytes — zero-copy)
+                blob = data if isinstance(data, bytes) else bytes(data)
+                self._buckets.setdefault(len(data), []).append((blob, r))
+                self._cv.notify_all()
+        if idle:
+            try:
+                r._set(*_hash_one(data))
+            finally:
+                with self._cv:
+                    self._active_sync -= 1
         return r
+
+    def submit_many(self, blobs) -> list[HashResult]:
+        """Enqueue a burst from one caller (e.g. every piece of a chunked
+        upload) as a group: unlike N submit() calls, the burst always goes
+        through the queue so same-length pieces coalesce into batch-kernel
+        calls — the idle fast path would otherwise hash each piece scalar
+        back-to-back."""
+        results = [HashResult() for _ in blobs]
+        if self._thread is None:
+            for data, r in zip(blobs, results):
+                r._set(*_hash_one(data))
+            return results
+        with self._cv:
+            for data, r in zip(blobs, results):
+                if len(data) == 0:
+                    r._set(*_hash_one(data))
+                    continue
+                blob = data if isinstance(data, bytes) else bytes(data)
+                self._buckets.setdefault(len(blob), []).append((blob, r))
+            self._cv.notify_all()
+        return results
 
     def hash_now(self, data: bytes) -> tuple[str, int]:
         """Synchronous convenience: (md5 hex, crc32c)."""

@@ -95,12 +95,21 @@ class FilerServer:
         # equal plaintexts distinct, and convergent encryption leaks equality.
         self.dedup = dedup and not cipher
         if self.dedup:
+            import threading as _threading
+
             from seaweedfs_tpu.filer.dedup import DedupIndex
 
             self.dedup_index = DedupIndex(self.filer)
             self.dedup_avg_bits = dedup_avg_bits
             self.dedup_min = dedup_min
             self.dedup_max = dedup_max
+            # gc-vs-upload coordination (see dedup_gc): hits record the fid
+            # under this lock; gc condemns keys under the same lock, so every
+            # hit either lands before the gc decision (gc skips the fid) or
+            # sees the key condemned (upload treats it as a miss).
+            self._dedup_mu = _threading.Lock()
+            self._dedup_recent: dict[str, float] = {}
+            self._dedup_condemned: set[str] = set()
         from seaweedfs_tpu.util.chunk_cache import TieredChunkCache
 
         self.chunk_cache = TieredChunkCache(disk_dir=chunk_cache_dir)
@@ -178,15 +187,17 @@ class FilerServer:
         ext = os.path.splitext(filename)[1]
         md5 = hashlib.md5()
         chunks: list[FileChunk] = []
-        etag_futures = []  # per-chunk MD5 via the batch hash service: every
-        # chunk of this upload (and of concurrent uploads) coalesces into
-        # one batch-kernel call (`upload_content.go` md5 ETag semantics)
-        hash_svc = get_hash_service()
+        pieces = [
+            data[o : o + self.chunk_size]
+            for o in range(0, len(data), self.chunk_size)
+        ]
+        # per-chunk MD5 via the batch hash service: every chunk of this
+        # upload (and of concurrent uploads) coalesces into one batch-kernel
+        # call (`upload_content.go` md5 ETag semantics)
+        etag_futures = get_hash_service().submit_many(pieces)
         offset = 0
-        while offset < len(data):
-            piece = data[offset : offset + self.chunk_size]
+        for piece in pieces:
             md5.update(piece)
-            etag_futures.append(hash_svc.submit(piece))
             logical_size = len(piece)
             payload, compressed = (
                 maybe_compress_data(piece, mime, ext) if self.compress
@@ -242,7 +253,7 @@ class FilerServer:
         for c in cuts:
             pieces.append(data[prev:c])
             prev = c
-        futures = [hash_svc.submit(p) for p in pieces]
+        futures = hash_svc.submit_many(pieces)
         chunks: list[FileChunk] = []
         offset = 0
         idx = self.dedup_index
@@ -250,6 +261,14 @@ class FilerServer:
             etag = fut.md5_hex()
             key = f"{etag}-{len(piece):x}"
             rec = idx.lookup(key)
+            if rec is not None:
+                # linearize vs gc: record the fid as freshly referenced, or
+                # learn the key was condemned this instant and re-upload
+                with self._dedup_mu:
+                    if key in self._dedup_condemned:
+                        rec = None
+                    else:
+                        self._dedup_recent[rec["fid"]] = time.monotonic()
             if rec is not None:
                 idx.hits += 1
                 idx.bytes_saved += len(piece)
@@ -279,6 +298,9 @@ class FilerServer:
                 )
                 # TTL'd chunks expire under shared references; skip the index
                 if not ttl:
+                    with self._dedup_mu:
+                        self._dedup_condemned.discard(key)
+                        self._dedup_recent[out["fid"]] = time.monotonic()
                     idx.insert(key, {"fid": out["fid"], "z": int(compressed)})
             offset += len(piece)
         return chunks, md5.hexdigest()
@@ -558,6 +580,12 @@ class FilerServer:
             out["enabled"] = True
             return Response(out)
 
+        @svc.route("POST", r"/__dedup__/gc")
+        def dedup_gc(req: Request) -> Response:
+            if not self.dedup:
+                return Response({"error": "dedup not enabled"}, 400)
+            return Response(self.dedup_gc())
+
         # --- distributed lock manager (weed/cluster/lock_manager) ---
         @svc.route("POST", r"/__dlm__/lock")
         def dlm_lock(req: Request) -> Response:
@@ -717,10 +745,97 @@ class FilerServer:
             try:
                 if c.is_chunk_manifest:
                     for inner in resolve_chunk_manifest(self._fetch_chunk, [c]):
-                        self.client.delete(inner.file_id)
+                        if not self._dedup_managed(inner):
+                            self.client.delete(inner.file_id)
+                    self.client.delete(c.file_id)  # manifests are never shared
+                    continue
+                if self._dedup_managed(c):
+                    continue
                 self.client.delete(c.file_id)
             except Exception:
                 pass
+
+    def _dedup_managed(self, chunk: FileChunk) -> bool:
+        """True when the chunk's blob is owned by the dedup index — other
+        entries may reference the same fid, so delete/overwrite must not
+        reclaim it (`fs.dedup.gc` does, once nothing references it)."""
+        if not self.dedup or not chunk.etag:
+            return False
+        rec = self.dedup_index.lookup(f"{chunk.etag}-{chunk.size:x}")
+        return rec is not None and rec.get("fid") == chunk.file_id
+
+    def dedup_gc(self) -> dict:
+        """Walk the namespace, then drop every index entry (and delete its
+        blob) that no live entry references. The reclaim path promised by
+        `filer/dedup.py`. Concurrency-safe against in-flight dedup'd
+        uploads: a lookup-hit records its fid in `_dedup_recent` under
+        `_dedup_mu` before the entry exists, and the gc decision runs under
+        the same lock — so a hit either precedes the decision (gc skips the
+        fid as recently referenced) or follows the key's condemnation (the
+        upload sees `_dedup_condemned` and re-uploads instead)."""
+        from seaweedfs_tpu.filer.dedup import DEDUP_DIR
+
+        gc_start = time.monotonic()
+        referenced: set[str] = set()
+
+        def walk(p: str) -> None:
+            for e in self.filer.list_entries(p, limit=1 << 31):
+                if e.is_directory:
+                    if e.full_path != DEDUP_DIR:
+                        walk(e.full_path)
+                    continue
+                chunks = e.chunks
+                if any(c.is_chunk_manifest for c in chunks):
+                    # a manifest we cannot resolve hides data fids — any
+                    # error here must abort the gc, not shrink the pin set
+                    chunks = self._resolved_chunks(e)
+                for c in chunks:
+                    referenced.add(c.file_id)
+
+        try:
+            walk("/")
+        except Exception as e:
+            return {"error": f"namespace walk failed, gc aborted: {e}",
+                    "scanned": 0, "dropped": 0, "bytes_freed": 0, "errors": 1}
+        scanned = dropped = freed = errors = 0
+        for key, rec in list(self.dedup_index.iter_records()):
+            scanned += 1
+            fid = rec.get("fid", "")
+            if not fid or fid in referenced:
+                continue
+            with self._dedup_mu:
+                # referenced (or re-inserted) since the walk began: keep
+                ts = self._dedup_recent.get(fid)
+                if ts is not None and ts >= gc_start - 1.0:
+                    continue
+                self._dedup_condemned.add(key)
+            try:
+                # index entry first: if this fails the blob merely leaks and
+                # a later gc retries; the reverse order would leave the index
+                # handing out a deleted fid (silent data loss)
+                self.dedup_index.remove(key)
+            except Exception:
+                errors += 1
+                continue
+            try:
+                self.client.delete(fid)
+            except Exception:
+                errors += 1  # blob leaked; index is already consistent
+                continue
+            dropped += 1
+            try:
+                freed += int(key.rsplit("-", 1)[1], 16)
+            except (IndexError, ValueError):
+                pass
+        with self._dedup_mu:  # prune the recency map so it stays bounded
+            cutoff = time.monotonic() - 600.0
+            self._dedup_recent = {
+                f: t for f, t in self._dedup_recent.items() if t > cutoff
+            }
+        return {
+            "scanned": scanned, "dropped": dropped,
+            "bytes_freed": freed, "errors": errors,
+        }
 
     def _do_read(self, req: Request, head: bool) -> Response:
         path = normalize(urllib.parse.unquote(req.path))

@@ -117,15 +117,40 @@ class WebDavServer:
             return None
         return token
 
-    def _lock_conflict(self, req: Request, path: str) -> Response | None:
+    def _lock_conflict(
+        self, req: Request, path: str, check_descendants: bool = False
+    ) -> Response | None:
         """423 unless the request carries the live lock token in its If
-        header (RFC 4918 §6; clients send `If: (<token>)`)."""
-        token = self._live_lock(path)
-        if token is None:
-            return None
-        if token in req.headers.get("If", ""):
-            return None
-        return Response({"error": "locked"}, 423)
+        header (RFC 4918 §6; clients send `If: (<token>)`). Locks are
+        depth-infinity (RFC 4918 §7): a lock on a collection covers every
+        member, so ancestors of the target are checked too; recursive
+        DELETE/MOVE also checks locks held below the target."""
+        if_header = req.headers.get("If", "")
+        probe = path
+        while True:
+            token = self._live_lock(probe)
+            if token is not None and token not in if_header:
+                return Response({"error": "locked"}, 423)
+            if probe == "/":
+                break
+            probe = probe.rsplit("/", 1)[0] or "/"
+        if check_descendants:
+            prefix = path.rstrip("/") + "/"
+            for locked in list(self._locks):
+                if locked.startswith(prefix):
+                    token = self._live_lock(locked)
+                    if token is not None and token not in if_header:
+                        return Response({"error": "locked"}, 423)
+        return None
+
+    def _drop_locks_under(self, path: str) -> None:
+        """Forget the lock on `path` and on everything below it (after a
+        successful DELETE or MOVE — the resources the locks named are gone)."""
+        self._locks.pop(path, None)
+        prefix = path.rstrip("/") + "/"
+        for locked in list(self._locks):
+            if locked.startswith(prefix):
+                self._locks.pop(locked, None)
 
     # --- routes --------------------------------------------------------------
     def _routes(self) -> None:
@@ -206,14 +231,14 @@ class WebDavServer:
             if self.read_only:
                 return Response({"error": "read-only"}, 403)
             path = self._norm(req.path)
-            conflict = self._lock_conflict(req, path)
+            conflict = self._lock_conflict(req, path, check_descendants=True)
             if conflict is not None:
                 return conflict
             if self._entry(path) is None:
                 return Response({"error": "not found"}, 404)
             self.fc.delete(path, recursive=True)
-            self._locks.pop(path, None)
-            return Response(b"", 204)
+            self._drop_locks_under(path)  # RFC 4918 §9.6: DELETE removes
+            return Response(b"", 204)     # locks on the deleted resources
 
         @svc.route("MKCOL", any_path)
         def mkcol(req: Request) -> Response:
@@ -247,6 +272,12 @@ class WebDavServer:
                 else:
                     return Response({"error": "locked"}, 423)
             else:
+                # an exclusive depth-infinity lock anywhere above or below
+                # forbids creating this one (RFC 4918 §7: a collection lock
+                # covers members; a new lock would cover locked descendants)
+                conflict = self._lock_conflict(req, path, check_descendants=True)
+                if conflict is not None:
+                    return conflict
                 token = f"opaquelocktoken:{uuid.uuid4()}"
                 self._locks[path] = (token, time.time() + self.lock_timeout)
             owner = ""
@@ -318,10 +349,10 @@ class WebDavServer:
             return Response({"error": "missing Destination"}, 400)
         dst = self._norm(urllib.parse.urlparse(dest_header).path)
         if is_move:  # COPY does not mutate the source
-            conflict = self._lock_conflict(req, src)
+            conflict = self._lock_conflict(req, src, check_descendants=True)
             if conflict is not None:
                 return conflict
-        conflict = self._lock_conflict(req, dst)
+        conflict = self._lock_conflict(req, dst, check_descendants=True)
         if conflict is not None:
             return conflict
         entry = self._entry(src)
@@ -336,6 +367,7 @@ class WebDavServer:
                 self.fc.rename(src, dst)
             except OSError as e:
                 return Response({"error": str(e)}, 409)
+            self._drop_locks_under(src)  # locks name paths, not resources
         else:
             if entry.get("is_directory"):
                 self._copy_tree(src, dst)

@@ -221,3 +221,17 @@ def cmd_fs_meta_cat(env: CommandEnv, args: list[str]) -> str:
     if status != 200:
         raise ShellError(f"{path}: not found")
     return _json.dumps(_json.loads(body), indent=2)
+
+@command("fs.dedup.gc", "garbage-collect unreferenced dedup'd chunk blobs")
+def cmd_fs_dedup_gc(env: CommandEnv, args: list[str]) -> str:
+    """Triggers the filer's dedup GC (`filer/dedup.py` semantics): walk the
+    namespace, delete every indexed blob no entry references, drop its index
+    entry. New capability vs the reference (it has no CDC dedup)."""
+    status, _, body = http_request("POST", f"{env.require_filer()}/__dedup__/gc", b"")
+    out = json.loads(body)
+    if status >= 400:
+        raise ShellError(out.get("error", f"gc failed: {status}"))
+    return (
+        f"scanned {out['scanned']} index entries, dropped {out['dropped']} "
+        f"({out['bytes_freed']} bytes freed, {out['errors']} errors)"
+    )

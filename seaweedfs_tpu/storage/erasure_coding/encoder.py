@@ -134,13 +134,17 @@ def _schedule(total: int, large: int, small: int, batch: int):
 class _ShardWriters:
     """14 positional-write fds; existing files are overwritten in place
     (tmpfs/page-cache overwrite is far cheaper than fresh allocation) and
-    truncated to the final shard size on close."""
+    truncated to the final shard size on close. On a failed encode the
+    partially written files are deleted (`abort`) — a half-encoded shard
+    truncated to full size would look complete while holding stale bytes."""
 
     def __init__(self, base: str, final_size: int, shard_ids=None) -> None:
         self.fds: dict[int, int] = {}
+        self.paths: dict[int, str] = {}
         self.final_size = final_size
         for i in shard_ids if shard_ids is not None else range(TOTAL_SHARDS_COUNT):
             path = base + to_ext(i)
+            self.paths[i] = path
             self.fds[i] = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
 
     def pwrite(self, shard: int, data, offset: int) -> None:
@@ -155,6 +159,16 @@ class _ShardWriters:
             os.ftruncate(fd, self.final_size)
             os.close(fd)
         self.fds.clear()
+
+    def abort(self) -> None:
+        for fd in self.fds.values():
+            os.close(fd)
+        self.fds.clear()
+        for path in self.paths.values():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
 
 def _run_pipeline(jobs, read_job, encode_job, write_job) -> None:
@@ -319,9 +333,13 @@ def write_ec_files(
                     )
 
         _run_pipeline(jobs, read_job, encode_job, write_job)
+    except BaseException:
+        writers.abort()
+        raise
+    else:
+        writers.close()
     finally:
         os.close(dat_fd)
-        writers.close()
 
 
 def rebuild_ec_files(
@@ -400,7 +418,10 @@ def rebuild_ec_files(
                     writers.pwrite(sid, out[i, :width], off)
 
             _run_pipeline(jobs, read_job, encode_job, write_job)
-        finally:
+        except BaseException:
+            writers.abort()
+            raise
+        else:
             writers.close()
     finally:
         for fd in present_fds.values():

@@ -204,3 +204,40 @@ def test_read_only_mode(dav):
         assert status == 403
     finally:
         ro.stop()
+
+def test_collection_lock_covers_members(dav):
+    """RFC 4918 depth-infinity: a lock on a collection guards every member,
+    and recursive DELETE/MOVE of an ancestor respects locks held below."""
+    lockinfo = (b'<?xml version="1.0"?><D:lockinfo xmlns:D="DAV:">'
+                b"<D:lockscope><D:exclusive/></D:lockscope>"
+                b"<D:locktype><D:write/></D:locktype></D:lockinfo>")
+    http_request("MKCOL", dav.url + "/proj")
+    http_request("PUT", dav.url + "/proj/doc.txt", body=b"v1")
+    status, headers, _ = http_request("LOCK", dav.url + "/proj", body=lockinfo)
+    assert status == 200
+    token = headers.get("Lock-Token", "").strip("<>")
+    # member mutations without the token: blocked by the ancestor lock
+    assert http_request("PUT", dav.url + "/proj/doc.txt", body=b"x")[0] == 423
+    assert http_request("DELETE", dav.url + "/proj/doc.txt")[0] == 423
+    assert http_request("MKCOL", dav.url + "/proj/sub")[0] == 423
+    # with the token they succeed
+    status, _, _ = http_request(
+        "PUT", dav.url + "/proj/doc.txt", body=b"v2",
+        headers={"If": f"(<{token}>)"})
+    assert status == 201
+    http_request("UNLOCK", dav.url + "/proj",
+                 headers={"Lock-Token": f"<{token}>"})
+
+    # descendant lock blocks recursive DELETE/MOVE of the ancestor
+    status, headers, _ = http_request(
+        "LOCK", dav.url + "/proj/doc.txt", body=lockinfo)
+    assert status == 200
+    child_token = headers.get("Lock-Token", "").strip("<>")
+    assert http_request("DELETE", dav.url + "/proj")[0] == 423
+    status, _, _ = http_request(
+        "MOVE", dav.url + "/proj",
+        headers={"Destination": dav.url + "/proj2"})
+    assert status == 423
+    status, _, _ = http_request(
+        "DELETE", dav.url + "/proj", headers={"If": f"(<{child_token}>)"})
+    assert status == 204
