@@ -81,6 +81,27 @@ class NativeLib:
         self._lib.sw_gf256_has_gfni.argtypes = []
         self._lib.sw_gf256_set_gfni.restype = ctypes.c_int
         self._lib.sw_gf256_set_gfni.argtypes = [ctypes.c_int]
+        self._lib.sw_ec_encode_volume.restype = ctypes.c_longlong
+        self._lib.sw_ec_encode_volume.argtypes = [
+            ctypes.c_char_p,  # matrix rows*cols
+            ctypes.c_int,  # parity rows
+            ctypes.c_int,  # data cols
+            ctypes.c_int,  # dat fd
+            ctypes.c_ulonglong,  # total .dat bytes
+            ctypes.POINTER(ctypes.c_int),  # shard fds [cols+rows]
+            ctypes.c_ulonglong,  # shard size
+            ctypes.c_ulonglong,  # large block
+            ctypes.c_ulonglong,  # small block
+        ]
+        self._lib.sw_gf256_matmul_fds.restype = ctypes.c_longlong
+        self._lib.sw_gf256_matmul_fds.argtypes = [
+            ctypes.c_char_p,  # matrix rows*cols
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),  # input shard fds [cols]
+            ctypes.c_ulonglong,  # bytes per shard
+            ctypes.POINTER(ctypes.c_int),  # output shard fds [rows]
+        ]
         self._lib.sw_gf256_encode_rows.restype = None
         self._lib.sw_gf256_encode_rows.argtypes = [
             ctypes.c_char_p,  # matrix rows*cols
@@ -141,6 +162,27 @@ class NativeLib:
             out.ctypes.data,
         )
         return out
+
+    def ec_encode_volume(self, matrix: bytes, parity: int, cols: int,
+                         dat_fd: int, total: int, shard_fds, shard_size: int,
+                         large_block: int, small_block: int) -> int:
+        """Whole-volume fused encode (see sw_ec_encode_volume): mmap'd .dat
+        -> GFNI -> NT-stores into the (pre-truncated) mmap'd shard files.
+        One GIL-released call; returns 0 on success, <0 => caller falls back
+        to the staged pipeline."""
+        fds = (ctypes.c_int * len(shard_fds))(*shard_fds)
+        return int(self._lib.sw_ec_encode_volume(
+            matrix, parity, cols, dat_fd, total, fds, shard_size,
+            large_block, small_block,
+        ))
+
+    def gf256_matmul_fds(self, matrix: bytes, rows: int, cols: int,
+                         in_fds, n: int, out_fds) -> int:
+        """Fused matmul with fd-mmapped inputs/outputs (rebuild/decode hot
+        path). Returns 0 on success, <0 => caller falls back."""
+        ifds = (ctypes.c_int * cols)(*in_fds)
+        ofds = (ctypes.c_int * rows)(*out_fds)
+        return int(self._lib.sw_gf256_matmul_fds(matrix, rows, cols, ifds, n, ofds))
 
     def has_gfni(self) -> bool:
         return bool(self._lib.sw_gf256_has_gfni())

@@ -11,6 +11,9 @@
 #include <cstring>
 #include <vector>
 
+#include <sys/mman.h>
+#include <unistd.h>
+
 #if defined(__GFNI__) && defined(__AVX512F__) && defined(__AVX512BW__)
 #include <immintrin.h>
 #define SW_HAVE_GFNI 1
@@ -190,6 +193,151 @@ extern "C" void sw_gf256_encode_rows(const unsigned char* matrix, int rows,
             outs[r] = out + (size_t)r * span + (size_t)r2 * block;
         sw_gf256_matmul(matrix, rows, cols, ins.data(), outs.data(), block);
     }
+}
+
+#ifdef SW_HAVE_GFNI
+namespace {
+
+// Fused encode of one full block row: 10 data blocks stream from the mmap'd
+// .dat straight through registers — each 64B line is NT-stored to its data
+// shard while GF2P8AFFINEQB accumulates the 4 parity lines, which are then
+// NT-stored too. One pass over memory: read 1x, write 1.4x, no user<->kernel
+// copies and no cache pollution (the page-cache copies of the pread/pwrite
+// pipeline cost ~2x this on a single-core host).
+void encode_row_fused(const __m512i* am, int prows, int dcols,
+                      const unsigned char* src, size_t block,
+                      unsigned char** dst, size_t shard_off) {
+    for (size_t i = 0; i < block; i += 64) {
+        __m512i acc[4];
+        for (int r = 0; r < prows; r++) acc[r] = _mm512_setzero_si512();
+        for (int c = 0; c < dcols; c++) {
+            __m512i x = _mm512_loadu_si512(
+                (const void*)(src + (size_t)c * block + i));
+            _mm512_stream_si512((__m512i*)(dst[c] + shard_off + i), x);
+            for (int r = 0; r < prows; r++)
+                acc[r] = _mm512_xor_si512(
+                    acc[r], _mm512_gf2p8affine_epi64_epi8(
+                                x, am[(size_t)r * dcols + c], 0));
+        }
+        for (int r = 0; r < prows; r++)
+            _mm512_stream_si512(
+                (__m512i*)(dst[dcols + r] + shard_off + i), acc[r]);
+    }
+}
+
+} // namespace
+#endif
+
+// Whole-volume fused EC encode over the reference's striped row layout
+// (`ec_encoder.go:198-235`): large rows while >1 full large row remains,
+// then small rows with the tail zero-padded. Caller must have ftruncated
+// every shard file to shard_size. Returns 0 on success, <0 when this host
+// can't run the fused path (caller falls back to the staged pipeline).
+extern "C" long long sw_ec_encode_volume(
+    const unsigned char* matrix, int prows, int dcols, int dat_fd,
+    unsigned long long total, const int* shard_fds,
+    unsigned long long shard_size, unsigned long long large_block,
+    unsigned long long small_block) {
+#ifndef SW_HAVE_GFNI
+    (void)matrix; (void)prows; (void)dcols; (void)dat_fd; (void)total;
+    (void)shard_fds; (void)shard_size; (void)large_block; (void)small_block;
+    return -1;
+#else
+    init_gf();
+    if (!gfni_ok) return -1;
+    if (prows <= 0 || prows > 4 || dcols <= 0 || dcols > 30) return -2;
+    if (large_block % 64 || small_block % 64 || !small_block || !large_block)
+        return -2;  // a zero block would spin the GIL-released row loop
+    if (!total) return -2;
+    int nshards = dcols + prows;
+
+    const unsigned char* src = (const unsigned char*)mmap(
+        nullptr, total, PROT_READ, MAP_SHARED | MAP_POPULATE, dat_fd, 0);
+    if (src == MAP_FAILED) return -3;
+    std::vector<unsigned char*> maps(nshards, nullptr);
+    long long rc = 0;
+    for (int s = 0; s < nshards && rc == 0; s++) {
+        maps[s] = (unsigned char*)mmap(nullptr, shard_size,
+                                       PROT_READ | PROT_WRITE,
+                                       MAP_SHARED | MAP_POPULATE,
+                                       shard_fds[s], 0);
+        if (maps[s] == MAP_FAILED) { maps[s] = nullptr; rc = -4; }
+    }
+    if (rc == 0) {
+        std::vector<__m512i> am((size_t)prows * dcols);
+        for (int r = 0; r < prows; r++)
+            for (int c = 0; c < dcols; c++)
+                am[(size_t)r * dcols + c] = _mm512_set1_epi64(
+                    (long long)affine_matrix(matrix[r * dcols + c]));
+        std::vector<unsigned char> bounce;
+        size_t remaining = total, dat_off = 0, shard_off = 0;
+        size_t large_row = large_block * (size_t)dcols;
+        size_t small_row = small_block * (size_t)dcols;
+        while (remaining > large_row) {
+            // full large rows only (the loop condition guarantees it)
+            encode_row_fused(am.data(), prows, dcols, src + dat_off,
+                             large_block, maps.data(), shard_off);
+            dat_off += large_row;
+            shard_off += large_block;
+            remaining -= large_row;
+        }
+        while (remaining > 0 && rc == 0) {
+            if (shard_off + small_block > shard_size) { rc = -5; break; }
+            if (remaining >= small_row) {
+                encode_row_fused(am.data(), prows, dcols, src + dat_off,
+                                 small_block, maps.data(), shard_off);
+            } else {
+                // tail row: zero-padded copy, then the same fused kernel
+                if (bounce.size() < small_row) bounce.resize(small_row);
+                std::memset(bounce.data(), 0, small_row);
+                std::memcpy(bounce.data(), src + dat_off, remaining);
+                encode_row_fused(am.data(), prows, dcols, bounce.data(),
+                                 small_block, maps.data(), shard_off);
+            }
+            dat_off += small_row;
+            shard_off += small_block;
+            remaining = remaining > small_row ? remaining - small_row : 0;
+        }
+        _mm_sfence();
+        if (rc == 0 && shard_off != shard_size) rc = -5;
+    }
+    for (int s = 0; s < nshards; s++)
+        if (maps[s]) munmap(maps[s], shard_size);
+    munmap((void*)src, total);
+    return rc;
+#endif
+}
+
+// Fused matmul over fd-mmapped shards: out[r] = sum_c M[r][c]*in[c], with
+// every input read straight from the page cache (MAP_POPULATE) instead of
+// pread copies. Serves ec.rebuild (decode_matrix rows) and ec.decode.
+extern "C" long long sw_gf256_matmul_fds(const unsigned char* matrix,
+                                         int rows, int cols,
+                                         const int* in_fds,
+                                         unsigned long long n,
+                                         const int* out_fds) {
+    init_gf();
+    if (rows <= 0 || cols <= 0 || !n) return -2;
+    std::vector<const unsigned char*> ins(cols, nullptr);
+    std::vector<unsigned char*> outs(rows, nullptr);
+    long long rc = 0;
+    for (int c = 0; c < cols && rc == 0; c++) {
+        void* m = mmap(nullptr, n, PROT_READ, MAP_SHARED | MAP_POPULATE,
+                       in_fds[c], 0);
+        if (m == MAP_FAILED) rc = -3; else ins[c] = (const unsigned char*)m;
+    }
+    for (int r = 0; r < rows && rc == 0; r++) {
+        void* m = mmap(nullptr, n, PROT_READ | PROT_WRITE,
+                       MAP_SHARED | MAP_POPULATE, out_fds[r], 0);
+        if (m == MAP_FAILED) rc = -4; else outs[r] = (unsigned char*)m;
+    }
+    if (rc == 0)
+        sw_gf256_matmul(matrix, rows, cols, ins.data(), outs.data(), n);
+    for (int c = 0; c < cols; c++)
+        if (ins[c]) munmap((void*)ins[c], n);
+    for (int r = 0; r < rows; r++)
+        if (outs[r]) munmap(outs[r], n);
+    return rc;
 }
 
 extern "C" int sw_gf256_has_gfni() {

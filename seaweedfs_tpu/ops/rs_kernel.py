@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 
 import numpy as np
 
@@ -284,6 +285,7 @@ def _device_put_2d(data: np.ndarray):
 
 
 _PIPELINE_BACKEND: str | None = None
+_PIPELINE_LOCK = threading.Lock()
 
 
 def pick_pipeline_backend(codec: RSCodec | None = None) -> str:
@@ -295,7 +297,6 @@ def pick_pipeline_backend(codec: RSCodec | None = None) -> str:
     the native GFNI/AVX-512 path instead. VERDICT.md r1 weak #1 is exactly
     the gap between those two numbers. Override: SEAWEEDFS_TPU_EC_BACKEND."""
     global _PIPELINE_BACKEND
-    import time as _time
 
     if codec is not None and codec._backend != "auto":
         return codec._backend
@@ -304,6 +305,16 @@ def pick_pipeline_backend(codec: RSCodec | None = None) -> str:
         return env
     if _PIPELINE_BACKEND is not None:
         return _PIPELINE_BACKEND
+    # one calibration per process: a boot-time warmer and the first encode
+    # RPC must not probe the link / benchmark kernels concurrently
+    with _PIPELINE_LOCK:
+        if _PIPELINE_BACKEND is None:
+            _PIPELINE_BACKEND = _calibrate_pipeline_backend()
+        return _PIPELINE_BACKEND
+
+
+def _calibrate_pipeline_backend() -> str:
+    import time as _time
 
     candidates: list[str] = []
     try:
@@ -321,11 +332,32 @@ def pick_pipeline_backend(codec: RSCodec | None = None) -> str:
     except Exception:
         pass
     if not candidates:
-        _PIPELINE_BACKEND = "numpy"
-        return _PIPELINE_BACKEND
+        return "numpy"
     if len(candidates) == 1:
-        _PIPELINE_BACKEND = candidates[0]
-        return _PIPELINE_BACKEND
+        return candidates[0]
+
+    if "jax" in candidates:
+        # Cheap link probe before the expensive calibration: the full jax
+        # candidate costs a Pallas compile plus tens of MB through the
+        # host<->device link. A device behind a slow relay (~30MB/s here)
+        # can never win the e2e pipeline, so measure raw H2D rate with two
+        # tiny puts first and drop the candidate outright below 1 GB/s —
+        # this was the 17s trial-1 cold start in BENCH_r03.
+        try:
+            import jax
+
+            warm = np.zeros(65536, np.uint8)
+            jax.device_put(warm).block_until_ready()
+            probe = np.zeros(4 * 1024 * 1024, np.uint8)
+            t0 = _time.perf_counter()
+            jax.device_put(probe).block_until_ready()
+            h2d = probe.nbytes / (_time.perf_counter() - t0)
+            if h2d < 1e9:
+                candidates.remove("jax")
+        except Exception:
+            candidates.remove("jax")
+        if len(candidates) == 1:
+            return candidates[0]
 
     rng = np.random.RandomState(0)
     sample = rng.randint(0, 256, size=(DATA_SHARDS, 2 * 1024 * 1024)).astype(
@@ -341,5 +373,4 @@ def pick_pipeline_backend(codec: RSCodec | None = None) -> str:
         rate = sample.nbytes / dt
         if rate > best_rate:
             best, best_rate = name, rate
-    _PIPELINE_BACKEND = best
     return best

@@ -83,6 +83,19 @@ class VolumeServer:
                 self._attach_shard_fetcher(ev)
         self.heartbeat_once()
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+        # Calibrate the EC pipeline backend (host GFNI vs TPU, measured
+        # link rate) at boot instead of inside the first ec.encode request —
+        # on a relayed chip the probe incl. jax init costs seconds that a
+        # data-plane RPC should never absorb. Result is process-cached.
+        def _calibrate():  # pragma: no cover - timing-dependent
+            try:
+                from seaweedfs_tpu.ops.rs_kernel import pick_pipeline_backend
+
+                pick_pipeline_backend()
+            except Exception:
+                pass
+
+        threading.Thread(target=_calibrate, daemon=True).start()
 
     def stop(self) -> None:
         self._stop.set()

@@ -132,41 +132,77 @@ def _schedule(total: int, large: int, small: int, batch: int):
 
 
 class _ShardWriters:
-    """14 positional-write fds; existing files are overwritten in place
-    (tmpfs/page-cache overwrite is far cheaper than fresh allocation) and
-    truncated to the final shard size on close. On a failed encode the
-    partially written files are deleted (`abort`) — a half-encoded shard
-    truncated to full size would look complete while holding stale bytes."""
+    """14 positional-write fds. Each shard is written under a `.tmp` name,
+    pre-sized to the final shard size (file-extending pwrite measures ~20x
+    slower than writes into a pre-truncated file on this kernel's tmpfs, and
+    the fused mmap path needs the full size mapped up front), and renamed
+    into place only in close(). A crashed or aborted encode therefore never
+    leaves a full-size shard that looks complete while holding stale bytes —
+    only ignorable `.tmp` litter. A pre-existing final shard (re-encode) is
+    renamed onto the `.tmp` name first: it was about to be replaced anyway,
+    and overwriting its pages in place is far cheaper than allocating fresh
+    ones (every byte is rewritten before the rename back). An abort before
+    any byte was written (`dirty` still False) renames those originals back;
+    a dirty abort deletes the tmps — partially overwritten bytes must never
+    reappear under a valid shard name."""
 
     def __init__(self, base: str, final_size: int, shard_ids=None) -> None:
         self.fds: dict[int, int] = {}
         self.paths: dict[int, str] = {}
+        self.tmp_paths: dict[int, str] = {}
+        self._recycled: set[int] = set()
         self.final_size = final_size
-        for i in shard_ids if shard_ids is not None else range(TOTAL_SHARDS_COUNT):
-            path = base + to_ext(i)
-            self.paths[i] = path
-            self.fds[i] = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        self.dirty = False
+        try:
+            for i in (
+                shard_ids if shard_ids is not None else range(TOTAL_SHARDS_COUNT)
+            ):
+                path = base + to_ext(i)
+                self.paths[i] = path
+                tmp = path + ".tmp"
+                self.tmp_paths[i] = tmp
+                # Recycle only a same-size original: its pages are reused in
+                # place and a clean abort can restore it bit-for-bit (the
+                # ftruncate below is then a no-op). A different-size original
+                # stays valid under its real name until close() replaces it.
+                try:
+                    if os.path.getsize(path) == final_size:
+                        os.replace(path, tmp)
+                        self._recycled.add(i)
+                except OSError:
+                    pass
+                self.fds[i] = os.open(tmp, os.O_RDWR | os.O_CREAT, 0o644)
+                os.ftruncate(self.fds[i], final_size)
+        except BaseException:
+            self.abort()  # restore any renamed originals, close opened fds
+            raise
 
     def pwrite(self, shard: int, data, offset: int) -> None:
+        self.dirty = True
         os.pwrite(self.fds[shard], data, offset)
 
     def pwritev(self, shard: int, views, offset: int) -> None:
         """Scatter-gather write: one syscall, no host-side concat copy."""
+        self.dirty = True
         os.pwritev(self.fds[shard], views, offset)
 
     def close(self) -> None:
-        for fd in self.fds.values():
+        for i, fd in self.fds.items():
             os.ftruncate(fd, self.final_size)
             os.close(fd)
+            os.replace(self.tmp_paths[i], self.paths[i])
         self.fds.clear()
 
     def abort(self) -> None:
         for fd in self.fds.values():
             os.close(fd)
         self.fds.clear()
-        for path in self.paths.values():
+        for i, path in self.tmp_paths.items():
             try:
-                os.unlink(path)
+                if not self.dirty and i in self._recycled:
+                    os.replace(path, self.paths[i])  # original, untouched
+                else:
+                    os.unlink(path)
             except OSError:
                 pass
 
@@ -253,6 +289,66 @@ def _run_pipeline(jobs, read_job, encode_job, write_job) -> None:
         raise errors[0]
 
 
+def _write_ec_files_fused(
+    base_file_name: str, large_block_size: int, small_block_size: int
+) -> bool:
+    """Single-pass fused encode (sw_ec_encode_volume): the .dat is mmap'd
+    (MAP_POPULATE), every 64B line flows dat -> registers -> NT-store into
+    the mmap'd shard files while GFNI accumulates parity — no pread/pwrite
+    page-cache copies at all. On a single-core host this is ~2.5x the
+    staged pipeline, whose three stages serialize on the one CPU. Returns
+    False when this host/geometry can't run it (caller uses the pipeline)."""
+    try:
+        from seaweedfs_tpu.native import lib
+    except Exception:  # pragma: no cover - import-gated
+        return False
+    if lib is None or not hasattr(lib, "ec_encode_volume"):
+        return False
+    if (
+        large_block_size % 64
+        or small_block_size % 64
+        or small_block_size <= 0
+        or large_block_size <= 0
+    ):
+        return False
+    from seaweedfs_tpu.ops import gf256
+
+    dat_path = base_file_name + ".dat"
+    total = os.path.getsize(dat_path)
+    if total == 0:
+        return False
+    shard_size = shard_file_size(total, large_block_size, small_block_size)
+    matrix = gf256.parity_rows(DATA_SHARDS_COUNT, PARITY_SHARDS_COUNT)
+    writers = _ShardWriters(base_file_name, shard_size)
+    try:
+        dat_fd = os.open(dat_path, os.O_RDONLY)
+        try:
+            rc = lib.ec_encode_volume(
+                matrix.tobytes(),
+                PARITY_SHARDS_COUNT,
+                DATA_SHARDS_COUNT,
+                dat_fd,
+                total,
+                [writers.fds[i] for i in range(TOTAL_SHARDS_COUNT)],
+                shard_size,
+                large_block_size,
+                small_block_size,
+            )
+        finally:
+            os.close(dat_fd)
+        # -1..-4 fail before any store; only 0/-5 may have touched bytes
+        writers.dirty = writers.dirty or rc in (0, -5)
+    except BaseException:
+        writers.dirty = True  # unknown state: never restore over it
+        writers.abort()
+        raise
+    if rc != 0:
+        writers.abort()  # no GFNI / mmap failed: pipeline will recreate
+        return False
+    writers.close()
+    return True
+
+
 def write_ec_files(
     base_file_name: str,
     codec: RSCodec | None = None,
@@ -261,15 +357,27 @@ def write_ec_files(
     batch: int | None = None,
 ) -> None:
     """Generate .ec00–.ec13 from .dat (`ec_encoder.go:57,198-235`),
-    pipelined (see module docstring)."""
-    codec = codec or RSCodec(backend=pick_pipeline_backend())
+    via the fused native single-pass kernel when the host supports it,
+    else the 3-stage pipeline (see module docstring)."""
+    if codec is None or codec.backend == "native":
+        backend = codec.backend if codec else pick_pipeline_backend()
+        if backend == "native" and _write_ec_files_fused(
+            base_file_name, large_block_size, small_block_size
+        ):
+            return
+        if codec is None:
+            codec = RSCodec(backend=backend)
     if batch is None:
         batch = _default_batch(codec.backend)
     dat_path = base_file_name + ".dat"
     total = os.path.getsize(dat_path)
     shard_size = shard_file_size(total, large_block_size, small_block_size)
-    dat_fd = os.open(dat_path, os.O_RDONLY)
     writers = _ShardWriters(base_file_name, shard_size)
+    try:
+        dat_fd = os.open(dat_path, os.O_RDONLY)
+    except BaseException:
+        writers.abort()
+        raise
     try:
         jobs = _schedule(total, large_block_size, small_block_size, batch)
 
@@ -383,6 +491,38 @@ def rebuild_ec_files(
         writers = _ShardWriters(
             base_file_name, shard_size, shard_ids=missing
         )
+        # The fused mmap path reads every surviving shard at shard_size; a
+        # truncated survivor would SIGBUS past its last page instead of
+        # raising, so require exact sizes (mismatch falls through to the
+        # pread pipeline, which reports the short read as an IOError).
+        sizes_ok = all(
+            os.fstat(present_fds[sid]).st_size == shard_size for sid in use
+        )
+        if codec.backend == "native" and shard_size > 0 and sizes_ok:
+            # fused fd-mmap matmul: surviving shards are read straight from
+            # the page cache (no pread copies) into the GFNI reconstruct
+            try:
+                from seaweedfs_tpu.native import lib
+            except Exception:  # pragma: no cover - import-gated
+                lib = None
+            if lib is not None and hasattr(lib, "gf256_matmul_fds"):
+                try:
+                    rc = lib.gf256_matmul_fds(
+                        matrix.tobytes(),
+                        len(missing),
+                        codec.data_shards,
+                        [present_fds[sid] for sid in use],
+                        shard_size,
+                        [writers.fds[sid] for sid in missing],
+                    )
+                except BaseException:
+                    writers.dirty = True
+                    writers.abort()
+                    raise
+                if rc == 0:
+                    writers.dirty = True
+                    writers.close()
+                    return missing
         try:
             jobs = [
                 (off, min(chunk, shard_size - off))

@@ -229,3 +229,73 @@ class TestEcVolume:
         entries = list(idx_mod.walk_index_file(str(d / "1.idx")))
         assert entries[-1][0] == keys[0]
         assert entries[-1][2] == -1  # tombstone appended
+
+
+class TestFusedNativeEncode:
+    """The fused single-pass engine (sw_ec_encode_volume / sw_gf256_matmul_fds:
+    mmap'd .dat -> GFNI -> NT-stores) must stay byte-identical to the numpy
+    oracle pipeline across row layouts, incl. the zero-padded tail row."""
+
+    @pytest.fixture()
+    def native_lib(self):
+        from seaweedfs_tpu.native import lib
+
+        if lib is None or not lib.has_gfni():
+            pytest.skip("no native GFNI lib on this host")
+        return lib
+
+    @pytest.mark.parametrize(
+        "nbytes",
+        [
+            64 * 10 * 3 + 17,       # partial tail row
+            64 * 10 * 8,            # exact small rows
+            4096 * 10 * 2 + 4096,   # mid-block tail
+        ],
+    )
+    def test_fused_encode_matches_oracle(self, native_lib, tmp_path, nbytes):
+        large, small = 64 * 64, 64  # scaled-down, 64B-aligned geometry
+        rng = np.random.RandomState(nbytes)
+        data = rng.randint(0, 256, size=nbytes, dtype=np.uint8).tobytes()
+        fused_dir, oracle_dir = tmp_path / "fused", tmp_path / "oracle"
+        for d in (fused_dir, oracle_dir):
+            d.mkdir()
+            with open(d / "1.dat", "wb") as f:
+                f.write(data)
+        assert encoder._write_ec_files_fused(str(fused_dir / "1"), large, small)
+        encoder.write_ec_files(
+            str(oracle_dir / "1"),
+            codec=RSCodec(backend="numpy"),
+            large_block_size=large,
+            small_block_size=small,
+        )
+        for i in range(geometry.TOTAL_SHARDS_COUNT):
+            ext = geometry.to_ext(i)
+            got = (fused_dir / f"1{ext}").read_bytes()
+            want = (oracle_dir / f"1{ext}").read_bytes()
+            assert got == want, f"shard {i} differs for nbytes={nbytes}"
+
+    def test_fused_rejects_unaligned_geometry(self, native_lib, tmp_path):
+        with open(tmp_path / "1.dat", "wb") as f:
+            f.write(b"x" * 1000)
+        assert not encoder._write_ec_files_fused(str(tmp_path / "1"), 10000, 100)
+
+    def test_fused_rebuild_matches(self, native_lib, tmp_path):
+        large, small = 64 * 64, 64
+        rng = np.random.RandomState(7)
+        with open(tmp_path / "1.dat", "wb") as f:
+            f.write(rng.randint(0, 256, size=64 * 10 * 5 + 33,
+                                dtype=np.uint8).tobytes())
+        assert encoder._write_ec_files_fused(str(tmp_path / "1"), large, small)
+        originals = {
+            i: (tmp_path / f"1{geometry.to_ext(i)}").read_bytes()
+            for i in range(geometry.TOTAL_SHARDS_COUNT)
+        }
+        for victim in (0, 9, 13):
+            os.remove(tmp_path / f"1{geometry.to_ext(victim)}")
+        rebuilt = encoder.rebuild_ec_files(
+            str(tmp_path / "1"), codec=RSCodec(backend="native")
+        )
+        assert sorted(rebuilt) == [0, 9, 13]
+        for victim in (0, 9, 13):
+            got = (tmp_path / f"1{geometry.to_ext(victim)}").read_bytes()
+            assert got == originals[victim], f"rebuilt shard {victim} differs"

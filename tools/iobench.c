@@ -1,0 +1,173 @@
+/* iobench: measure single-core IO strategies on tmpfs for the EC encoder.
+ *
+ * Usage: iobench <dir> [mb]
+ * Prints one line per strategy: name MB/s.
+ */
+#define _GNU_SOURCE
+#include <fcntl.h>
+#include <immintrin.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/time.h>
+#include <sys/uio.h>
+#include <time.h>
+#include <unistd.h>
+
+static double now(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+static void report(const char *name, size_t bytes, double dt) {
+  printf("%-28s %8.2f GB/s  (%.4fs)\n", name, bytes / dt / 1e9, dt);
+}
+
+int main(int argc, char **argv) {
+  const char *dir = argc > 1 ? argv[1] : "/dev/shm";
+  size_t mb = argc > 2 ? (size_t)atol(argv[2]) : 1024;
+  size_t total = mb << 20;
+  char src_path[4096], dst_path[4096];
+  snprintf(src_path, sizeof src_path, "%s/iobench.src", dir);
+  snprintf(dst_path, sizeof dst_path, "%s/iobench.dst", dir);
+
+  /* build source file */
+  int sfd = open(src_path, O_RDWR | O_CREAT, 0644);
+  if (sfd < 0) { perror("open src"); return 1; }
+  if (ftruncate(sfd, total)) { perror("trunc"); return 1; }
+  size_t chunk = 64 << 20;
+  unsigned char *buf;
+  if (posix_memalign((void **)&buf, 4096, chunk)) return 1;
+  for (size_t i = 0; i < chunk; i++) buf[i] = (unsigned char)(i * 2654435761u >> 24);
+  for (size_t off = 0; off < total; off += chunk)
+    if (pwrite(sfd, buf, chunk, off) != (ssize_t)chunk) { perror("pw"); return 1; }
+
+  int dfd = open(dst_path, O_RDWR | O_CREAT, 0644);
+  if (ftruncate(dfd, total)) { perror("trunc dst"); return 1; }
+  /* prewarm dst pages */
+  for (size_t off = 0; off < total; off += chunk) pwrite(dfd, buf, chunk, off);
+
+  double t0, dt;
+  volatile uint64_t sink = 0;
+
+  /* 1. memcpy user->user */
+  unsigned char *buf2; posix_memalign((void **)&buf2, 4096, chunk);
+  memcpy(buf2, buf, chunk); /* warm */
+  t0 = now();
+  for (int i = 0; i < 16; i++) memcpy(buf2, buf, chunk);
+  report("memcpy(64MB x16)", chunk * 16, now() - t0);
+
+  /* 2. pread existing tmpfs -> buf */
+  t0 = now();
+  for (size_t off = 0; off < total; off += chunk)
+    if (pread(sfd, buf, chunk, off) != (ssize_t)chunk) { perror("pr"); return 1; }
+  report("pread 64MB chunks", total, now() - t0);
+
+  /* 2b. pread 1MB chunks */
+  t0 = now();
+  for (size_t off = 0; off < total; off += (1<<20))
+    if (pread(sfd, buf, 1<<20, off) != (1<<20)) { perror("pr1m"); return 1; }
+  report("pread 1MB chunks", total, now() - t0);
+
+  /* 3. pwrite buf -> existing tmpfs */
+  t0 = now();
+  for (size_t off = 0; off < total; off += chunk)
+    if (pwrite(dfd, buf, chunk, off) != (ssize_t)chunk) { perror("pw2"); return 1; }
+  report("pwrite existing 64MB", total, now() - t0);
+
+  t0 = now();
+  for (size_t off = 0; off < total; off += (1<<20))
+    if (pwrite(dfd, buf, 1<<20, off) != (1<<20)) { perror("pw1m"); return 1; }
+  report("pwrite existing 1MB", total, now() - t0);
+
+  /* 3b. pwrite to FRESH tmpfs file (page alloc cost) */
+  int ffd = open(dst_path, O_RDWR | O_CREAT | O_TRUNC, 0644);
+  t0 = now();
+  for (size_t off = 0; off < total; off += chunk)
+    if (pwrite(ffd, buf, chunk, off) != (ssize_t)chunk) { perror("pwf"); return 1; }
+  report("pwrite fresh 64MB", total, now() - t0);
+  close(ffd);
+
+  /* 4. copy_file_range src -> existing dst */
+  t0 = now();
+  for (size_t off = 0; off < total; off += chunk) {
+    loff_t in = off, out = off;
+    ssize_t n = copy_file_range(sfd, &in, dfd, &out, chunk, 0);
+    if (n != (ssize_t)chunk) { fprintf(stderr, "cfr: %zd\n", n); break; }
+  }
+  report("copy_file_range 64MB", total, now() - t0);
+
+  /* 4b. copy_file_range 1MB pieces (shard-block granularity) */
+  t0 = now();
+  for (size_t off = 0; off < total; off += (1<<20)) {
+    loff_t in = off, out = off;
+    if (copy_file_range(sfd, &in, dfd, &out, 1<<20, 0) != (1<<20)) { perror("cfr1m"); break; }
+  }
+  report("copy_file_range 1MB", total, now() - t0);
+
+  /* 5. mmap src MAP_POPULATE, stream-read */
+  t0 = now();
+  unsigned char *sm = mmap(NULL, total, PROT_READ, MAP_SHARED | MAP_POPULATE, sfd, 0);
+  if (sm == MAP_FAILED) { perror("mmap src"); return 1; }
+  dt = now() - t0;
+  printf("%-28s %8.4f s  (populate %zuMB read map)\n", "mmap+POPULATE src", dt, mb);
+  t0 = now();
+  uint64_t acc = 0;
+  for (size_t i = 0; i < total; i += 64) acc += *(const uint64_t *)(sm + i);
+  sink = acc;
+  report("mmap read touch (cached)", total, now() - t0);
+
+  /* 6. mmap dst existing, populate-write, then NT stores */
+  t0 = now();
+  unsigned char *dm = mmap(NULL, total, PROT_READ | PROT_WRITE, MAP_SHARED | MAP_POPULATE, dfd, 0);
+  if (dm == MAP_FAILED) { perror("mmap dst"); return 1; }
+  dt = now() - t0;
+  printf("%-28s %8.4f s  (populate %zuMB write map)\n", "mmap+POPULATE dst", dt, mb);
+
+  /* first-touch write pass (page_mkwrite faults if any) */
+  t0 = now();
+  for (size_t i = 0; i < total; i += 4096) dm[i] = 1;
+  printf("%-28s %8.4f s  (4K touch writes over %zuMB)\n", "mmap dst touch-write", now() - t0, mb);
+
+  /* NT store full pass from L3-hot buf */
+  t0 = now();
+  for (size_t off = 0; off < total; off += chunk) {
+    for (size_t i = 0; i < chunk; i += 64) {
+      __m512i v = _mm512_load_si512(buf + i);
+      _mm512_stream_si512((__m512i *)(dm + off + i), v);
+    }
+  }
+  _mm_sfence();
+  report("mmap NT-store pass", total, now() - t0);
+
+  /* regular store pass */
+  t0 = now();
+  for (size_t off = 0; off < total; off += chunk) memcpy(dm + off, buf, chunk);
+  report("mmap memcpy store pass", total, now() - t0);
+
+  /* 7. read from src map + NT store to dst map (the fused pattern, no GF) */
+  t0 = now();
+  for (size_t i = 0; i < total; i += 64) {
+    __m512i v = _mm512_load_si512(sm + i);
+    _mm512_stream_si512((__m512i *)(dm + i), v);
+  }
+  _mm_sfence();
+  report("map->map NT copy", total, now() - t0);
+
+  /* 8. fresh-mmap fault cost on tmpfs with existing pages: remap + touch */
+  munmap(dm, total);
+  t0 = now();
+  dm = mmap(NULL, total, PROT_READ | PROT_WRITE, MAP_SHARED, dfd, 0);
+  for (size_t i = 0; i < total; i += 4096) dm[i] = 2;
+  printf("%-28s %8.4f s  (no-populate fault+write all pages)\n", "mmap fresh fault-write", now() - t0);
+
+  (void)sink;
+  munmap(sm, total); munmap(dm, total);
+  close(sfd); close(dfd);
+  unlink(src_path); unlink(dst_path);
+  return 0;
+}
