@@ -84,14 +84,16 @@ def bench_verb(staging_base: str, trials: int = 3) -> tuple[float, dict]:
     # the 1.5GB of shard files, regardless of encode architecture. Touch and
     # free the trial working set once so trial 1 measures the verb, not the
     # balloon refill; raw per-trial times are still reported unedited.
-    pool = np.ones(2 * 1024**3 // 8, dtype=np.int64)
-    del pool
     # Let the server's boot-time backend calibration finish before timing:
     # on a single-core host the jax-init probe thread would otherwise steal
-    # cycles from trial 1 (same process, same calibration lock).
+    # cycles from trial 1 (same process, same calibration lock). Run it
+    # before the pool prewarm — the hypervisor reclaims freed pages after a
+    # delay, so the pool must be freed as close to trial 1 as possible.
     from seaweedfs_tpu.ops.rs_kernel import pick_pipeline_backend
 
     pick_pipeline_backend()
+    pool = np.ones(2 * 1024**3 // 8, dtype=np.int64)
+    del pool
     best = 0.0
     times = []
     try:
