@@ -274,6 +274,142 @@ def bench_device_pipeline(staging_base: str, mb: int = 128) -> float:
     return best
 
 
+def bench_rebuild(staging_base: str, trials: int = 3) -> dict:
+    """BASELINE config 2: single-missing-shard recovery on the 1GiB volume.
+    Rate is source-volume GB/s (same convention as ec.encode: the rebuild
+    reads 10 surviving shards = one volume's worth of bytes)."""
+    import shutil
+
+    from seaweedfs_tpu.storage.erasure_coding import encoder
+    from seaweedfs_tpu.storage.erasure_coding.geometry import to_ext
+
+    d = os.path.join(BENCH_DIR, "rebuild")
+    os.makedirs(d, exist_ok=True)
+    base = os.path.join(d, "1")
+    if not os.path.exists(base + to_ext(13)):
+        for ext in (".dat", ".idx"):
+            if not os.path.exists(base + ext):
+                os.link(staging_base + ext, base + ext)
+        encoder.write_ec_files(base)
+    dat_bytes = os.path.getsize(staging_base + ".dat")
+    best, times = 0.0, []
+    for i in range(trials):
+        victim = to_ext(3 if i % 2 == 0 else 12)  # a data and a parity shard
+        saved = base + victim + ".orig"
+        os.replace(base + victim, saved)
+        t0 = time.perf_counter()
+        rebuilt = encoder.rebuild_ec_files(base)
+        dt = time.perf_counter() - t0
+        assert rebuilt, "nothing rebuilt"
+        with open(base + victim, "rb") as f_new, open(saved, "rb") as f_old:
+            if f_new.read(1 << 20) != f_old.read(1 << 20):
+                raise AssertionError("rebuilt shard differs from original")
+        os.unlink(saved)
+        times.append(round(dt, 3))
+        best = max(best, dat_bytes / dt / 1e9)
+    return {"gbps": round(best, 3), "trial_seconds": times}
+
+
+def bench_cdc_dedup(gib: int = 8) -> dict:
+    """BASELINE config 4: rolling-hash CDC + content hashing + dedup index
+    over a multi-GiB stream, exercised exactly as the filer's dedup write
+    path does per upload (find_boundaries -> batched md5 via the hash
+    service -> index lookup/insert), minus the blob upload that configs 1-3
+    already measure. Uploads alternate fresh random data with byte-SHIFTED
+    repeats of earlier data, so dedup only happens when content-defined
+    boundaries re-align — the hard case offset-based chunking cannot catch."""
+    from seaweedfs_tpu.filer.dedup import DedupIndex
+    from seaweedfs_tpu.filer.filer import Filer
+    from seaweedfs_tpu.filer.filerstore import MemoryStore
+    from seaweedfs_tpu.ops.cdc import find_boundaries, pick_backend
+    from seaweedfs_tpu.ops.hash_service import get_hash_service
+
+    seg = 64 * 1024 * 1024
+    rng = np.random.RandomState(9)
+    base_segs = [
+        rng.randint(0, 256, size=seg, dtype=np.uint8) for _ in range(4)
+    ]
+    backend = pick_backend()
+    svc = get_hash_service()
+    svc.submit_many([b"warm" * 64] * 32)[0].md5_hex()  # backend calibration
+    idx = DedupIndex(Filer(MemoryStore()))
+
+    # materialize every upload before the clock starts: building the
+    # byte-shifted repeats costs fresh-page allocation that belongs to the
+    # workload generator, not the dedup path being measured
+    n_uploads = gib * 1024**3 // seg
+    uploads = []
+    for i in range(n_uploads):
+        if i % 2 == 0:
+            uploads.append(base_segs[(i // 2) % len(base_segs)])
+        else:
+            shift = 1 + 37 * i % 4093  # not a chunk boundary multiple
+            src = base_segs[(i // 3) % len(base_segs)]
+            uploads.append(np.concatenate([src[shift:], src[:shift]]))
+    n_chunks = dup_chunks = dup_bytes = 0
+    total = 0
+    t0 = time.perf_counter()
+    for data in uploads:
+        total += data.nbytes
+        cuts = find_boundaries(
+            data, avg_bits=16, min_size=16 * 1024, max_size=512 * 1024,
+            backend=backend,
+        )
+        span_hashes = svc.hash_spans(data, cuts)
+        prev = 0
+        for cut, (etag, _crc) in zip(cuts, span_hashes):
+            ln = cut - prev
+            prev = cut
+            key = f"{etag}-{ln:x}"
+            n_chunks += 1
+            if idx.lookup(key) is not None:
+                dup_chunks += 1
+                dup_bytes += ln
+            else:
+                idx.insert(key, {"fid": f"3,{n_chunks:x}00000000", "size": ln})
+    dt = time.perf_counter() - t0
+    return {
+        "gib_streamed": round(total / 1024**3, 2),
+        "gbps": round(total / dt / 1e9, 3),
+        "chunks": n_chunks,
+        "dedup_chunk_pct": round(100.0 * dup_chunks / max(1, n_chunks), 1),
+        "dedup_byte_pct": round(100.0 * dup_bytes / max(1, total), 1),
+        "backend": backend,
+    }
+
+
+def bench_small_files(n: int = 20000, size: int = 1024, c: int = 16) -> dict:
+    """BASELINE.md rows 1-2: small-file write + random read req/s through
+    the real master+volume HTTP data plane (`weed benchmark` semantics,
+    reference: 15,708 write / 47,019 read req/s on an i7 MacBook)."""
+    from seaweedfs_tpu.command.benchmark import run_benchmark
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    d = os.path.join(BENCH_DIR, "smallfiles")
+    os.makedirs(d, exist_ok=True)
+    master = MasterServer(port=0, pulse_seconds=1)
+    master.start()
+    vs = VolumeServer([d], master.url, port=0, pulse_seconds=1,
+                      max_volume_count=20)
+    vs.start()
+    try:
+        report = run_benchmark(master.url, n=n, size=size, c=c)
+    finally:
+        vs.stop()
+        master.stop()
+    return {
+        "files": n,
+        "size": size,
+        "concurrency": c,
+        "write_req_s": report["write"]["req_per_sec"],
+        "read_req_s": report["read"]["req_per_sec"],
+        "write_p99_ms": report["write"].get("p99_ms"),
+        "read_p99_ms": report["read"].get("p99_ms"),
+        "reference_req_s": {"write": 15708, "read": 47019},
+    }
+
+
 def bench_hash_1m_4k(total_blobs: int = 1_000_000, slab: int = 65536) -> dict:
     """BASELINE config 3: 1M x 4KB upload-path MD5+CRC32C batch hashing.
     Runs the full 1M through the native batch kernels (the serving path's
@@ -313,21 +449,24 @@ def bench_hash_1m_4k(total_blobs: int = 1_000_000, slab: int = 65536) -> dict:
     out["seconds_for_1m"] = round(dt, 2)
 
     # device kernels, device-resident sample (chip-side rate; transfers are
-    # what rules them out for serving through this relay)
+    # what rules them out for serving through this relay); watchdogged —
+    # the relay can wedge outright
     try:
-        import jax
+        from seaweedfs_tpu.ops.device_probe import run_with_timeout
 
-        from seaweedfs_tpu.ops.crc32c_kernel import crc32c_batch
-        from seaweedfs_tpu.ops.md5_kernel import md5_batch
+        def _device_hash():
+            from seaweedfs_tpu.ops.crc32c_kernel import crc32c_batch
+            from seaweedfs_tpu.ops.md5_kernel import md5_batch
 
-        dev_sample = sample[:16384]
-        md5_batch(dev_sample[:64], backend="jax")  # compile
-        crc32c_batch(dev_sample[:64], backend="jax")
-        t0 = time.perf_counter()
-        md5_batch(dev_sample, backend="jax")
-        crc32c_batch(dev_sample, backend="jax")
-        dev_dt = time.perf_counter() - t0
-        out["device_batch_gbps"] = round(len(dev_sample) * 4096 / dev_dt / 1e9, 3)
+            dev_sample = sample[:16384]
+            md5_batch(dev_sample[:64], backend="jax")  # compile
+            crc32c_batch(dev_sample[:64], backend="jax")
+            t0 = time.perf_counter()
+            md5_batch(dev_sample, backend="jax")
+            crc32c_batch(dev_sample, backend="jax")
+            return len(dev_sample) * 4096 / (time.perf_counter() - t0)
+
+        out["device_batch_gbps"] = round(run_with_timeout(_device_hash, 180) / 1e9, 3)
     except Exception as e:
         out["device_batch_error"] = str(e)[:120]
     out["vs_scalar"] = round(out["native_batch_gbps"] * 1e9 / base_rate, 2)
@@ -352,14 +491,21 @@ def main() -> None:
         "host_kernel_gfni_gbps": round(bench_host_kernel(), 3),
         **verb_info,
     }
+    # device benches run under a watchdog: the TPU relay on this host has
+    # been observed to wedge entirely, and a hung bench reports nothing
+    from seaweedfs_tpu.ops.device_probe import run_with_timeout
+
     try:
-        extra["device_kernel_gbps"] = round(bench_device_kernel(), 3)
-    except Exception as e:  # no chip attached
+        extra["device_kernel_gbps"] = round(
+            run_with_timeout(bench_device_kernel, 180), 3
+        )
+    except Exception as e:  # no chip attached / link wedged
         extra["device_kernel_gbps"] = None
         extra["device_kernel_error"] = str(e)[:120]
     try:
         extra["device_pipeline_e2e_gbps"] = round(
-            bench_device_pipeline(staging_base), 3
+            run_with_timeout(lambda: bench_device_pipeline(staging_base), 180),
+            3,
         )
     except Exception as e:
         extra["device_pipeline_e2e_gbps"] = None
@@ -368,6 +514,18 @@ def main() -> None:
         extra["hash_1m_4k"] = bench_hash_1m_4k()  # BASELINE config 3
     except Exception as e:
         extra["hash_1m_4k"] = {"error": str(e)[:120]}
+    try:
+        extra["ec_rebuild"] = bench_rebuild(staging_base)  # BASELINE config 2
+    except Exception as e:
+        extra["ec_rebuild"] = {"error": str(e)[:120]}
+    try:
+        extra["cdc_dedup"] = bench_cdc_dedup()  # BASELINE config 4
+    except Exception as e:
+        extra["cdc_dedup"] = {"error": str(e)[:120]}
+    try:
+        extra["small_files"] = bench_small_files()  # BASELINE.md rows 1-2
+    except Exception as e:
+        extra["small_files"] = {"error": str(e)[:120]}
     extra["note"] = (
         "value is the real shell ec.encode verb, disk-to-shards, 1GiB volume,"
         " best of 3. vs_baseline divides by baseline_seq_gfni_gbps: the"

@@ -35,9 +35,32 @@ def run(args: list[str]) -> int:
     p.add_argument("-collection", default="benchmark")
     p.add_argument("-seed", type=int, default=0)
     opts = p.parse_args(args)
+    report = run_benchmark(
+        opts.master, n=opts.n, size=opts.size, c=opts.c,
+        collection=opts.collection, seed=opts.seed,
+    )
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+def run_benchmark(
+    master: str,
+    n: int = 1000,
+    size: int = 1024,
+    c: int = 16,
+    collection: str = "benchmark",
+    seed: int = 0,
+) -> dict:
+    """Write n files of `size` bytes at concurrency c, then read them back
+    shuffled; returns the req/s + latency-percentile report (the reference's
+    `weed benchmark` loop, `benchmark.go:113-260`)."""
+    import types
 
     from seaweedfs_tpu.filer.wdclient import WeedClient
 
+    opts = types.SimpleNamespace(
+        master=master, n=n, size=size, c=c, collection=collection, seed=seed
+    )
     client = WeedClient(opts.master)
     rng = random.Random(opts.seed)
     payload = bytes(rng.randrange(256) for _ in range(opts.size))
@@ -73,7 +96,7 @@ def run(args: list[str]) -> int:
         read_lat = list(ex.map(do_read, order))
     read_wall = time.perf_counter() - t_start
 
-    report = {
+    return {
         "write": {
             "requests": opts.n,
             "req_per_sec": round(opts.n / write_wall, 1),
@@ -87,5 +110,3 @@ def run(args: list[str]) -> int:
             **_percentiles(read_lat),
         },
     }
-    print(json.dumps(report, indent=2))
-    return 0

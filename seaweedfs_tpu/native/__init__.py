@@ -68,6 +68,36 @@ class NativeLib:
             ctypes.c_size_t,
             ctypes.c_void_p,  # out uint32[n]
         ]
+        self._lib.sw_md5_batch_var.restype = None
+        self._lib.sw_md5_batch_var.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),  # blob pointers [n]
+            ctypes.POINTER(ctypes.c_size_t),  # lengths [n]
+            ctypes.c_size_t,
+            ctypes.c_void_p,  # out (n, 16)
+        ]
+        self._lib.sw_crc32c_batch_var.restype = None
+        self._lib.sw_crc32c_batch_var.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_size_t,
+            ctypes.c_void_p,  # out uint32[n]
+        ]
+        self._lib.sw_md5_batch_spans.restype = None
+        self._lib.sw_md5_batch_spans.argtypes = [
+            ctypes.c_void_p,  # base buffer
+            ctypes.c_void_p,  # offs size_t[n]
+            ctypes.c_void_p,  # lens size_t[n]
+            ctypes.c_size_t,
+            ctypes.c_void_p,  # out (n, 16)
+        ]
+        self._lib.sw_crc32c_batch_spans.restype = None
+        self._lib.sw_crc32c_batch_spans.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,  # out uint32[n]
+        ]
         self._lib.sw_gf256_matmul2d.restype = None
         self._lib.sw_gf256_matmul2d.argtypes = [
             ctypes.c_char_p,  # matrix rows*cols
@@ -203,6 +233,55 @@ class NativeLib:
         out = np.empty((n, 16), dtype=np.uint8)
         self._lib.sw_md5_batch(blobs.ctypes.data, n, blob_len, out.ctypes.data)
         return out
+
+    def md5_crc_batch_var(self, blobs: list) -> tuple:
+        """Variable-length batch MD5+CRC32C: blobs is a list of bytes
+        objects (zero-copy pointers). Hash the batch LENGTH-SORTED for full
+        lane utilization, returning results in the caller's order.
+        Returns ((n, 16) uint8 digests, (n,) uint32 crcs)."""
+        import numpy as np
+
+        n = len(blobs)
+        order = sorted(range(n), key=lambda i: -len(blobs[i]))
+        ptrs = (ctypes.c_char_p * n)(*[blobs[i] for i in order])
+        lens = (ctypes.c_size_t * n)(*[len(blobs[i]) for i in order])
+        dig_s = np.empty((n, 16), dtype=np.uint8)
+        crc_s = np.empty(n, dtype=np.uint32)
+        self._lib.sw_md5_batch_var(ptrs, lens, n, dig_s.ctypes.data)
+        self._lib.sw_crc32c_batch_var(ptrs, lens, n, crc_s.ctypes.data)
+        digests = np.empty_like(dig_s)
+        crcs = np.empty_like(crc_s)
+        digests[order] = dig_s
+        crcs[order] = crc_s
+        return digests, crcs
+
+    def md5_crc_batch_spans(self, buf, cuts) -> tuple:
+        """Zero-copy span hashing: buf is one contiguous uint8 buffer (numpy
+        array or bytes), cuts the CDC exclusive chunk ends. No per-chunk
+        Python slices — the C side length-sorts and runs the lockstep
+        kernels. Returns ((n, 16) uint8 digests, (n,) uint32 crcs)."""
+        import numpy as np
+
+        arr = np.frombuffer(buf, dtype=np.uint8) if not isinstance(
+            buf, np.ndarray
+        ) else buf
+        ends = np.asarray(cuts, dtype=np.uintp)
+        offs = np.empty_like(ends)
+        offs[0] = 0
+        offs[1:] = ends[:-1]
+        lens = ends - offs
+        n = len(ends)
+        digests = np.empty((n, 16), dtype=np.uint8)
+        crcs = np.empty(n, dtype=np.uint32)
+        self._lib.sw_md5_batch_spans(
+            arr.ctypes.data, offs.ctypes.data, lens.ctypes.data, n,
+            digests.ctypes.data,
+        )
+        self._lib.sw_crc32c_batch_spans(
+            arr.ctypes.data, offs.ctypes.data, lens.ctypes.data, n,
+            crcs.ctypes.data,
+        )
+        return digests, crcs
 
     def gear_boundaries(self, data, gear, mask: int, min_size: int,
                         max_size: int):

@@ -1,33 +1,190 @@
-// Serial gear-CDC boundary scan. Bit-identical to the data-parallel
-// XOR-window hash in ops/cdc.py: h_i = XOR_{k<32} G[b_{i-k}] << k, whose
-// serial recurrence is h = (h << 1) ^ G[b] (the k=32 term self-shifts out
-// of uint32). The window rolls straight across cut points, exactly like the
+// Gear-CDC boundary scan. Bit-identical to the data-parallel XOR-window
+// hash in ops/cdc.py: h_i = XOR_{k<32} G[b_{i-k}] << k, whose serial
+// recurrence is h = (h << 1) ^ G[b] (the k=32 term self-shifts out of
+// uint32). The window rolls straight across cut points, exactly like the
 // vectorized path which hashes every position of the buffer first and picks
 // cuts afterwards. Cut rule per ops/cdc.py find_boundaries: first position
 // i >= start+min_size with (h_i & mask) == 0 cuts at i+1; otherwise cut at
-// start+max_size (or n). ~1 GB/s single core; the TPU kernel is the batch
-// path.
+// start+max_size (or n).
+//
+// Two speed tricks, both exact:
+// 1. h_i depends on only the last 32 bytes (G entries are uint32, so
+//    contributions shifted >= 32 bits vanish) — after a cut the scan jumps
+//    to start+min_size-32 and re-warms the window with 32 bytes, skipping
+//    the table walk over the rest of the minimum chunk.
+// 2. The serial recurrence's 2-cycle/byte dependency chain is broken with
+//    AVX-512: 16 positions advance per step via a log-step lane-prefix XOR
+//    (P_j = XOR_{m<=j} v_m << (j-m)), candidates found with one compare
+//    mask — boundaries are ~2^-avg_bits dense, so the common path is
+//    branch-free. Verified bit-identical to the scalar loop at init.
 #include <cstdint>
 #include <cstddef>
+#include <cstring>
+#include <initializer_list>
+
+#if defined(__AVX512F__) && defined(__AVX512BW__)
+#include <immintrin.h>
+#define SW_CDC_AVX512 1
+#endif
+
+namespace {
+
+// scalar reference core: advance h over [i, end) testing for candidates
+// with position >= can_from; returns end or the cut position's byte index.
+inline size_t scan_scalar(const unsigned char* data, size_t i, size_t end,
+                          size_t can_from, const uint32_t* gear,
+                          uint32_t mask, uint32_t& h, bool& found) {
+    for (; i < end; i++) {
+        h = (h << 1) ^ gear[data[i]];
+        if (i >= can_from && (h & mask) == 0) {
+            found = true;
+            return i;
+        }
+    }
+    found = false;
+    return end;
+}
+
+#ifdef SW_CDC_AVX512
+// vector core: same contract as scan_scalar, 16 bytes per iteration.
+size_t scan_vec(const unsigned char* data, size_t i, size_t end,
+                size_t can_from, const uint32_t* gear, uint32_t mask,
+                uint32_t& h, bool& found) {
+    const __m512i lane_idx =
+        _mm512_setr_epi32(0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15);
+    const __m512i shift_amt = _mm512_setr_epi32(1, 2, 3, 4, 5, 6, 7, 8, 9, 10,
+                                                11, 12, 13, 14, 15, 16);
+    const __m512i vmask = _mm512_set1_epi32((int)mask);
+    const __m512i zero = _mm512_setzero_si512();
+    // permute indices for lane-left-shift by 1/2/4/8 (lane j takes j-s)
+    const __m512i p1 = _mm512_setr_epi32(0, 0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10,
+                                         11, 12, 13, 14);
+    const __m512i p2 = _mm512_setr_epi32(0, 0, 0, 1, 2, 3, 4, 5, 6, 7, 8, 9,
+                                         10, 11, 12, 13);
+    const __m512i p4 = _mm512_setr_epi32(0, 0, 0, 0, 0, 1, 2, 3, 4, 5, 6, 7,
+                                         8, 9, 10, 11);
+    const __m512i p8 = _mm512_setr_epi32(0, 0, 0, 0, 0, 0, 0, 0, 0, 1, 2, 3,
+                                         4, 5, 6, 7);
+    while (i + 16 <= end) {
+        __m128i bytes = _mm_loadu_si128((const __m128i*)(data + i));
+        __m512i idx = _mm512_cvtepu8_epi32(bytes);
+        __m512i v = _mm512_i32gather_epi32(idx, (const int*)gear, 4);
+        // P_j = XOR_{m<=j} v_m << (j-m), via log-step shifted prefix
+        __m512i p = v;
+        p = _mm512_xor_si512(
+            p, _mm512_slli_epi32(
+                   _mm512_maskz_permutexvar_epi32(0xFFFE, p1, p), 1));
+        p = _mm512_xor_si512(
+            p, _mm512_slli_epi32(
+                   _mm512_maskz_permutexvar_epi32(0xFFFC, p2, p), 2));
+        p = _mm512_xor_si512(
+            p, _mm512_slli_epi32(
+                   _mm512_maskz_permutexvar_epi32(0xFFF0, p4, p), 4));
+        p = _mm512_xor_si512(
+            p, _mm512_slli_epi32(
+                   _mm512_maskz_permutexvar_epi32(0xFF00, p8, p), 8));
+        // H_j = P_j ^ (h << (j+1))  (lanes j+1 > 31 impossible: max 16)
+        __m512i hv = _mm512_sllv_epi32(_mm512_set1_epi32((int)h), shift_amt);
+        __m512i H = _mm512_xor_si512(p, hv);
+        __mmask16 cand = _mm512_cmpeq_epi32_mask(_mm512_and_si512(H, vmask), zero);
+        if (can_from > i)  // drop lanes whose position is below can_from
+            cand &= (__mmask16)(can_from - i >= 16
+                                    ? 0
+                                    : (0xFFFF << (can_from - i)));
+        if (cand) {
+            int lane = __builtin_ctz((unsigned)cand);
+            alignas(64) uint32_t hs[16];
+            _mm512_store_si512(hs, H);
+            h = hs[lane];
+            found = true;
+            return i + lane;
+        }
+        alignas(64) uint32_t hs[16];
+        _mm512_store_si512(hs, H);
+        h = hs[15];
+        i += 16;
+    }
+    return scan_scalar(data, i, end, can_from, gear, mask, h, found);
+}
+
+bool cdc_selftest() {
+    // random-ish data, tiny mask so candidates are dense; compare cores
+    unsigned char buf[4096];
+    uint32_t gear[256];
+    uint32_t s = 2463534242u;
+    for (int i = 0; i < 4096; i++) {
+        s ^= s << 13; s ^= s >> 17; s ^= s << 5;
+        buf[i] = (unsigned char)s;
+    }
+    for (int i = 0; i < 256; i++) {
+        s ^= s << 13; s ^= s >> 17; s ^= s << 5;
+        gear[i] = s;
+    }
+    for (uint32_t mask : {0xFFu, 0x3Fu, 0x1FFFu}) {
+        size_t i1 = 7, i2 = 7;
+        uint32_t h1 = 12345, h2 = 12345;
+        while (true) {
+            bool f1 = false, f2 = false;
+            i1 = scan_scalar(buf, i1, 4096, 19, gear, mask, h1, f1);
+            i2 = scan_vec(buf, i2, 4096, 19, gear, mask, h2, f2);
+            if (i1 != i2 || h1 != h2 || f1 != f2) return false;
+            if (!f1) break;
+            i1++; i2++;
+        }
+    }
+    return true;
+}
+#endif
+
+} // namespace
 
 extern "C" size_t sw_gear_boundaries(const unsigned char* data, size_t n,
                                      const uint32_t* gear, uint32_t mask,
                                      size_t min_size, size_t max_size,
                                      uint64_t* cuts, size_t max_cuts) {
+#ifdef SW_CDC_AVX512
+    // magic static: thread-safe lazy selftest (concurrent first uploads)
+    static const bool cdc_avx512_usable =
+        __builtin_cpu_supports("avx512f") &&
+        __builtin_cpu_supports("avx512bw") && cdc_selftest();
+#endif
     size_t count = 0;
     size_t start = 0;
+    size_t i = 0;
     uint32_t h = 0;
-    for (size_t i = 0; i < n; i++) {
-        h = (h << 1) ^ gear[data[i]];
-        bool cut = false;
-        if (i >= start + min_size && (h & mask) == 0)
-            cut = true;
-        else if (i + 1 - start == max_size)
-            cut = true;
-        if (cut) {
+    while (i < n) {
+        // window trick: h at any position needs only the previous 32 bytes,
+        // so jump to 32 bytes before the first cut-eligible position
+        size_t can_from = start + min_size;  // first index where a cut may land
+        if (can_from >= 32 && i < can_from - 32) {
+            i = can_from - 32;
+            h = 0;
+        }
+        size_t span_end = start + max_size - 1;  // forced-cut byte index
+        if (span_end > n - 1) span_end = n - 1;
+        bool found = false;
+#ifdef SW_CDC_AVX512
+        size_t at = cdc_avx512_usable
+                        ? scan_vec(data, i, span_end + 1, can_from, gear, mask,
+                                   h, found)
+                        : scan_scalar(data, i, span_end + 1, can_from, gear,
+                                      mask, h, found);
+#else
+        size_t at = scan_scalar(data, i, span_end + 1, can_from, gear, mask,
+                                h, found);
+#endif
+        if (found) {
             if (count == max_cuts) return count;
-            cuts[count++] = i + 1;
-            start = i + 1;
+            cuts[count++] = at + 1;
+            start = at + 1;
+            i = at + 1;
+        } else if (span_end == start + max_size - 1) {
+            if (count == max_cuts) return count;
+            cuts[count++] = span_end + 1;  // max_size forced cut
+            start = span_end + 1;
+            i = span_end + 1;
+        } else {
+            break;  // ran off the end of the buffer
         }
     }
     if (start < n && count < max_cuts) cuts[count++] = n;

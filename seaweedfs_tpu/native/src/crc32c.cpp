@@ -68,3 +68,19 @@ extern "C" void sw_crc32c_batch(const unsigned char* blobs, size_t n,
     for (size_t i = 0; i < n; i++)
         out[i] = sw_crc32c_update(0, blobs + i * blob_len, blob_len);
 }
+
+// Variable-length batch (CDC dedup chunks have content-defined lengths).
+extern "C" void sw_crc32c_batch_var(const unsigned char* const* ptrs,
+                                    const size_t* lens, size_t n,
+                                    uint32_t* out) {
+    for (size_t i = 0; i < n; i++)
+        out[i] = sw_crc32c_update(0, ptrs[i], lens[i]);
+}
+
+// Span batch over one contiguous buffer (see sw_md5_batch_spans).
+extern "C" void sw_crc32c_batch_spans(const unsigned char* base,
+                                      const size_t* offs, const size_t* lens,
+                                      size_t n, uint32_t* out) {
+    for (size_t i = 0; i < n; i++)
+        out[i] = sw_crc32c_update(0, base + offs[i], lens[i]);
+}

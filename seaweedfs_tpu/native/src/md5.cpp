@@ -9,6 +9,8 @@
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
+#include <algorithm>
+#include <vector>
 
 #if defined(__AVX512F__)
 #include <immintrin.h>
@@ -165,6 +167,99 @@ void md5_16lane(const uint8_t* base, size_t blob_len, uint8_t* out) {
     }
 }
 
+// Variable-length lockstep: 16 blobs of DIFFERENT lengths advance together,
+// each lane staging its own next 64B block into a contiguous 16x64 buffer
+// (L1-resident, so the per-round vpgatherdd hits cache); lanes whose blob
+// ran out of full blocks retire via merge-masked state adds. Callers get
+// the most out of it by length-sorting the batch so groups retire together
+// (CDC dedup chunks have content-defined, i.e. unique, lengths — the
+// equal-length kernel above degenerates to scalar there).
+void md5_16lane_var(const uint8_t* const ptrs[16], const size_t lens[16],
+                    uint8_t* out) {
+    alignas(64) uint8_t stage[16 * 64];
+    const __m512i lane_off = _mm512_slli_epi32(
+        _mm512_setr_epi32(0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15),
+        6);  // l*64: lane l's block lives at stage + l*64
+    __m512i a = _mm512_set1_epi32((int)0x67452301);
+    __m512i b = _mm512_set1_epi32((int)0xefcdab89);
+    __m512i c = _mm512_set1_epi32((int)0x98badcfe);
+    __m512i d = _mm512_set1_epi32((int)0x10325476);
+    const __m512i ones = _mm512_set1_epi32(-1);
+    size_t full[16];
+    size_t maxfull = 0;
+    for (int l = 0; l < 16; l++) {
+        full[l] = lens[l] / 64;
+        if (full[l] > maxfull) maxfull = full[l];
+    }
+    for (size_t blk = 0; blk < maxfull; blk++) {
+        __mmask16 active = 0;
+        for (int l = 0; l < 16; l++)
+            if (blk < full[l]) {
+                std::memcpy(stage + l * 64, ptrs[l] + blk * 64, 64);
+                active |= (__mmask16)(1u << l);
+            }
+        __m512i m[16];
+        for (int g = 0; g < 16; g++)
+            m[g] = _mm512_i32gather_epi32(lane_off, (const int*)(stage + g * 4), 1);
+        __m512i aa = a, bb = b, cc = c, dd = d;
+        for (int i = 0; i < 64; i++) {
+            __m512i f;
+            int g;
+            if (i < 16) {
+                f = _mm512_or_si512(_mm512_and_si512(bb, cc),
+                                    _mm512_andnot_si512(bb, dd));
+                g = i;
+            } else if (i < 32) {
+                f = _mm512_or_si512(_mm512_and_si512(dd, bb),
+                                    _mm512_andnot_si512(dd, cc));
+                g = (5 * i + 1) & 15;
+            } else if (i < 48) {
+                f = _mm512_xor_si512(_mm512_xor_si512(bb, cc), dd);
+                g = (3 * i + 5) & 15;
+            } else {
+                f = _mm512_xor_si512(cc,
+                                     _mm512_or_si512(bb, _mm512_xor_si512(dd, ones)));
+                g = (7 * i) & 15;
+            }
+            __m512i sum = _mm512_add_epi32(
+                _mm512_add_epi32(aa, f),
+                _mm512_add_epi32(_mm512_set1_epi32((int)K[i]), m[g]));
+            __m512i tmp = dd;
+            dd = cc;
+            cc = bb;
+            bb = _mm512_add_epi32(bb, rotl16(sum, S[i]));
+            aa = tmp;
+        }
+        a = _mm512_mask_add_epi32(a, active, a, aa);
+        b = _mm512_mask_add_epi32(b, active, b, bb);
+        c = _mm512_mask_add_epi32(c, active, c, cc);
+        d = _mm512_mask_add_epi32(d, active, d, dd);
+    }
+    uint32_t av[16], bv[16], cv[16], dv[16];
+    _mm512_storeu_si512(av, a);
+    _mm512_storeu_si512(bv, b);
+    _mm512_storeu_si512(cv, c);
+    _mm512_storeu_si512(dv, d);
+    uint8_t tail[128];
+    for (int l = 0; l < 16; l++) {
+        MD5Ctx ctx{av[l], bv[l], cv[l], dv[l]};
+        size_t rem = lens[l] - full[l] * 64;
+        size_t tail_len = (rem + 9 <= 64) ? 64 : 128;
+        std::memset(tail, 0, sizeof(tail));
+        std::memcpy(tail, ptrs[l] + full[l] * 64, rem);
+        tail[rem] = 0x80;
+        uint64_t bits = (uint64_t)lens[l] * 8;
+        std::memcpy(tail + tail_len - 8, &bits, 8);
+        md5_block(ctx, tail);
+        if (tail_len == 128) md5_block(ctx, tail + 64);
+        uint8_t* o = out + (size_t)l * 16;
+        std::memcpy(o, &ctx.a, 4);
+        std::memcpy(o + 4, &ctx.b, 4);
+        std::memcpy(o + 8, &ctx.c, 4);
+        std::memcpy(o + 12, &ctx.d, 4);
+    }
+}
+
 bool md5_avx512_ok() {
     static int ok = -1;
     if (ok >= 0) return ok;
@@ -192,4 +287,43 @@ extern "C" void sw_md5_batch(const unsigned char* blobs, size_t n,
 #endif
     for (; i < n; i++)
         md5_one(blobs + i * blob_len, blob_len, out + i * 16);
+}
+
+// Variable-length batch: ptrs/lens describe n independent blobs anywhere in
+// memory. Caller should length-sort for best lane utilization; groups of 16
+// run the lockstep kernel, the remainder runs scalar.
+extern "C" void sw_md5_batch_var(const unsigned char* const* ptrs,
+                                 const size_t* lens, size_t n,
+                                 unsigned char* out) {
+    size_t i = 0;
+#ifdef SW_MD5_AVX512
+    if (n >= 16 && md5_avx512_ok()) {
+        for (; i + 16 <= n; i += 16)
+            md5_16lane_var(ptrs + i, lens + i, out + i * 16);
+    }
+#endif
+    for (; i < n; i++) md5_one(ptrs[i], lens[i], out + i * 16);
+}
+
+// Span batch: n sub-ranges of one contiguous buffer (CDC chunks of an
+// upload) — zero per-piece copies on the Python side. Length-sorts
+// internally so lockstep lanes retire together, restoring caller order.
+extern "C" void sw_md5_batch_spans(const unsigned char* base,
+                                   const size_t* offs, const size_t* lens,
+                                   size_t n, unsigned char* out) {
+    if (n == 0) return;
+    std::vector<size_t> order(n);
+    for (size_t i = 0; i < n; i++) order[i] = i;
+    std::sort(order.begin(), order.end(),
+              [&](size_t a, size_t b) { return lens[a] > lens[b]; });
+    std::vector<const unsigned char*> ptrs(n);
+    std::vector<size_t> slens(n);
+    for (size_t i = 0; i < n; i++) {
+        ptrs[i] = base + offs[order[i]];
+        slens[i] = lens[order[i]];
+    }
+    std::vector<unsigned char> tmp(n * 16);
+    sw_md5_batch_var(ptrs.data(), slens.data(), n, tmp.data());
+    for (size_t i = 0; i < n; i++)
+        std::memcpy(out + order[i] * 16, tmp.data() + i * 16, 16);
 }

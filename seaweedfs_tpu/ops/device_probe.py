@@ -1,0 +1,79 @@
+"""Watchdogged accelerator probes.
+
+This host's TPU sits behind a network relay that can wedge entirely (a
+jax.default_backend() call has been observed to hang for minutes). Every
+auto-tune path that might touch the device goes through these helpers so a
+dead link degrades to the host backend instead of hanging a server thread
+or the benchmark. The stuck worker thread is a daemon: it parks on the
+device call and never holds a lock the rest of the process needs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+def run_with_timeout(fn, seconds: float):
+    """Run fn() on a daemon thread; raise TimeoutError if it outlives
+    `seconds`. The abandoned thread keeps running (device calls are not
+    cancellable) but owns no shared state."""
+    out: dict = {}
+    done = threading.Event()
+
+    def target():  # pragma: no cover - trivial wrapper
+        try:
+            out["v"] = fn()
+        except BaseException as e:  # noqa: BLE001 - reraised below
+            out["e"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=target, daemon=True, name="device-probe")
+    t.start()
+    if not done.wait(seconds):
+        raise TimeoutError(f"device probe exceeded {seconds}s")
+    if "e" in out:
+        raise out["e"]
+    return out["v"]
+
+
+def device_platform(timeout: float = 20.0) -> str | None:
+    """jax.default_backend() with a watchdog; None if jax is missing, the
+    platform is cpu, or the device link is wedged."""
+    try:
+        import jax
+
+        platform = run_with_timeout(jax.default_backend, timeout)
+        return platform if platform != "cpu" else None
+    except Exception:
+        return None
+
+
+def link_fast_enough(min_rate: float = 1e9, timeout: float = 20.0) -> bool:
+    """Shared gate for auto-tuners: is the host->device link worth the cost
+    of a full device-candidate calibration (Pallas compile + tens of MB of
+    transfers)? Below `min_rate` bytes/s the device path cannot beat the
+    host kernels end-to-end regardless of chip-side speed."""
+    rate = h2d_rate(timeout=timeout)
+    return rate is not None and rate >= min_rate
+
+
+def h2d_rate(timeout: float = 20.0, probe_bytes: int = 4 * 1024 * 1024):
+    """Measured host->device bandwidth in bytes/s, or None when jax/device
+    is unavailable or the link is wedged/slow beyond `timeout`."""
+    try:
+        import numpy as np
+
+        import jax
+
+        def measure() -> float:
+            jax.device_put(np.zeros(65536, np.uint8)).block_until_ready()
+            probe = np.zeros(probe_bytes, np.uint8)
+            t0 = time.perf_counter()
+            jax.device_put(probe).block_until_ready()
+            return probe.nbytes / (time.perf_counter() - t0)
+
+        return run_with_timeout(measure, timeout)
+    except Exception:
+        return None

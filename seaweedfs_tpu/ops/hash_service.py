@@ -112,17 +112,31 @@ class HashService:
         if env:
             return env
         candidates = []
-        try:
-            import jax
+        # consider the device path only when this process already runs jax
+        # (e.g. the EC pipeline initialized it): hashing alone never warrants
+        # paying jax init. All device calls go through the watchdogged
+        # probes — a wedged relay must not stall the flusher, and with it
+        # every submitted future.
+        import sys as _sys
 
-            if jax.default_backend() != "cpu":
+        if "jax" in _sys.modules:
+            from seaweedfs_tpu.ops.device_probe import (
+                device_platform,
+                link_fast_enough,
+            )
+
+            if device_platform() is not None:
                 candidates.append("jax")
-        except Exception:
-            pass
         if _native_lib() is not None:
             candidates.append("native")
         if not candidates:
             return "python"
+        if len(candidates) == 1:
+            return candidates[0]
+        if "jax" in candidates and not link_fast_enough():
+            # the full jax candidate costs a compile plus MBs through the
+            # host<->device link; a slow relay can never win the e2e rate
+            candidates.remove("jax")
         if len(candidates) == 1:
             return candidates[0]
         # measure true end-to-end batch rate (transfers included) per backend
@@ -212,6 +226,34 @@ class HashService:
         md5, crc = _hash_one(data)
         return binascii.hexlify(md5).decode(), crc
 
+    def hash_spans(self, buf, cuts) -> list[tuple[str, int]]:
+        """Synchronous batch over CDC spans of one contiguous buffer:
+        returns [(md5 hex, crc32c)] per chunk, cuts being exclusive ends.
+        One GIL-released native call hashes the whole upload's chunks in
+        lockstep with zero per-chunk copies — the dedup write path's shape
+        (the future-per-chunk queue costs more in lock churn than the
+        hashing itself on a single-core host). Backend "python" (the
+        operator escape hatch) hashes scalar; "jax" also uses the native
+        span kernel — span batches are host-resident and latency-bound, the
+        worst case for a device round-trip."""
+        if not cuts:
+            return []
+        lib = _native_lib() if self.backend in ("native", "jax") else None
+        if lib is not None and hasattr(lib, "md5_crc_batch_spans"):
+            digests, crcs = lib.md5_crc_batch_spans(buf, cuts)
+            return [
+                (binascii.hexlify(digests[i].tobytes()).decode(), int(crcs[i]))
+                for i in range(len(cuts))
+            ]
+        mv = memoryview(buf)
+        out = []
+        prev = 0
+        for c in cuts:
+            md5, crc = _hash_one(bytes(mv[prev:c]))
+            prev = c
+            out.append((binascii.hexlify(md5).decode(), crc))
+        return out
+
     # --- internals -----------------------------------------------------------
     def _flusher(self) -> None:
         while True:
@@ -232,6 +274,23 @@ class HashService:
                     self._cv.wait(self.linger_s / 4 or 0.0001)
                 work = self._buckets
                 self._buckets = {}
+            lib = _native_lib() if self.backend == "native" else None
+            if lib is not None and hasattr(lib, "md5_crc_batch_var"):
+                # variable-length lockstep kernel: one call for the whole
+                # drain, length-sorted inside. Content-defined (CDC) chunks
+                # have unique lengths, so the per-length buckets would each
+                # hold one blob and the batch kernels would never engage.
+                items = [it for bucket in work.values() for it in bucket]
+                try:
+                    digests, crcs = lib.md5_crc_batch_var(
+                        [d for d, _ in items]
+                    )
+                    for i, (_, r) in enumerate(items):
+                        r._set(digests[i].tobytes(), int(crcs[i]))
+                except Exception:
+                    for data, r in items:  # degrade to scalar, never drop
+                        r._set(*_hash_one(data))
+                continue
             for length, items in work.items():
                 try:
                     self._flush_bucket(length, items)

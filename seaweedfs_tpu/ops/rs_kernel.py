@@ -316,14 +316,14 @@ def pick_pipeline_backend(codec: RSCodec | None = None) -> str:
 def _calibrate_pipeline_backend() -> str:
     import time as _time
 
-    candidates: list[str] = []
-    try:
-        import jax
+    from seaweedfs_tpu.ops.device_probe import (
+        device_platform,
+        link_fast_enough,
+    )
 
-        if jax.default_backend() != "cpu":
-            candidates.append("jax")
-    except Exception:
-        pass
+    candidates: list[str] = []
+    if device_platform() is not None:
+        candidates.append("jax")
     try:
         from seaweedfs_tpu.native import lib
 
@@ -340,21 +340,10 @@ def _calibrate_pipeline_backend() -> str:
         # Cheap link probe before the expensive calibration: the full jax
         # candidate costs a Pallas compile plus tens of MB through the
         # host<->device link. A device behind a slow relay (~30MB/s here)
-        # can never win the e2e pipeline, so measure raw H2D rate with two
-        # tiny puts first and drop the candidate outright below 1 GB/s —
-        # this was the 17s trial-1 cold start in BENCH_r03.
-        try:
-            import jax
-
-            warm = np.zeros(65536, np.uint8)
-            jax.device_put(warm).block_until_ready()
-            probe = np.zeros(4 * 1024 * 1024, np.uint8)
-            t0 = _time.perf_counter()
-            jax.device_put(probe).block_until_ready()
-            h2d = probe.nbytes / (_time.perf_counter() - t0)
-            if h2d < 1e9:
-                candidates.remove("jax")
-        except Exception:
+        # can never win the e2e pipeline, so measure raw H2D rate (with a
+        # watchdog — the relay has been seen to wedge outright) and drop
+        # the candidate below 1 GB/s — this was BENCH_r03's 17s cold start.
+        if not link_fast_enough():
             candidates.remove("jax")
         if len(candidates) == 1:
             return candidates[0]

@@ -248,18 +248,17 @@ class FilerServer:
             backend=cdc.pick_backend(),
         )
         hash_svc = get_hash_service()
-        pieces: list[bytes] = []
-        prev = 0
-        for c in cuts:
-            pieces.append(data[prev:c])
-            prev = c
-        futures = hash_svc.submit_many(pieces)
+        # one zero-copy native batch for every chunk's md5+crc (lockstep
+        # kernels, GIL released once); bytes are sliced only for the chunks
+        # that actually need uploading
+        span_hashes = hash_svc.hash_spans(memoryview(data), cuts)
         chunks: list[FileChunk] = []
         offset = 0
         idx = self.dedup_index
-        for piece, fut in zip(pieces, futures):
-            etag = fut.md5_hex()
-            key = f"{etag}-{len(piece):x}"
+        prev = 0
+        for c, (etag, _crc) in zip(cuts, span_hashes):
+            ln = c - prev
+            key = f"{etag}-{ln:x}"
             rec = idx.lookup(key)
             if rec is not None:
                 # linearize vs gc: record the fid as freshly referenced, or
@@ -271,16 +270,17 @@ class FilerServer:
                         self._dedup_recent[rec["fid"]] = time.monotonic()
             if rec is not None:
                 idx.hits += 1
-                idx.bytes_saved += len(piece)
+                idx.bytes_saved += ln
                 chunks.append(
                     FileChunk(
-                        file_id=rec["fid"], offset=offset, size=len(piece),
+                        file_id=rec["fid"], offset=offset, size=ln,
                         modified_ts_ns=time.time_ns(), etag=etag,
                         is_compressed=bool(rec.get("z")),
                     )
                 )
             else:
                 idx.misses += 1
+                piece = data[prev:c]  # bytes materialized only for uploads
                 payload, compressed = (
                     maybe_compress_data(piece, mime, ext) if self.compress
                     else (piece, False)
@@ -291,7 +291,7 @@ class FilerServer:
                 )
                 chunks.append(
                     FileChunk(
-                        file_id=out["fid"], offset=offset, size=len(piece),
+                        file_id=out["fid"], offset=offset, size=ln,
                         modified_ts_ns=time.time_ns(), etag=etag,
                         is_compressed=compressed,
                     )
@@ -302,7 +302,8 @@ class FilerServer:
                         self._dedup_condemned.discard(key)
                         self._dedup_recent[out["fid"]] = time.monotonic()
                     idx.insert(key, {"fid": out["fid"], "z": int(compressed)})
-            offset += len(piece)
+            prev = c
+            offset += ln
         return chunks, md5.hexdigest()
 
     def _save_manifest_blob(self, blob: bytes) -> FileChunk:
