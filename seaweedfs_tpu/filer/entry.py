@@ -79,7 +79,8 @@ class Entry:
     attributes: Attributes = field(default_factory=Attributes)
     chunks: list[FileChunk] = field(default_factory=list)
     extended: dict[str, str] = field(default_factory=dict)
-    hard_link_id: str = ""
+    hard_link_id: str = ""  # hex id; shared metadata lives in the KV store
+    hard_link_counter: int = 0  # nlink (reference entry.go HardLinkCounter)
     content: bytes = b""  # small-file inlining
 
     @property
@@ -108,6 +109,7 @@ class Entry:
             "chunks": [c.to_dict() for c in self.chunks],
             "extended": self.extended,
             "hard_link_id": self.hard_link_id,
+            "hard_link_counter": self.hard_link_counter,
             "content": self.content.hex() if self.content else "",
         }
 
@@ -120,5 +122,6 @@ class Entry:
             chunks=[FileChunk.from_dict(c) for c in d.get("chunks", [])],
             extended=d.get("extended", {}) or {},
             hard_link_id=d.get("hard_link_id", ""),
+            hard_link_counter=int(d.get("hard_link_counter", 0)),
             content=bytes.fromhex(d["content"]) if d.get("content") else b"",
         )

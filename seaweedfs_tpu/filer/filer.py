@@ -7,6 +7,7 @@ Reference: `weed/filer/filer.go:37`, `filer_delete_entry.go`,
 
 from __future__ import annotations
 
+import json
 import random
 import threading
 import time
@@ -158,7 +159,113 @@ class Filer:
             if not quiet:
                 self._notify(e.parent, None, e)
 
-    def create_entry(self, entry: Entry, signatures: list[int] | None = None) -> None:
+    # --- hard links (reference `weed/filer/filerstore_hardlink.go`,
+    # `entry.go` HardLinkId/HardLinkCounter) --------------------------------
+    # A hardlinked entry's shared state (attributes, chunks, content,
+    # counter) lives ONCE in the store's KV under the hardlink id; directory
+    # rows carry only the id. Reads hydrate from KV; writes write through;
+    # deleting a link decrements the counter and the blobs are reclaimable
+    # only when it reaches zero. Renames move the row without touching the
+    # counter (reference DeleteEntry skips DeleteHardLink when op == "MV").
+
+    _HL_PREFIX = "hardlink:"
+
+    def _hl_blob(self, entry: Entry) -> bytes:
+        return json.dumps({
+            "attributes": entry.attributes.to_dict(),
+            "chunks": [c.to_dict() for c in entry.chunks],
+            "extended": entry.extended,
+            "content": entry.content.hex() if entry.content else "",
+            "counter": entry.hard_link_counter,
+        }).encode()
+
+    def _hl_write(self, entry: Entry) -> None:
+        self.store.kv_put(self._HL_PREFIX + entry.hard_link_id,
+                          self._hl_blob(entry))
+
+    def maybe_read_hardlink(self, entry: Entry | None) -> Entry | None:
+        if entry is None or entry.is_directory or not entry.hard_link_id:
+            return entry
+        blob = self.store.kv_get(self._HL_PREFIX + entry.hard_link_id)
+        if blob is None:
+            return entry
+        d = json.loads(blob)
+        entry.attributes = Attributes.from_dict(d.get("attributes", {}))
+        entry.chunks = [FileChunk.from_dict(c) for c in d.get("chunks", [])]
+        entry.extended = d.get("extended", {}) or {}
+        entry.content = bytes.fromhex(d["content"]) if d.get("content") else b""
+        entry.hard_link_counter = int(d.get("counter", 1))
+        return entry
+
+    def _hl_delete_link(self, hard_link_id: str) -> list[FileChunk]:
+        """Decrement; returns the chunks to reclaim iff the last link died
+        (reference DeleteHardLink)."""
+        key = self._HL_PREFIX + hard_link_id
+        blob = self.store.kv_get(key)
+        if blob is None:
+            return []
+        d = json.loads(blob)
+        d["counter"] = int(d.get("counter", 1)) - 1
+        if d["counter"] <= 0:
+            self.store.kv_delete(key)
+            return [FileChunk.from_dict(c) for c in d.get("chunks", [])]
+        self.store.kv_put(key, json.dumps(d).encode())
+        return []
+
+    def _hl_on_write(
+        self, existing: Entry | None, entry: Entry
+    ) -> list[FileChunk]:
+        """handleUpdateToHardLinks: write-through the shared blob; if the
+        row previously pointed at a different hardlink, drop that link.
+        Returns the chunks freed when that drop killed the last link —
+        the caller owns reclaiming their blobs."""
+        if entry.is_directory:
+            return []
+        if entry.hard_link_id:
+            self._hl_write(entry)
+        if (
+            existing is not None
+            and existing.hard_link_id
+            and existing.hard_link_id != entry.hard_link_id
+        ):
+            return self._hl_delete_link(existing.hard_link_id)
+        return []
+
+    def create_hard_link(self, old_path: str, new_path: str) -> Entry:
+        """The FUSE Link flow (`weed/mount/weedfs_link.go:53-76`): promote
+        the target to hardlink mode if needed, bump the counter, create the
+        new row sharing the id."""
+        import secrets
+
+        old_path, new_path = normalize(old_path), normalize(new_path)
+        with self._lock:
+            entry = self.maybe_read_hardlink(self.store.find_entry(old_path))
+            if entry is None:
+                raise FilerError(f"{old_path} not found")
+            if entry.is_directory:
+                raise FilerError("cannot hardlink a directory")
+            if self.store.find_entry(new_path) is not None:
+                raise FilerError(f"{new_path} already exists")
+            if not entry.hard_link_id:
+                entry.hard_link_id = secrets.token_hex(16)
+                entry.hard_link_counter = 1
+            entry.hard_link_counter += 1
+            entry.attributes.mtime = time.time()
+            self._hl_write(entry)
+            self.store.update_entry(entry)
+            self._notify(entry.parent, entry, entry)
+            link = Entry.from_dict(entry.to_dict())
+            link.full_path = new_path
+            self._ensure_parents(new_path)
+            self.store.insert_entry(link)
+            self._notify(link.parent, None, link)
+            return link
+
+    def create_entry(
+        self, entry: Entry, signatures: list[int] | None = None
+    ) -> list[FileChunk]:
+        """Insert; returns chunks freed by detaching a dead hardlink (the
+        caller reclaims their blobs — empty for ordinary writes)."""
         entry.full_path = normalize(entry.full_path)
         with self._lock:
             existing = self.store.find_entry(entry.full_path)
@@ -168,17 +275,26 @@ class Filer:
                     f"{'directory' if existing.is_directory else 'file'}"
                 )
             self._ensure_parents(entry.full_path)
+            freed = self._hl_on_write(existing, entry)
             self.store.insert_entry(entry)
             self._notify(entry.parent, existing, entry, signatures)
+            return freed
 
     def find_entry(self, path: str) -> Entry | None:
-        return self.store.find_entry(normalize(path))
+        return self.maybe_read_hardlink(
+            self.store.find_entry(normalize(path))
+        )
 
-    def update_entry(self, entry: Entry, signatures: list[int] | None = None) -> None:
+    def update_entry(
+        self, entry: Entry, signatures: list[int] | None = None
+    ) -> list[FileChunk]:
+        """Update; same freed-chunks contract as create_entry."""
         with self._lock:
             old = self.store.find_entry(entry.full_path)
+            freed = self._hl_on_write(old, entry)
             self.store.update_entry(entry)
             self._notify(entry.parent, old, entry, signatures)
+            return freed
 
     def delete_entry(
         self, path: str, recursive: bool = False,
@@ -202,7 +318,11 @@ class Filer:
                             child.full_path, recursive=True, signatures=signatures
                         )
                     )
-            collected.extend(entry.chunks)
+            if not entry.is_directory and entry.hard_link_id:
+                # last-link-standing reclaims the shared chunks
+                collected.extend(self._hl_delete_link(entry.hard_link_id))
+            else:
+                collected.extend(entry.chunks)
             self.store.delete_entry(path)
             self._notify(entry.parent, entry, None, signatures)
             return collected
@@ -215,9 +335,12 @@ class Filer:
         self, dir_path: str, start_from: str = "", inclusive: bool = False,
         limit: int = 1024,
     ) -> list[Entry]:
-        return list(
-            self.store.list_entries(normalize(dir_path), start_from, inclusive, limit)
-        )
+        return [
+            self.maybe_read_hardlink(e)
+            for e in self.store.list_entries(
+                normalize(dir_path), start_from, inclusive, limit
+            )
+        ]
 
     def rename(self, old_path: str, new_path: str) -> None:
         """Atomic-within-this-filer rename, directories recursively

@@ -66,6 +66,15 @@ class FilerClient:
         if status >= 300:
             raise IOError(f"rename {old} -> {new}: {status} {body[:200]!r}")
 
+    def link(self, old: str, new: str) -> None:
+        """Hard link: new path shares the old path's content and metadata
+        (filer `link.from` API; reference FUSE Link semantics)."""
+        status, _, body = http_request(
+            "POST", self._u(new, {"link.from": old}), b""
+        )
+        if status >= 300:
+            raise IOError(f"link {old} -> {new}: {status} {body[:200]!r}")
+
     # --- metadata ---------------------------------------------------------------
     def get_entry(self, path: str) -> dict | None:
         status, _, body = http_request(

@@ -50,6 +50,9 @@ class FilerStore:
     def kv_get(self, key: str) -> bytes | None:
         raise NotImplementedError
 
+    def kv_delete(self, key: str) -> None:
+        raise NotImplementedError
+
     def close(self) -> None:
         pass
 
@@ -104,6 +107,9 @@ class MemoryStore(FilerStore):
 
     def kv_get(self, key: str) -> bytes | None:
         return self._kv.get(key)
+
+    def kv_delete(self, key: str) -> None:
+        self._kv.pop(key, None)
 
 
 class SqliteStore(FilerStore):
@@ -186,6 +192,11 @@ class SqliteStore(FilerStore):
                 "SELECT v FROM kv WHERE k=?", (key,)
             ).fetchone()
         return row[0] if row else None
+
+    def kv_delete(self, key: str) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k=?", (key,))
+            self._conn.commit()
 
     def close(self) -> None:
         self._conn.close()

@@ -20,6 +20,7 @@ FORGET = 2
 GETATTR = 3
 SETATTR = 4
 UNLINK = 10
+LINK = 13
 RMDIR = 11
 RENAME = 12
 OPEN = 14

@@ -71,8 +71,24 @@ class WFS:
         self._lock = threading.Lock()
 
     # --- inode table ----------------------------------------------------------
-    def _ino_for(self, path: str) -> int:
+    def _ino_for(self, path: str, entry: dict | None = None) -> int:
+        """Inode for a path. All names of one hardlink set share an inode
+        (keyed by the hardlink id — reference inodeToPath.AddPath in
+        `weedfs_link.go`) so st_ino-based tools (rsync -H, du) see them as
+        one file; reverse lookup keeps the first name."""
         with self._lock:
+            hl = (entry or {}).get("hard_link_id") or ""
+            if hl:
+                key = "\0hl:" + hl  # cannot collide with a real path
+                ino = self._path_to_ino.get(key)
+                if ino is None:
+                    ino = self._next_ino
+                    self._next_ino += 1
+                    self._path_to_ino[key] = ino
+                if ino not in self._ino_to_path:
+                    self._ino_to_path[ino] = path
+                self._path_to_ino[path] = ino
+                return ino
             ino = self._path_to_ino.get(path)
             if ino is None:
                 ino = self._next_ino
@@ -110,8 +126,8 @@ class WFS:
         mode = attrs.get("mode", 0o755 if is_dir else 0o644) & 0o7777
         mode |= fp.S_IFDIR if is_dir else fp.S_IFREG
         return fp.pack_attr(
-            self._ino_for(path), size, mode,
-            nlink=2 if is_dir else 1,
+            self._ino_for(path, entry), size, mode,
+            nlink=2 if is_dir else max(1, entry.get("hard_link_counter", 0)),
             uid=attrs.get("uid", 0), gid=attrs.get("gid", 0),
             mtime=attrs.get("mtime", 0.0), ctime=attrs.get("crtime", 0.0),
         )
@@ -208,6 +224,7 @@ class WFS:
                 fp.FSYNC: self._op_flush,
                 fp.RELEASE: self._op_release,
                 fp.UNLINK: self._op_unlink,
+                fp.LINK: self._op_link,
                 fp.RMDIR: self._op_rmdir,
                 fp.RENAME: self._op_rename,
                 fp.RENAME2: self._op_rename2,
@@ -497,6 +514,31 @@ class WFS:
         self.meta.invalidate(old_path)
         self.meta.invalidate(new_path)
         return fp.reply(hdr.unique)
+
+    def _op_link(self, hdr, payload) -> bytes:
+        """Hard link (`weed/mount/weedfs_link.go`): payload is
+        fuse_link_in{oldnodeid u64} + name; nodeid is the new parent."""
+        import struct as _struct
+
+        (oldnodeid,) = _struct.unpack_from("<Q", payload)
+        name = payload[8:].split(b"\0", 1)[0].decode()
+        old_path = self._path_of(oldnodeid)
+        new_path = self._child_path(hdr.nodeid, name)
+        if old_path is None or new_path is None:
+            return fp.reply(hdr.unique, error=fp.ERRNO_NOENT)
+        try:
+            self.meta.fc.link(old_path, new_path)
+        except IOError:
+            return fp.reply(hdr.unique, error=fp.ERRNO_IO)
+        self.meta.invalidate(old_path)
+        self.meta.invalidate(new_path)  # clear the cached negative lookup
+        entry = self.meta.get_entry(new_path)
+        if entry is None:
+            return fp.reply(hdr.unique, error=fp.ERRNO_IO)
+        attr = self._attr_from_entry(new_path, entry)
+        return fp.reply(
+            hdr.unique, fp.pack_entry_out(self._ino_for(new_path), attr)
+        )
 
     def _op_rename(self, hdr, payload) -> bytes:
         (newdir,) = fp.RENAME_IN.unpack_from(payload)

@@ -401,7 +401,7 @@ class FilerServer:
                 })
                 existing.chunks = []  # changed upstream: drop stale cache
                 existing.attributes.file_size = size
-                self.filer.update_entry(existing)
+                self._reclaim_chunks(self.filer.update_entry(existing))
             else:
                 e = Entry(full_path=full)
                 e.attributes.file_size = size
@@ -437,7 +437,7 @@ class FilerServer:
             entry.chunks = maybe_manifestize(self._save_manifest_blob, chunks)
             entry.attributes.md5 = md5_hex
         entry.attributes.file_size = len(data)
-        self.filer.update_entry(entry)
+        self._reclaim_chunks(self.filer.update_entry(entry))
         return entry
 
     def _register_remote_routes(self, svc) -> None:
@@ -688,6 +688,17 @@ class FilerServer:
             except FilerError as e:
                 return Response({"error": str(e)}, 409)
             return Response({"ok": True}, 200)
+        if "link.from" in req.query:
+            # POST /new/path?link.from=/old/path — hard link (the FUSE Link
+            # flow, `weed/mount/weedfs_link.go:53`; counter semantics from
+            # `weed/filer/filerstore_hardlink.go`)
+            try:
+                link = self.filer.create_hard_link(req.query["link.from"], path)
+            except FilerError as e:
+                return Response({"error": str(e)}, 409)
+            return Response(
+                {"ok": True, "nlink": link.hard_link_counter}, 201
+            )
         if req.query.get("meta.entry") == "true":
             # raw metadata restore (fs.meta.load): entry dict incl. chunks
             try:
@@ -731,10 +742,14 @@ class FilerServer:
             entry.attributes.md5 = md5_hex
         old_entry = self.filer.find_entry(path)
         try:
-            self.filer.create_entry(entry, signatures=signatures)
+            freed = self.filer.create_entry(entry, signatures=signatures)
         except FilerError as e:
             return Response({"error": str(e)}, 409)
-        if old_entry is not None and old_entry.chunks:
+        if old_entry is not None and old_entry.hard_link_id:
+            # hardlinked target: surviving links still reference the shared
+            # chunks — reclaim only what the detach actually freed
+            self._reclaim_chunks(freed)
+        elif old_entry is not None and old_entry.chunks:
             self._reclaim_chunks(old_entry.chunks)  # overwritten version's blobs
         return Response(
             {"name": entry.name, "size": len(data), "md5": entry.attributes.md5},

@@ -31,7 +31,7 @@ from .needle import (
     get_actual_size,
     needle_body_length,
 )
-from .needle_map import NeedleMap
+from .needle_map import CompactNeedleMap
 from .super_block import SUPER_BLOCK_SIZE, SuperBlock
 from .types import (
     NEEDLE_HEADER_SIZE,
@@ -100,7 +100,7 @@ class Volume:
         if not is_new:
             header = self._dat.read_at(SUPER_BLOCK_SIZE, 0)
             self.super_block = SuperBlock.from_bytes(header)
-        self.nm = NeedleMap(self.base_name + ".idx")
+        self.nm = CompactNeedleMap(self.base_name + ".idx")
         self._size = self._dat.file_size()
         if not is_new and tier is None:
             self._check_idx_integrity()
@@ -311,7 +311,7 @@ class Volume:
             self._dat = DiskFile(self.base_name + ".dat")
             header = self._dat.read_at(SUPER_BLOCK_SIZE, 0)
             self.super_block = SuperBlock.from_bytes(header)
-            self.nm = NeedleMap(self.base_name + ".idx")
+            self.nm = CompactNeedleMap(self.base_name + ".idx")
             self._size = os.path.getsize(self.base_name + ".dat")
 
     def _makeup_diff(self, dst_dat: str, dst_idx: str) -> None:

@@ -1,0 +1,145 @@
+"""Filer hardlinks (reference `weed/filer/filerstore_hardlink.go`,
+`weed/mount/weedfs_link.go:53-76`): shared KV blob, counter lifecycle,
+rename neutrality, last-link chunk reclaim."""
+
+import pytest
+
+from seaweedfs_tpu.filer.entry import Attributes, Entry, FileChunk
+from seaweedfs_tpu.filer.filer import Filer, FilerError
+from seaweedfs_tpu.filer.filerstore import MemoryStore, SqliteStore
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def filer(request, tmp_path):
+    if request.param == "memory":
+        return Filer(MemoryStore())
+    return Filer(SqliteStore(str(tmp_path / "f.db")))
+
+
+def make_file(filer, path, nchunks=2):
+    e = Entry(
+        full_path=path,
+        chunks=[
+            FileChunk(file_id=f"3,{i:x}00000000", offset=i * 100, size=100)
+            for i in range(nchunks)
+        ],
+        attributes=Attributes(file_size=nchunks * 100),
+    )
+    filer.create_entry(e)
+    return e
+
+
+def test_link_shares_metadata_and_counts(filer):
+    make_file(filer, "/dir/a")
+    link = filer.create_hard_link("/dir/a", "/dir/b")
+    assert link.hard_link_id
+    a = filer.find_entry("/dir/a")
+    b = filer.find_entry("/dir/b")
+    assert a.hard_link_id == b.hard_link_id
+    assert a.hard_link_counter == b.hard_link_counter == 2
+    assert [c.file_id for c in a.chunks] == [c.file_id for c in b.chunks]
+    # writes through one name are visible via the other (shared KV blob)
+    a.chunks.append(FileChunk(file_id="3,900000000", offset=200, size=50))
+    a.attributes.file_size = 250
+    filer.update_entry(a)
+    b2 = filer.find_entry("/dir/b")
+    assert len(b2.chunks) == 3 and b2.attributes.file_size == 250
+
+
+def test_delete_decrements_then_reclaims(filer):
+    make_file(filer, "/d/a")
+    filer.create_hard_link("/d/a", "/d/b")
+    filer.create_hard_link("/d/a", "/d/c")  # counter 3
+    # deleting two links reclaims nothing
+    assert filer.delete_entry("/d/b") == []
+    assert filer.delete_entry("/d/a") == []
+    c = filer.find_entry("/d/c")
+    assert c.hard_link_counter == 1
+    # last link: chunks come back for blob reclaim
+    reclaimed = filer.delete_entry("/d/c")
+    assert sorted(ch.file_id for ch in reclaimed) == [
+        "3,000000000", "3,100000000"
+    ]
+    assert filer.store.kv_get("hardlink:" + c.hard_link_id) is None
+
+
+def test_rename_keeps_counter(filer):
+    make_file(filer, "/r/a")
+    filer.create_hard_link("/r/a", "/r/b")
+    filer.rename("/r/b", "/r/b2")
+    a = filer.find_entry("/r/a")
+    b2 = filer.find_entry("/r/b2")
+    assert a.hard_link_counter == b2.hard_link_counter == 2
+    assert filer.delete_entry("/r/b2") == []
+    assert len(filer.delete_entry("/r/a")) == 2
+
+
+def test_link_errors(filer):
+    make_file(filer, "/e/a")
+    filer.create_entry(Entry(full_path="/e/dir", is_directory=True))
+    with pytest.raises(FilerError):
+        filer.create_hard_link("/e/missing", "/e/x")
+    with pytest.raises(FilerError):
+        filer.create_hard_link("/e/dir", "/e/x")
+    with pytest.raises(FilerError):
+        filer.create_hard_link("/e/a", "/e/a")
+
+
+def test_overwrite_link_drops_old_reference(filer):
+    make_file(filer, "/o/a")
+    filer.create_hard_link("/o/a", "/o/b")
+    # overwriting /o/b with a plain file must decrement the old link
+    plain = Entry(full_path="/o/b",
+                  chunks=[FileChunk(file_id="3,f00000000", offset=0, size=10)])
+    filer.create_entry(plain)
+    a = filer.find_entry("/o/a")
+    assert a.hard_link_counter == 1
+    assert len(filer.delete_entry("/o/a")) == 2  # now the last link
+
+
+class TestHardLinksHTTP:
+    """Through the real filer HTTP server: the link.from API, and the
+    overwrite-reclaim regression (overwriting one name of a hardlink set
+    must NOT reclaim the shared blobs other names still reference)."""
+
+    @pytest.fixture()
+    def cluster(self):
+        from seaweedfs_tpu.filer.filer_client import FilerClient
+        from seaweedfs_tpu.server.filer import FilerServer
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume import VolumeServer
+        import tempfile
+
+        d = tempfile.mkdtemp()
+        m = MasterServer(port=0, pulse_seconds=1)
+        m.start()
+        v = VolumeServer([d], m.url, port=0, pulse_seconds=1)
+        v.start()
+        f = FilerServer(m.url, port=0, chunk_size_mb=1)
+        f.start()
+        try:
+            yield FilerClient(f.url)
+        finally:
+            f.stop()
+            v.stop()
+            m.stop()
+
+    def test_link_api_and_overwrite_keeps_other_links(self, cluster):
+        import os as _os
+
+        body = _os.urandom(3 * 1024 * 1024)  # multi-chunk (chunk_size 1MB)
+        cluster.put("/hl/a.bin", body)
+        cluster.link("/hl/a.bin", "/hl/b.bin")
+        assert cluster.read("/hl/b.bin") == body
+        # overwrite /hl/a.bin with new content: /hl/b.bin must survive
+        body2 = _os.urandom(2 * 1024 * 1024)
+        cluster.put("/hl/a.bin", body2)
+        assert cluster.read("/hl/a.bin") == body2
+        assert cluster.read("/hl/b.bin") == body, (
+            "shared chunks were reclaimed while a link still references them"
+        )
+        e = cluster.get_entry("/hl/b.bin")
+        assert e["hard_link_counter"] == 1  # detach dropped a from the set
+        # deleting the last link ends the set
+        cluster.delete("/hl/b.bin")
+        assert cluster.get_entry("/hl/b.bin") is None

@@ -1,0 +1,152 @@
+"""CompactNeedleMap / SortedFileNeedleMap vs the dict NeedleMap oracle.
+
+The compact map is the default volume mapper (reference design point:
+`weed/storage/needle_map/compact_map.go:28,198` — ~16 B/needle); these
+tests pin (a) operational equivalence incl. metrics on randomized op
+sequences, (b) replay equivalence from a shared .idx, (c) the memory
+budget (< 30 B/needle at 1M entries), (d) the .sdx cold-volume variant.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage.needle_map import (
+    CompactNeedleMap,
+    NeedleMap,
+    SortedFileNeedleMap,
+)
+
+
+def random_ops(seed, n_ops=4000, key_space=900):
+    rng = random.Random(seed)
+    ops = []
+    for _ in range(n_ops):
+        key = rng.randrange(1, key_space)
+        if rng.random() < 0.25:
+            ops.append(("delete", key, 0, 0))
+        else:
+            ops.append(
+                ("put", key, rng.randrange(1, 1 << 20) * 8, rng.randrange(1, 5000))
+            )
+    return ops
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_equivalent_to_dict_map(seed):
+    a, b = CompactNeedleMap(), NeedleMap()
+    ops = random_ops(seed)
+    for op, key, off, size in ops:
+        if op == "put":
+            a.put(key, off, size)
+            b.put(key, off, size)
+        else:
+            a.delete(key)
+            b.delete(key)
+    assert len(a) == len(b)
+    assert a.content_size() == b.content_size()
+    assert a.metrics.file_count == b.metrics.file_count
+    assert a.metrics.deleted_count == b.metrics.deleted_count
+    assert a.metrics.deleted_bytes == b.metrics.deleted_bytes
+    assert a.metrics.maximum_key == b.metrics.maximum_key
+    for key in range(1, 900):
+        assert a.get(key) == b.get(key), f"key {key}"
+    assert list(a.ascending_visit()) == list(b.ascending_visit())
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_replay_equivalence(tmp_path, seed):
+    idx = str(tmp_path / "1.idx")
+    w = NeedleMap(idx)
+    for op, key, off, size in random_ops(seed, n_ops=3000):
+        if op == "put":
+            w.put(key, off, size)
+        else:
+            w.delete(key)
+    w.close()
+    a, b = CompactNeedleMap(idx), NeedleMap(str(tmp_path / "1.idx"))
+    assert a.metrics == b.metrics
+    assert list(a.ascending_visit()) == list(b.ascending_visit())
+    a.close()
+    b.close()
+
+
+def test_memory_budget_1m_entries():
+    m = CompactNeedleMap()
+    n = 1_000_000
+    # bulk puts through the public API (ascending keys, the sequencer's
+    # common pattern) — merges amortize
+    for key in range(1, n + 1):
+        m.put(key, key * 8, 100)
+    assert len(m) == n
+    bpn = m.bytes_per_needle()
+    assert bpn < 30, f"{bpn:.1f} B/needle exceeds the CompactMap budget"
+    # spot reads
+    assert m.get(1) == (8, 100)
+    assert m.get(n) == (n * 8, 100)
+    assert m.get(n + 1) is None
+
+
+def test_sorted_file_map(tmp_path):
+    idx = str(tmp_path / "1.idx")
+    w = NeedleMap(idx)
+    for key in range(1, 500):
+        w.put(key, key * 8, key)
+    for key in range(1, 500, 7):
+        w.delete(key)
+    w.close()
+    oracle = NeedleMap(idx)
+    sf = SortedFileNeedleMap(str(tmp_path / "1"))
+    assert os.path.exists(str(tmp_path / "1.sdx"))
+    for key in range(1, 520):
+        assert sf.get(key) == oracle.get(key), f"key {key}"
+    assert list(sf.ascending_visit()) == list(oracle.ascending_visit())
+    # in-place delete
+    sf.delete(2)
+    assert sf.get(2) is None
+    sf.close()
+    # reopen: deletion persisted in the .sdx
+    sf2 = SortedFileNeedleMap(str(tmp_path / "1"))
+    assert sf2.get(2) is None
+    assert sf2.get(3) == oracle.get(3)
+    sf2.close()
+
+
+def test_offset_5_bytes_mode_roundtrip(tmp_path):
+    """SEAWEEDFS_TPU_OFFSET_BYTES=5 (the reference's 5BytesOffset build
+    tag, `offset_5bytes.go:15`): 17-byte idx entries round-trip an offset
+    beyond the 4-byte 32GB ceiling. Runs in a subprocess because offset
+    width is a process-wide import-time switch, like a build tag."""
+    import subprocess
+    import sys
+
+    code = f"""
+import sys
+sys.path.insert(0, {repr(os.getcwd())})
+from seaweedfs_tpu.storage import idx as idx_mod
+from seaweedfs_tpu.storage.needle_map import CompactNeedleMap
+from seaweedfs_tpu.storage.types import NEEDLE_MAP_ENTRY_SIZE, MAX_POSSIBLE_VOLUME_SIZE
+assert NEEDLE_MAP_ENTRY_SIZE == 17, NEEDLE_MAP_ENTRY_SIZE
+assert MAX_POSSIBLE_VOLUME_SIZE == (1 << 40) * 8
+big = (40 << 30) + 8  # > 32GB, 8-aligned
+path = {repr(str(tmp_path / "5b.idx"))}
+m = CompactNeedleMap(path)
+m.put(7, big, 1234)
+m.put(9, 16, 99)
+m.close()
+entries = list(idx_mod.walk_index_file(path))
+assert entries == [(7, big, 1234), (9, 16, 99)], entries
+m2 = CompactNeedleMap(path)
+assert m2.get(7) == (big, 1234), m2.get(7)
+assert m2.get(9) == (16, 99)
+m2.close()
+print("ok")
+"""
+    env = dict(os.environ, SEAWEEDFS_TPU_OFFSET_BYTES="5",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, env=env, cwd=os.getcwd())
+    assert out.returncode == 0, out.stderr
+    assert "ok" in out.stdout
