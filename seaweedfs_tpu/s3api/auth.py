@@ -46,6 +46,11 @@ ERRORS = {
     "NoSuchKey": 404,
     "NoSuchUpload": 404,
     "NoSuchTagSet": 404,
+    "NoSuchBucketPolicy": 404,
+    "NoSuchCORSConfiguration": 404,
+    "NoSuchLifecycleConfiguration": 404,
+    "MalformedPolicy": 400,
+    "MalformedPOSTRequest": 400,
     "BucketAlreadyExists": 409,
     "BucketNotEmpty": 409,
     "InvalidBucketName": 400,

@@ -26,12 +26,14 @@ from xml.sax.saxutils import escape
 from seaweedfs_tpu.filer.filer_client import FilerClient
 from seaweedfs_tpu.server.httpd import HTTPService, Request, Response
 
+from . import policy as bucket_policy
 from .auth import (
     ACTION_ADMIN,
     ACTION_LIST,
     ACTION_READ,
     ACTION_TAGGING,
     ACTION_WRITE,
+    Identity,
     IdentityAccessManagement,
     S3ApiError,
     deframe_streaming_body,
@@ -41,6 +43,7 @@ from .circuit_breaker import CircuitBreaker
 
 BUCKETS_DIR = "/buckets"
 UPLOADS_FOLDER = ".uploads"
+VERSIONS_FOLDER = ".versions"
 TAG_PREFIX = "X-Amz-Tagging-"
 AMZ_META_PREFIX = "x-amz-meta-"
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
@@ -81,6 +84,8 @@ class S3Server:
         if config:
             self.iam.load_config(config)
         self.cb = circuit_breaker or CircuitBreaker()
+        self.lifecycle_sweep_interval = 3600.0  # 0 disables the sweeper
+        self._sweep_stop = None
         self.service = HTTPService(host, port)
         self.service.enable_metrics("s3", serve_route=False)
         self._iam_subscriber = None
@@ -94,8 +99,23 @@ class S3Server:
             pass
         self._load_iam_from_filer()
         self._watch_iam()
+        if self.lifecycle_sweep_interval > 0:
+            import threading
+
+            self._sweep_stop = threading.Event()
+
+            def sweeper():  # pragma: no cover - timing loop
+                while not self._sweep_stop.wait(self.lifecycle_sweep_interval):
+                    try:
+                        self.run_lifecycle_sweep()
+                    except Exception:
+                        pass
+
+            threading.Thread(target=sweeper, daemon=True).start()
 
     def stop(self) -> None:
+        if self._sweep_stop is not None:
+            self._sweep_stop.set()
         if self._iam_subscriber is not None:
             self._iam_subscriber.stop()
         self.service.stop()
@@ -141,6 +161,17 @@ class S3Server:
         def list_buckets(req: Request) -> Response:
             return self._dispatch(req, "", "")
 
+        for method in ("OPTIONS",):
+            # CORS preflight carries no credentials; matched against the
+            # bucket's CORS config only (`s3api_server.go` cors.New wrapper)
+            @svc.route(method, r"/([^/]+)")
+            def bucket_preflight(req: Request) -> Response:
+                return self._preflight(req, req.match.group(1))
+
+            @svc.route(method, r"/([^/]+)/(.*)")
+            def object_preflight(req: Request) -> Response:
+                return self._preflight(req, req.match.group(1))
+
         for method in ("GET", "PUT", "POST", "DELETE", "HEAD"):
             @svc.route(method, r"/([^/]+)")
             def bucket_level(req: Request) -> Response:
@@ -162,17 +193,59 @@ class S3Server:
         pairs = self._query_pairs(req)
         q = dict(pairs)
         resource = f"/{bucket}/{key}" if key else f"/{bucket}"
+        if (
+            req.method == "POST"
+            and bucket
+            and not key
+            and "multipart/form-data" in req.headers.get("Content-Type", "")
+        ):
+            # browser POST upload, authenticated by its signed form policy
+            # (`s3api_object_handlers_postpolicy.go`)
+            try:
+                with self.cb.limit(ACTION_WRITE, bucket):
+                    resp = self._post_policy_upload(req, bucket)
+            except S3ApiError as e:
+                resp = error_response(e, resource)
+            self._apply_cors_headers(req, bucket, resp)
+            return resp
         try:
             body = req.body
-            ident = self.iam.authenticate(
-                req.method,
-                urllib.parse.unquote(urllib.parse.urlparse(req.handler.path).path),
-                pairs,
-                dict(req.headers),
-                body,
-            )
+            try:
+                ident = self.iam.authenticate(
+                    req.method,
+                    urllib.parse.unquote(
+                        urllib.parse.urlparse(req.handler.path).path
+                    ),
+                    pairs,
+                    dict(req.headers),
+                    body,
+                )
+            except S3ApiError as e:
+                # unauthenticated (NOT mis-signed) requests proceed as the
+                # anonymous principal: a bucket policy may Allow "*"
+                if e.code != "AccessDenied":
+                    raise
+                ident = Identity("anonymous", [], [])
             action = self._required_action(req.method, bucket, key, q)
-            if not ident.can_do(action, bucket, key):
+            # bucket-policy evaluation (s3api/policy.py): explicit Deny
+            # wins; Allow unions with the identity's IAM grants
+            decision = None
+            if bucket:
+                doc = self._bucket_policy_doc(bucket)
+                if doc is not None:
+                    decision = bucket_policy.evaluate(
+                        doc,
+                        ident.name,
+                        self._s3_action_name(req.method, bucket, key, q),
+                        bucket_policy.arn(bucket, urllib.parse.unquote(key)),
+                    )
+            if decision == bucket_policy.DENY:
+                raise err(
+                    "AccessDenied", f"policy denies {resource} to {ident.name}"
+                )
+            if decision != bucket_policy.ALLOW and not ident.can_do(
+                action, bucket, key
+            ):
                 raise err("AccessDenied", f"{ident.name} cannot {action} {resource}")
             # CopyObject also reads the source object — authorize both sides
             copy_source = req.headers.get("x-amz-copy-source")
@@ -184,14 +257,23 @@ class S3Server:
                         "AccessDenied", f"{ident.name} cannot Read /{src}"
                     )
             with self.cb.limit(action, bucket):
-                return self._handle(req, bucket, urllib.parse.unquote(key), q, ident)
+                resp = self._handle(
+                    req, bucket, urllib.parse.unquote(key), q, ident
+                )
         except S3ApiError as e:
-            return error_response(e, resource)
+            resp = error_response(e, resource)
         except Exception as e:  # any internal failure → S3 XML error surface
-            return error_response(err("InternalError", str(e)), resource)
+            resp = error_response(err("InternalError", str(e)), resource)
+        if bucket:
+            self._apply_cors_headers(req, bucket, resp)
+        return resp
 
     @staticmethod
     def _required_action(method: str, bucket: str, key: str, q: dict) -> str:
+        if "policy" in q or "cors" in q or "lifecycle" in q or (
+            "versioning" in q and method == "PUT"
+        ):
+            return ACTION_ADMIN  # bucket-owner configuration surfaces
         if "tagging" in q:
             return ACTION_TAGGING
         if not bucket:
@@ -205,6 +287,45 @@ class S3Server:
         if method in ("GET", "HEAD"):
             return ACTION_READ
         return ACTION_WRITE
+
+    @staticmethod
+    def _s3_action_name(method: str, bucket: str, key: str, q: dict) -> str:
+        """Canonical AWS action name for policy matching."""
+        if "policy" in q:
+            return {"GET": "s3:GetBucketPolicy", "PUT": "s3:PutBucketPolicy",
+                    "DELETE": "s3:DeleteBucketPolicy"}.get(method, "s3:GetBucketPolicy")
+        if "cors" in q:
+            return {"GET": "s3:GetBucketCors", "PUT": "s3:PutBucketCors",
+                    "DELETE": "s3:DeleteBucketCors"}.get(method, "s3:GetBucketCors")
+        if "lifecycle" in q:
+            return {"GET": "s3:GetLifecycleConfiguration",
+                    "PUT": "s3:PutLifecycleConfiguration",
+                    "DELETE": "s3:PutLifecycleConfiguration"}.get(
+                method, "s3:GetLifecycleConfiguration")
+        if "tagging" in q:
+            kind = "Object" if key else "Bucket"
+            return {"GET": f"s3:Get{kind}Tagging", "PUT": f"s3:Put{kind}Tagging",
+                    "DELETE": f"s3:Delete{kind}Tagging"}.get(
+                method, f"s3:Get{kind}Tagging")
+        if not key:
+            if method == "PUT":
+                return "s3:CreateBucket"
+            if method == "DELETE":
+                return "s3:DeleteBucket"
+            if method == "POST":
+                return "s3:DeleteObject"  # batch delete
+            if "uploads" in q:
+                return "s3:ListBucketMultipartUploads"
+            return "s3:ListBucket"
+        if "uploadId" in q or "uploads" in q:
+            return {"DELETE": "s3:AbortMultipartUpload",
+                    "GET": "s3:ListMultipartUploadParts"}.get(
+                method, "s3:PutObject")
+        if method in ("GET", "HEAD"):
+            return "s3:GetObject"
+        if method == "DELETE":
+            return "s3:DeleteObject"
+        return "s3:PutObject"
 
     def _handle(
         self, req: Request, bucket: str, key: str, q: dict, ident
@@ -221,7 +342,30 @@ class S3Server:
                     return self._put_tagging(path, req.body)
                 if m == "DELETE":
                     return self._delete_tagging(path)
+            if "policy" in q:
+                if m == "GET":
+                    return self._get_bucket_policy(bucket)
+                if m == "PUT":
+                    return self._put_bucket_policy(bucket, req.body)
+                if m == "DELETE":
+                    return self._delete_bucket_policy(bucket)
+            if "cors" in q:
+                if m == "GET":
+                    return self._get_bucket_cors(bucket)
+                if m == "PUT":
+                    return self._put_bucket_cors(bucket, req.body)
+                if m == "DELETE":
+                    return self._delete_bucket_ext(bucket, "cors", 204)
+            if "lifecycle" in q:
+                if m == "GET":
+                    return self._get_bucket_lifecycle(bucket)
+                if m == "PUT":
+                    return self._put_bucket_lifecycle(bucket, req.body)
+                if m == "DELETE":
+                    return self._delete_bucket_ext(bucket, "lifecycle", 204)
             if m == "PUT":
+                if "versioning" in q:
+                    return self._put_bucket_versioning(bucket, req.body)
                 return self._put_bucket(bucket)
             if m == "DELETE":
                 return self._delete_bucket(bucket)
@@ -235,9 +379,9 @@ class S3Server:
                 if "location" in q:
                     return xml_response("LocationConstraint", "")
                 if "versioning" in q:
-                    return xml_response("VersioningConfiguration", "")
-                if "lifecycle" in q:
-                    raise err("NoSuchTagSet", "no lifecycle configuration")
+                    return self._get_bucket_versioning(bucket)
+                if "versions" in q:
+                    return self._list_object_versions(bucket, q)
                 if "acl" in q:
                     return self._canned_acl(ident)
                 return self._list_objects(req, bucket, q)
@@ -266,8 +410,16 @@ class S3Server:
                     return self._copy_object(req, bucket, key)
                 return self._put_object(req, bucket, key)
             if m in ("GET", "HEAD"):
+                if "versionId" in q:
+                    return self._get_object_version(
+                        req, bucket, key, q["versionId"], head=(m == "HEAD")
+                    )
                 return self._get_object(req, bucket, key, head=(m == "HEAD"))
             if m == "DELETE":
+                if "versionId" in q:
+                    return self._delete_object_version(
+                        bucket, key, q["versionId"]
+                    )
                 return self._delete_object(bucket, key)
         raise err("NotImplemented", f"{m} {req.path}?{urllib.parse.urlencode(q)}")
 
@@ -326,7 +478,8 @@ class S3Server:
         listing = self.fc.list(self._bucket_path(bucket), limit=2)
         entries = [
             e for e in listing.get("Entries", [])
-            if e["FullPath"].rsplit("/", 1)[-1] != UPLOADS_FOLDER
+            if e["FullPath"].rsplit("/", 1)[-1]
+            not in (UPLOADS_FOLDER, VERSIONS_FOLDER)
         ]
         if entries:
             raise err("BucketNotEmpty", bucket)
@@ -336,6 +489,297 @@ class S3Server:
     def _head_bucket(self, bucket: str) -> Response:
         self._require_bucket(bucket)
         return Response(b"", 200)
+
+    # --- bucket configuration (policy / CORS / lifecycle) -------------------
+    # Stored as extended attributes of the bucket directory entry, the same
+    # place the reference keeps bucket metadata (`bucket_metadata.go` reads
+    # entry.Extended). Policy documents are JSON; CORS and lifecycle keep
+    # their original XML.
+
+    _EXT_POLICY = "s3-policy"
+    _EXT_CORS = "s3-cors"
+    _EXT_LIFECYCLE = "s3-lifecycle"
+
+    def _bucket_ext_get(self, bucket: str, attr: str) -> str | None:
+        entry = self._require_bucket(bucket)
+        return (entry.get("extended") or {}).get(attr)
+
+    def _bucket_ext_set(self, bucket: str, attr: str, value: str | None) -> None:
+        path = self._bucket_path(bucket)
+        entry = self._require_bucket(bucket)
+        ext = entry.setdefault("extended", {})
+        if value is None:
+            ext.pop(attr, None)
+        else:
+            ext[attr] = value
+        self.fc.put_entry(path, entry)
+
+    def _delete_bucket_ext(self, bucket: str, kind: str, status: int) -> Response:
+        attr = {"cors": self._EXT_CORS, "lifecycle": self._EXT_LIFECYCLE,
+                "policy": self._EXT_POLICY}[kind]
+        self._bucket_ext_set(bucket, attr, None)
+        return Response(b"", status)
+
+    def _bucket_policy_doc(self, bucket: str) -> dict | None:
+        try:
+            raw = self._bucket_ext_get(bucket, self._EXT_POLICY)
+        except S3ApiError:
+            return None  # NoSuchBucket surfaces from the handler itself
+        if not raw:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:  # pragma: no cover - validated at put time
+            return None
+
+    def _get_bucket_policy(self, bucket: str) -> Response:
+        raw = self._bucket_ext_get(bucket, self._EXT_POLICY)
+        if not raw:
+            raise err("NoSuchBucketPolicy", bucket)
+        return Response(raw.encode(), 200, {"Content-Type": "application/json"})
+
+    def _put_bucket_policy(self, bucket: str, body: bytes) -> Response:
+        self._require_bucket(bucket)
+        try:
+            doc = bucket_policy.validate(body, bucket)
+        except ValueError as e:
+            raise err("MalformedPolicy", str(e))
+        self._bucket_ext_set(
+            bucket, self._EXT_POLICY, json.dumps(doc, separators=(",", ":"))
+        )
+        return Response(b"", 204)
+
+    def _delete_bucket_policy(self, bucket: str) -> Response:
+        return self._delete_bucket_ext(bucket, "policy", 204)
+
+    # CORS (`s3api_server.go` cors wrapper; AWS CORSConfiguration semantics)
+    def _parse_cors_rules(self, xml_text: str) -> list[dict]:
+        try:
+            root = ET.fromstring(xml_text)
+        except ET.ParseError:
+            raise err("MalformedXML", "bad CORSConfiguration")
+        rules = []
+        for rule_el in root.iter():
+            if not (rule_el.tag == "CORSRule" or rule_el.tag.endswith("}CORSRule")):
+                continue
+            rule: dict = {"origins": [], "methods": [], "headers": [],
+                          "expose": [], "max_age": None}
+            for c in rule_el:
+                tag = c.tag.rsplit("}", 1)[-1]
+                text = (c.text or "").strip()
+                if tag == "AllowedOrigin":
+                    rule["origins"].append(text)
+                elif tag == "AllowedMethod":
+                    rule["methods"].append(text.upper())
+                elif tag == "AllowedHeader":
+                    rule["headers"].append(text)
+                elif tag == "ExposeHeader":
+                    rule["expose"].append(text)
+                elif tag == "MaxAgeSeconds":
+                    rule["max_age"] = int(text or 0)
+            if rule["origins"] and rule["methods"]:
+                rules.append(rule)
+        if not rules:
+            raise err("MalformedXML", "CORSConfiguration has no valid rules")
+        return rules
+
+    def _cors_rules(self, bucket: str) -> list[dict]:
+        try:
+            raw = self._bucket_ext_get(bucket, self._EXT_CORS)
+        except S3ApiError:
+            return []
+        if not raw:
+            return []
+        try:
+            return self._parse_cors_rules(raw)
+        except S3ApiError:  # pragma: no cover - validated at put time
+            return []
+
+    @staticmethod
+    def _match_cors_rule(rules: list[dict], origin: str, method: str,
+                         req_headers: list[str]) -> dict | None:
+        from .policy import _wild_match
+
+        for rule in rules:
+            if not any(_wild_match(o, origin) for o in rule["origins"]):
+                continue
+            if method not in rule["methods"]:
+                continue
+            if req_headers and not all(
+                any(_wild_match(h.lower(), want.lower())
+                    for h in rule["headers"])
+                for want in req_headers
+            ):
+                continue
+            return rule
+        return None
+
+    def _get_bucket_cors(self, bucket: str) -> Response:
+        raw = self._bucket_ext_get(bucket, self._EXT_CORS)
+        if not raw:
+            raise err("NoSuchCORSConfiguration", bucket)
+        return Response(raw.encode(), 200, {"Content-Type": "application/xml"})
+
+    def _put_bucket_cors(self, bucket: str, body: bytes) -> Response:
+        self._require_bucket(bucket)
+        self._parse_cors_rules(body.decode("utf-8", "replace"))  # validate
+        self._bucket_ext_set(bucket, self._EXT_CORS,
+                             body.decode("utf-8", "replace"))
+        return Response(b"", 200)
+
+    def _preflight(self, req: Request, bucket: str) -> Response:
+        origin = req.headers.get("origin", "")
+        method = req.headers.get("access-control-request-method", "")
+        want_headers = [
+            h.strip()
+            for h in req.headers.get("access-control-request-headers", "").split(",")
+            if h.strip()
+        ]
+        rule = self._match_cors_rule(
+            self._cors_rules(bucket), origin, method, want_headers
+        )
+        if origin == "" or method == "" or rule is None:
+            return Response(b"", 403)
+        headers = {
+            "Access-Control-Allow-Origin":
+                "*" if rule["origins"] == ["*"] else origin,
+            "Access-Control-Allow-Methods": ", ".join(rule["methods"]),
+            "Vary": "Origin, Access-Control-Request-Headers",
+        }
+        allow_headers = want_headers or rule["headers"]
+        if allow_headers:
+            headers["Access-Control-Allow-Headers"] = ", ".join(allow_headers)
+        if rule["expose"]:
+            headers["Access-Control-Expose-Headers"] = ", ".join(rule["expose"])
+        if rule["max_age"] is not None:
+            headers["Access-Control-Max-Age"] = str(rule["max_age"])
+        return Response(b"", 200, headers)
+
+    def _apply_cors_headers(self, req: Request, bucket: str, resp: Response) -> None:
+        origin = req.headers.get("origin", "")
+        if not origin:
+            return
+        rule = self._match_cors_rule(
+            self._cors_rules(bucket), origin, req.method, []
+        )
+        if rule is None:
+            return
+        resp.headers.setdefault(
+            "Access-Control-Allow-Origin",
+            "*" if rule["origins"] == ["*"] else origin,
+        )
+        if rule["expose"]:
+            resp.headers.setdefault(
+                "Access-Control-Expose-Headers", ", ".join(rule["expose"])
+            )
+        resp.headers.setdefault("Vary", "Origin")
+
+    # lifecycle (`s3api_bucket_handlers.go:308-435`; expiry applied here by
+    # an explicit sweep over the namespace rather than collection TTLs)
+    def _get_bucket_lifecycle(self, bucket: str) -> Response:
+        raw = self._bucket_ext_get(bucket, self._EXT_LIFECYCLE)
+        if not raw:
+            raise err("NoSuchLifecycleConfiguration", bucket)
+        return Response(raw.encode(), 200, {"Content-Type": "application/xml"})
+
+    def _parse_lifecycle_rules(self, xml_text: str) -> list[dict]:
+        try:
+            root = ET.fromstring(xml_text)
+        except ET.ParseError:
+            raise err("MalformedXML", "bad LifecycleConfiguration")
+        rules = []
+        for rule_el in root.iter():
+            if not (rule_el.tag == "Rule" or rule_el.tag.endswith("}Rule")):
+                continue
+            status = ""
+            prefix = ""
+            days = 0
+            for c in rule_el.iter():
+                tag = c.tag.rsplit("}", 1)[-1]
+                text = (c.text or "").strip()
+                if tag == "Status":
+                    status = text
+                elif tag == "Prefix" and text:
+                    prefix = text
+                elif tag == "Days" and text:
+                    days = int(text)
+            if status == "Enabled" and days > 0:
+                rules.append({"prefix": prefix, "days": days})
+        return rules
+
+    def _put_bucket_lifecycle(self, bucket: str, body: bytes) -> Response:
+        self._require_bucket(bucket)
+        text = body.decode("utf-8", "replace")
+        if not self._parse_lifecycle_rules(text):
+            raise err(
+                "MalformedXML",
+                "no Enabled rule with Expiration Days found",
+            )
+        self._bucket_ext_set(bucket, self._EXT_LIFECYCLE, text)
+        return Response(b"", 200)
+
+    def run_lifecycle_sweep(self, now: float | None = None) -> dict:
+        """Apply every bucket's lifecycle expiry rules: delete objects whose
+        mtime is older than the rule's Days (prefix-filtered). Returns
+        {bucket: expired_count}. Driven by the background sweeper thread or
+        the `s3.lifecycle.apply` shell verb."""
+        now = now or time.time()
+        out: dict[str, int] = {}
+        listing = self.fc.list(BUCKETS_DIR, limit=10_000)
+        for e in listing.get("Entries", []):
+            if not e.get("IsDirectory"):
+                continue
+            bucket = e["FullPath"].rsplit("/", 1)[-1]
+            if bucket.startswith("."):
+                continue
+            raw = self._bucket_ext_get(bucket, self._EXT_LIFECYCLE)
+            if not raw:
+                continue
+            try:
+                rules = self._parse_lifecycle_rules(raw)
+            except S3ApiError:
+                continue
+            vstate = self._versioning_state(bucket)
+            expired = 0
+            for rule in rules:
+                cutoff = now - rule["days"] * 86400
+                expired += self._expire_prefix(
+                    bucket, rule["prefix"], cutoff, vstate
+                )
+            if expired:
+                out[bucket] = expired
+        return out
+
+    def _expire_prefix(
+        self, bucket: str, prefix: str, cutoff: float, vstate: str = ""
+    ) -> int:
+        removed = 0
+        base = self._bucket_path(bucket)
+
+        def walk(dir_path: str, rel: str) -> None:
+            nonlocal removed
+            listing = self.fc.list(dir_path, limit=100_000)
+            for e in listing.get("Entries", []):
+                name = e["FullPath"].rsplit("/", 1)[-1]
+                if name in (UPLOADS_FOLDER, VERSIONS_FOLDER):
+                    continue
+                rel_key = f"{rel}{name}"
+                if e.get("IsDirectory"):
+                    walk(e["FullPath"], rel_key + "/")
+                    continue
+                if not rel_key.startswith(prefix):
+                    continue
+                if e.get("Mtime", 0) < cutoff:
+                    try:
+                        # expiry on a versioned bucket leaves a delete
+                        # marker (AWS lifecycle semantics), not destruction
+                        self._versioned_delete(bucket, rel_key, vstate)
+                        removed += 1
+                    except IOError:
+                        pass
+
+        walk(base, "")
+        return removed
 
     def _canned_acl(self, ident) -> Response:
         owner = (
@@ -347,6 +791,144 @@ class S3Server:
             "<Permission>FULL_CONTROL</Permission></Grant></AccessControlList>"
         )
         return xml_response("AccessControlPolicy", owner)
+
+    def _post_policy_upload(self, req: Request, bucket: str) -> Response:
+        """POST object via browser form (sigv4-HTTPPOSTConstructPolicy):
+        verify the form's signature over its base64 policy, enforce every
+        policy condition, then store under the form's key."""
+        import base64
+        import hmac as hmac_mod
+
+        from .auth import signing_key
+
+        self._require_bucket(bucket)
+        fields, file_part = req.multipart_form()
+        if file_part is None:
+            raise err("MalformedPOSTRequest", "form has no file part")
+        filename, file_ctype, data = file_part
+        fields_ci = {k.lower(): v for k, v in fields.items()}
+        key = fields_ci.get("key", "")
+        if not key:
+            raise err("MalformedPOSTRequest", "form has no key field")
+        key = key.replace("${filename}", filename)
+
+        policy_b64 = fields_ci.get("policy", "")
+        if not policy_b64:
+            raise err("AccessDenied", "POST without policy is not allowed")
+        if fields_ci.get("x-amz-algorithm") != "AWS4-HMAC-SHA256":
+            raise err("MalformedPOSTRequest", "unsupported x-amz-algorithm")
+        cred = fields_ci.get("x-amz-credential", "")
+        parts = cred.split("/")
+        if len(parts) != 5 or parts[3] != "s3" or parts[4] != "aws4_request":
+            raise err("MalformedPOSTRequest", f"bad credential {cred!r}")
+        akid, date, region = parts[0], parts[1], parts[2]
+        ident, secret = self.iam.lookup(akid)
+        want = hmac_mod.new(
+            signing_key(secret, date, region, "s3"),
+            policy_b64.encode(),
+            hashlib.sha256,
+        ).hexdigest()
+        if not hmac_mod.compare_digest(
+            want, fields_ci.get("x-amz-signature", "")
+        ):
+            raise err("SignatureDoesNotMatch", "post policy signature")
+        try:
+            doc = json.loads(base64.b64decode(policy_b64))
+            bucket_policy.check_post_policy(
+                doc, {**fields_ci, "bucket": bucket, "key": key}, len(data)
+            )
+        except ValueError as e:
+            raise err("AccessDenied", f"policy check failed: {e}")
+        if not ident.can_do(ACTION_WRITE, bucket, key):
+            raise err("AccessDenied", f"{ident.name} cannot Write /{bucket}/{key}")
+
+        ctype = fields_ci.get("content-type", file_ctype)
+        self.fc.put(self._object_path(bucket, key), data, ctype)
+        etag = hashlib.md5(data).hexdigest()
+        status = int(fields_ci.get("success_action_status", "204") or 204)
+        headers = {"ETag": f'"{etag}"', "Location": f"/{bucket}/{key}"}
+        if status == 201:
+            inner = (
+                f"<Location>/{escape(bucket)}/{escape(key)}</Location>"
+                f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+                f'<ETag>"{etag}"</ETag>'
+            )
+            resp = xml_response("PostResponse", inner, 201)
+            resp.headers.update(headers)
+            return resp
+        if status not in (200, 204):
+            status = 204
+        return Response(b"", status, headers)
+
+    # --- versioning (`s3api_object_handlers_put.go` versioning flags; real
+    # version retention rather than the reference's pass-through) ------------
+    _EXT_VERSIONING = "s3-versioning"
+    _EXT_VID = "s3-vid"
+    _EXT_DELETE_MARKER = "s3-delete-marker"
+
+    def _versioning_state(self, bucket: str) -> str:
+        try:
+            return self._bucket_ext_get(bucket, self._EXT_VERSIONING) or ""
+        except S3ApiError:
+            return ""
+
+    def _get_bucket_versioning(self, bucket: str) -> Response:
+        self._require_bucket(bucket)
+        state = self._versioning_state(bucket)
+        inner = f"<Status>{state}</Status>" if state else ""
+        return xml_response("VersioningConfiguration", inner)
+
+    def _put_bucket_versioning(self, bucket: str, body: bytes) -> Response:
+        self._require_bucket(bucket)
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise err("MalformedXML", "bad VersioningConfiguration")
+        status = ""
+        for el in root.iter():
+            if el.tag.rsplit("}", 1)[-1] == "Status":
+                status = (el.text or "").strip()
+        if status not in ("Enabled", "Suspended"):
+            raise err("MalformedXML", "Status must be Enabled or Suspended")
+        self._bucket_ext_set(bucket, self._EXT_VERSIONING, status)
+        return Response(b"", 200)
+
+    @staticmethod
+    def _new_version_id() -> str:
+        return f"{time.time_ns():020d}.{uuid.uuid4().hex[:8]}"
+
+    def _versions_dir(self, bucket: str, key: str) -> str:
+        return f"{self._bucket_path(bucket)}/{VERSIONS_FOLDER}/{key}"
+
+    def _entry_vid(self, entry: dict | None) -> str:
+        if not entry:
+            return ""
+        return (entry.get("extended") or {}).get(self._EXT_VID, "null")
+
+    def _retire_current_version(
+        self, bucket: str, key: str, only_real_vid: bool = False
+    ) -> None:
+        """Move the current object into the versions folder under its own
+        version id (chunks move with the entry — no data copy).
+        only_real_vid: leave a "null"-version current in place (Suspended
+        semantics: the null version is the one that gets overwritten)."""
+        path = self._object_path(bucket, key)
+        cur = self.fc.get_entry(path)
+        if cur is None or cur.get("is_directory"):
+            return
+        vid = self._entry_vid(cur)
+        if only_real_vid and vid == "null":
+            return
+        try:
+            self.fc.rename(path, f"{self._versions_dir(bucket, key)}/{vid}")
+        except IOError:
+            pass
+
+    def _stamp_vid(self, path: str, vid: str) -> None:
+        entry = self.fc.get_entry(path)
+        if entry is not None:
+            entry.setdefault("extended", {})[self._EXT_VID] = vid
+            self.fc.put_entry(path, entry)
 
     # --- object handlers --------------------------------------------------------
     def _put_object(self, req: Request, bucket: str, key: str) -> Response:
@@ -360,7 +942,20 @@ class S3Server:
             return Response(b"", 200, {"ETag": '"d41d8cd98f00b204e9800998ecf8427e"'})
         etag = hashlib.md5(body).hexdigest()
         content_type = req.headers.get("Content-Type", "")
+        vstate = self._versioning_state(bucket)
+        vid = ""
+        if vstate == "Enabled":
+            self._retire_current_version(bucket, key)
+            vid = self._new_version_id()
+        elif vstate == "Suspended":
+            # AWS: suspension only stops MINTING ids — versions written
+            # while enabled stay retained; only the "null" version is
+            # overwritten in place
+            self._retire_current_version(bucket, key, only_real_vid=True)
+            vid = "null"
         self.fc.put(self._object_path(bucket, key), body, content_type)
+        if vid:
+            self._stamp_vid(self._object_path(bucket, key), vid)
         # x-amz-meta-* headers persist as extended attributes
         meta = {
             k.lower()[len(AMZ_META_PREFIX):]: v
@@ -375,7 +970,10 @@ class S3Server:
                     {f"{AMZ_META_PREFIX}{k}": v for k, v in meta.items()}
                 )
                 self.fc.put_entry(path, entry)
-        return Response(b"", 200, {"ETag": f'"{etag}"'})
+        headers = {"ETag": f'"{etag}"'}
+        if vid:
+            headers["x-amz-version-id"] = vid
+        return Response(b"", 200, headers)
 
     def _copy_object(self, req: Request, bucket: str, key: str) -> Response:
         self._require_bucket(bucket)
@@ -400,10 +998,11 @@ class S3Server:
         return xml_response("CopyObjectResult", inner)
 
     def _get_object(
-        self, req: Request, bucket: str, key: str, head: bool
+        self, req: Request, bucket: str, key: str, head: bool,
+        path_override: str | None = None,
     ) -> Response:
         self._require_bucket(bucket)
-        path = self._object_path(bucket, key)
+        path = path_override or self._object_path(bucket, key)
         entry = self.fc.get_entry(path)
         if entry is None or entry.get("is_directory"):
             raise err("NoSuchKey", key)
@@ -435,10 +1034,215 @@ class S3Server:
             headers["Content-Range"] = fh["Content-Range"]
         return Response(body, status, headers)
 
+    def _versioned_delete(self, bucket: str, key: str, vstate: str) -> dict:
+        """Versioning-aware delete shared by DELETE, batch delete and the
+        lifecycle sweep; returns the response headers. Enabled: retire the
+        current version, leave a delete marker. Suspended: real-vid current
+        versions are still retained; the null version dies and a null
+        marker takes its place. Off: plain destructive delete."""
+        if vstate not in ("Enabled", "Suspended"):
+            self.fc.delete(self._object_path(bucket, key), recursive=True)
+            return {}
+        if vstate == "Enabled":
+            self._retire_current_version(bucket, key)
+            vid = self._new_version_id()
+        else:
+            self._retire_current_version(bucket, key, only_real_vid=True)
+            try:
+                self.fc.delete(self._object_path(bucket, key))
+            except IOError:
+                pass
+            vid = "null"
+        marker_path = f"{self._versions_dir(bucket, key)}/{vid}"
+        self.fc.put(marker_path, b"", "")
+        entry = self.fc.get_entry(marker_path)
+        if entry is not None:
+            entry.setdefault("extended", {}).update(
+                {self._EXT_VID: vid, self._EXT_DELETE_MARKER: "1"}
+            )
+            self.fc.put_entry(marker_path, entry)
+        return {"x-amz-delete-marker": "true", "x-amz-version-id": vid}
+
     def _delete_object(self, bucket: str, key: str) -> Response:
         self._require_bucket(bucket)
+        vstate = self._versioning_state(bucket)
+        if vstate in ("Enabled", "Suspended"):
+            return Response(b"", 204, self._versioned_delete(bucket, key, vstate))
         self.fc.delete(self._object_path(bucket, key), recursive=True)
         return Response(b"", 204)
+
+    def _iter_versions(self, bucket: str, key: str) -> list[dict]:
+        """All retired versions of one key, newest first (version ids are
+        time-ordered)."""
+        try:
+            listing = self.fc.list(
+                self._versions_dir(bucket, key), limit=10_000
+            )
+        except IOError:
+            return []  # key has no retained versions
+        out = [
+            e for e in listing.get("Entries", [])
+            if not e.get("IsDirectory")
+        ]
+        # newest first; the "null" (pre-versioning) id is always oldest
+        out.sort(
+            key=lambda e: (
+                "" if (n := e["FullPath"].rsplit("/", 1)[-1]) == "null" else n
+            ),
+            reverse=True,
+        )
+        return out
+
+    def _get_object_version(
+        self, req: Request, bucket: str, key: str, vid: str, head: bool
+    ) -> Response:
+        self._require_bucket(bucket)
+        cur = self.fc.get_entry(self._object_path(bucket, key))
+        if cur is not None and self._entry_vid(cur) == vid:
+            return self._get_object(req, bucket, key, head=head)
+        path = f"{self._versions_dir(bucket, key)}/{vid}"
+        entry = self.fc.get_entry(path)
+        if entry is None:
+            raise err("NoSuchKey", f"{key}?versionId={vid}")
+        if (entry.get("extended") or {}).get(self._EXT_DELETE_MARKER):
+            return Response(
+                b"", 405,
+                {"x-amz-delete-marker": "true", "x-amz-version-id": vid,
+                 "Allow": "DELETE"},
+            )
+        resp = self._get_object(
+            req, bucket, key, head=head, path_override=path
+        )
+        resp.headers["x-amz-version-id"] = vid
+        return resp
+
+    def _delete_object_version(self, bucket: str, key: str, vid: str) -> Response:
+        """Permanent removal of one version; the next-newest non-marker
+        version is promoted back to the current path when the current slot
+        is empty (AWS: the latest remaining version becomes current)."""
+        self._require_bucket(bucket)
+        cur_path = self._object_path(bucket, key)
+        cur = self.fc.get_entry(cur_path)
+        marker = False
+        if cur is not None and self._entry_vid(cur) == vid:
+            self.fc.delete(cur_path)
+        else:
+            path = f"{self._versions_dir(bucket, key)}/{vid}"
+            entry = self.fc.get_entry(path)
+            if entry is None:
+                return Response(b"", 204)
+            marker = bool(
+                (entry.get("extended") or {}).get(self._EXT_DELETE_MARKER)
+            )
+            self.fc.delete(path)
+        # promote: only when no live current remains and the newest
+        # remaining version is a real object (not a delete marker)
+        if self.fc.get_entry(cur_path) is None:
+            for v in self._iter_versions(bucket, key):
+                entry = self.fc.get_entry(v["FullPath"])
+                vext = (entry or {}).get("extended") or {}
+                if vext.get(self._EXT_DELETE_MARKER):
+                    break  # a marker is the latest: stay deleted
+                try:
+                    self.fc.rename(v["FullPath"], cur_path)
+                except IOError:
+                    pass
+                break
+        headers = {"x-amz-version-id": vid}
+        if marker:
+            headers["x-amz-delete-marker"] = "true"
+        return Response(b"", 204, headers)
+
+    def _list_object_versions(self, bucket: str, q: dict) -> Response:
+        """GET ?versions — Version + DeleteMarker elements, newest first per
+        key, current object marked IsLatest."""
+        self._require_bucket(bucket)
+        prefix = q.get("prefix", "")
+        key_marker = q.get("key-marker", "")
+        max_keys = min(int(q.get("max-keys", "1000") or 1000), 1000)
+        inner = [
+            f"<Name>{escape(bucket)}</Name>",
+            f"<Prefix>{escape(prefix)}</Prefix>",
+            f"<KeyMarker>{escape(key_marker)}</KeyMarker>",
+            f"<MaxKeys>{max_keys}</MaxKeys>",
+        ]
+
+        def emit(key: str, entry: dict, is_latest: bool) -> None:
+            ext = entry.get("extended") or {}
+            vid = ext.get(self._EXT_VID, "null")
+            mtime = entry.get("attributes", {}).get("mtime", 0)
+            if ext.get(self._EXT_DELETE_MARKER):
+                inner.append(
+                    f"<DeleteMarker><Key>{escape(key)}</Key>"
+                    f"<VersionId>{vid}</VersionId>"
+                    f"<IsLatest>{'true' if is_latest else 'false'}</IsLatest>"
+                    f"<LastModified>{amz_time(mtime)}</LastModified>"
+                    f"</DeleteMarker>"
+                )
+            else:
+                size = entry.get("attributes", {}).get("file_size", 0)
+                inner.append(
+                    f"<Version><Key>{escape(key)}</Key>"
+                    f"<VersionId>{vid}</VersionId>"
+                    f"<IsLatest>{'true' if is_latest else 'false'}</IsLatest>"
+                    f"<LastModified>{amz_time(mtime)}</LastModified>"
+                    f"<Size>{size}</Size></Version>"
+                )
+
+        # keys with retained versions, discovered from the versions tree
+        vroot = f"{self._bucket_path(bucket)}/{VERSIONS_FOLDER}"
+        keys: set[str] = set()
+
+        def walk(dir_path: str, rel: str) -> None:
+            listing = self.fc.list(dir_path, limit=100_000)
+            entries = listing.get("Entries", [])
+            if entries and all(not e.get("IsDirectory") for e in entries):
+                keys.add(rel.rstrip("/"))
+                return
+            for e in entries:
+                name = e["FullPath"].rsplit("/", 1)[-1]
+                if e.get("IsDirectory"):
+                    walk(e["FullPath"], rel + name + "/")
+                else:
+                    keys.add(rel.rstrip("/"))
+
+        if self.fc.exists(vroot):
+            walk(vroot, "")
+        # current objects too (they may have no retired versions yet)
+        marker = ""
+        while True:
+            contents, _, truncated, marker = self._walk(
+                bucket, prefix, "", marker, 1000
+            )
+            for item in contents:
+                keys.add(item["key"])
+            if not truncated or not contents:
+                break
+        selected = sorted(
+            k for k in keys
+            if k.startswith(prefix) and (not key_marker or k > key_marker)
+        )
+        truncated = len(selected) > max_keys
+        for key in selected[:max_keys]:
+            cur = self.fc.get_entry(self._object_path(bucket, key))
+            emitted_latest = False
+            if cur is not None and not cur.get("is_directory"):
+                emit(key, cur, True)
+                emitted_latest = True
+            for v in self._iter_versions(bucket, key):
+                entry = self.fc.get_entry(v["FullPath"])
+                if entry is not None:
+                    emit(key, entry, not emitted_latest)
+                    emitted_latest = True
+        inner.append(
+            f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
+        )
+        if truncated:
+            inner.append(
+                f"<NextKeyMarker>{escape(selected[max_keys - 1])}"
+                f"</NextKeyMarker>"
+            )
+        return xml_response("ListVersionsResult", "".join(inner))
 
     def _delete_objects(self, req: Request, bucket: str) -> Response:
         self._require_bucket(bucket)
@@ -447,6 +1251,7 @@ class S3Server:
         except ET.ParseError:
             raise err("MalformedXML", "bad Delete document")
         deleted, errors = [], []
+        vstate = self._versioning_state(bucket)
         for obj in root.iter():
             if not obj.tag.endswith("Object"):
                 continue
@@ -457,7 +1262,9 @@ class S3Server:
                 continue
             k = key_el.text
             try:
-                self.fc.delete(self._object_path(bucket, k), recursive=True)
+                # same semantics as single-object DELETE: a versioned
+                # bucket gets markers, not destruction
+                self._versioned_delete(bucket, k, vstate)
                 deleted.append(k)
             except Exception as e:
                 errors.append((k, str(e)))
@@ -551,7 +1358,7 @@ class S3Server:
             for e in sorted(entries, key=eff_key):
                 name = e["FullPath"].rsplit("/", 1)[-1]
                 rel = dir_rel + name
-                if not dir_rel and name == UPLOADS_FOLDER:
+                if not dir_rel and name in (UPLOADS_FOLDER, VERSIONS_FOLDER):
                     continue
                 if e.get("IsDirectory"):
                     sub = rel + "/"

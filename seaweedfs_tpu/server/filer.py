@@ -704,7 +704,8 @@ class FilerServer:
             try:
                 entry = Entry.from_dict(req.json())
                 entry.full_path = path
-                self.filer.create_entry(entry, signatures=signatures)
+                freed = self.filer.create_entry(entry, signatures=signatures)
+                self._reclaim_chunks(freed)
             except (FilerError, KeyError, ValueError) as e:
                 return Response({"error": str(e)}, 409)
             return Response({"name": entry.name}, 201)

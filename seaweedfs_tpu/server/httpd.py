@@ -67,6 +67,39 @@ class Request:
         return None
 
 
+    def multipart_form(self) -> tuple[dict, tuple[str, str, bytes] | None]:
+        """Parse a multipart/form-data body -> ({field: value}, file_part)
+        where file_part is (filename, content_type, data) for the part named
+        "file" (or any part carrying a filename). Browser-POST uploads
+        (S3 post-policy) arrive this way."""
+        ctype = self.headers.get("Content-Type", "")
+        m = re.search(r'boundary="?([^";]+)"?', ctype)
+        fields: dict = {}
+        if "multipart/form-data" not in ctype or not m:
+            return fields, None
+        boundary = m.group(1).encode()
+        file_part = None
+        for part in self.body.split(b"--" + boundary):
+            if b"\r\n\r\n" not in part:
+                continue
+            head, _, data = part.partition(b"\r\n\r\n")
+            if data.endswith(b"\r\n"):
+                data = data[:-2]
+            head_s = head.decode("utf-8", "replace")
+            nm = re.search(r'name="([^"]*)"', head_s)
+            if nm is None:
+                continue
+            fm = re.search(r'filename="([^"]*)"', head_s)
+            if fm is not None:
+                cm = re.search(r"Content-Type:\s*([^\r\n]+)", head_s, re.I)
+                file_part = (
+                    fm.group(1), (cm.group(1).strip() if cm else ""), data
+                )
+            else:
+                fields[nm.group(1)] = data.decode("utf-8", "replace")
+        return fields, file_part
+
+
 class Response:
     def __init__(
         self,

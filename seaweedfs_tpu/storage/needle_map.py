@@ -156,6 +156,12 @@ class CompactNeedleMap:
     _HOLE = 0
 
     def __init__(self, idx_path: str | None = None) -> None:
+        import threading
+
+        # readers (Volume.read_needle, fsck visits) run concurrently with
+        # writers; _merge() reallocates all three arrays, so unlike the
+        # GIL-atomic dict map every access must hold the lock
+        self._mu = threading.RLock()
         self._keys = np.empty(0, dtype=np.uint64)
         self._offs = np.empty(0, dtype=_OFF_DTYPE)  # 8-byte units
         self._sizes = np.empty(0, dtype=np.int32)
@@ -250,25 +256,27 @@ class CompactNeedleMap:
 
     # --- public API (same shape as NeedleMap) -------------------------------
     def get(self, key: int) -> tuple[int, int] | None:
-        v = self._overflow.get(key)
-        if v is not None:
-            return (v[0] * 8, v[1])
-        i = self._sorted_slot(key)
-        if i >= 0 and int(self._sizes[i]) != self._HOLE:
-            return (int(self._offs[i]) * 8, int(self._sizes[i]))
-        return None
+        with self._mu:
+            v = self._overflow.get(key)
+            if v is not None:
+                return (v[0] * 8, v[1])
+            i = self._sorted_slot(key)
+            if i >= 0 and int(self._sizes[i]) != self._HOLE:
+                return (int(self._offs[i]) * 8, int(self._sizes[i]))
+            return None
 
     def put(self, key: int, offset: int, size: int) -> None:
-        self.metrics.maximum_key = max(self.metrics.maximum_key, key)
-        if offset > 0 and size_is_valid(size):
-            if not self._set_live(key, offset, size):
-                self.metrics.file_count += 1
-                self._live += 1
-        else:
-            self._delete_state(key)
-        if self._idx_file is not None:
-            self._idx_file.write(idx_mod.entry_to_bytes(key, offset, size))
-            self._idx_file.flush()
+        with self._mu:
+            self.metrics.maximum_key = max(self.metrics.maximum_key, key)
+            if offset > 0 and size_is_valid(size):
+                if not self._set_live(key, offset, size):
+                    self.metrics.file_count += 1
+                    self._live += 1
+            else:
+                self._delete_state(key)
+            if self._idx_file is not None:
+                self._idx_file.write(idx_mod.entry_to_bytes(key, offset, size))
+                self._idx_file.flush()
 
     def _delete_state(self, key: int) -> None:
         old = self._overflow.pop(key, None)
@@ -285,20 +293,25 @@ class CompactNeedleMap:
             self._live -= 1
 
     def delete(self, key: int, tombstone_offset: int = 0) -> None:
-        self.metrics.maximum_key = max(self.metrics.maximum_key, key)
-        self._delete_state(key)
-        if self._idx_file is not None:
-            self._idx_file.write(
-                idx_mod.entry_to_bytes(key, tombstone_offset, TOMBSTONE_FILE_SIZE)
-            )
-            self._idx_file.flush()
+        with self._mu:
+            self.metrics.maximum_key = max(self.metrics.maximum_key, key)
+            self._delete_state(key)
+            if self._idx_file is not None:
+                self._idx_file.write(
+                    idx_mod.entry_to_bytes(
+                        key, tombstone_offset, TOMBSTONE_FILE_SIZE
+                    )
+                )
+                self._idx_file.flush()
 
     def ascending_visit(self):
-        self._merge()
-        live = self._sizes != self._HOLE
-        for key, off_u, size in zip(
-            self._keys[live], self._offs[live], self._sizes[live]
-        ):
+        with self._mu:
+            self._merge()
+            live = self._sizes != self._HOLE
+            keys = self._keys[live].copy()
+            offs = self._offs[live].copy()
+            sizes = self._sizes[live].copy()
+        for key, off_u, size in zip(keys, offs, sizes):
             yield int(key), int(off_u) * 8, int(size)
 
     def __len__(self) -> int:
@@ -308,8 +321,11 @@ class CompactNeedleMap:
         return self.get(key) is not None
 
     def content_size(self) -> int:
-        block = int(np.maximum(self._sizes, 0).sum()) if self._sizes.size else 0
-        return block + sum(s for _, s in self._overflow.values())
+        with self._mu:
+            block = (
+                int(np.maximum(self._sizes, 0).sum()) if self._sizes.size else 0
+            )
+            return block + sum(s for _, s in self._overflow.values())
 
     def bytes_per_needle(self) -> float:
         """Resident index bytes per live needle (the CompactMap design
