@@ -20,6 +20,12 @@ expires_after_seconds = 60
 
 [guard]
 white_list = []               # e.g. ["127.0.0.1", "10.0.0.0/8"]
+
+[tls]                         # mutual TLS for every listener + client
+ca = ""                       # e.g. "/etc/seaweedfs/ca.pem"; empty = plain HTTP
+cert = ""                     # this node's certificate (signed by ca)
+key = ""                      # this node's private key
+allowed_commonNames = ""      # e.g. "master1,volume*"; "" = any CA-signed cert
 ''',
     "filer": '''\
 # filer.toml — filer metadata store

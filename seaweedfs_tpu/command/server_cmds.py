@@ -8,6 +8,19 @@ import argparse
 import signal
 import threading
 
+from seaweedfs_tpu.server.httpd import peer_url
+
+
+def _load_security():
+    """security.toml discovery once per process: JWT keys + IP guard for
+    the servers, and the [tls] section installed process-wide (mTLS on
+    every listener and outbound client, `weed/security/tls.go`)."""
+    from seaweedfs_tpu.security import load_security_config
+
+    cfg = load_security_config()
+    cfg.apply_tls()
+    return cfg
+
 
 def _wait_forever() -> int:
     stop = threading.Event()
@@ -35,6 +48,7 @@ def run_master(args: list[str]) -> int:
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.master import MasterServer
 
+    sec = _load_security()
     m = MasterServer(
         host=opts.ip,
         port=opts.port,
@@ -43,7 +57,8 @@ def run_master(args: list[str]) -> int:
         default_replication=opts.defaultReplication,
         meta_dir=opts.mdir,
         garbage_threshold=opts.garbageThreshold,
-        peers=[u if u.startswith("http") else f"http://{u}"
+        security=sec,
+        peers=[peer_url(u)
                for u in opts.peers.split(",") if u],
         raft_dir=opts.mdir,
     )
@@ -66,9 +81,11 @@ def run_volume(args: list[str]) -> int:
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.volume import VolumeServer
 
+    sec = _load_security()
     vs = VolumeServer(
         opts.dir.split(","),
         opts.mserver,
+        security=sec,
         host=opts.ip,
         port=opts.port,
         public_url=opts.publicUrl,
@@ -111,6 +128,7 @@ def run_filer(args: list[str]) -> int:
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.filer import FilerServer
 
+    sec = _load_security()
     queue = None
     if opts.notification_spool:
         from seaweedfs_tpu.notification import FileQueue
@@ -130,9 +148,10 @@ def run_filer(args: list[str]) -> int:
         compress=opts.compressData == "true",
         chunk_cache_dir=opts.chunkCacheDir,
         notification_queue=queue,
-        peers=[u if u.startswith("http") else f"http://{u}"
+        peers=[peer_url(u)
                for u in opts.peers.split(",") if u],
         dedup=opts.dedup,
+        security=sec,
     )
     f.start()
     print(f"filer listening at {f.url}")
@@ -168,16 +187,19 @@ def run_server(args: list[str]) -> int:
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume import VolumeServer
 
+    sec = _load_security()
     m = MasterServer(
         host=opts.ip,
         port=opts.master_port,
         volume_size_limit_mb=opts.volumeSizeLimitMB,
         default_replication=opts.defaultReplication,
+        security=sec,
     )
     m.start()
     print(f"master listening at {m.url}")
     vs = VolumeServer(
-        opts.dir.split(","), m.url, host=opts.ip, port=opts.volume_port
+        opts.dir.split(","), m.url, host=opts.ip, port=opts.volume_port,
+        security=sec,
     )
     vs.start()
     print(f"volume server listening at {vs.url}")
@@ -193,6 +215,7 @@ def run_server(args: list[str]) -> int:
             cipher=opts.filer_cipher,
             compress=opts.filer_compress == "true",
             dedup=opts.filer_dedup,
+            security=sec,
         )
         f.start()
         print(f"filer listening at {f.url}")
@@ -291,7 +314,7 @@ def run_mq_broker(args: list[str]) -> int:
         filer = f"http://{filer}"
     srv = BrokerServer(
         filer, master_url=opts.master, host=opts.ip, port=opts.port,
-        peers=[u if u.startswith("http") else f"http://{u}"
+        peers=[peer_url(u)
                for u in opts.peers.split(",") if u],
     )
     srv.start()

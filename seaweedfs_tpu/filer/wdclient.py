@@ -12,7 +12,7 @@ import random
 import threading
 import time
 
-from seaweedfs_tpu.server.httpd import get_json, http_request
+from seaweedfs_tpu.server.httpd import get_json, http_request, peer_url
 
 
 class WeedClient:
@@ -22,7 +22,7 @@ class WeedClient:
         # comma-separated master list; requests follow raft leader hints
         # (`wdclient/masterclient.go` leader failover)
         self.masters = [
-            (u if u.startswith("http") else f"http://{u}").rstrip("/")
+            peer_url(u).rstrip("/")
             for u in master_url.split(",") if u
         ]
         self.master_url = self.masters[0]
@@ -98,7 +98,7 @@ class WeedClient:
 
     def lookup_file_id(self, file_id: str) -> list[str]:
         vid = int(file_id.split(",")[0])
-        return [f"http://{u}/{file_id}" for u in self.lookup(vid)]
+        return [f"{peer_url(u)}/{file_id}" for u in self.lookup(vid)]
 
     def invalidate(self, vid: int) -> None:
         with self._lock:
@@ -147,7 +147,7 @@ class WeedClient:
             headers["Content-Type"] = mime
         if auth:
             headers["Authorization"] = f"BEARER {auth}"
-        url = f"http://{location}/{fid}"
+        url = f"{peer_url(location)}/{fid}"
         if ttl:
             url += f"?ttl={ttl}"
         status, _, body = http_request("POST", url, data, headers)

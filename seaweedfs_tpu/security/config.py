@@ -13,6 +13,12 @@ Schema subset:
 
     [guard]
     white_list = ["127.0.0.1", "10.0.0.0/8"]
+
+    [tls]                # mutual TLS on every listener + outbound client
+    ca = "ca.pem"
+    cert = "server.pem"
+    key = "server.key"
+    allowed_commonNames = "master1,volume*"   # "" = any cert the CA signed
 """
 
 from __future__ import annotations
@@ -28,10 +34,28 @@ class SecurityConfig:
     read_key: str = ""
     read_expires_sec: int = 60
     white_list: list[str] = field(default_factory=list)
+    # [tls] — mutual TLS for every listener + client (`weed/security/tls.go`)
+    tls_ca: str = ""
+    tls_cert: str = ""
+    tls_key: str = ""
+    tls_allowed_common_names: str = ""
 
     @property
     def enabled(self) -> bool:
         return bool(self.write_key or self.read_key or self.white_list)
+
+    def apply_tls(self) -> None:
+        """Install the [tls] section process-wide (no-op when unset)."""
+        from . import tls as tls_mod
+
+        tls_mod.configure(
+            tls_mod.TLSConfig(
+                ca=self.tls_ca,
+                cert=self.tls_cert,
+                key=self.tls_key,
+                allowed_common_names=self.tls_allowed_common_names,
+            )
+        )
 
 
 def load_security_config(path: str | None = None) -> SecurityConfig:
@@ -52,11 +76,16 @@ def load_security_config(path: str | None = None) -> SecurityConfig:
                 data = tomllib.load(f)
             jwt_sign = data.get("jwt", {}).get("signing", {})
             read = jwt_sign.get("read", {})
+            tls_sec = data.get("tls", {})
             return SecurityConfig(
                 write_key=jwt_sign.get("key", ""),
                 write_expires_sec=int(jwt_sign.get("expires_after_seconds", 10)),
                 read_key=read.get("key", ""),
                 read_expires_sec=int(read.get("expires_after_seconds", 60)),
                 white_list=list(data.get("guard", {}).get("white_list", [])),
+                tls_ca=tls_sec.get("ca", ""),
+                tls_cert=tls_sec.get("cert", ""),
+                tls_key=tls_sec.get("key", ""),
+                tls_allowed_common_names=tls_sec.get("allowed_commonNames", ""),
             )
     return SecurityConfig()

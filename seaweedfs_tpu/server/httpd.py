@@ -13,6 +13,8 @@ import socket
 import threading
 import urllib.parse
 import urllib.request
+
+from seaweedfs_tpu.security import tls as _tls
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
@@ -170,7 +172,18 @@ class HTTPService:
 
         start = _time.monotonic()
         path = urllib.parse.urlparse(handler.path).path
-        if self.guard is not None and not self.guard.is_allowed(
+        peer_ok = True
+        if getattr(self, "_tls_on", False):
+            try:
+                peer_ok = _tls.peer_allowed(
+                    handler.connection.getpeercert(), self._allowed_cns
+                )
+            except Exception:
+                peer_ok = False
+        if not peer_ok:
+            req = None
+            resp = Response({"error": "client certificate CN not allowed"}, 403)
+        elif self.guard is not None and not self.guard.is_allowed(
             handler.client_address[0]
         ):
             req = None
@@ -238,7 +251,29 @@ class HTTPService:
             do_OPTIONS = do_PROPFIND = do_PROPPATCH = do_MKCOL = _handle
             do_MOVE = do_COPY = do_LOCK = do_UNLOCK = _handle
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        ctx = _tls.server_context()
+        self._tls_on = ctx is not None
+        self._allowed_cns = _tls.allowed_cn_patterns()
+        if ctx is None:
+            self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        else:
+            # mTLS on every listener (`weed/security/tls.go` semantics).
+            # The accepted socket is wrapped WITHOUT handshaking: the
+            # handshake runs lazily on first read inside the per-connection
+            # handler thread, so a stalled client cannot pin the accept loop.
+            class TLSHTTPServer(ThreadingHTTPServer):
+                def get_request(inner):
+                    sock, addr = inner.socket.accept()
+                    sock.settimeout(60)
+                    return (
+                        ctx.wrap_socket(
+                            sock, server_side=True,
+                            do_handshake_on_connect=False,
+                        ),
+                        addr,
+                    )
+
+            self._httpd = TLSHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
@@ -251,7 +286,8 @@ class HTTPService:
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if getattr(self, "_tls_on", False) else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
 
 class MetricsService(HTTPService):
@@ -272,6 +308,16 @@ class MetricsService(HTTPService):
             )
 
 
+def peer_url(hostport: str) -> str:
+    """Scheme-qualify another node's advertised host:port. Heartbeats and
+    lookups carry bare addresses; when process-wide mTLS is configured
+    (`security.tls`), every peer listener is TLS too."""
+    if hostport.startswith(("http://", "https://")):
+        return hostport
+    scheme = "https" if _tls.client_context() is not None else "http"
+    return f"{scheme}://{hostport}"
+
+
 # --- tiny client helpers ----------------------------------------------------
 def http_request(
     method: str,
@@ -283,8 +329,9 @@ def http_request(
     req = urllib.request.Request(url, data=body, method=method)
     for k, v in (headers or {}).items():
         req.add_header(k, v)
+    ctx = _tls.client_context() if url.startswith("https:") else None
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        with urllib.request.urlopen(req, timeout=timeout, context=ctx) as resp:
             return resp.status, dict(resp.headers), resp.read()
     except urllib.error.HTTPError as e:
         return e.code, dict(e.headers), e.read()

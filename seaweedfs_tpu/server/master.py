@@ -18,7 +18,7 @@ from seaweedfs_tpu.topology import Topology
 from seaweedfs_tpu.topology.sequence import MemorySequencer
 from seaweedfs_tpu.topology.volume_layout import NoWritableVolume
 
-from .httpd import HTTPService, Request, Response, post_json
+from .httpd import HTTPService, Request, Response, post_json, peer_url
 
 
 class MasterServer:
@@ -185,7 +185,7 @@ class MasterServer:
                 for node in nodes:
                     try:
                         post_json(
-                            f"http://{node.url}/admin/allocate_volume",
+                            peer_url(node.url) + "/admin/allocate_volume",
                             {
                                 "volume": vid,
                                 "collection": collection,
@@ -225,7 +225,7 @@ class MasterServer:
                 if info.deleted_byte_count / max(info.size, 1) > self.garbage_threshold:
                     try:
                         post_json(
-                            f"http://{node.url}/admin/vacuum",
+                            peer_url(node.url) + "/admin/vacuum",
                             {"volume": vid},
                             timeout=120,
                         )
@@ -541,7 +541,7 @@ class MasterServer:
                     if v.collection == name:
                         try:
                             post_json(
-                                f"http://{node.url}/admin/delete_volume",
+                                peer_url(node.url) + "/admin/delete_volume",
                                 {"volume": vid}, timeout=30,
                             )
                             deleted += 1

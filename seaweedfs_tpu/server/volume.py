@@ -23,7 +23,7 @@ from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.volume import NotFound, VolumeError, volume_file_name
 
-from .httpd import HTTPService, Request, Response, get_json, http_request, post_json
+from .httpd import HTTPService, Request, Response, get_json, http_request, post_json, peer_url
 
 FID_RE = r"/(\d+),([0-9a-fA-F_]+)(?:\.[^/]*)?"
 _SAFE_EXT_RE = re.compile(r"\.(dat|idx|vif|ecx|ecj|ec\d\d)")
@@ -46,7 +46,7 @@ class VolumeServer:
         # -mserver may list several masters; heartbeats follow the raft
         # leader hint (`volume_grpc_client_to_master.go` re-dial on redirect)
         self.master_urls = [
-            u if u.startswith("http") else f"http://{u}"
+            peer_url(u)
             for u in master_url.split(",") if u
         ]
         self.master_urls = [u.rstrip("/") for u in self.master_urls]
@@ -174,7 +174,7 @@ class VolumeServer:
                     continue
                 status, _, body = http_request(
                     "GET",
-                    f"http://{target}/admin/ec/shard?volume={ev.volume_id}"
+                    peer_url(target) + f"/admin/ec/shard?volume={ev.volume_id}"
                     f"&shard={shard_id}&offset={off}&size={size}",
                     timeout=30,
                 )
@@ -212,7 +212,7 @@ class VolumeServer:
                 continue
             status, _, out = http_request(
                 method,
-                f"http://{target}/{vid},{fid}?{qs}",
+                peer_url(target) + f"/{vid},{fid}?{qs}",
                 body=body,
                 headers={k: v for k, v in headers.items() if v},
             )

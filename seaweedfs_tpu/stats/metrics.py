@@ -264,9 +264,12 @@ def start_push_loop(push_url: str, role: str, instance: str,
 
     def push_once():
         body = reg.render().encode()
+        from seaweedfs_tpu.security import tls as _tls
+
         req = urllib.request.Request(url, data=body, method="PUT")
         req.add_header("Content-Type", "text/plain")
-        urllib.request.urlopen(req, timeout=10).read()
+        ctx = _tls.client_context() if url.startswith("https:") else None
+        urllib.request.urlopen(req, timeout=10, context=ctx).read()
 
     def loop():
         while True:
