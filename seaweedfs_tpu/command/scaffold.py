@@ -42,6 +42,10 @@ dbFile = "./filer.db"
 [leveldb]                     # embedded WAL+snapshot KV store
 enabled = false
 dir = "./filerldb"
+
+[lsm]                         # embedded LSM/SSTable store (leveldb-class;
+enabled = false               # cold metadata stays on disk)
+dir = "./filerlsm"
 ''',
     "master": '''\
 # master.toml — volume growth + sequencer

@@ -105,7 +105,7 @@ def run_filer(args: list[str]) -> int:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument(
-        "-store", default="memory", choices=["memory", "sqlite", "leveldb"]
+        "-store", default="memory", choices=["memory", "sqlite", "leveldb", "lsm"]
     )
     p.add_argument("-storePath", default=None)
     p.add_argument("-maxMB", type=int, default=4, help="chunk size")
@@ -243,9 +243,11 @@ def run_iam(args: list[str]) -> int:
     opts = p.parse_args(args)
     from seaweedfs_tpu.iamapi import IamServer
 
+    _load_security()
+
     filer = opts.filer
     if not filer.startswith("http"):
-        filer = f"http://{filer}"
+        filer = peer_url(filer)
     srv = IamServer(filer, host=opts.ip, port=opts.port)
     srv.start()
     print(f"iam api listening at {srv.url}")
@@ -261,6 +263,7 @@ def run_s3(args: list[str]) -> int:
     p.add_argument("-filer", default="http://127.0.0.1:8888")
     p.add_argument("-config", default=None, help="identities json (s3.json)")
     opts = p.parse_args(args)
+    _load_security()
     import json as _json
 
     from seaweedfs_tpu.s3api import S3Server
@@ -271,7 +274,7 @@ def run_s3(args: list[str]) -> int:
             config = _json.load(fh)
     filer = opts.filer
     if not filer.startswith("http"):
-        filer = f"http://{filer}"
+        filer = peer_url(filer)
     s3 = S3Server(filer, host=opts.ip, port=opts.port, config=config)
     s3.start()
     print(f"s3 gateway listening at {s3.url}")
@@ -286,11 +289,12 @@ def run_webdav(args: list[str]) -> int:
     p.add_argument("-filer", default="http://127.0.0.1:8888")
     p.add_argument("-readOnly", action="store_true")
     opts = p.parse_args(args)
+    _load_security()
     from seaweedfs_tpu.server.webdav import WebDavServer
 
     filer = opts.filer
     if not filer.startswith("http"):
-        filer = f"http://{filer}"
+        filer = peer_url(filer)
     srv = WebDavServer(filer, host=opts.ip, port=opts.port,
                        read_only=opts.readOnly)
     srv.start()
@@ -307,11 +311,12 @@ def run_mq_broker(args: list[str]) -> int:
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument("-peers", default="", help="comma-separated peer broker urls")
     opts = p.parse_args(args)
+    _load_security()
     from seaweedfs_tpu.mq import BrokerServer
 
     filer = opts.filer
     if not filer.startswith("http"):
-        filer = f"http://{filer}"
+        filer = peer_url(filer)
     srv = BrokerServer(
         filer, master_url=opts.master, host=opts.ip, port=opts.port,
         peers=[peer_url(u)
@@ -331,11 +336,12 @@ def run_mount(args: list[str]) -> int:
     p.add_argument("-readOnly", action="store_true")
     p.add_argument("-chunkCacheDir", default=None)
     opts = p.parse_args(args)
+    _load_security()
     from seaweedfs_tpu.mount import WFS, mount_fs
 
     filer = opts.filer
     if not filer.startswith("http"):
-        filer = f"http://{filer}"
+        filer = peer_url(filer)
     wfs = WFS(filer, read_only=opts.readOnly,
               chunk_cache_dir=opts.chunkCacheDir)
     try:
@@ -359,11 +365,12 @@ def run_ftp(args: list[str]) -> int:
     p.add_argument("-anonymous", action="store_true",
                    help="explicitly allow login without credentials")
     opts = p.parse_args(args)
+    _load_security()
     from seaweedfs_tpu.ftpd import FtpServer
 
     filer = opts.filer
     if not filer.startswith("http"):
-        filer = f"http://{filer}"
+        filer = peer_url(filer)
     srv = FtpServer(filer, host=opts.ip, port=opts.port,
                     user=opts.user, password=opts.password,
                     anonymous=opts.anonymous)

@@ -209,6 +209,12 @@ def make_store(kind: str, path: str | None = None) -> FilerStore:
         if not path:
             raise ValueError("sqlite store needs a path")
         return SqliteStore(path)
+    if kind == "lsm":
+        if not path:
+            raise ValueError("lsm store needs a directory path")
+        from .lsm import LsmStore
+
+        return LsmStore(path)
     if kind == "leveldb":
         if not path:
             raise ValueError("leveldb store needs a directory path")

@@ -161,7 +161,10 @@ class PostgresStore(AbstractSqlStore):  # pragma: no cover
 class RedisStore(FilerStore):  # pragma: no cover - driver not in image
     """Path -> entry-json hash layout (`weed/filer/redis2/`)."""
 
-    def __init__(self, host="127.0.0.1", port=6379, db=0) -> None:
+    def __init__(self, host="127.0.0.1", port=6379, db=0, client=None) -> None:
+        if client is not None:
+            self.r = client  # injected (contract tests use an in-process fake)
+            return
         try:
             import redis
         except ImportError as e:
@@ -204,6 +207,15 @@ class RedisStore(FilerStore):  # pragma: no cover - driver not in image
             if len(out) >= limit:
                 break
         return out
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        self.r.set("swkv:" + key, value)
+
+    def kv_get(self, key: str) -> bytes | None:
+        return self.r.get("swkv:" + key)
+
+    def kv_delete(self, key: str) -> None:
+        self.r.delete("swkv:" + key)
 
     def close(self) -> None:
         self.r.close()

@@ -37,6 +37,12 @@ class TLSConfig:
     def enabled(self) -> bool:
         return bool(self.ca and self.cert and self.key)
 
+    @property
+    def partially_set(self) -> bool:
+        some = bool(self.ca or self.cert or self.key or
+                    self.allowed_common_names)
+        return some and not self.enabled
+
 
 _SERVER_CTX: ssl.SSLContext | None = None
 _CLIENT_CTX: ssl.SSLContext | None = None
@@ -47,6 +53,14 @@ def configure(cfg: TLSConfig) -> None:
     """Install mutual TLS process-wide (like the reference's security.toml:
     every listener and every outbound client in the process)."""
     global _SERVER_CTX, _CLIENT_CTX, _ALLOWED_CNS
+    if cfg.partially_set:
+        # fail CLOSED: a typo'd [tls] section must not silently run the
+        # cluster as plaintext HTTP (the reference errors on cert-load
+        # failure too, tls.go)
+        raise ValueError(
+            "[tls] needs all of ca, cert and key (allowed_commonNames"
+            " alone has nothing to gate); refusing to start without TLS"
+        )
     if not cfg.enabled:
         reset()
         return

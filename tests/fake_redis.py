@@ -1,0 +1,78 @@
+"""Minimal in-process Redis fake covering exactly the command surface the
+RedisStore uses (set/get/delete/zadd/zrem/zrangebylex/close) — the store
+contract suite runs against it so 'redis' stops being an untested gate."""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+
+class FakeRedis:
+    def __init__(self) -> None:
+        self._kv: dict[str, bytes] = {}
+        self._zsets: dict[str, list[str]] = {}  # lex-sorted members, score 0
+        self._mu = threading.RLock()
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._mu:
+            self._kv[key] = bytes(value)
+
+    def get(self, key: str) -> bytes | None:
+        with self._mu:
+            return self._kv.get(key)
+
+    def delete(self, *keys: str) -> int:
+        with self._mu:
+            n = 0
+            for k in keys:
+                if self._kv.pop(k, None) is not None:
+                    n += 1
+                self._zsets.pop(k, None)
+            return n
+
+    def zadd(self, key: str, mapping: dict) -> int:
+        with self._mu:
+            members = self._zsets.setdefault(key, [])
+            added = 0
+            for member in mapping:
+                i = bisect.bisect_left(members, member)
+                if i >= len(members) or members[i] != member:
+                    members.insert(i, member)
+                    added += 1
+            return added
+
+    def zrem(self, key: str, *members: str) -> int:
+        with self._mu:
+            lst = self._zsets.get(key, [])
+            n = 0
+            for member in members:
+                i = bisect.bisect_left(lst, member)
+                if i < len(lst) and lst[i] == member:
+                    lst.pop(i)
+                    n += 1
+            return n
+
+    def zrangebylex(self, key: str, lo: str, hi: str) -> list[bytes]:
+        with self._mu:
+            lst = self._zsets.get(key, [])
+            if lo == "-":
+                start = 0
+            elif lo.startswith("["):
+                start = bisect.bisect_left(lst, lo[1:])
+            elif lo.startswith("("):
+                start = bisect.bisect_right(lst, lo[1:])
+            else:
+                raise ValueError(f"bad min {lo!r}")
+            if hi == "+":
+                end = len(lst)
+            elif hi.startswith("["):
+                end = bisect.bisect_right(lst, hi[1:])
+            elif hi.startswith("("):
+                end = bisect.bisect_left(lst, hi[1:])
+            else:
+                raise ValueError(f"bad max {hi!r}")
+            return [m.encode() for m in lst[start:end]]
+
+    def close(self) -> None:
+        pass

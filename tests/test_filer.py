@@ -15,10 +15,26 @@ from seaweedfs_tpu.server.master import MasterServer
 from seaweedfs_tpu.server.volume import VolumeServer
 
 
-@pytest.fixture(params=["memory", "sqlite", "abstract_sql"])
+@pytest.fixture(
+    params=["memory", "sqlite", "abstract_sql", "leveldb", "lsm", "redis"]
+)
 def store(request, tmp_path):
     if request.param == "memory":
         return MemoryStore()
+    if request.param == "leveldb":
+        from seaweedfs_tpu.filer.kvstore import LocalKVStore
+
+        return LocalKVStore(str(tmp_path / "ldb"))
+    if request.param == "lsm":
+        from seaweedfs_tpu.filer.lsm import LsmStore
+
+        return LsmStore(str(tmp_path / "lsm"))
+    if request.param == "redis":
+        from seaweedfs_tpu.filer.stores_gated import RedisStore
+
+        from .fake_redis import FakeRedis
+
+        return RedisStore(client=FakeRedis())
     if request.param == "abstract_sql":
         # the shared SQL layer the gated mysql/postgres stores ride on,
         # proven against sqlite3's DB-API

@@ -9,6 +9,8 @@ import urllib.request
 
 import pytest
 
+pytest.importorskip("cryptography")
+
 from seaweedfs_tpu.security import tls as tls_mod
 from seaweedfs_tpu.security.tls import TLSConfig
 
