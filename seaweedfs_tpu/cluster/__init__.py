@@ -50,6 +50,19 @@ class LockRing:
                 key=lambda s: hashlib.sha1(f"{s}|{key}".encode()).digest(),
             )
 
+    def ranked_for(self, key: str, n: int) -> list[str]:
+        """Top-n servers for the key by rendezvous rank — rank 0 is the
+        owner, ranks 1.. are its natural followers (when membership
+        changes, a follower is the next owner, which is what makes
+        follower replication survive owner loss)."""
+        with self._lock:
+            ranked = sorted(
+                self._servers,
+                key=lambda s: hashlib.sha1(f"{s}|{key}".encode()).digest(),
+                reverse=True,
+            )
+            return ranked[:n]
+
 
 class LockEntry:
     __slots__ = ("key", "owner", "token", "expires_at")

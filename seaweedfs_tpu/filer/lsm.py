@@ -175,12 +175,39 @@ class LsmKV:
         self._lock = threading.RLock()
         self._tables: list[SSTable] = []  # oldest .. newest
         self._seq = 0
-        for name in sorted(os.listdir(dir_path)):
-            if name.endswith(".sst"):
-                self._tables.append(SSTable(os.path.join(dir_path, name)))
+        self.manifest_path = os.path.join(dir_path, "MANIFEST")
+        names = None
+        if os.path.exists(self.manifest_path):
+            try:
+                names = json.loads(open(self.manifest_path).read())
+            except ValueError:
+                names = None
+        if names is None:
+            names = sorted(
+                n for n in os.listdir(dir_path) if n.endswith(".sst")
+            )
+        for name in names:
+            path = os.path.join(dir_path, name)
+            if os.path.exists(path):
+                self._tables.append(SSTable(path))
                 self._seq = max(self._seq, int(name.split(".")[0]) + 1)
+        # orphans outside the manifest (crash between manifest write and
+        # old-table unlink) are dead: remove so they never resurrect
+        # tombstoned keys on a later manifest-less open
+        for name in os.listdir(dir_path):
+            if name.endswith(".sst") and name not in names:
+                try:
+                    os.unlink(os.path.join(dir_path, name))
+                except OSError:
+                    pass
         self._replay_wal()
         self._wal = open(self.wal_path, "ab")
+
+    def _write_manifest(self) -> None:
+        tmp = self.manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump([os.path.basename(t.path) for t in self._tables], f)
+        os.replace(tmp, self.manifest_path)
 
     # --- WAL ----------------------------------------------------------------
     def _replay_wal(self) -> None:
@@ -216,6 +243,7 @@ class LsmKV:
         self._seq += 1
         SSTable.write(path, iter(sorted(self._mem.items())))
         self._tables.append(SSTable(path))
+        self._write_manifest()
         self._mem.clear()
         self._mem_bytes = 0
         self._wal.close()
@@ -237,10 +265,15 @@ class LsmKV:
                 (k, v) for k, v in merged.items() if v is not None
             )),
         )
-        for table in self._tables:
-            table.close()
-            os.unlink(table.path)
+        olds = self._tables
         self._tables = [SSTable(path)]
+        self._write_manifest()  # atomic switch BEFORE unlinking the olds:
+        for table in olds:      # a crash here leaves ignorable orphans only
+            table.close()
+            try:
+                os.unlink(table.path)
+            except OSError:
+                pass
 
     # --- API ----------------------------------------------------------------
     def put(self, key: bytes, value: bytes) -> None:
@@ -323,7 +356,7 @@ class LsmStore(FilerStore):
     @staticmethod
     def _key(full_path: str) -> bytes:
         if full_path == "/":
-            return b"/\x00"
+            return b"\x00/"  # before every dir prefix: root never lists itself
         d, _, name = full_path.rpartition("/")
         return (d or "/").encode() + b"\x00" + name.encode()
 

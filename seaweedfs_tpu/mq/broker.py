@@ -1,7 +1,8 @@
 """MQ broker server (`weed/mq/broker/broker_server.go:53`).
 
 HTTP surface (the reference speaks gRPC `SeaweedMessaging`; verbs match):
-  POST /topics/create   {namespace, topic, partition_count}
+  POST /topics/create   {namespace, topic, partition_count[, replication,
+                         schema]}
   GET  /topics/list
   GET  /topics/describe?namespace=&topic=
   POST /publish         {namespace, topic, key, value[, partition]}
@@ -9,6 +10,15 @@ HTTP surface (the reference speaks gRPC `SeaweedMessaging`; verbs match):
   POST /offsets/commit  {namespace, topic, group, partition, offset}
   GET  /offsets         ?namespace=&topic=&group=
   POST /flush           (force segment flush — tests/shutdown)
+  POST /follow/append   (owner -> follower replication; ack-before-commit)
+
+Follower replication (`weed/mq/broker/broker_grpc_pub_follow.go`): with
+topic replication=R, the partition owner synchronously copies each publish
+to the next R brokers in rendezvous-rank order and acks the publisher only
+after every follower acked. A follower holds the replica tail in memory;
+when the ring reassigns a dead owner's partition, the new owner — by
+construction the rank-1 follower — adopts its replica and flushes it to
+segments before serving, so acked messages survive owner loss.
 """
 
 from __future__ import annotations
@@ -26,6 +36,14 @@ TOPICS_DIR = "/topics"
 SEGMENT_FLUSH_COUNT = 512  # messages buffered per partition before flush
 
 
+class ReplicationError(Exception):
+    """Followers did not ack: the message was NOT committed."""
+
+    def __init__(self, offset: int) -> None:
+        super().__init__(f"no follower ack for offset {offset}")
+        self.offset = offset
+
+
 class TopicPartition:
     """In-memory tail of one partition; segments hold the flushed prefix."""
 
@@ -34,6 +52,9 @@ class TopicPartition:
         self.fc = fc
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
+        # serializes publishes so an offset can be replicated to followers
+        # BEFORE it is committed to the tail (ack-before-commit)
+        self.pub_lock = threading.Lock()
         self.tail: list[dict] = []  # unflushed messages
         self.tail_start = 0  # offset of tail[0]
         self._load_flushed_extent()
@@ -61,14 +82,29 @@ class TopicPartition:
         self.tail_start = segs[-1][1] + 1 if segs else 0
 
     def append(self, key: str, value, ts_ns: int | None = None) -> int:
-        with self.cond:
-            offset = self.tail_start + len(self.tail)
-            self.tail.append({
+        return self.publish(key, value, replicate=None, ts_ns=ts_ns)
+
+    def publish(
+        self, key: str, value, replicate=None, ts_ns: int | None = None
+    ) -> int:
+        """Serialized publish. With `replicate` (msg -> bool), the message
+        is handed to followers FIRST and committed to the tail only after
+        they acked — a failed replication commits nothing and subscribers
+        never see the offset (`broker_grpc_pub_follow.go` semantics).
+        Raises ReplicationError when followers don't ack."""
+        with self.pub_lock:
+            with self.lock:
+                offset = self.tail_start + len(self.tail)
+            msg = {
                 "offset": offset, "key": key, "value": value,
                 "ts_ns": ts_ns or time.time_ns(),
-            })
-            self.cond.notify_all()
-            need_flush = len(self.tail) >= SEGMENT_FLUSH_COUNT
+            }
+            if replicate is not None and not replicate(msg):
+                raise ReplicationError(offset)
+            with self.cond:
+                self.tail.append(msg)
+                self.cond.notify_all()
+                need_flush = len(self.tail) >= SEGMENT_FLUSH_COUNT
         if need_flush:
             self.flush()
         return offset
@@ -110,6 +146,21 @@ class TopicPartition:
                     out.append(m)
         return out
 
+    def adopt(self, replica: list[dict]) -> int:
+        """Fold a follower replica in after taking ownership: keep only
+        messages past the flushed extent, then flush for durability."""
+        with self.lock:
+            known = self.tail_start + len(self.tail)
+            added = 0
+            for m in sorted(replica, key=lambda m: m["offset"]):
+                if m["offset"] == known:
+                    self.tail.append(m)
+                    known += 1
+                    added += 1
+        if added:
+            self.flush()
+        return added
+
     def high_water_mark(self) -> int:
         with self.lock:
             return self.tail_start + len(self.tail)
@@ -125,6 +176,8 @@ class BrokerServer:
         self.ring = LockRing()
         self._static_peers = list(peers or [])
         self._partitions: dict[str, TopicPartition] = {}
+        # follower replica tails: partition key -> {offset: message}
+        self._replicas: dict[str, dict[int, dict]] = {}
         self._plock = threading.Lock()
         self._stop = threading.Event()
         self._routes()
@@ -182,8 +235,23 @@ class BrokerServer:
                 tp = TopicPartition(
                     f"{self._topic_dir(ns, topic)}/p{k:04d}", self.fc
                 )
+                # adopt a held follower replica ONLY when the ring says this
+                # broker now owns the partition (a describe on a follower
+                # must not fork a second flusher), and BEFORE the partition
+                # becomes visible — a concurrent publish grabbing the new
+                # partition pre-adoption would burn the replica's offsets
+                owner = self._owner_of(ns, topic, k)
+                replica = None
+                if owner is None or owner == self.url:
+                    replica = self._replicas.pop(key, None)
+                if replica:
+                    tp.adopt(list(replica.values()))
                 self._partitions[key] = tp
             return tp
+
+    def _followers_of(self, ns: str, topic: str, k: int, r: int) -> list[str]:
+        ranked = self.ring.ranked_for(f"{ns}/{topic}/p{k}", 1 + r)
+        return [s for s in ranked[1:] if s != self.url]
 
     def _owner_of(self, ns: str, topic: str, k: int) -> str | None:
         return self.ring.server_for(f"{ns}/{topic}/p{k}")
@@ -203,16 +271,26 @@ class BrokerServer:
 
         @svc.route("POST", r"/topics/create")
         def topics_create(req: Request) -> Response:
+            from seaweedfs_tpu.mq.schema import SchemaError, validate_schema_def
+
             p = req.json()
             ns, topic = p.get("namespace", "default"), p["topic"]
             count = int(p.get("partition_count", 4))
+            replication = int(p.get("replication", 0))
+            conf = {
+                "namespace": ns, "topic": topic, "partition_count": count,
+                "replication": replication, "created_ts": time.time(),
+            }
+            if p.get("schema") is not None:
+                try:
+                    conf["schema"] = validate_schema_def(p["schema"])
+                except SchemaError as e:
+                    return Response({"error": str(e)}, 400)
             conf_path = f"{self._topic_dir(ns, topic)}/topic.conf"
             if self.fc.get_entry(conf_path) is not None:
                 return Response({"error": f"{ns}/{topic} exists"}, 409)
-            self.fc.put(conf_path, json.dumps({
-                "namespace": ns, "topic": topic, "partition_count": count,
-                "created_ts": time.time(),
-            }).encode(), content_type="application/json")
+            self.fc.put(conf_path, json.dumps(conf).encode(),
+                        content_type="application/json")
             return Response({"ok": True, "partition_count": count}, 201)
 
         @svc.route("GET", r"/topics/list")
@@ -267,7 +345,47 @@ class BrokerServer:
             owner = self._owner_of(ns, topic, k)
             if owner and owner != self.url:
                 return Response({"moved_to": owner, "partition": k}, 307)
-            offset = self._partition(ns, topic, k).append(key, p.get("value"))
+            if conf.get("schema") is not None:
+                from seaweedfs_tpu.mq.schema import SchemaError, validate_record
+
+                try:
+                    validate_record(conf["schema"], p.get("value"))
+                except SchemaError as e:
+                    return Response({"error": str(e)}, 400)
+            tp = self._partition(ns, topic, k)
+            replication = int(conf.get("replication", 0))
+            replicate = None
+            if replication > 0:
+                from seaweedfs_tpu.server.httpd import post_json
+
+                need = min(replication, max(0, len(self.ring.servers()) - 1))
+
+                def replicate(msg, _ns=ns, _topic=topic, _k=k, _need=need):
+                    # the follower also learns the flushed extent so it can
+                    # trim replica offsets the owner already made durable
+                    with tp.lock:
+                        flushed_through = tp.tail_start
+                    acked = 0
+                    for follower in self._followers_of(
+                        _ns, _topic, _k, replication
+                    ):
+                        try:
+                            post_json(f"{follower}/follow/append", {
+                                "namespace": _ns, "topic": _topic,
+                                "partition": _k, "messages": [msg],
+                                "flushed_through": flushed_through,
+                            }, timeout=10)
+                            acked += 1
+                        except Exception:
+                            pass
+                    return acked >= _need
+
+            try:
+                offset = tp.publish(key, p.get("value"), replicate=replicate)
+            except ReplicationError:
+                return Response(
+                    {"error": "not enough follower acks"}, 503
+                )
             return Response({"ok": True, "partition": k, "offset": offset})
 
         @svc.route("GET", r"/subscribe")
@@ -323,6 +441,31 @@ class BrokerServer:
             return Response(
                 {"offsets": json.loads(bytes.fromhex(e["content"]))}
             )
+
+        @svc.route("POST", r"/follow/append")
+        def follow_append(req: Request) -> Response:
+            p = req.json()
+            ns, topic = p.get("namespace", "default"), p["topic"]
+            k = int(p["partition"])
+            key = f"{ns}/{topic}/p{k:04d}"
+            flushed_through = int(p.get("flushed_through", 0))
+            with self._plock:
+                live = self._partitions.get(key)
+                if live is not None and self._owner_of(ns, topic, k) == self.url:
+                    tp = live  # ring flapped back: fold into the live copy
+                else:
+                    tp = None
+                    replica = self._replicas.setdefault(key, {})
+                    for m in p.get("messages", []):
+                        replica[int(m["offset"])] = m
+                    # trim what the owner already flushed durably: adoption
+                    # only ever needs offsets past the flushed extent, so
+                    # the replica buffer stays bounded by the flush cadence
+                    for off in [o for o in replica if o < flushed_through]:
+                        del replica[off]
+            if tp is not None:
+                tp.adopt(p.get("messages", []))
+            return Response({"ok": True})
 
         @svc.route("POST", r"/flush")
         def flush(req: Request) -> Response:

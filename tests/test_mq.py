@@ -198,3 +198,160 @@ class TestTwoBrokerOwnership:
         finally:
             broker.ring.set_servers([broker.url])
             b2.stop()
+
+
+class TestFollowerReplication:
+    """Kill-the-owner: with replication=1, every acked publish must survive
+    the owner broker dying before any flush
+    (`broker_grpc_pub_follow.go` ack-before-commit semantics)."""
+
+    def test_kill_owner_loses_nothing(self, stack):
+        from seaweedfs_tpu.mq import BrokerServer
+
+        _, filer, _ = stack
+        b1 = BrokerServer(filer.url, port=0)
+        b1.start()
+        b2 = BrokerServer(filer.url, port=0)
+        b2.start()
+        ring = sorted([b1.url, b2.url])
+        b1.ring.set_servers(ring)
+        b2.ring.set_servers(ring)
+        try:
+            status, _ = _post(f"{b1.url}/topics/create", {
+                "topic": "crashy", "partition_count": 1, "replication": 1,
+            })
+            assert status == 201
+            # find the owner of p0 and its follower
+            owner_url = b1.ring.server_for("default/crashy/p0")
+            owner = b1 if owner_url == b1.url else b2
+            follower = b2 if owner is b1 else b1
+            acked = []
+            for i in range(25):
+                status, out = _post(f"{owner.url}/publish", {
+                    "topic": "crashy", "partition": 0,
+                    "key": f"k{i}", "value": {"n": i},
+                })
+                assert status == 200, out
+                acked.append(out["offset"])
+            assert acked == list(range(25))
+            # CRASH the owner: no flush, no graceful anything
+            owner.service.stop()
+            # ring heals around the survivor
+            follower.ring.set_servers([follower.url])
+            status, out = _get(
+                f"{follower.url}/subscribe?topic=crashy&partition=0"
+                f"&offset=0&limit=100"
+            )
+            assert status == 200, out
+            got = [m["value"]["n"] for m in out["messages"]]
+            assert got == list(range(25)), "acked messages lost on owner crash"
+            # and the adopted messages are DURABLE (flushed to the filer)
+            status, out2 = _post(f"{follower.url}/flush", {})
+            seg_listing = filer.filer.list_entries("/topics/default/crashy/p0000")
+            assert any(e.name.endswith(".log") for e in seg_listing)
+        finally:
+            try:
+                follower.stop()
+            except Exception:
+                pass
+
+    def test_publish_fails_without_follower_ack(self, stack):
+        from seaweedfs_tpu.mq import BrokerServer
+
+        _, filer, _ = stack
+        b = BrokerServer(filer.url, port=0)
+        b.start()
+        # ring believes a second broker exists, but it is unreachable
+        b.ring.set_servers(sorted([b.url, "http://127.0.0.1:1"]))
+        try:
+            # pick a topic whose p0 the REAL broker owns (ring is hash-based)
+            topic = next(
+                t for t in (f"needsack{i}" for i in range(64))
+                if b.ring.server_for(f"default/{t}/p0") == b.url
+            )
+            _post(f"{b.url}/topics/create", {
+                "topic": topic, "partition_count": 1, "replication": 1,
+            })
+            status, out = _post(f"{b.url}/publish", {
+                "topic": topic, "partition": 0, "key": "k", "value": 1,
+            })
+            assert status == 503, out  # ack-before-commit: no ack, no OK
+        finally:
+            b.stop()
+
+
+class TestSchemaTopics:
+    def test_schema_validation(self, stack):
+        _, _, broker = stack
+        status, out = _post(f"{broker.url}/topics/create", {
+            "topic": "typed", "partition_count": 1,
+            "schema": {"fields": [
+                {"name": "id", "type": "int"},
+                {"name": "name", "type": "string"},
+                {"name": "score", "type": "float", "required": False},
+            ]},
+        })
+        assert status == 201, out
+        ok = {"topic": "typed", "partition": 0, "key": "a",
+              "value": {"id": 1, "name": "x", "score": 2.5}}
+        status, out = _post(f"{broker.url}/publish", ok)
+        assert status == 200, out
+        # missing required field
+        status, out = _post(f"{broker.url}/publish", {
+            "topic": "typed", "partition": 0, "key": "a",
+            "value": {"id": 2}})
+        assert status == 400 and "name" in out["error"]
+        # wrong type
+        status, out = _post(f"{broker.url}/publish", {
+            "topic": "typed", "partition": 0, "key": "a",
+            "value": {"id": "not-int", "name": "x"}})
+        assert status == 400
+        # unknown field
+        status, out = _post(f"{broker.url}/publish", {
+            "topic": "typed", "partition": 0, "key": "a",
+            "value": {"id": 3, "name": "x", "bogus": 1}})
+        assert status == 400
+        # optional field may be omitted
+        status, out = _post(f"{broker.url}/publish", {
+            "topic": "typed", "partition": 0, "key": "a",
+            "value": {"id": 4, "name": "y"}})
+        assert status == 200
+
+    def test_bad_schema_rejected_at_create(self, stack):
+        _, _, broker = stack
+        status, out = _post(f"{broker.url}/topics/create", {
+            "topic": "badschema",
+            "schema": {"fields": [{"name": "x", "type": "quaternion"}]},
+        })
+        assert status == 400 and "quaternion" in out["error"]
+
+    def test_failed_ack_commits_nothing(self, stack):
+        """Review-pinned: a 503 publish must leave no trace — no tail
+        entry, no hwm advance, no duplicate on retry."""
+        from seaweedfs_tpu.mq import BrokerServer
+
+        _, filer, _ = stack
+        b = BrokerServer(filer.url, port=0)
+        b.start()
+        b.ring.set_servers(sorted([b.url, "http://127.0.0.1:1"]))
+        try:
+            topic = next(
+                t for t in (f"noghost{i}" for i in range(64))
+                if b.ring.server_for(f"default/{t}/p0") == b.url
+            )
+            _post(f"{b.url}/topics/create", {
+                "topic": topic, "partition_count": 1, "replication": 1,
+            })
+            status, _ = _post(f"{b.url}/publish", {
+                "topic": topic, "partition": 0, "key": "k", "value": 1})
+            assert status == 503
+            tp = b._partition("default", topic, 0)
+            assert tp.high_water_mark() == 0  # nothing committed
+            # follower comes back: retry succeeds at offset 0, no duplicate
+            b.ring.set_servers([b.url])
+            status, out = _post(f"{b.url}/publish", {
+                "topic": topic, "partition": 0, "key": "k", "value": 1})
+            assert status == 200 and out["offset"] == 0
+            assert tp.high_water_mark() == 1
+        finally:
+            b.stop()
