@@ -58,20 +58,38 @@ def run_filer_replicate(args: list[str]) -> int:
                    help="mirror into this directory")
     p.add_argument("-sink.filer", dest="sink_filer", default=None,
                    help="replicate to this filer url")
+    p.add_argument("-sink.s3.endpoint", dest="sink_s3_endpoint", default=None,
+                   help="replicate into an S3 endpoint (any S3 API, incl. "
+                        "this framework's own gateway)")
+    p.add_argument("-sink.s3.bucket", dest="sink_s3_bucket", default="backup")
+    p.add_argument("-sink.s3.prefix", dest="sink_s3_prefix", default="")
+    p.add_argument("-sink.s3.accessKey", dest="sink_s3_ak", default="")
+    p.add_argument("-sink.s3.secretKey", dest="sink_s3_sk", default="")
     p.add_argument("-interval", type=float, default=1.0)
     p.add_argument("-once", action="store_true", help="drain spool and exit")
     opts = p.parse_args(args)
 
     from seaweedfs_tpu.filer.filer_client import FilerClient
     from seaweedfs_tpu.notification import FileQueue
-    from seaweedfs_tpu.replication import FilerSink, LocalSink, Replicator
+    from seaweedfs_tpu.replication import (
+        FilerSink,
+        LocalSink,
+        Replicator,
+        S3Sink,
+    )
 
     if opts.sink_local:
         sink = LocalSink(opts.sink_local)
     elif opts.sink_filer:
         sink = FilerSink(opts.sink_filer)
+    elif opts.sink_s3_endpoint:
+        sink = S3Sink(
+            opts.sink_s3_endpoint, opts.sink_s3_bucket,
+            access_key=opts.sink_s3_ak, secret_key=opts.sink_s3_sk,
+            prefix=opts.sink_s3_prefix,
+        )
     else:
-        print("need -sink.local or -sink.filer")
+        print("need -sink.local, -sink.filer or -sink.s3.endpoint")
         return 1
     src = FilerClient(opts.source)
     rep = Replicator(sink, read_content=lambda path, entry: src.read(path))

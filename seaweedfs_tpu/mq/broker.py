@@ -148,15 +148,17 @@ class TopicPartition:
 
     def adopt(self, replica: list[dict]) -> int:
         """Fold a follower replica in after taking ownership: keep only
-        messages past the flushed extent, then flush for durability."""
-        with self.lock:
-            known = self.tail_start + len(self.tail)
-            added = 0
-            for m in sorted(replica, key=lambda m: m["offset"]):
-                if m["offset"] == known:
-                    self.tail.append(m)
-                    known += 1
-                    added += 1
+        messages past the flushed extent, then flush for durability.
+        Takes pub_lock so an in-flight publish can't interleave offsets."""
+        with self.pub_lock:
+            with self.lock:
+                known = self.tail_start + len(self.tail)
+                added = 0
+                for m in sorted(replica, key=lambda m: m["offset"]):
+                    if m["offset"] == known:
+                        self.tail.append(m)
+                        known += 1
+                        added += 1
         if added:
             self.flush()
         return added
@@ -231,23 +233,29 @@ class BrokerServer:
         key = f"{ns}/{topic}/p{k:04d}"
         with self._plock:
             tp = self._partitions.get(key)
-            if tp is None:
+            created = tp is None
+            if created:
                 tp = TopicPartition(
                     f"{self._topic_dir(ns, topic)}/p{k:04d}", self.fc
                 )
-                # adopt a held follower replica ONLY when the ring says this
-                # broker now owns the partition (a describe on a follower
-                # must not fork a second flusher), and BEFORE the partition
-                # becomes visible — a concurrent publish grabbing the new
-                # partition pre-adoption would burn the replica's offsets
-                owner = self._owner_of(ns, topic, k)
-                replica = None
-                if owner is None or owner == self.url:
-                    replica = self._replicas.pop(key, None)
+            # adopt a held follower replica whenever the ring says this
+            # broker owns the partition — including a partition that was
+            # pre-created while following (e.g. by /topics/describe) and
+            # only now gained ownership. A describe on a follower must NOT
+            # adopt (it would fork a second flusher), hence the owner gate.
+            owner = self._owner_of(ns, topic, k)
+            replica = None
+            if owner is None or owner == self.url:
+                replica = self._replicas.pop(key, None)
+            if created:
+                # adopt BEFORE the partition becomes visible: a concurrent
+                # publish grabbing it pre-adoption would burn the offsets
                 if replica:
                     tp.adopt(list(replica.values()))
                 self._partitions[key] = tp
-            return tp
+        if not created and replica:
+            tp.adopt(list(replica.values()))  # adopt() takes pub_lock itself
+        return tp
 
     def _followers_of(self, ns: str, topic: str, k: int, r: int) -> list[str]:
         ranked = self.ring.ranked_for(f"{ns}/{topic}/p{k}", 1 + r)
@@ -362,22 +370,43 @@ class BrokerServer:
 
                 def replicate(msg, _ns=ns, _topic=topic, _k=k, _need=need):
                     # the follower also learns the flushed extent so it can
-                    # trim replica offsets the owner already made durable
+                    # trim replica offsets the owner already made durable.
+                    # Posts run concurrently with a short timeout — one
+                    # blackholed follower must not stall the partition's
+                    # pub_lock for the full publish timeout
+                    import concurrent.futures
+
                     with tp.lock:
                         flushed_through = tp.tail_start
+                    followers = self._followers_of(_ns, _topic, _k, replication)
+                    if not followers:
+                        return 0 >= _need
+
+                    def one(follower):
+                        post_json(f"{follower}/follow/append", {
+                            "namespace": _ns, "topic": _topic,
+                            "partition": _k, "messages": [msg],
+                            "flushed_through": flushed_through,
+                        }, timeout=3)
+                        return 1
+
                     acked = 0
-                    for follower in self._followers_of(
-                        _ns, _topic, _k, replication
-                    ):
-                        try:
-                            post_json(f"{follower}/follow/append", {
-                                "namespace": _ns, "topic": _topic,
-                                "partition": _k, "messages": [msg],
-                                "flushed_through": flushed_through,
-                            }, timeout=10)
-                            acked += 1
-                        except Exception:
-                            pass
+                    ex = concurrent.futures.ThreadPoolExecutor(len(followers))
+                    futs = [ex.submit(one, f) for f in followers]
+                    try:
+                        for fut in concurrent.futures.as_completed(
+                            futs, timeout=5
+                        ):
+                            try:
+                                acked += fut.result()
+                            except Exception:
+                                pass
+                    except concurrent.futures.TimeoutError:
+                        pass  # stragglers count as un-acked
+                    finally:
+                        # don't block the publish on a blackholed follower;
+                        # the worker threads die with their 3s post timeout
+                        ex.shutdown(wait=False)
                     return acked >= _need
 
             try:

@@ -99,17 +99,20 @@ class FileQueue(NotificationQueue):
         return out
 
 
-class KafkaQueue(NotificationQueue):  # pragma: no cover - kafka not in image
+class KafkaQueue(NotificationQueue):
     kind = "kafka"
 
-    def __init__(self, hosts: list[str], topic: str) -> None:
+    def __init__(self, hosts: list[str], topic: str, producer=None) -> None:
+        self.topic = topic
+        if producer is not None:
+            self._producer = producer  # injected (contract tests use a fake)
+            return
         try:
             from kafka import KafkaProducer
         except ImportError as e:
             raise RuntimeError(
                 "kafka notification backend requires kafka-python"
             ) from e
-        self.topic = topic
         self._producer = KafkaProducer(bootstrap_servers=hosts)
 
     def send_message(self, key: str, message: dict) -> None:

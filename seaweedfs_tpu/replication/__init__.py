@@ -131,15 +131,25 @@ class Replicator:
             new_path = new["full_path"]
             if new_path.startswith(SYSTEM_LOG_DIR):
                 return
-            data = None
-            if not new.get("is_directory"):
-                data = self._read(new_path, new)
+            # a rename's old-path delete happens regardless of whether the
+            # new content is still readable — otherwise a replayed rename
+            # leaves the stale old key in the sink forever
             if old is not None and old["full_path"] != new_path:
                 self.sink.delete_entry(
                     old["full_path"], bool(old.get("is_directory"))
                 )
-                self.sink.create_entry(new_path, new, data)
-            elif old is not None:
+            data = None
+            if not new.get("is_directory"):
+                try:
+                    data = self._read(new_path, new)
+                except IOError as e:
+                    if "404" in str(e):
+                        # replaying history: this create was superseded
+                        # (renamed/deleted later at the source); a later
+                        # event in the stream converges the sink
+                        return
+                    raise  # transient source failure: caller retries
+            if old is not None and old["full_path"] == new_path:
                 self.sink.update_entry(new_path, new, data)
             else:
                 self.sink.create_entry(new_path, new, data)
@@ -209,3 +219,68 @@ class FilerSyncer:
                     time.sleep(min(poll_interval, 0.2))
             except Exception:
                 time.sleep(poll_interval)
+
+
+class S3Sink(ReplicationSink):
+    """Replicate the namespace into any S3 endpoint — AWS or this
+    framework's own gateway (`weed/replication/sink/s3sink/s3_sink.go`).
+    Filer path /a/b.txt lands at s3://bucket/<prefix>/a/b.txt. Directories
+    become zero-byte "dir/" marker objects, the convention S3 browsers use."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        bucket: str,
+        access_key: str = "",
+        secret_key: str = "",
+        prefix: str = "",
+        create_bucket: bool = True,
+    ) -> None:
+        from seaweedfs_tpu.s3api.sigv4_client import S3Client, S3Error
+
+        self._S3Error = S3Error
+        self.client = S3Client(endpoint, access_key, secret_key)
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        if create_bucket:
+            try:
+                self.client.create_bucket(bucket)
+            except S3Error:
+                pass  # exists / owned
+
+    def _key(self, path: str) -> str:
+        key = path.lstrip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def create_entry(self, path: str, entry: dict, data: bytes | None) -> None:
+        if entry.get("is_directory"):
+            self.client.put_object(self.bucket, self._key(path) + "/", b"")
+            return
+        mime = (entry.get("attributes") or {}).get("mime", "")
+        self.client.put_object(
+            self.bucket, self._key(path), data or b"", content_type=mime
+        )
+
+    def update_entry(self, path: str, entry: dict, data: bytes | None) -> None:
+        self.create_entry(path, entry, data)
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        if is_directory:
+            # drop the subtree: marker + every object under the prefix,
+            # paging until the listing is exhausted
+            token = ""
+            while True:
+                listing = self.client.list_objects(
+                    self.bucket, prefix=self._key(path) + "/",
+                    continuation_token=token,
+                )
+                keys = [c["key"] for c in listing["contents"]]
+                if keys:
+                    self.client.delete_objects(self.bucket, keys)
+                token = listing.get("next_token") or ""
+                if not listing.get("is_truncated") or (not token and not keys):
+                    break
+        try:
+            self.client.delete_object(self.bucket, self._key(path))
+        except self._S3Error:
+            pass
