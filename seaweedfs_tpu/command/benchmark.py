@@ -56,37 +56,71 @@ def run_benchmark(
     `weed benchmark` loop, `benchmark.go:113-260`)."""
     import types
 
-    from seaweedfs_tpu.filer.wdclient import WeedClient
+    from seaweedfs_tpu.server.httpd import PooledHTTP, peer_url
 
     opts = types.SimpleNamespace(
         master=master, n=n, size=size, c=c, collection=collection, seed=seed
     )
-    client = WeedClient(opts.master)
+    masters = [peer_url(u).rstrip("/") for u in opts.master.split(",") if u]
+    state = {"master": masters[0]}
+    pool = PooledHTTP()  # keep-alive per worker thread, like the Go client
     rng = random.Random(opts.seed)
     payload = bytes(rng.randrange(256) for _ in range(opts.size))
+
+    def assign() -> dict:
+        for _ in range(len(masters) + 2):  # follow raft leader hints
+            status, _, body = pool.request(
+                "GET",
+                f"{state['master']}/dir/assign?count=1"
+                f"&collection={opts.collection}",
+            )
+            if status >= 400:
+                try:
+                    out = json.loads(body)
+                except ValueError:
+                    raise IOError(f"assign -> {status}: {body[:120]!r}")
+                leader = out.get("leader")
+                if out.get("error") == "raft.not.leader" and leader:
+                    state["master"] = peer_url(leader).rstrip("/")
+                    continue
+                raise IOError(f"assign -> {status}: {out}")
+            out = json.loads(body)
+            if out.get("error"):
+                raise IOError(f"assign: {out['error']}")
+            return out
+        raise IOError("assign: no leader found")
 
     write_lat: list[float] = []
     fids: list[str] = []
 
     def do_write(i: int):
         t0 = time.perf_counter()
-        out = client.upload(payload, collection=opts.collection)
-        dt = time.perf_counter() - t0
-        return out["fid"], dt
+        a = assign()
+        url = f"{peer_url(a['publicUrl'])}/{a['fid']}"
+        headers = {}
+        if a.get("auth"):
+            headers["Authorization"] = f"BEARER {a['auth']}"
+        status, _, body = pool.request("POST", url, payload, headers)
+        if status >= 300:
+            raise IOError(f"upload -> {status}: {body[:120]!r}")
+        # remember the volume location: the reader reuses it instead of
+        # paying a lookup per read (the Go benchmark caches locations too)
+        return a["fid"], a["publicUrl"], time.perf_counter() - t0
 
     t_start = time.perf_counter()
     with concurrent.futures.ThreadPoolExecutor(opts.c) as ex:
-        for fid, dt in ex.map(do_write, range(opts.n)):
-            fids.append(fid)
+        for fid, loc, dt in ex.map(do_write, range(opts.n)):
+            fids.append((fid, loc))
             write_lat.append(dt)
     write_wall = time.perf_counter() - t_start
 
     read_lat: list[float] = []
 
-    def do_read(fid: str):
+    def do_read(item):
+        fid, loc = item
         t0 = time.perf_counter()
-        data = client.fetch(fid)
-        assert len(data) == opts.size
+        status, _, data = pool.request("GET", f"{peer_url(loc)}/{fid}")
+        assert status == 200 and len(data) == opts.size, (status, len(data))
         return time.perf_counter() - t0
 
     order = fids[:]

@@ -181,6 +181,13 @@ class BrokerServer:
         # follower replica tails: partition key -> {offset: message}
         self._replicas: dict[str, dict[int, dict]] = {}
         self._plock = threading.Lock()
+        # one long-lived pool for follower fan-out: per-publish executors
+        # would pay thread spawn inside pub_lock and stall process exit
+        import concurrent.futures as _cf
+
+        self._repl_pool = _cf.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="mq-follow"
+        )
         self._stop = threading.Event()
         self._routes()
 
@@ -195,6 +202,7 @@ class BrokerServer:
         self._stop.set()
         self.flush_all()
         self.service.stop()
+        self._repl_pool.shutdown(wait=False, cancel_futures=True)
 
     @property
     def url(self) -> str:
@@ -391,8 +399,7 @@ class BrokerServer:
                         return 1
 
                     acked = 0
-                    ex = concurrent.futures.ThreadPoolExecutor(len(followers))
-                    futs = [ex.submit(one, f) for f in followers]
+                    futs = [self._repl_pool.submit(one, f) for f in followers]
                     try:
                         for fut in concurrent.futures.as_completed(
                             futs, timeout=5
@@ -403,10 +410,6 @@ class BrokerServer:
                                 pass
                     except concurrent.futures.TimeoutError:
                         pass  # stragglers count as un-acked
-                    finally:
-                        # don't block the publish on a blackholed follower;
-                        # the worker threads die with their 3s post timeout
-                        ex.shutdown(wait=False)
                     return acked >= _need
 
             try:

@@ -131,24 +131,32 @@ class Replicator:
             new_path = new["full_path"]
             if new_path.startswith(SYSTEM_LOG_DIR):
                 return
-            # a rename's old-path delete happens regardless of whether the
-            # new content is still readable — otherwise a replayed rename
-            # leaves the stale old key in the sink forever
-            if old is not None and old["full_path"] != new_path:
-                self.sink.delete_entry(
-                    old["full_path"], bool(old.get("is_directory"))
-                )
+            # read BEFORE mutating the sink: a transient source failure
+            # must leave the sink untouched (drain loops like
+            # filer.replicate advance past raised events, so partial
+            # application would be permanent)
             data = None
+            superseded = False
             if not new.get("is_directory"):
                 try:
                     data = self._read(new_path, new)
                 except IOError as e:
-                    if "404" in str(e):
+                    # status suffix, not substring: paths may contain "404"
+                    if str(e).rstrip().endswith("404"):
                         # replaying history: this create was superseded
-                        # (renamed/deleted later at the source); a later
-                        # event in the stream converges the sink
-                        return
-                    raise  # transient source failure: caller retries
+                        # (renamed/deleted later at the source); later
+                        # events converge the sink
+                        superseded = True
+                    else:
+                        raise  # transient failure: caller retries
+            if old is not None and old["full_path"] != new_path:
+                # rename: the old key must go even when the new content is
+                # superseded, or a replayed rename leaves it stale forever
+                self.sink.delete_entry(
+                    old["full_path"], bool(old.get("is_directory"))
+                )
+            if superseded:
+                return
             if old is not None and old["full_path"] == new_path:
                 self.sink.update_entry(new_path, new, data)
             else:

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import re
+import os
 import socket
 import threading
 import urllib.parse
@@ -234,11 +235,31 @@ class HTTPService:
         except (BrokenPipeError, ConnectionResetError):
             pass
 
+    _SWITCH_INTERVAL_SET = False
+
     def start(self) -> None:
+        # Many handler threads on few cores convoy badly on the default 5ms
+        # GIL switch interval (p99 explodes, throughput collapses ~2-4x on a
+        # single-core host). Request serving is IO-and-syscall heavy and the
+        # compute kernels release the GIL in C, so a sub-ms interval is the
+        # right trade for every server in this process. Override:
+        # SEAWEEDFS_TPU_SWITCH_INTERVAL (seconds; "0" leaves the default).
+        if not HTTPService._SWITCH_INTERVAL_SET:
+            HTTPService._SWITCH_INTERVAL_SET = True
+            import sys as _sys
+
+            val = os.environ.get("SEAWEEDFS_TPU_SWITCH_INTERVAL", "0.0005")
+            try:
+                if float(val) > 0:
+                    _sys.setswitchinterval(float(val))
+            except ValueError:
+                pass
         service = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True  # response headers+body are
+            # separate writes; Nagle would stall keep-alive clients ~40ms
 
             def log_message(self, fmt, *args):  # silent
                 pass
@@ -354,3 +375,89 @@ def post_json(url: str, payload: dict | None = None, timeout: float = 30.0) -> d
     if status >= 400:
         raise IOError(f"POST {url} -> {status}: {data}")
     return data
+
+
+class PooledHTTP:
+    """Thread-local keep-alive connections per endpoint.
+
+    urllib opens (and tears down) a TCP connection per call, so hot
+    small-request paths — `weed benchmark`'s 1KB writes/reads, replication
+    fan-outs — end up measuring connection setup instead of the server.
+    The reference's Go clients all reuse connections; this is the
+    equivalent for the data-plane hot paths. Honors process mTLS."""
+
+    def __init__(self, timeout: float = 30.0) -> None:
+        self._tl = threading.local()
+        self.timeout = timeout
+        self._all: set = set()  # every conn, all threads (for close())
+        self._all_mu = threading.Lock()
+
+    def request(
+        self,
+        method: str,
+        url: str,
+        body: bytes | None = None,
+        headers: dict | None = None,
+    ) -> tuple[int, dict, bytes]:
+        import http.client
+        import ssl as _ssl
+
+        u = urllib.parse.urlsplit(url)
+        key = f"{u.scheme}://{u.netloc}"
+        pool = getattr(self._tl, "conns", None)
+        if pool is None:
+            pool = self._tl.conns = {}
+        path = u.path + (f"?{u.query}" if u.query else "")
+        last: Exception | None = None
+        # stale-socket retry only for idempotent methods: a POST may have
+        # been fully processed before the kept-alive socket died, and a
+        # blind re-send would duplicate its side effect
+        attempts = (0, 1) if method in ("GET", "HEAD") else (0,)
+        for attempt in attempts:
+            conn = pool.get(key)
+            if conn is None:
+                if u.scheme == "https":
+                    ctx = _tls.client_context() or _ssl.create_default_context()
+                    conn = http.client.HTTPSConnection(
+                        u.netloc, timeout=self.timeout, context=ctx
+                    )
+                else:
+                    conn = http.client.HTTPConnection(
+                        u.netloc, timeout=self.timeout
+                    )
+                pool[key] = conn
+                with self._all_mu:
+                    self._all.add(conn)
+            try:
+                if conn.sock is None:
+                    conn.connect()
+                    # headers and body go out as separate writes; without
+                    # TCP_NODELAY Nagle + delayed ACK adds ~40ms per request
+                    conn.sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                conn.request(method, path, body=body, headers=headers or {})
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp.status, dict(resp.headers), data
+            except (http.client.HTTPException, OSError) as e:
+                last = e
+                conn.close()
+                pool.pop(key, None)
+                with self._all_mu:
+                    self._all.discard(conn)
+        raise last  # type: ignore[misc]
+
+    def close(self) -> None:
+        """Close every connection this pool ever opened, across threads
+        (worker threads exit without closing their thread-locals)."""
+        with self._all_mu:
+            conns, self._all = self._all, set()
+        for conn in conns:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        pool = getattr(self._tl, "conns", None)
+        if pool:
+            pool.clear()
