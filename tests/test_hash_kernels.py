@@ -179,3 +179,38 @@ class TestHashService:
                 assert f.md5_hex() == hashlib.md5(p).hexdigest()
         finally:
             svc.stop()
+
+
+def test_crc_interleaved_batches_match_oracle():
+    """Triplet-interleaved CRC paths (equal batch / var batch / spans) must
+    stay bit-identical to the scalar oracle across lengths incl. tails that
+    exercise the common-prefix split."""
+    import numpy as np
+
+    from seaweedfs_tpu.native import lib
+    from seaweedfs_tpu.storage import crc as crc_mod
+
+    if lib is None:
+        import pytest
+
+        pytest.skip("no native lib")
+    rng = np.random.RandomState(17)
+    for blob_len in (1, 7, 8, 9, 4096, 4097):
+        for n in (1, 2, 3, 4, 7):
+            blobs = rng.randint(0, 256, size=(n, blob_len), dtype=np.uint8)
+            got = lib.crc32c_batch(blobs, n, blob_len)
+            for i in range(n):
+                assert int(got[i]) == crc_mod.crc32c(blobs[i].tobytes())
+    # var + spans with wildly different lengths in one triplet
+    data = rng.randint(0, 256, size=100_000, dtype=np.uint8)
+    cuts = [1, 9, 5000, 5001, 5002, 65_000, 100_000]
+    digs, crcs = lib.md5_crc_batch_spans(data, cuts)
+    prev = 0
+    for i, c in enumerate(cuts):
+        assert int(crcs[i]) == crc_mod.crc32c(data[prev:c].tobytes()), i
+        prev = c
+    blobs = [rng.randint(0, 256, size=int(l), dtype=np.uint8).tobytes()
+             for l in (0, 3, 8, 100, 5000, 12345, 6)]
+    _, crcs2 = lib.md5_crc_batch_var(blobs)
+    for i, b in enumerate(blobs):
+        assert int(crcs2[i]) == crc_mod.crc32c(b), i
