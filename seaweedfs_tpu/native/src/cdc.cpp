@@ -65,11 +65,10 @@ size_t scan_vec(const unsigned char* data, size_t i, size_t end,
                                          8, 9, 10, 11);
     const __m512i p8 = _mm512_setr_epi32(0, 0, 0, 0, 0, 0, 0, 0, 0, 1, 2, 3,
                                          4, 5, 6, 7);
-    while (i + 16 <= end) {
-        __m128i bytes = _mm_loadu_si128((const __m128i*)(data + i));
-        __m512i idx = _mm512_cvtepu8_epi32(bytes);
-        __m512i v = _mm512_i32gather_epi32(idx, (const int*)gear, 4);
-        // P_j = XOR_{m<=j} v_m << (j-m), via log-step shifted prefix
+    // two independent 16-lane groups per iteration: the two gathers (the
+    // long-latency op) overlap, and the second group's prefix combine only
+    // serializes at the final h-carry xor
+    auto prefix = [&](__m512i v) {
         __m512i p = v;
         p = _mm512_xor_si512(
             p, _mm512_slli_epi32(
@@ -83,24 +82,70 @@ size_t scan_vec(const unsigned char* data, size_t i, size_t end,
         p = _mm512_xor_si512(
             p, _mm512_slli_epi32(
                    _mm512_maskz_permutexvar_epi32(0xFF00, p8, p), 8));
+        return p;
+    };
+    auto lane_filter = [&](size_t base_i) -> __mmask16 {
+        if (can_from <= base_i) return 0xFFFF;
+        return (__mmask16)(can_from - base_i >= 16
+                               ? 0
+                               : (0xFFFF << (can_from - base_i)));
+    };
+    while (i + 32 <= end) {
+        __m128i b0 = _mm_loadu_si128((const __m128i*)(data + i));
+        __m128i b1 = _mm_loadu_si128((const __m128i*)(data + i + 16));
+        __m512i v0 = _mm512_i32gather_epi32(
+            _mm512_cvtepu8_epi32(b0), (const int*)gear, 4);
+        __m512i v1 = _mm512_i32gather_epi32(
+            _mm512_cvtepu8_epi32(b1), (const int*)gear, 4);
+        __m512i pA = prefix(v0);
+        __m512i pB = prefix(v1);
+        __m512i hv = _mm512_sllv_epi32(_mm512_set1_epi32((int)h), shift_amt);
+        __m512i H0 = _mm512_xor_si512(pA, hv);
+        alignas(64) uint32_t hs0[16], hs1[16];
+        _mm512_store_si512(hs0, H0);
+        uint32_t h_mid = hs0[15];
+        __m512i hv1 = _mm512_sllv_epi32(
+            _mm512_set1_epi32((int)h_mid), shift_amt);
+        __m512i H1 = _mm512_xor_si512(pB, hv1);
+        __mmask16 cand0 = _mm512_cmpeq_epi32_mask(
+            _mm512_and_si512(H0, vmask), zero) & lane_filter(i);
+        if (cand0) {
+            int lane = __builtin_ctz((unsigned)cand0);
+            h = hs0[lane];
+            found = true;
+            return i + lane;
+        }
+        __mmask16 cand1 = _mm512_cmpeq_epi32_mask(
+            _mm512_and_si512(H1, vmask), zero) & lane_filter(i + 16);
+        if (cand1) {
+            int lane = __builtin_ctz((unsigned)cand1);
+            _mm512_store_si512(hs1, H1);
+            h = hs1[lane];
+            found = true;
+            return i + 16 + lane;
+        }
+        _mm512_store_si512(hs1, H1);
+        h = hs1[15];
+        i += 32;
+    }
+    while (i + 16 <= end) {
+        __m128i bytes = _mm_loadu_si128((const __m128i*)(data + i));
+        __m512i idx = _mm512_cvtepu8_epi32(bytes);
+        __m512i v = _mm512_i32gather_epi32(idx, (const int*)gear, 4);
+        __m512i p = prefix(v);
         // H_j = P_j ^ (h << (j+1))  (lanes j+1 > 31 impossible: max 16)
         __m512i hv = _mm512_sllv_epi32(_mm512_set1_epi32((int)h), shift_amt);
         __m512i H = _mm512_xor_si512(p, hv);
-        __mmask16 cand = _mm512_cmpeq_epi32_mask(_mm512_and_si512(H, vmask), zero);
-        if (can_from > i)  // drop lanes whose position is below can_from
-            cand &= (__mmask16)(can_from - i >= 16
-                                    ? 0
-                                    : (0xFFFF << (can_from - i)));
+        __mmask16 cand = _mm512_cmpeq_epi32_mask(
+            _mm512_and_si512(H, vmask), zero) & lane_filter(i);
+        alignas(64) uint32_t hs[16];
+        _mm512_store_si512(hs, H);
         if (cand) {
             int lane = __builtin_ctz((unsigned)cand);
-            alignas(64) uint32_t hs[16];
-            _mm512_store_si512(hs, H);
             h = hs[lane];
             found = true;
             return i + lane;
         }
-        alignas(64) uint32_t hs[16];
-        _mm512_store_si512(hs, H);
         h = hs[15];
         i += 16;
     }

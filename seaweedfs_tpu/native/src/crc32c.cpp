@@ -51,15 +51,7 @@ static uint32_t sw_crc32c_tail(uint32_t c, const unsigned char* data, size_t n) 
 extern "C" uint32_t sw_crc32c_update(uint32_t crc, const unsigned char* data, size_t n) {
     uint32_t c = ~crc;
 #if defined(__SSE4_2__)
-    while (n >= 8) {
-        uint64_t v;
-        std::memcpy(&v, data, 8);
-        c = (uint32_t)_mm_crc32_u64(c, v);
-        data += 8;
-        n -= 8;
-    }
-    while (n--) c = _mm_crc32_u8(c, *data++);
-    return ~c;
+    return ~sw_crc32c_tail(c, data, n);
 #else
     init_tables();
     while (n >= 8) {

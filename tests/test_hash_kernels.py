@@ -119,6 +119,40 @@ class TestCDC:
             data, backend="numpy"
         )
 
+    def test_native_scan_bit_identical_to_numpy(self):
+        """The AVX-512 dual-group scan (incl. the can_from lane filter in
+        both 16-lane groups and the min-skip window rewarm) must match the
+        numpy oracle exactly — and this must FAIL, not silently fall back,
+        if the native path regresses."""
+        from seaweedfs_tpu.native import lib
+
+        if lib is None:
+            import pytest
+
+            pytest.skip("no native lib")
+        rng = np.random.RandomState(23)
+        cases = [
+            (70, 8, 64, 1024),
+            (5_000, 8, 64, 1024),
+            (100_000, 13, 2048, 65536),
+            (333_333, 10, 512, 8192),
+            (999_999, 16, 16384, 524288),
+            # tiny min_size: cut-eligible positions land INSIDE the first
+            # vector blocks, exercising the lane filters of both groups
+            (4_096, 6, 8, 256),
+            (4_096, 6, 16, 128),
+            (4_097, 6, 40, 4096),
+        ]
+        for n, ab, mn, mx in cases:
+            data = rng.randint(0, 256, size=n, dtype=np.uint8)
+            a = list(cdc.find_boundaries(
+                data, avg_bits=ab, min_size=mn, max_size=mx,
+                backend="native"))
+            b = list(cdc.find_boundaries(
+                data, avg_bits=ab, min_size=mn, max_size=mx,
+                backend="numpy"))
+            assert a == b, (n, ab, mn, mx)
+
 
 class TestHashService:
     """ops.hash_service: the upload-path micro-batcher (VERDICT r1 next #2)."""
