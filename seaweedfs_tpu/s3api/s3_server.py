@@ -721,8 +721,9 @@ class S3Server:
     def run_lifecycle_sweep(self, now: float | None = None) -> dict:
         """Apply every bucket's lifecycle expiry rules: delete objects whose
         mtime is older than the rule's Days (prefix-filtered). Returns
-        {bucket: expired_count}. Driven by the background sweeper thread or
-        the `s3.lifecycle.apply` shell verb."""
+        {bucket: expired_count}. Driven by the background sweeper thread
+        (lifecycle_sweep_interval) or called directly (tests, operators
+        embedding the gateway)."""
         now = now or time.time()
         out: dict[str, int] = {}
         listing = self.fc.list(BUCKETS_DIR, limit=10_000)
