@@ -65,6 +65,25 @@ def run_filer_replicate(args: list[str]) -> int:
     p.add_argument("-sink.s3.prefix", dest="sink_s3_prefix", default="")
     p.add_argument("-sink.s3.accessKey", dest="sink_s3_ak", default="")
     p.add_argument("-sink.s3.secretKey", dest="sink_s3_sk", default="")
+    p.add_argument("-sink.azure.account", dest="sink_az_account", default=None,
+                   help="replicate into an Azure Blob container")
+    p.add_argument("-sink.azure.key", dest="sink_az_key", default="")
+    p.add_argument("-sink.azure.container", dest="sink_az_container",
+                   default="backup")
+    p.add_argument("-sink.azure.endpoint", dest="sink_az_endpoint",
+                   default=None)
+    p.add_argument("-sink.gcs.bucket", dest="sink_gcs_bucket", default=None,
+                   help="replicate into a GCS bucket (JSON API)")
+    p.add_argument("-sink.gcs.credentials", dest="sink_gcs_creds", default="",
+                   help="service-account JSON key file")
+    p.add_argument("-sink.gcs.endpoint", dest="sink_gcs_endpoint",
+                   default="https://storage.googleapis.com")
+    p.add_argument("-sink.b2.accountId", dest="sink_b2_account", default=None,
+                   help="replicate into a Backblaze B2 bucket")
+    p.add_argument("-sink.b2.applicationKey", dest="sink_b2_key", default="")
+    p.add_argument("-sink.b2.bucket", dest="sink_b2_bucket", default="backup")
+    p.add_argument("-sink.b2.endpoint", dest="sink_b2_endpoint",
+                   default="https://api.backblazeb2.com")
     p.add_argument("-interval", type=float, default=1.0)
     p.add_argument("-once", action="store_true", help="drain spool and exit")
     opts = p.parse_args(args)
@@ -88,8 +107,36 @@ def run_filer_replicate(args: list[str]) -> int:
             access_key=opts.sink_s3_ak, secret_key=opts.sink_s3_sk,
             prefix=opts.sink_s3_prefix,
         )
+    elif opts.sink_az_account:
+        from seaweedfs_tpu.replication.cloud_sinks import AzureSink
+
+        sink = AzureSink(opts.sink_az_account, opts.sink_az_key,
+                         opts.sink_az_container,
+                         endpoint=opts.sink_az_endpoint)
+    elif opts.sink_gcs_bucket:
+        import json as _json
+
+        from seaweedfs_tpu.replication.cloud_sinks import (
+            GcsSink,
+            service_account_token_provider,
+        )
+
+        if not opts.sink_gcs_creds:
+            print("-sink.gcs.bucket needs -sink.gcs.credentials "
+                  "(service-account JSON key file)")
+            return 1
+        with open(opts.sink_gcs_creds) as fh:
+            creds = _json.load(fh)
+        sink = GcsSink(opts.sink_gcs_bucket,
+                       service_account_token_provider(creds),
+                       endpoint=opts.sink_gcs_endpoint)
+    elif opts.sink_b2_account:
+        from seaweedfs_tpu.replication.cloud_sinks import B2Sink
+
+        sink = B2Sink(opts.sink_b2_account, opts.sink_b2_key,
+                      opts.sink_b2_bucket, endpoint=opts.sink_b2_endpoint)
     else:
-        print("need -sink.local, -sink.filer or -sink.s3.endpoint")
+        print("need a -sink.{local,filer,s3,azure,gcs,b2} target")
         return 1
     src = FilerClient(opts.source)
     rep = Replicator(sink, read_content=lambda path, entry: src.read(path))

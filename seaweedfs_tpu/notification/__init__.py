@@ -130,4 +130,34 @@ def configure_notification(kind: str, **opts) -> NotificationQueue:
         return FileQueue(opts["spool_dir"])
     if kind == "kafka":
         return KafkaQueue(opts["hosts"], opts["topic"])  # pragma: no cover
+    if kind == "aws_sqs":
+        from .cloud import SqsQueue
+
+        return SqsQueue(
+            opts.get("access_key", ""), opts.get("secret_key", ""),
+            opts.get("region", "us-east-1"), opts["queue_name"],
+            endpoint=opts.get("endpoint"),
+        )
+    if kind == "google_pub_sub":
+        from .cloud import GooglePubSubQueue
+
+        provider = opts.get("token_provider")
+        if provider is None and opts.get("google_application_credentials"):
+            # config files can only carry strings: build the OAuth2 provider
+            # from the service-account key path, like the reference's
+            # google_application_credentials option
+            from seaweedfs_tpu.replication.cloud_sinks import (
+                service_account_token_provider,
+            )
+
+            with open(opts["google_application_credentials"]) as fh:
+                creds = json.load(fh)
+            provider = service_account_token_provider(
+                creds, scope="https://www.googleapis.com/auth/pubsub"
+            )
+        return GooglePubSubQueue(
+            opts["project"], opts["topic"],
+            token_provider=provider,
+            endpoint=opts.get("endpoint", "https://pubsub.googleapis.com"),
+        )
     raise ValueError(f"unknown notification kind {kind!r}")

@@ -1,0 +1,553 @@
+"""Contract tests for the native REST cloud sinks + notification queues.
+
+Each fake implements the provider's wire protocol server-side — Azure
+SharedKey signature verification, GCS OAuth2 JWT grant with real RS256
+verification, B2's auth/upload-url/sha1 handshake, SQS SigV4 — so the
+clients are exercised end-to-end exactly as the real services would,
+minus the network (`weed/replication/sink/{azuresink,gcssink,b2sink}`,
+`weed/notification/{aws_sqs,google_pub_sub}` are the behavior specs).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+AZ_ACCOUNT = "testaccount"
+AZ_KEY = base64.b64encode(b"0123456789abcdef0123456789abcdef").decode()
+
+
+def _start(handler_cls):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+class _QuietHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _reply(self, status: int, body: bytes = b"", ctype="application/json"):
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+# ---------------------------------------------------------------------------
+# Azure
+# ---------------------------------------------------------------------------
+
+
+class TestAzureSink:
+    @pytest.fixture()
+    def fake_azure(self):
+        blobs: dict[str, bytearray] = {}
+        rejected: list[str] = []
+
+        class Handler(_QuietHandler):
+            def _verify(self) -> bool:
+                from seaweedfs_tpu.replication.cloud_sinks import (
+                    azure_sharedkey_signature,
+                )
+
+                parsed = urllib.parse.urlparse(self.path)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                # server-side recomputation from the raw request: any
+                # canonicalization drift between what the client signed
+                # and what it sent fails here
+                headers = {
+                    k: v for k, v in self.headers.items()
+                    if k.lower().startswith("x-ms-")
+                    or k.lower() in ("content-length", "content-type")
+                }
+                expect = azure_sharedkey_signature(
+                    AZ_ACCOUNT, AZ_KEY, self.command, headers,
+                    parsed.path, query,  # the URI as sent (percent-encoded)
+                )
+                ok = self.headers.get("Authorization") == expect
+                if not ok:
+                    rejected.append(self.path)
+                return ok
+
+            def do_PUT(self):
+                body = self._body()  # drain before any error reply
+                if not self._verify():
+                    return self._reply(403)
+                parsed = urllib.parse.urlparse(self.path)
+                blob = urllib.parse.unquote(parsed.path).split("/", 2)[2]
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                if query.get("comp") == "appendblock":
+                    if blob not in blobs:
+                        return self._reply(404)
+                    blobs[blob].extend(body)
+                    return self._reply(201)
+                if self.headers.get("x-ms-blob-type") != "AppendBlob":
+                    return self._reply(400)
+                blobs[blob] = bytearray()
+                return self._reply(201)
+
+            def do_DELETE(self):
+                if not self._verify():
+                    return self._reply(403)
+                blob = urllib.parse.unquote(
+                    urllib.parse.urlparse(self.path).path
+                ).split("/", 2)[2]
+                if blob in blobs:
+                    del blobs[blob]
+                    return self._reply(202)
+                return self._reply(404)
+
+        srv, url = _start(Handler)
+        try:
+            yield blobs, rejected, url
+        finally:
+            srv.shutdown()
+
+    def test_create_append_delete_signed(self, fake_azure):
+        from seaweedfs_tpu.replication.cloud_sinks import AzureSink
+
+        blobs, rejected, url = fake_azure
+        sink = AzureSink(AZ_ACCOUNT, AZ_KEY, "ctr", endpoint=url)
+        sink.create_entry("/docs/a bin.dat", {}, b"hello " * 100)
+        assert bytes(blobs["docs/a bin.dat"]) == b"hello " * 100
+        assert rejected == []
+        sink.update_entry("/docs/a bin.dat", {}, b"v2")
+        assert bytes(blobs["docs/a bin.dat"]) == b"v2"
+        sink.delete_entry("/docs/a bin.dat", is_directory=False)
+        assert blobs == {}
+        # 404 deletes are tolerated (reference ignores missing blobs)
+        sink.delete_entry("/gone.txt", is_directory=False)
+        # directories are implicit: create is a no-op
+        sink.create_entry("/docs", {"is_directory": True}, None)
+        assert blobs == {}
+
+    def test_large_file_appends_in_blocks(self, fake_azure):
+        from seaweedfs_tpu.replication import Replicator
+        from seaweedfs_tpu.replication.cloud_sinks import (
+            _APPEND_BLOCK,
+            AzureSink,
+        )
+
+        blobs, rejected, url = fake_azure
+        sink = AzureSink(AZ_ACCOUNT, AZ_KEY, "ctr", endpoint=url)
+        payload = bytes(range(256)) * ((_APPEND_BLOCK + 512) // 256)
+        rep = Replicator(sink, read_content=lambda p, e: payload)
+        rep.replicate({"old_entry": None,
+                       "new_entry": {"full_path": "/big.bin"}})
+        assert bytes(blobs["big.bin"]) == payload
+        # rename = delete old + create new
+        rep.replicate({"old_entry": {"full_path": "/big.bin"},
+                       "new_entry": {"full_path": "/big2.bin"}})
+        assert "big.bin" not in blobs and bytes(blobs["big2.bin"]) == payload
+        assert rejected == []
+
+    def test_sharedkey_pinned_vector(self):
+        """Non-circular spec check: the string-to-sign is written out by
+        hand here per the Storage Services auth spec (VERB, 11 standard
+        header slots with empty Date and empty zero content-length,
+        lexicographic x-ms-* canonicalization, /account + path + sorted
+        query resource) and HMAC'd independently of the implementation."""
+        import hmac as _hmac
+
+        from seaweedfs_tpu.replication.cloud_sinks import (
+            azure_sharedkey_signature,
+        )
+
+        headers = {
+            "x-ms-date": "Thu, 30 Jul 2026 01:02:03 GMT",
+            "x-ms-version": "2021-08-06",
+            "x-ms-blob-type": "AppendBlob",
+            "content-length": "0",
+            "content-type": "application/octet-stream",
+        }
+        expected_to_sign = (
+            "PUT\n"            # VERB
+            "\n"               # Content-Encoding
+            "\n"               # Content-Language
+            "\n"               # Content-Length ("0" signs as empty)
+            "\n"               # Content-MD5
+            "application/octet-stream\n"  # Content-Type
+            "\n"               # Date (always empty; x-ms-date rules)
+            "\n\n\n\n"         # If-Modified/Match/None-Match/Unmodified
+            "\n"               # Range
+            "x-ms-blob-type:AppendBlob\n"
+            "x-ms-date:Thu, 30 Jul 2026 01:02:03 GMT\n"
+            "x-ms-version:2021-08-06\n"
+            "/testaccount/ctr/a%20b.txt\n"
+            "comp:appendblock"
+        )
+        digest = _hmac.new(
+            base64.b64decode(AZ_KEY), expected_to_sign.encode(),
+            hashlib.sha256,
+        ).digest()
+        pinned = f"SharedKey testaccount:{base64.b64encode(digest).decode()}"
+        got = azure_sharedkey_signature(
+            "testaccount", AZ_KEY, "PUT", headers,
+            "/ctr/a%20b.txt", {"comp": "appendblock"},
+        )
+        assert got == pinned
+
+    def test_wrong_key_rejected(self, fake_azure):
+        from seaweedfs_tpu.replication.cloud_sinks import (
+            AzureSink,
+            CloudSinkError,
+        )
+
+        blobs, rejected, url = fake_azure
+        bad = base64.b64encode(b"wrong-key-wrong-key-wrong-key-!!").decode()
+        sink = AzureSink(AZ_ACCOUNT, bad, "ctr", endpoint=url)
+        with pytest.raises(CloudSinkError):
+            sink.create_entry("/x.txt", {}, b"data")
+        assert rejected and blobs == {}
+
+
+# ---------------------------------------------------------------------------
+# GCS
+# ---------------------------------------------------------------------------
+
+
+class TestGcsSink:
+    @pytest.fixture()
+    def fake_gcs(self):
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        pem = key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ).decode()
+        pub = key.public_key()
+        objects: dict[str, bytes] = {}
+        state = {"tokens_issued": 0}
+
+        class Handler(_QuietHandler):
+            def do_POST(self):
+                body = self._body()
+                if self.path == "/token":
+                    form = dict(urllib.parse.parse_qsl(body.decode()))
+                    h, c, s = form["assertion"].split(".")
+                    sig = base64.urlsafe_b64decode(s + "==")
+                    pub.verify(  # raises on a bad RS256 signature
+                        sig, f"{h}.{c}".encode(),
+                        padding.PKCS1v15(), hashes.SHA256(),
+                    )
+                    claims = json.loads(
+                        base64.urlsafe_b64decode(c + "=="))
+                    assert claims["iss"] == "svc@proj.iam.gserviceaccount.com"
+                    state["tokens_issued"] += 1
+                    tok = f"tok-{state['tokens_issued']}"
+                    return self._reply(200, json.dumps(
+                        {"access_token": tok, "expires_in": 3600}).encode())
+                if self.path.startswith("/upload/storage/v1/b/buck/o"):
+                    if self.headers.get("Authorization", "").removeprefix(
+                            "Bearer ") != f"tok-{state['tokens_issued']}":
+                        return self._reply(401)
+                    q = dict(urllib.parse.parse_qsl(
+                        urllib.parse.urlparse(self.path).query))
+                    assert q["uploadType"] == "media"
+                    objects[urllib.parse.unquote(q["name"])] = body
+                    return self._reply(200, b"{}")
+                return self._reply(404)
+
+            def do_DELETE(self):
+                if not self.path.startswith("/storage/v1/b/buck/o/"):
+                    return self._reply(404)
+                name = urllib.parse.unquote(self.path.split("/o/", 1)[1])
+                if objects.pop(name, None) is None:
+                    return self._reply(404)
+                return self._reply(204)
+
+        srv, url = _start(Handler)
+        try:
+            yield pem, objects, state, url
+        finally:
+            srv.shutdown()
+
+    def test_jwt_grant_and_object_lifecycle(self, fake_gcs):
+        from seaweedfs_tpu.replication.cloud_sinks import (
+            GcsSink,
+            service_account_token_provider,
+        )
+
+        pem, objects, state, url = fake_gcs
+        creds = {
+            "client_email": "svc@proj.iam.gserviceaccount.com",
+            "private_key": pem,
+            "token_uri": f"{url}/token",
+        }
+        sink = GcsSink("buck", service_account_token_provider(creds),
+                       endpoint=url)
+        sink.create_entry("/a/b c.txt", {"attributes": {"mime": "text/plain"}},
+                          b"gcs-data")
+        assert objects["a/b c.txt"] == b"gcs-data"
+        assert state["tokens_issued"] == 1
+        sink.update_entry("/a/b c.txt", {}, b"v2")
+        assert objects["a/b c.txt"] == b"v2"
+        assert state["tokens_issued"] == 1  # cached until expiry
+        sink.delete_entry("/a/b c.txt", is_directory=False)
+        assert objects == {}
+        sink.delete_entry("/a", is_directory=True)  # marker delete, 404 ok
+
+
+# ---------------------------------------------------------------------------
+# B2
+# ---------------------------------------------------------------------------
+
+
+class TestB2Sink:
+    @pytest.fixture()
+    def fake_b2(self):
+        files: dict[str, list[tuple[str, bytes]]] = {}  # name -> [(id, data)]
+        state = {"auth_calls": 0, "upload_urls": 0, "next_id": 0,
+                 "expire_first_upload_url": False}
+
+        class Handler(_QuietHandler):
+            def do_GET(self):
+                if self.path == "/b2api/v2/b2_authorize_account":
+                    expect = base64.b64encode(b"acct:app-key").decode()
+                    if self.headers.get("Authorization") != f"Basic {expect}":
+                        return self._reply(401)
+                    state["auth_calls"] += 1
+                    port = self.server.server_address[1]
+                    return self._reply(200, json.dumps({
+                        "accountId": "acct",
+                        "apiUrl": f"http://127.0.0.1:{port}",
+                        "authorizationToken": "api-tok",
+                    }).encode())
+                return self._reply(404)
+
+            def do_POST(self):
+                body = self._body()
+                if self.path.startswith("/b2api/v2/"):
+                    if self.headers.get("Authorization") != "api-tok":
+                        return self._reply(401)
+                    call = self.path.rsplit("/", 1)[1]
+                    req = json.loads(body)
+                    if call == "b2_list_buckets":
+                        return self._reply(200, json.dumps({"buckets": [
+                            {"bucketName": "bkt", "bucketId": "bkt-id"}
+                        ]}).encode())
+                    if call == "b2_get_upload_url":
+                        assert req["bucketId"] == "bkt-id"
+                        state["upload_urls"] += 1
+                        n = state["upload_urls"]
+                        port = self.server.server_address[1]
+                        return self._reply(200, json.dumps({
+                            "uploadUrl": f"http://127.0.0.1:{port}/upload/{n}",
+                            "authorizationToken": f"up-tok-{n}",
+                        }).encode())
+                    if call == "b2_list_file_versions":
+                        start = req["startFileName"]
+                        out = []
+                        for name in sorted(files):
+                            if name >= start:
+                                out += [{"fileName": name, "fileId": fid}
+                                        for fid, _ in files[name]]
+                        return self._reply(
+                            200, json.dumps({"files": out}).encode())
+                    if call == "b2_delete_file_version":
+                        vs = files.get(req["fileName"], [])
+                        vs = [v for v in vs if v[0] != req["fileId"]]
+                        if vs:
+                            files[req["fileName"]] = vs
+                        else:
+                            files.pop(req["fileName"], None)
+                        return self._reply(200, b"{}")
+                    return self._reply(400)
+                if self.path.startswith("/upload/"):
+                    n = int(self.path.rsplit("/", 1)[1])
+                    if (state["expire_first_upload_url"] and n == 1) or \
+                            self.headers.get("Authorization") != f"up-tok-{n}":
+                        return self._reply(401)
+                    if hashlib.sha1(body).hexdigest() != \
+                            self.headers.get("X-Bz-Content-Sha1"):
+                        return self._reply(400)
+                    name = urllib.parse.unquote(
+                        self.headers["X-Bz-File-Name"])
+                    state["next_id"] += 1
+                    files.setdefault(name, []).append(
+                        (f"id-{state['next_id']}", body))
+                    return self._reply(200, b"{}")
+                return self._reply(404)
+
+        srv, url = _start(Handler)
+        try:
+            yield files, state, url
+        finally:
+            srv.shutdown()
+
+    def test_auth_upload_delete_versions(self, fake_b2):
+        from seaweedfs_tpu.replication.cloud_sinks import B2Sink
+
+        files, state, url = fake_b2
+        sink = B2Sink("acct", "app-key", "bkt", endpoint=url)
+        sink.create_entry("/p/x.txt", {}, b"one")
+        sink.create_entry("/p/x.txt", {}, b"two")  # second version
+        assert [d for _, d in files["p/x.txt"]] == [b"one", b"two"]
+        assert state["auth_calls"] == 1  # session cached
+        # delete removes EVERY version (b2_sink.go deletes the object)
+        sink.delete_entry("/p/x.txt", is_directory=False)
+        assert files == {}
+
+    def test_expired_upload_url_retried(self, fake_b2):
+        from seaweedfs_tpu.replication.cloud_sinks import B2Sink
+
+        files, state, url = fake_b2
+        sink = B2Sink("acct", "app-key", "bkt", endpoint=url)
+        state["expire_first_upload_url"] = True
+        sink.create_entry("/y.bin", {}, b"payload")
+        assert [d for _, d in files["y.bin"]] == [b"payload"]
+        assert state["upload_urls"] == 2  # first URL 401'd, client re-fetched
+
+
+# ---------------------------------------------------------------------------
+# SQS + Pub/Sub notification queues
+# ---------------------------------------------------------------------------
+
+
+class TestCloudNotification:
+    @pytest.fixture()
+    def fake_sqs(self):
+        sent: list[dict] = []
+
+        class Handler(_QuietHandler):
+            def do_POST(self):
+                import hmac as _hmac
+
+                from seaweedfs_tpu.s3api.auth import (
+                    canonical_request,
+                    signing_key,
+                    string_to_sign,
+                )
+
+                body = self._body()
+                # server-side SigV4 recomputation with the known secret
+                auth = self.headers["Authorization"]
+                assert auth.startswith("AWS4-HMAC-SHA256 Credential=AK/")
+                scope = auth.split("Credential=AK/", 1)[1].split(",", 1)[0]
+                date = scope.split("/", 1)[0]
+                assert scope.endswith("/eu-west-1/sqs/aws4_request")
+                headers = {
+                    "host": self.headers["Host"],
+                    "x-amz-date": self.headers["x-amz-date"],
+                    "content-type": self.headers["Content-Type"],
+                }
+                canon = canonical_request(
+                    "POST", self.path, [], headers, sorted(headers),
+                    hashlib.sha256(body).hexdigest(),
+                )
+                sig = _hmac.new(
+                    signing_key("SK", date, "eu-west-1", "sqs"),
+                    string_to_sign(
+                        self.headers["x-amz-date"], scope, canon
+                    ).encode(),
+                    hashlib.sha256,
+                ).hexdigest()
+                if f"Signature={sig}" not in auth:
+                    return self._reply(403, b"<Error/>")
+                form = dict(urllib.parse.parse_qsl(body.decode()))
+                if form["Action"] == "GetQueueUrl":
+                    assert form["QueueName"] == "events"
+                    port = self.server.server_address[1]
+                    return self._reply(200, (
+                        "<GetQueueUrlResponse><GetQueueUrlResult><QueueUrl>"
+                        f"http://127.0.0.1:{port}/123/events"
+                        "</QueueUrl></GetQueueUrlResult></GetQueueUrlResponse>"
+                    ).encode(), "text/xml")
+                if form["Action"] == "SendMessage":
+                    assert self.path == "/123/events"
+                    sent.append(form)
+                    return self._reply(
+                        200, b"<SendMessageResponse/>", "text/xml")
+                return self._reply(400)
+
+        srv, url = _start(Handler)
+        try:
+            yield sent, url
+        finally:
+            srv.shutdown()
+
+    def test_sqs_send_signed(self, fake_sqs):
+        from seaweedfs_tpu.notification import configure_notification
+
+        sent, url = fake_sqs
+        q = configure_notification(
+            "aws_sqs", access_key="AK", secret_key="SK", region="eu-west-1",
+            queue_name="events", endpoint=url,
+        )
+        q.send_message("/dir/f.txt", {"op": "create"})
+        assert len(sent) == 1
+        m = sent[0]
+        assert json.loads(m["MessageBody"]) == {"op": "create"}
+        assert m["MessageAttribute.1.Name"] == "key"
+        assert m["MessageAttribute.1.Value.StringValue"] == "/dir/f.txt"
+        assert m["DelaySeconds"] == "10"  # aws_sqs_pub.go:78
+
+    def test_pubsub_publish_and_autocreate(self):
+        published: list[dict] = []
+        topics: set[str] = set()
+
+        class Handler(_QuietHandler):
+            def do_GET(self):
+                ok = self.path.strip("/").removeprefix("v1/") in topics
+                self._reply(200 if ok else 404, b"{}")
+
+            def do_PUT(self):
+                topics.add(self.path.strip("/").removeprefix("v1/"))
+                self._reply(200, b"{}")
+
+            def do_POST(self):
+                assert self.path.endswith(":publish")
+                published.append(json.loads(self._body()))
+                self._reply(200, b'{"messageIds": ["1"]}')
+
+        srv, url = _start(Handler)
+        try:
+            from seaweedfs_tpu.notification import configure_notification
+
+            q = configure_notification(
+                "google_pub_sub", project="proj", topic="seaweed",
+                endpoint=url,
+            )
+            assert "projects/proj/topics/seaweed" in topics
+            q.send_message("/k.txt", {"op": "delete"})
+            msg = published[0]["messages"][0]
+            assert json.loads(base64.b64decode(msg["data"])) == {
+                "op": "delete"}
+            assert msg["attributes"]["key"] == "/k.txt"
+        finally:
+            srv.shutdown()
+
+    def test_filer_events_flow_to_sqs(self, fake_sqs, tmp_path):
+        """Live filer wired to the SQS queue: mutations publish."""
+        from seaweedfs_tpu.filer.entry import Entry
+        from seaweedfs_tpu.filer.filer import Filer
+        from seaweedfs_tpu.notification import configure_notification
+
+        sent, url = fake_sqs
+        f = Filer()
+        f.notification_queue = configure_notification(
+            "aws_sqs", access_key="AK", secret_key="SK", region="eu-west-1",
+            queue_name="events", endpoint=url,
+        )
+        f.create_entry(Entry(full_path="/n/a.txt"))
+        f.delete_entry("/n/a.txt")
+        keys = [m["MessageAttribute.1.Value.StringValue"] for m in sent]
+        assert keys.count("/n/a.txt") >= 2
