@@ -142,6 +142,18 @@ class NativeLib:
             ctypes.c_int,  # row_count
             ctypes.c_void_p,  # out (rows, row_count*block)
         ]
+        self._lib.sw_loadgen.restype = ctypes.c_int
+        self._lib.sw_loadgen.argtypes = [
+            ctypes.c_char_p,  # host
+            ctypes.c_int,  # port
+            ctypes.c_int,  # concurrent keep-alive conns
+            ctypes.c_char_p,  # method
+            ctypes.c_char_p,  # \0-joined paths
+            ctypes.c_size_t,  # path count
+            ctypes.c_char_p,  # body (POST)
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_ulonglong),  # out: ok, err, ns
+        ]
 
     def has(self, _name: str) -> bool:
         return True
@@ -296,6 +308,30 @@ class NativeLib:
             min_size, max_size, cuts.ctypes.data, max_cuts,
         )
         return cuts[:n]
+
+    def loadgen(self, host: str, port: int, conns: int, method: str,
+                paths: list, body: bytes | None = None) -> dict:
+        """Drive an HTTP server with keep-alive connections from native code
+        (one epoll thread, no GIL in the request loop). Returns ok/err
+        counts and req/s — the measuring stick for the fastlane engine."""
+        blob = b"".join(
+            (p if isinstance(p, bytes) else p.encode()) + b"\0" for p in paths
+        )
+        out = (ctypes.c_ulonglong * 3)()
+        rc = self._lib.sw_loadgen(
+            host.encode(), port, conns, method.encode(), blob, len(paths),
+            body, len(body) if body else 0, out,
+        )
+        secs = out[2] / 1e9 if out[2] else 1.0
+        result = {
+            "ok": int(out[0]),
+            "errors": int(out[1]),  # C side accounts every unfinished path
+            "seconds": round(secs, 3),
+            "req_per_sec": round(out[0] / secs, 1),
+        }
+        if rc != 0:
+            result["error"] = f"sw_loadgen rc={rc} (connect failure)"
+        return result
 
     def crc32c_batch(self, blobs, n: int, blob_len: int):
         """blobs: C-contiguous uint8 numpy array (n, blob_len) — zero-copy;

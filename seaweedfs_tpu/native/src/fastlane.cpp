@@ -1,0 +1,1371 @@
+// Fastlane: epoll HTTP/1.1 front door for the volume-server data plane.
+//
+// The reference serves its data plane from Go (one goroutine per
+// connection, all cores; `weed/server/volume_server_handlers_read.go:45`,
+// `_write.go:18`). A Python http.server cannot reach that under the GIL,
+// so this engine owns the hot path natively inside the same process:
+//
+//   GET/HEAD /<vid>,<fid>       -> lock-free-ish map lookup + pread + parse
+//   POST/PUT /<vid>,<fid>       -> needle encode + append + map/idx update
+//   DELETE   /<vid>,<fid>       -> tombstone append
+//   everything else             -> proxied verbatim to the Python backend
+//                                  (admin plane, range reads, TTL writes,
+//                                  overwrites, replicated volumes, JWT...)
+//
+// Python stays the owner of volume lifecycle: it registers volumes
+// (dup'd .dat/.idx fds + a bulk map load), routes its own rare appends
+// through this engine's per-volume lock/tail, and drains an event queue
+// to keep its needle map in sync (storage/fastlane.py).
+//
+// On-disk formats written here are bit-identical to storage/needle.py
+// (v2/v3 needle records) and storage/idx.py (16-byte idx entries).
+
+#include <arpa/inet.h>
+#include <ctype.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/uio.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" uint32_t sw_crc32c_update(uint32_t crc, const char* data, size_t len);
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// needle map: open addressing, u64 key -> (offset bytes u64, size i32)
+// ---------------------------------------------------------------------------
+
+struct NMap {
+    struct Slot { uint64_t key; uint64_t off; int32_t size; uint8_t state; };
+    // state: 0 empty, 1 live, 2 hole (deleted; key kept for probing)
+    std::vector<Slot> slots;
+    size_t live = 0, used = 0;
+
+    NMap() { slots.resize(1024); }
+
+    static uint64_t hash(uint64_t k) {
+        k ^= k >> 33; k *= 0xff51afd7ed558ccdULL; k ^= k >> 33;
+        k *= 0xc4ceb9fe1a85ec53ULL; k ^= k >> 33; return k;
+    }
+    void grow() {
+        std::vector<Slot> old;
+        old.swap(slots);
+        slots.resize(old.size() * 2);
+        used = live;
+        for (auto& s : old)
+            if (s.state == 1) place(s.key, s.off, s.size);
+    }
+    void place(uint64_t key, uint64_t off, int32_t size) {
+        size_t mask = slots.size() - 1;
+        size_t i = hash(key) & mask;
+        while (slots[i].state == 1 && slots[i].key != key) i = (i + 1) & mask;
+        if (slots[i].state != 1) { if (slots[i].state == 0) used++; live++; }
+        slots[i] = {key, off, size, 1};
+    }
+    void put(uint64_t key, uint64_t off, int32_t size) {
+        if ((used + 1) * 10 >= slots.size() * 7) grow();
+        // overwrite-in-place if present (incl. reviving a hole)
+        size_t mask = slots.size() - 1;
+        size_t i = hash(key) & mask;
+        size_t first_hole = SIZE_MAX;
+        while (slots[i].state != 0) {
+            if (slots[i].key == key) {
+                if (slots[i].state != 1) live++;
+                slots[i].off = off; slots[i].size = size; slots[i].state = 1;
+                return;
+            }
+            if (slots[i].state == 2 && first_hole == SIZE_MAX) first_hole = i;
+            i = (i + 1) & mask;
+        }
+        if (first_hole != SIZE_MAX) i = first_hole; else used++;
+        slots[i] = {key, off, size, 1};
+        live++;
+    }
+    bool get(uint64_t key, uint64_t* off, int32_t* size) const {
+        size_t mask = slots.size() - 1;
+        size_t i = hash(key) & mask;
+        while (slots[i].state != 0) {
+            if (slots[i].state == 1 && slots[i].key == key) {
+                *off = slots[i].off; *size = slots[i].size; return true;
+            }
+            i = (i + 1) & mask;
+        }
+        return false;
+    }
+    bool del(uint64_t key) {
+        size_t mask = slots.size() - 1;
+        size_t i = hash(key) & mask;
+        while (slots[i].state != 0) {
+            if (slots[i].state == 1 && slots[i].key == key) {
+                slots[i].state = 2; live--; return true;
+            }
+            i = (i + 1) & mask;
+        }
+        return false;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// volume registry
+// ---------------------------------------------------------------------------
+
+struct Vol {
+    uint32_t vid;
+    int dat_fd = -1, idx_fd = -1;
+    int version = 3;
+    std::atomic<uint64_t> tail{0};
+    std::atomic<uint64_t> last_ns{0};
+    std::atomic<bool> readonly{false};
+    std::atomic<bool> forward_writes{false};
+    std::mutex append_mu;           // serializes .dat appends (C++ and Python)
+    std::shared_mutex map_mu;       // guards nmap
+    NMap nmap;
+    ~Vol() {
+        if (dat_fd >= 0) close(dat_fd);
+        if (idx_fd >= 0) close(idx_fd);
+    }
+};
+
+struct Event {  // mirrored by storage/fastlane.py (40 bytes, little-endian)
+    uint32_t vid;
+    uint32_t op;        // 0 put, 1 delete-tombstone
+    uint64_t key;
+    uint64_t offset;    // byte offset of the written record
+    int32_t size;       // needle body size (put) or freed size (delete)
+    uint32_t pad;
+    uint64_t append_ns;
+};
+
+struct Engine;
+std::vector<Engine*> g_engines;   // slot per started engine; null when stopped
+std::mutex g_engine_mu;
+
+Engine* engine_at(int h) {
+    std::lock_guard<std::mutex> gl(g_engine_mu);
+    if (h < 0 || (size_t)h >= g_engines.size()) return nullptr;
+    return g_engines[h];
+}
+
+struct Stats {
+    std::atomic<uint64_t> requests{0}, native_reads{0}, native_writes{0},
+        native_deletes{0}, proxied{0};
+};
+
+// ---------------------------------------------------------------------------
+// HTTP connection state
+// ---------------------------------------------------------------------------
+
+struct BackendConn;
+
+struct Conn {
+    int kind = 0;        // epoll data discriminator: 0 = client connection
+    int fd = -1;
+    std::string in;      // accumulated request bytes
+    std::string out;     // pending response bytes
+    size_t out_off = 0;
+    bool want_close = false;
+    BackendConn* upstream = nullptr;  // pending proxied request, if any
+    time_t last_active = 0;
+};
+
+// One in-flight proxied request to the Python backend. The worker never
+// blocks on it: the backend socket sits in the same epoll and this struct
+// is the parse state machine for its response.
+struct BackendConn {
+    int kind = 1;
+    int fd = -1;
+    Conn* client = nullptr;   // null if the client went away mid-flight
+    std::string req;          // original request bytes (kept for one retry)
+    size_t req_off = 0;       // send progress
+    std::string resp;
+    size_t hdr_end = 0;       // 0 until headers parsed
+    size_t body_need = 0;     // with content-length: total expected bytes
+    int body_mode = 0;        // 0 unknown, 1 content-length, 2 chunked, 3 to-EOF
+    size_t chunk_pos = 0;     // chunked scan cursor
+    bool backend_close = false;
+    bool retried = false;
+    time_t started = 0;
+};
+
+struct Worker {
+    int epfd = -1;
+    std::vector<int> idle_backends;   // keep-alive conns to Python, not in epoll
+    std::vector<BackendConn*> pending;  // in-flight proxied requests
+    std::mutex conns_mu;            // acceptor adds, worker removes
+    std::vector<Conn*> conns;       // for idle sweep / teardown
+    std::vector<Conn*> graveyard;   // closed this loop pass; freed next pass
+    std::vector<BackendConn*> back_graveyard;
+    pthread_t thread;
+};
+
+struct Engine {
+    int listen_fd = -1;
+    int port = 0;
+    int backend_port = 0;
+    bool secure_writes = false;     // JWT configured -> proxy writes
+    bool secure_reads = false;
+    std::atomic<bool> running{true};
+    std::deque<Worker> workers;  // deque: Worker holds mutexes, never moves
+    pthread_t accept_thread;
+    std::shared_mutex reg_mu;
+    std::unordered_map<uint32_t, std::shared_ptr<Vol>> vols;
+    std::mutex ev_mu;
+    std::deque<Event> events;
+    Stats stats;
+
+    std::shared_ptr<Vol> vol(uint32_t vid) {
+        std::shared_lock<std::shared_mutex> l(reg_mu);
+        auto it = vols.find(vid);
+        return it == vols.end() ? nullptr : it->second;
+    }
+    void push_event(const Event& e) {
+        std::lock_guard<std::mutex> l(ev_mu);
+        events.push_back(e);
+    }
+};
+
+// ---------------------------------------------------------------------------
+// small helpers
+// ---------------------------------------------------------------------------
+
+uint64_t now_ns() {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+void put_u32be(uint8_t* p, uint32_t v) {
+    p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+void put_u64be(uint8_t* p, uint64_t v) {
+    put_u32be(p, v >> 32); put_u32be(p + 4, (uint32_t)v);
+}
+uint32_t get_u32be(const uint8_t* p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | p[3];
+}
+uint64_t get_u64be(const uint8_t* p) {
+    return ((uint64_t)get_u32be(p) << 32) | get_u32be(p + 4);
+}
+
+bool set_nonblock(int fd) {
+    int fl = fcntl(fd, F_GETFL, 0);
+    return fl >= 0 && fcntl(fd, F_SETFL, fl | O_NONBLOCK) == 0;
+}
+
+// case-insensitive header lookup inside [hdr_begin, hdr_end); returns value
+// with surrounding spaces trimmed, or empty string
+std::string find_header(const char* b, const char* e, const char* name) {
+    size_t nlen = strlen(name);
+    const char* p = b;
+    while (p < e) {
+        const char* eol = (const char*)memchr(p, '\n', e - p);
+        if (!eol) break;
+        const char* colon = (const char*)memchr(p, ':', eol - p);
+        if (colon && (size_t)(colon - p) == nlen && strncasecmp(p, name, nlen) == 0) {
+            const char* v = colon + 1;
+            const char* ve = eol;
+            if (ve > v && ve[-1] == '\r') ve--;
+            while (v < ve && (*v == ' ' || *v == '\t')) v++;
+            while (ve > v && (ve[-1] == ' ' || ve[-1] == '\t')) ve--;
+            return std::string(v, ve - v);
+        }
+        p = eol + 1;
+    }
+    return "";
+}
+
+void json_escape(const std::string& s, std::string& out) {
+    for (unsigned char c : s) {
+        switch (c) {
+            case '"': out += "\\\""; break;
+            case '\\': out += "\\\\"; break;
+            case '\n': out += "\\n"; break;
+            case '\r': out += "\\r"; break;
+            case '\t': out += "\\t"; break;
+            default:
+                if (c < 0x20) {
+                    char buf[8];
+                    snprintf(buf, sizeof buf, "\\u%04x", c);
+                    out += buf;
+                } else out += (char)c;
+        }
+    }
+}
+
+// parse "<vid>,<hexkey+cookie8>[_delta]" -> ok
+bool parse_fid(const char* p, const char* end, uint32_t* vid, uint64_t* key,
+               uint32_t* cookie) {
+    // vid digits
+    uint64_t v = 0;
+    const char* q = p;
+    while (q < end && *q >= '0' && *q <= '9') { v = v * 10 + (*q - '0'); q++; }
+    if (q == p || q >= end || *q != ',' || v > 0xFFFFFFFFull) return false;
+    q++;
+    // hex run
+    const char* h0 = q;
+    while (q < end && isxdigit((unsigned char)*q)) q++;
+    size_t hlen = q - h0;
+    if (hlen <= 8 || hlen > 24) return false;  // cookie is 8 hex; key 1..16
+    uint64_t delta = 0;
+    if (q < end && *q == '_') {
+        q++;
+        const char* d0 = q;
+        while (q < end && *q >= '0' && *q <= '9') { delta = delta * 10 + (*q - '0'); q++; }
+        if (q == d0) return false;
+    }
+    // optional .ext
+    if (q < end && *q == '.') {
+        q++;
+        while (q < end && *q != '/' ) q++;
+    }
+    if (q != end) return false;
+    auto hexval = [](char c) -> uint64_t {
+        if (c >= '0' && c <= '9') return c - '0';
+        if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+        return c - 'A' + 10;
+    };
+    uint64_t k = 0;
+    for (size_t i = 0; i < hlen - 8; i++) k = (k << 4) | hexval(h0[i]);
+    uint32_t ck = 0;
+    for (size_t i = hlen - 8; i < hlen; i++) ck = (ck << 4) | (uint32_t)hexval(h0[i]);
+    *vid = (uint32_t)v;
+    *key = k + delta;
+    *cookie = ck;
+    return true;
+}
+
+int padding_len(int32_t size, int version) {
+    int fixed = 16 + size + 4 + (version == 3 ? 8 : 0);
+    return 8 - (fixed % 8);  // always 1..8
+}
+int64_t actual_size(int32_t size, int version) {
+    return 16 + size + 4 + (version == 3 ? 8 : 0) + padding_len(size, version);
+}
+
+void append_response(Conn* c, int status, const char* reason,
+                     const std::string& ctype,
+                     const std::string& extra_headers,
+                     const char* body, size_t body_len, bool head) {
+    char hdr[512];
+    int n = snprintf(hdr, sizeof hdr,
+                     "HTTP/1.1 %d %s\r\nContent-Length: %zu\r\n", status,
+                     reason, body_len);
+    c->out.append(hdr, n);
+    if (!ctype.empty()) {
+        c->out += "Content-Type: ";
+        c->out += ctype;
+        c->out += "\r\n";
+    }
+    c->out += extra_headers;
+    c->out += "\r\n";
+    if (!head && body_len) c->out.append(body, body_len);
+}
+
+void json_response(Conn* c, int status, const char* reason,
+                   const std::string& body) {
+    append_response(c, status, reason, "application/json", "", body.data(),
+                    body.size(), false);
+}
+
+// ---------------------------------------------------------------------------
+// native read
+// ---------------------------------------------------------------------------
+
+bool handle_read(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
+                 uint32_t cookie, bool head) {
+    uint64_t off; int32_t size;
+    {
+        std::shared_lock<std::shared_mutex> l(v->map_mu);
+        if (!v->nmap.get(key, &off, &size) || size <= 0) {
+            append_response(c, 404, "Not Found", "", "", "", 0, false);
+            return true;
+        }
+    }
+    int64_t total = actual_size(size, v->version);
+    std::string blob;
+    blob.resize(total);
+    ssize_t got = pread(v->dat_fd, &blob[0], total, off);
+    if (got < total) {
+        json_response(c, 500, "Internal Server Error",
+                      "{\"error\": \"short read\"}");
+        return true;
+    }
+    const uint8_t* b = (const uint8_t*)blob.data();
+    uint32_t rcookie = get_u32be(b);
+    if (rcookie != cookie) {
+        append_response(c, 404, "Not Found", "", "", "", 0, false);
+        return true;
+    }
+    int32_t rsize = (int32_t)get_u32be(b + 12);
+    if (rsize != size) {
+        json_response(c, 500, "Internal Server Error",
+                      "{\"error\": \"size mismatch\"}");
+        return true;
+    }
+    // body parse (needle.py _read_body_v2)
+    const uint8_t* body = b + 16;
+    const uint8_t* bend = body + size;
+    if (body + 4 > bend) {
+        json_response(c, 500, "Internal Server Error",
+                      "{\"error\": \"truncated needle\"}");
+        return true;
+    }
+    uint32_t data_size = get_u32be(body);
+    const uint8_t* data = body + 4;
+    if (data + data_size > bend) {
+        json_response(c, 500, "Internal Server Error",
+                      "{\"error\": \"needle data out of range\"}");
+        return true;
+    }
+    const uint8_t* p = data + data_size;
+    uint8_t flags = p < bend ? *p : 0;
+    p += 1;
+    std::string name, mime;
+    if ((flags & 0x02) && p < bend) {               // HAS_NAME
+        uint8_t nl = *p++;
+        if (p + nl <= bend) name.assign((const char*)p, nl);
+        p += nl;
+    }
+    if ((flags & 0x04) && p < bend) {               // HAS_MIME
+        uint8_t ml = *p++;
+        if (p + ml <= bend) mime.assign((const char*)p, ml);
+        p += ml;
+    }
+    uint64_t last_modified = 0;
+    if ((flags & 0x08) && p + 5 <= bend) {          // HAS_LAST_MODIFIED
+        for (int i = 0; i < 5; i++) last_modified = (last_modified << 8) | p[i];
+        p += 5;
+    }
+    if (flags & 0x10) {                              // HAS_TTL
+        if (p + 2 <= bend) {
+            uint32_t count = p[0], unit = p[1];
+            static const uint64_t mins[7] = {0, 1, 60, 1440, 10080, 43200, 525600};
+            uint64_t m = unit < 7 ? mins[unit] : 0;
+            if (count && m && (flags & 0x08)) {
+                uint64_t expires = last_modified + count * m * 60;
+                if (expires < (uint64_t)time(nullptr)) {
+                    append_response(c, 404, "Not Found", "", "", "", 0, false);
+                    return true;
+                }
+            }
+        }
+        p += 2;
+    }
+    // CRC check (needle.from_bytes): stored raw or legacy transform
+    uint32_t stored = get_u32be(b + 16 + size);
+    uint32_t actual = sw_crc32c_update(0, (const char*)data, data_size);
+    uint32_t rotated = ((actual >> 15) | (actual << 17));
+    uint32_t legacy = rotated + 0xA282EAD8u;
+    if (stored != actual && stored != legacy) {
+        json_response(c, 500, "Internal Server Error",
+                      "{\"error\": \"CRC error! Data On Disk Corrupted\"}");
+        return true;
+    }
+    std::string extra = "Accept-Ranges: bytes\r\n";
+    {
+        char etag[32];
+        snprintf(etag, sizeof etag, "ETag: \"%08x\"\r\n", actual);
+        extra += etag;
+    }
+    if (!name.empty()) {
+        extra += "Content-Disposition: inline; filename=\"";
+        // match urllib.parse.quote: conservative percent-encoding
+        for (unsigned char ch : name) {
+            if (isalnum(ch) || ch == '_' || ch == '.' || ch == '-' || ch == '~' || ch == '/')
+                extra += (char)ch;
+            else {
+                char buf[4];
+                snprintf(buf, sizeof buf, "%%%02X", ch);
+                extra += buf;
+            }
+        }
+        extra += "\"\r\n";
+    }
+    if (flags & 0x01) extra += "Content-Encoding: gzip\r\n";  // IS_COMPRESSED
+    std::string ctype = mime.empty() ? "application/octet-stream" : mime;
+    if (head) {
+        char hint[64];
+        snprintf(hint, sizeof hint, "Content-Length-Hint: %u\r\n", data_size);
+        extra += hint;
+        append_response(c, 200, "OK", ctype, extra, "", 0, false);
+    } else {
+        append_response(c, 200, "OK", ctype, extra, (const char*)data,
+                        data_size, false);
+    }
+    E->stats.native_reads++;
+    return true;
+}
+
+// ---------------------------------------------------------------------------
+// native write / delete
+// ---------------------------------------------------------------------------
+
+bool handle_write(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
+                  uint32_t cookie, const char* data, size_t data_len,
+                  const std::string& name, const std::string& mime) {
+    if (data_len > 0xFFFFFFFFull) return false;
+    // build the v2/v3 record (needle.py to_bytes with data non-empty)
+    uint8_t flags = 0x08;  // HAS_LAST_MODIFIED (server always sets it)
+    std::string nm = name.substr(0, 255);
+    std::string mm = mime;
+    if (!nm.empty()) flags |= 0x02;
+    if (!mm.empty()) flags |= 0x04;
+    int32_t size = 4 + (int32_t)data_len + 1 + 5;
+    if (!nm.empty()) size += 1 + (int32_t)nm.size();
+    if (!mm.empty()) size += 1 + (int32_t)mm.size();
+    int version = v->version;
+    int64_t total = actual_size(size, version);
+    std::string rec;
+    rec.resize(total, 0);
+    uint8_t* o = (uint8_t*)&rec[0];
+    put_u32be(o, cookie);
+    put_u64be(o + 4, key);
+    put_u32be(o + 12, (uint32_t)size);
+    uint8_t* w = o + 16;
+    put_u32be(w, (uint32_t)data_len); w += 4;
+    memcpy(w, data, data_len); w += data_len;
+    *w++ = flags;
+    if (!nm.empty()) { *w++ = (uint8_t)nm.size(); memcpy(w, nm.data(), nm.size()); w += nm.size(); }
+    if (!mm.empty()) { *w++ = (uint8_t)mm.size(); memcpy(w, mm.data(), mm.size()); w += mm.size(); }
+    uint64_t lm = (uint64_t)time(nullptr);
+    for (int i = 4; i >= 0; i--) *w++ = (uint8_t)(lm >> (8 * i));
+    uint32_t crc = sw_crc32c_update(0, data, data_len);
+    put_u32be(w, crc); w += 4;
+    uint64_t ns;
+    uint64_t offset;
+    {
+        std::lock_guard<std::mutex> l(v->append_mu);
+        if (v->readonly.load()) return false;  // raced a readonly flip: proxy
+        ns = now_ns();
+        uint64_t last = v->last_ns.load(std::memory_order_relaxed);
+        if (ns <= last) ns = last + 1;
+        if (version == 3) { put_u64be(w, ns); }
+        offset = v->tail.load(std::memory_order_relaxed);
+        if (offset % 8) offset += 8 - offset % 8;
+        ssize_t wr = pwrite(v->dat_fd, rec.data(), total, offset);
+        if (wr != total) {
+            json_response(c, 500, "Internal Server Error",
+                          "{\"error\": \"write failed\"}");
+            return true;
+        }
+        // idx entry: key u64 BE | offset/8 u32 BE | size u32 BE (O_APPEND fd)
+        uint8_t ie[16];
+        put_u64be(ie, key);
+        put_u32be(ie + 8, (uint32_t)(offset / 8));
+        put_u32be(ie + 12, (uint32_t)size);
+        if (write(v->idx_fd, ie, 16) != 16) {
+            json_response(c, 500, "Internal Server Error",
+                          "{\"error\": \"idx write failed\"}");
+            return true;
+        }
+        {
+            std::unique_lock<std::shared_mutex> ml(v->map_mu);
+            v->nmap.put(key, offset, size);
+        }
+        v->tail.store(offset + total, std::memory_order_relaxed);
+        v->last_ns.store(ns, std::memory_order_relaxed);
+    }
+    E->push_event({v->vid, 0, key, offset, size, 0, ns});
+    std::string body = "{\"name\": \"";
+    json_escape(nm, body);
+    char tailbuf[64];
+    snprintf(tailbuf, sizeof tailbuf, "\", \"size\": %zu, \"eTag\": \"%08x\"}",
+             data_len, crc);
+    body += tailbuf;
+    json_response(c, 201, "Created", body);
+    E->stats.native_writes++;
+    return true;
+}
+
+bool handle_delete(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
+                   uint32_t cookie) {
+    // no cookie check on delete — matches storage/volume.py delete_needle
+    uint64_t off; int32_t size;
+    {
+        std::shared_lock<std::shared_mutex> l(v->map_mu);
+        if (!v->nmap.get(key, &off, &size) || size <= 0) {
+            json_response(c, 202, "Accepted", "{\"size\": 0}");
+            return true;
+        }
+    }
+    // tombstone record: empty needle (size=0) + idx entry size=-1
+    int version = v->version;
+    int32_t zsize = 0;
+    int64_t total = actual_size(zsize, version);
+    std::string rec;
+    rec.resize(total, 0);
+    uint8_t* o = (uint8_t*)&rec[0];
+    put_u32be(o, cookie);
+    put_u64be(o + 4, key);
+    put_u32be(o + 12, 0);
+    put_u32be(o + 16, 0);  // crc32c of empty = 0
+    uint64_t ns, offset;
+    int32_t freed = size;
+    {
+        std::lock_guard<std::mutex> l(v->append_mu);
+        if (v->readonly.load()) return false;
+        {
+            // re-check under the append lock (racing delete/overwrite)
+            std::shared_lock<std::shared_mutex> ml(v->map_mu);
+            if (!v->nmap.get(key, &off, &freed) || freed <= 0) {
+                json_response(c, 202, "Accepted", "{\"size\": 0}");
+                return true;
+            }
+        }
+        ns = now_ns();
+        uint64_t last = v->last_ns.load(std::memory_order_relaxed);
+        if (ns <= last) ns = last + 1;
+        if (version == 3) put_u64be(o + 20, ns);
+        offset = v->tail.load(std::memory_order_relaxed);
+        if (offset % 8) offset += 8 - offset % 8;
+        if (pwrite(v->dat_fd, rec.data(), total, offset) != total) {
+            json_response(c, 500, "Internal Server Error",
+                          "{\"error\": \"write failed\"}");
+            return true;
+        }
+        uint8_t ie[16];
+        put_u64be(ie, key);
+        put_u32be(ie + 8, (uint32_t)(offset / 8));
+        put_u32be(ie + 12, 0xFFFFFFFFu);  // tombstone size -1
+        if (write(v->idx_fd, ie, 16) != 16) {
+            json_response(c, 500, "Internal Server Error",
+                          "{\"error\": \"idx write failed\"}");
+            return true;
+        }
+        {
+            std::unique_lock<std::shared_mutex> ml(v->map_mu);
+            v->nmap.del(key);
+        }
+        v->tail.store(offset + total, std::memory_order_relaxed);
+        v->last_ns.store(ns, std::memory_order_relaxed);
+    }
+    E->push_event({v->vid, 1, key, offset, freed, 0, ns});
+    char body[48];
+    snprintf(body, sizeof body, "{\"size\": %d}", freed);
+    json_response(c, 202, "Accepted", body);
+    E->stats.native_deletes++;
+    return true;
+}
+
+// ---------------------------------------------------------------------------
+// proxy to the Python backend
+// ---------------------------------------------------------------------------
+
+int backend_connect(int port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    struct sockaddr_in sa;
+    memset(&sa, 0, sizeof sa);
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(port);
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    if (connect(fd, (struct sockaddr*)&sa, sizeof sa) != 0) {
+        close(fd);
+        return -1;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    set_nonblock(fd);
+    return fd;
+}
+
+void flush_out(Worker* w, Conn* c);
+void process_buffered(Engine* E, Worker* w, Conn* c);
+
+void backend_finish(Worker* w, BackendConn* b, bool reusable) {
+    for (size_t i = 0; i < w->pending.size(); i++)
+        if (w->pending[i] == b) {
+            w->pending[i] = w->pending.back();
+            w->pending.pop_back();
+            break;
+        }
+    if (b->fd >= 0) {
+        epoll_ctl(w->epfd, EPOLL_CTL_DEL, b->fd, nullptr);
+        if (reusable && w->idle_backends.size() < 8)
+            w->idle_backends.push_back(b->fd);
+        else
+            close(b->fd);
+        b->fd = -1;
+    }
+    w->back_graveyard.push_back(b);
+}
+
+// launch (or relaunch, on retry) the backend request; never blocks
+bool backend_launch(Engine* E, Worker* w, BackendConn* b) {
+    int fd = -1;
+    while (!w->idle_backends.empty()) {  // pooled keep-alive conn if healthy
+        fd = w->idle_backends.back();
+        w->idle_backends.pop_back();
+        char probe;
+        ssize_t r = recv(fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
+        if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
+            close(fd);  // backend silently closed this pooled conn
+            fd = -1;
+            continue;
+        }
+        break;
+    }
+    if (fd < 0) fd = backend_connect(E->backend_port);
+    if (fd < 0) return false;
+    b->fd = fd;
+    b->req_off = 0;
+    b->resp.clear();
+    b->hdr_end = 0;
+    b->body_mode = 0;
+    b->started = time(nullptr);
+    // optimistic send; leftover bytes flush on EPOLLOUT
+    while (b->req_off < b->req.size()) {
+        ssize_t n = send(fd, b->req.data() + b->req_off,
+                         b->req.size() - b->req_off, MSG_NOSIGNAL);
+        if (n > 0) { b->req_off += n; continue; }
+        if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+        close(fd);
+        b->fd = -1;
+        return false;
+    }
+    struct epoll_event ev;
+    ev.events = EPOLLIN | (b->req_off < b->req.size() ? EPOLLOUT : 0);
+    ev.data.ptr = b;
+    epoll_ctl(w->epfd, EPOLL_CTL_ADD, fd, &ev);
+    return true;
+}
+
+void proxy_request(Engine* E, Worker* w, Conn* c, const char* req, size_t len) {
+    auto* b = new BackendConn();
+    b->client = c;
+    b->req.assign(req, len);
+    if (!backend_launch(E, w, b)) {
+        delete b;
+        json_response(c, 502, "Bad Gateway",
+                      "{\"error\": \"backend unavailable\"}");
+        c->want_close = true;
+        return;
+    }
+    c->upstream = b;  // halts further request processing on this client
+    w->pending.push_back(b);
+}
+
+// deliver the completed (or failed) proxy response to the client and resume
+// its request pipeline
+void backend_complete(Engine* E, Worker* w, BackendConn* b, bool ok,
+                      bool client_keep, bool reusable) {
+    Conn* c = b->client;
+    if (c != nullptr) {
+        c->upstream = nullptr;
+        if (ok) {
+            c->out += b->resp;
+            if (!client_keep) c->want_close = true;
+            E->stats.proxied++;
+        } else {
+            json_response(c, 502, "Bad Gateway",
+                          "{\"error\": \"backend unavailable\"}");
+            c->want_close = true;
+        }
+    }
+    backend_finish(w, b, reusable);
+    if (c != nullptr) {
+        if (!c->want_close) process_buffered(E, w, c);
+        flush_out(w, c);
+    }
+}
+
+// returns true when the buffered response is complete
+bool backend_parse(BackendConn* b) {
+    if (b->hdr_end == 0) {
+        size_t he = b->resp.find("\r\n\r\n");
+        if (he == std::string::npos) return false;
+        b->hdr_end = he + 4;
+        const char* hb = b->resp.data();
+        const char* hend = hb + b->hdr_end;
+        std::string cl = find_header(hb, hend, "content-length");
+        std::string te = find_header(hb, hend, "transfer-encoding");
+        std::string ch = find_header(hb, hend, "connection");
+        b->backend_close = strcasecmp(ch.c_str(), "close") == 0;
+        if (!cl.empty()) {
+            b->body_mode = 1;
+            b->body_need = b->hdr_end + strtoull(cl.c_str(), nullptr, 10);
+        } else if (strcasecmp(te.c_str(), "chunked") == 0) {
+            b->body_mode = 2;
+            b->chunk_pos = b->hdr_end;
+        } else {
+            b->body_mode = 3;  // close-delimited
+        }
+    }
+    if (b->body_mode == 1) return b->resp.size() >= b->body_need;
+    if (b->body_mode == 2) {
+        for (;;) {
+            size_t le = b->resp.find("\r\n", b->chunk_pos);
+            if (le == std::string::npos) return false;
+            size_t chunk = strtoull(b->resp.c_str() + b->chunk_pos, nullptr, 16);
+            size_t need = le + 2 + chunk + 2;
+            if (b->resp.size() < need) return false;
+            b->chunk_pos = need;
+            if (chunk == 0) return true;
+        }
+    }
+    return false;  // close-delimited: completes on EOF
+}
+
+void on_backend_event(Engine* E, Worker* w, BackendConn* b, uint32_t events) {
+    if (events & EPOLLOUT) {
+        while (b->req_off < b->req.size()) {
+            ssize_t n = send(b->fd, b->req.data() + b->req_off,
+                             b->req.size() - b->req_off, MSG_NOSIGNAL);
+            if (n > 0) { b->req_off += n; continue; }
+            if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+            events |= EPOLLERR;
+            break;
+        }
+        if (b->req_off >= b->req.size() && !(events & (EPOLLERR | EPOLLHUP))) {
+            struct epoll_event ev;
+            ev.events = EPOLLIN;
+            ev.data.ptr = b;
+            epoll_ctl(w->epfd, EPOLL_CTL_MOD, b->fd, &ev);
+        }
+    }
+    bool eof = false, err = (events & EPOLLERR) != 0;
+    if (!err) {
+        char buf[65536];
+        for (;;) {
+            ssize_t n = recv(b->fd, buf, sizeof buf, 0);
+            if (n > 0) { b->resp.append(buf, n); continue; }
+            if (n == 0) { eof = true; break; }
+            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            err = true;
+            break;
+        }
+    }
+    if (!err && backend_parse(b)) {
+        backend_complete(E, w, b, true, true, !b->backend_close && !eof);
+        return;
+    }
+    if (eof && !err && b->body_mode == 3 && b->hdr_end != 0) {
+        // close-delimited response fully read: forward, close client too
+        backend_complete(E, w, b, true, false, false);
+        return;
+    }
+    if (err || eof) {
+        // nothing usable arrived — retry once on a fresh conn (a pooled
+        // keep-alive socket may have died between requests)
+        if (b->resp.empty() && !b->retried) {
+            b->retried = true;
+            epoll_ctl(w->epfd, EPOLL_CTL_DEL, b->fd, nullptr);
+            close(b->fd);
+            b->fd = -1;
+            if (backend_launch(E, w, b)) return;
+        }
+        backend_complete(E, w, b, false, false, false);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// request dispatch
+// ---------------------------------------------------------------------------
+
+// handles one complete buffered request [req, req+req_len) whose headers end
+// at hdr_len; body follows. Returns nothing — always produces output bytes.
+void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
+              size_t hdr_len, const char* body, size_t body_len) {
+    E->stats.requests++;
+    const char* line_end = (const char*)memchr(req, '\r', hdr_len);
+    if (!line_end) { c->want_close = true; return; }
+    const char* sp1 = (const char*)memchr(req, ' ', line_end - req);
+    if (!sp1) { c->want_close = true; return; }
+    const char* sp2 = (const char*)memchr(sp1 + 1, ' ', line_end - sp1 - 1);
+    if (!sp2) { c->want_close = true; return; }
+    std::string method(req, sp1 - req);
+    const char* path = sp1 + 1;
+    const char* path_end = sp2;
+    const char* qmark = (const char*)memchr(path, '?', path_end - path);
+    const char* fid_end = qmark ? qmark : path_end;
+    bool has_query = qmark != nullptr;
+    const char* he = req + hdr_len;
+
+    uint32_t vid; uint64_t key; uint32_t cookie;
+    bool is_fid = path < fid_end && path[0] == '/' &&
+                  parse_fid(path + 1, fid_end, &vid, &key, &cookie);
+    if (is_fid) {
+        auto v = E->vol(vid);
+        if (method == "GET" || method == "HEAD") {
+            bool range = !find_header(req, he, "range").empty();
+            if (v && !has_query && !range && !E->secure_reads) {
+                if (handle_read(E, c, v, key, cookie, method == "HEAD")) return;
+            }
+            proxy_request(E, w, c, req, req_len);
+            return;
+        }
+        if (method == "POST" || method == "PUT") {
+            std::string ctype = find_header(req, he, "content-type");
+            bool multipart = ctype.rfind("multipart/", 0) == 0;
+            std::string fname = find_header(req, he, "x-file-name");
+            bool jpg = false;
+            {
+                std::string lower = fname;
+                for (auto& ch : lower) ch = tolower(ch);
+                if (lower.size() >= 4 &&
+                    (lower.rfind(".jpg") == lower.size() - 4 ||
+                     (lower.size() >= 5 && lower.rfind(".jpeg") == lower.size() - 5)))
+                    jpg = true;
+                if (ctype == "image/jpeg") jpg = true;
+            }
+            bool exists = false;
+            if (v) {
+                uint64_t off_; int32_t size_;
+                std::shared_lock<std::shared_mutex> l(v->map_mu);
+                exists = v->nmap.get(key, &off_, &size_) && size_ > 0;
+            }
+            if (v && !has_query && !multipart && !jpg && !exists &&
+                !E->secure_writes && !v->readonly.load() &&
+                !v->forward_writes.load()) {
+                std::string mime = ctype;
+                if (mime == "application/json" ||
+                    mime == "application/x-www-form-urlencoded" ||
+                    mime == "application/octet-stream" || mime.size() >= 256)
+                    mime.clear();
+                if (handle_write(E, c, v, key, cookie, body, body_len, fname,
+                                 mime))
+                    return;
+            }
+            proxy_request(E, w, c, req, req_len);
+            return;
+        }
+        if (method == "DELETE") {
+            if (v && !has_query && !E->secure_writes && !v->readonly.load() &&
+                !v->forward_writes.load()) {
+                if (handle_delete(E, c, v, key, cookie)) return;
+            }
+            proxy_request(E, w, c, req, req_len);
+            return;
+        }
+    }
+    proxy_request(E, w, c, req, req_len);
+}
+
+// ---------------------------------------------------------------------------
+// event loop
+// ---------------------------------------------------------------------------
+
+// closes the socket and queues the Conn for deferred deletion — other
+// epoll events in the same wait batch may still point at it, so the object
+// must stay alive until the next loop pass
+void close_conn(Worker* w, Conn* c) {
+    if (c->fd >= 0) {
+        if (c->upstream != nullptr) {
+            // orphan the in-flight proxy; it completes into the void and
+            // its backend conn is not reused (response must drain fully)
+            c->upstream->client = nullptr;
+            c->upstream = nullptr;
+        }
+        epoll_ctl(w->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+        close(c->fd);
+        c->fd = -1;
+        std::lock_guard<std::mutex> l(w->conns_mu);
+        for (size_t i = 0; i < w->conns.size(); i++)
+            if (w->conns[i] == c) {
+                w->conns[i] = w->conns.back();
+                w->conns.pop_back();
+                break;
+            }
+        w->graveyard.push_back(c);
+    }
+}
+
+void flush_out(Worker* w, Conn* c) {
+    while (c->out_off < c->out.size()) {
+        ssize_t n = send(c->fd, c->out.data() + c->out_off,
+                         c->out.size() - c->out_off, MSG_NOSIGNAL);
+        if (n > 0) { c->out_off += n; continue; }
+        if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+            struct epoll_event ev;
+            ev.events = EPOLLIN | EPOLLOUT;
+            ev.data.ptr = c;
+            epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+            return;
+        }
+        close_conn(w, c);
+        return;
+    }
+    c->out.clear();
+    c->out_off = 0;
+    if (c->want_close) { close_conn(w, c); return; }
+    struct epoll_event ev;
+    ev.events = EPOLLIN;
+    ev.data.ptr = c;
+    epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+// drain complete buffered requests; stops while a proxied request is in
+// flight (responses must stay ordered per connection)
+void process_buffered(Engine* E, Worker* w, Conn* c) {
+    while (c->upstream == nullptr && !c->want_close) {
+        size_t hdr_end = c->in.find("\r\n\r\n");
+        if (hdr_end == std::string::npos) {
+            if (c->in.size() > (1u << 20)) close_conn(w, c);
+            return;
+        }
+        size_t hdr_len = hdr_end + 4;
+        std::string cl = find_header(c->in.data(), c->in.data() + hdr_len,
+                                     "content-length");
+        size_t body_len = cl.empty() ? 0 : strtoull(cl.c_str(), nullptr, 10);
+        if (body_len > (1ull << 31)) { close_conn(w, c); return; }
+        if (c->in.size() < hdr_len + body_len) return;  // need more body
+        size_t req_len = hdr_len + body_len;
+        dispatch(E, w, c, c->in.data(), req_len, hdr_len,
+                 c->in.data() + hdr_len, body_len);
+        c->in.erase(0, req_len);
+    }
+}
+
+void on_readable(Engine* E, Worker* w, Conn* c) {
+    char buf[65536];
+    for (;;) {
+        ssize_t n = recv(c->fd, buf, sizeof buf, 0);
+        if (n > 0) {
+            c->in.append(buf, n);
+            if (c->in.size() > (1ull << 31)) { close_conn(w, c); return; }
+            continue;
+        }
+        if (n == 0) { close_conn(w, c); return; }
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        close_conn(w, c);
+        return;
+    }
+    c->last_active = time(nullptr);
+    process_buffered(E, w, c);
+    if (c->fd >= 0) flush_out(w, c);
+}
+
+void* worker_main(void* arg) {
+    auto* pair = (std::pair<Engine*, Worker*>*)arg;
+    Engine* E = pair->first;
+    Worker* w = pair->second;
+    delete pair;
+    struct epoll_event evs[256];
+    time_t last_sweep = time(nullptr);
+    while (E->running.load()) {
+        int n = epoll_wait(w->epfd, evs, 256, 500);
+        for (int i = 0; i < n; i++) {
+            int kind = *(int*)evs[i].data.ptr;  // first field of both structs
+            if (kind == 1) {
+                BackendConn* b = (BackendConn*)evs[i].data.ptr;
+                if (b->fd < 0) continue;
+                on_backend_event(E, w, b, evs[i].events);
+                continue;
+            }
+            Conn* c = (Conn*)evs[i].data.ptr;
+            if (c->fd < 0) continue;  // closed earlier in this batch
+            if (evs[i].events & (EPOLLHUP | EPOLLERR)) { close_conn(w, c); continue; }
+            if (evs[i].events & EPOLLOUT) {
+                flush_out(w, c);
+                if (c->fd < 0) continue;
+            }
+            if (evs[i].events & EPOLLIN) on_readable(E, w, c);
+        }
+        {
+            std::lock_guard<std::mutex> l(w->conns_mu);
+            for (auto* c : w->graveyard) delete c;
+            w->graveyard.clear();
+        }
+        for (auto* b : w->back_graveyard) delete b;
+        w->back_graveyard.clear();
+        time_t now = time(nullptr);
+        if (now - last_sweep > 30) {
+            last_sweep = now;
+            std::vector<Conn*> idle;
+            {
+                std::lock_guard<std::mutex> l(w->conns_mu);
+                for (auto* c : w->conns)
+                    if (now - c->last_active > 300 && c->upstream == nullptr)
+                        idle.push_back(c);
+            }
+            for (auto* c : idle) close_conn(w, c);
+            // Reclaim proxied requests: orphans (client gone) promptly,
+            // client-attached ones only after an hour — admin operations
+            // (vacuum, ec encode, tiering) legitimately run many minutes
+            // and had no front-door timeout before this engine existed
+            std::vector<BackendConn*> stuck;
+            for (auto* b : w->pending) {
+                long age = now - b->started;
+                if ((b->client == nullptr && age > 75) || age > 3600)
+                    stuck.push_back(b);
+            }
+            for (auto* b : stuck) backend_complete(E, w, b, false, false, false);
+            for (auto* b : w->back_graveyard) delete b;
+            w->back_graveyard.clear();
+        }
+    }
+    {
+        std::lock_guard<std::mutex> l(w->conns_mu);
+        for (auto* c : w->conns) { if (c->fd >= 0) close(c->fd); delete c; }
+        w->conns.clear();
+        for (auto* c : w->graveyard) delete c;
+        w->graveyard.clear();
+    }
+    for (auto* b : w->pending) { if (b->fd >= 0) close(b->fd); delete b; }
+    w->pending.clear();
+    for (auto* b : w->back_graveyard) delete b;
+    w->back_graveyard.clear();
+    for (int fd : w->idle_backends) close(fd);
+    w->idle_backends.clear();
+    return nullptr;
+}
+
+void* accept_main(void* arg) {
+    Engine* E = (Engine*)arg;
+    size_t next = 0;
+    while (E->running.load()) {
+        struct sockaddr_in sa;
+        socklen_t sl = sizeof sa;
+        int fd = accept(E->listen_fd, (struct sockaddr*)&sa, &sl);
+        if (fd < 0) {
+            if (errno == EINTR || errno == EAGAIN) continue;
+            if (!E->running.load()) break;
+            usleep(10000);
+            continue;
+        }
+        set_nonblock(fd);
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        Worker& w = E->workers[next % E->workers.size()];
+        next++;
+        Conn* c = new Conn();
+        c->fd = fd;
+        c->last_active = time(nullptr);
+        struct epoll_event ev;
+        ev.events = EPOLLIN;
+        ev.data.ptr = c;
+        {
+            std::lock_guard<std::mutex> l(w.conns_mu);
+            w.conns.push_back(c);
+        }
+        epoll_ctl(w.epfd, EPOLL_CTL_ADD, fd, &ev);
+    }
+    return nullptr;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// returns an engine handle (>=0); the bound port comes from sw_fl_port()
+int sw_fl_start(const char* host, int port, int backend_port, int workers,
+                int secure_reads, int secure_writes) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -2;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    struct sockaddr_in sa;
+    memset(&sa, 0, sizeof sa);
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(port);
+    sa.sin_addr.s_addr = host && *host ? inet_addr(host) : htonl(INADDR_ANY);
+    if (bind(fd, (struct sockaddr*)&sa, sizeof sa) != 0 ||
+        listen(fd, 1024) != 0) {
+        close(fd);
+        return -3;
+    }
+    socklen_t sl = sizeof sa;
+    getsockname(fd, (struct sockaddr*)&sa, &sl);
+    Engine* E = new Engine();
+    E->listen_fd = fd;
+    E->port = ntohs(sa.sin_port);
+    E->backend_port = backend_port;
+    E->secure_reads = secure_reads != 0;
+    E->secure_writes = secure_writes != 0;
+    if (workers < 1) workers = 2;
+    if (workers > 32) workers = 32;
+    E->workers.resize(workers);
+    for (auto& w : E->workers) {
+        w.epfd = epoll_create1(0);
+        auto* pair = new std::pair<Engine*, Worker*>(E, &w);
+        pthread_create(&w.thread, nullptr, worker_main, pair);
+    }
+    pthread_create(&E->accept_thread, nullptr, accept_main, E);
+    std::lock_guard<std::mutex> gl(g_engine_mu);
+    g_engines.push_back(E);
+    return (int)g_engines.size() - 1;
+}
+
+int sw_fl_port(int h) {
+    Engine* E = engine_at(h);
+    return E ? E->port : -1;
+}
+
+void sw_fl_stop(int h) {
+    Engine* E;
+    {
+        std::lock_guard<std::mutex> gl(g_engine_mu);
+        if (h < 0 || (size_t)h >= g_engines.size()) return;
+        E = g_engines[h];
+        g_engines[h] = nullptr;
+    }
+    if (!E) return;
+    E->running.store(false);
+    shutdown(E->listen_fd, SHUT_RDWR);
+    close(E->listen_fd);
+    pthread_join(E->accept_thread, nullptr);
+    for (auto& w : E->workers) {
+        pthread_join(w.thread, nullptr);
+        close(w.epfd);
+    }
+    delete E;
+}
+
+int sw_fl_register_volume(int h, uint32_t vid, int dat_fd, int idx_fd,
+                          int version, unsigned long long tail,
+                          unsigned long long last_append_ns, int readonly,
+                          int forward_writes) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto v = std::make_shared<Vol>();
+    v->vid = vid;
+    v->dat_fd = dat_fd;
+    v->idx_fd = idx_fd;
+    v->version = version;
+    v->tail.store(tail);
+    v->last_ns.store(last_append_ns);
+    v->readonly.store(readonly != 0);
+    v->forward_writes.store(forward_writes != 0);
+    std::unique_lock<std::shared_mutex> l(E->reg_mu);
+    E->vols[vid] = v;
+    return 0;
+}
+
+int sw_fl_load_entries(int h, uint32_t vid, const uint64_t* keys,
+                       const uint64_t* offsets, const int32_t* sizes,
+                       size_t n) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto v = E->vol(vid);
+    if (!v) return -2;
+    std::unique_lock<std::shared_mutex> ml(v->map_mu);
+    for (size_t i = 0; i < n; i++)
+        if (sizes[i] > 0) v->nmap.put(keys[i], offsets[i], sizes[i]);
+    return 0;
+}
+
+int sw_fl_unregister_volume(int h, uint32_t vid) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    std::shared_ptr<Vol> v;
+    {
+        std::unique_lock<std::shared_mutex> l(E->reg_mu);
+        auto it = E->vols.find(vid);
+        if (it == E->vols.end()) return 0;
+        v = it->second;
+        E->vols.erase(it);
+    }
+    // wait out any in-flight append; readers hold the shared_ptr and the
+    // fds stay open until the last reference drops
+    v->append_mu.lock();
+    v->append_mu.unlock();
+    return 0;
+}
+
+int sw_fl_set_flags(int h, uint32_t vid, int readonly, int forward_writes) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto v = E->vol(vid);
+    if (!v) return -2;
+    v->readonly.store(readonly != 0);
+    v->forward_writes.store(forward_writes != 0);
+    return 0;
+}
+
+int sw_fl_volume_lock(int h, uint32_t vid) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto v = E->vol(vid);
+    if (!v) return -2;
+    v->append_mu.lock();
+    return 0;
+}
+
+int sw_fl_volume_unlock(int h, uint32_t vid) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto v = E->vol(vid);
+    if (!v) return -2;
+    v->append_mu.unlock();
+    return 0;
+}
+
+unsigned long long sw_fl_tail_get(int h, uint32_t vid) {
+    Engine* E = engine_at(h);
+    if (!E) return 0;
+    auto v = E->vol(vid);
+    return v ? v->tail.load() : 0;
+}
+
+int sw_fl_tail_set(int h, uint32_t vid, unsigned long long tail,
+                   unsigned long long last_ns) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto v = E->vol(vid);
+    if (!v) return -2;
+    v->tail.store(tail);
+    if (last_ns) v->last_ns.store(last_ns);
+    return 0;
+}
+
+int sw_fl_map_put(int h, uint32_t vid, uint64_t key, unsigned long long offset,
+                  int32_t size) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto v = E->vol(vid);
+    if (!v) return -2;
+    std::unique_lock<std::shared_mutex> ml(v->map_mu);
+    if (size > 0) v->nmap.put(key, offset, size);
+    else v->nmap.del(key);
+    return 0;
+}
+
+long sw_fl_drain_events(int h, uint8_t* out, size_t max_events) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    std::lock_guard<std::mutex> l(E->ev_mu);
+    size_t n = E->events.size() < max_events ? E->events.size() : max_events;
+    for (size_t i = 0; i < n; i++) {
+        memcpy(out + i * sizeof(Event), &E->events.front(), sizeof(Event));
+        E->events.pop_front();
+    }
+    return (long)n;
+}
+
+void sw_fl_get_stats(int h, unsigned long long* out5) {
+    Engine* E = engine_at(h);
+    if (!E) { memset(out5, 0, 5 * sizeof(unsigned long long)); return; }
+    out5[0] = E->stats.requests.load();
+    out5[1] = E->stats.native_reads.load();
+    out5[2] = E->stats.native_writes.load();
+    out5[3] = E->stats.native_deletes.load();
+    out5[4] = E->stats.proxied.load();
+}
+
+}  // extern "C"

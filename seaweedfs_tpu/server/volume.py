@@ -66,16 +66,53 @@ class VolumeServer:
         self.max_volume_count = max_volume_count
         self.volume_size_limit = 30 * 1024 * 1024 * 1024
         self._stop = threading.Event()
+        self.fastlane = None  # native data-plane front door when available
         self._routes()
 
-    def start(self) -> None:
+    def _start_fastlane(self) -> None:
+        """Put the native epoll engine (storage/fastlane.py) in front of the
+        Python service: it serves data-plane GET/POST/PUT/DELETE across all
+        cores and proxies everything else here. Python keeps the requested
+        port's role by moving to an ephemeral backend port."""
+        from seaweedfs_tpu.security import tls as _tlsmod
+        from seaweedfs_tpu.storage import fastlane as fl_mod
+
+        requested = self.service.port  # 0 = ephemeral, fine either way
+        if (
+            not fl_mod.available()
+            or self.security.white_list      # Guard checks stay in Python
+            or _tlsmod.server_context() is not None  # engine is plain TCP
+        ):
+            self.service.start()
+            return
+        self.service.port = 0
         self.service.start()
+        secure = bool(self.security.write_key or self.security.read_key)
+        self.fastlane = fl_mod.Fastlane.start(
+            self._host, requested, self.service.port,
+            secure_reads=secure, secure_writes=secure,
+        )
+        if self.fastlane is None:  # bind failure: plain Python on requested
+            self.service.stop()
+            self.service.port = requested
+            self.service.start()
+
+    @property
+    def data_port(self) -> int:
+        return self.fastlane.port if self.fastlane else self.service.port
+
+    def start(self) -> None:
+        self._start_fastlane()
         self.store = Store(
             self._dirs,
             ip=self._host,
-            port=self.service.port,
+            port=self.data_port,
             public_url=self._public_url,
         )
+        if self.fastlane:
+            for vid in self.store.volume_ids():
+                self._fl_register(vid)
+            threading.Thread(target=self._fl_drain_loop, daemon=True).start()
         for loc in self.store.locations:
             loc.max_volume_count = self.max_volume_count
         for loc in self.store.locations:
@@ -97,20 +134,67 @@ class VolumeServer:
 
         threading.Thread(target=_calibrate, daemon=True).start()
 
-    def stop(self) -> None:
+    def stop(self) -> None:  # idempotent: fixtures may stop twice
         self._stop.set()
+        if self.fastlane:
+            self.fastlane.drain()
+            self.fastlane.stop()
+            self.fastlane = None
         self.service.stop()
         if self.store:
             self.store.close()
+            self.store = None
 
     @property
     def url(self) -> str:
+        if self.fastlane:
+            return f"http://{self._host}:{self.fastlane.port}"
         return self.service.url
+
+    # --- fastlane lifecycle -----------------------------------------------------
+    def _fl_forward_writes(self, v) -> bool:
+        """Writes the engine must hand to Python: replicated volumes (the
+        fan-out runs here) — see _do_write."""
+        rp = v.super_block.replica_placement
+        return rp is not None and rp.copy_count() > 1
+
+    def _fl_register(self, vid: int) -> None:
+        if not self.fastlane:
+            return
+        v = self.store.get_volume(vid)
+        if v is not None:
+            self.fastlane.register_volume(v, self._fl_forward_writes(v))
+
+    def _fl_unregister(self, vid: int) -> None:
+        if self.fastlane:
+            self.fastlane.unregister_volume(vid)  # waits in-flight + drains
+
+    def _fl_sync_flags(self, vid: int) -> None:
+        if not self.fastlane:
+            return
+        v = self.store.get_volume(vid)
+        if v is not None:
+            self.fastlane.set_flags(vid, v.readonly, self._fl_forward_writes(v))
+
+    def _fl_drain_loop(self) -> None:  # pragma: no cover - timing loop
+        tick = 0
+        while not self._stop.is_set():
+            try:
+                self.fastlane.drain()
+                tick += 1
+                if tick % 50 == 0:  # ~1s flag reconcile (low-disk readonly...)
+                    for vid in list(self.fastlane._volumes):
+                        self._fl_sync_flags(vid)
+            except Exception:
+                pass
+            self._stop.wait(0.02)
 
     # --- heartbeat --------------------------------------------------------------
     def heartbeat_once(self) -> None:
         import json as _json
 
+        if self.fastlane:  # report the engine's appends, not a stale view
+            self.fastlane.drain()
         hb = self.store.collect_heartbeat()
         hb["data_center"] = self.data_center
         hb["rack"] = self.rack
@@ -155,7 +239,7 @@ class VolumeServer:
         """Give an EcVolume remote shard sourcing: master ec_lookup for
         locations, then /admin/ec/shard range reads off sibling servers
         (`store_ec.go:281` readRemoteEcShardInterval)."""
-        me = f"{self._host}:{self.service.port}"
+        me = f"{self._host}:{self.data_port}"
         state = {"expires": 0.0, "shards": {}}
 
         def fetch(shard_id: int, off: int, size: int) -> bytes | None:
@@ -202,7 +286,7 @@ class VolumeServer:
             info = get_json(f"{self.master_url}/dir/lookup?volumeId={vid}", timeout=5)
         except Exception as e:
             raise VolumeError(f"replicate lookup failed: {e}")
-        me = f"{self._host}:{self.service.port}"
+        me = f"{self._host}:{self.data_port}"
         qs = "type=replicate"
         for k, v in (extra_query or {}).items():
             qs += f"&{k}={urllib.parse.quote(str(v))}"
@@ -247,7 +331,10 @@ class VolumeServer:
         @svc.route("GET", r"/status")
         def status(req: Request) -> Response:
             hb = self.store.collect_heartbeat()
-            return Response({"Version": "seaweedfs-tpu", **hb})
+            out = {"Version": "seaweedfs-tpu", **hb}
+            if self.fastlane:
+                out["fastlane"] = self.fastlane.stats()
+            return Response(out)
 
         @svc.route("POST", r"/admin/allocate_volume")
         def allocate(req: Request) -> Response:
@@ -258,10 +345,12 @@ class VolumeServer:
                 p.get("replication", "000"),
                 p.get("ttl", ""),
             )
+            self._fl_register(int(p["volume"]))
             return Response({"ok": True})
 
         @svc.route("POST", r"/admin/delete_volume")
         def delete_volume(req: Request) -> Response:
+            self._fl_unregister(int(req.json()["volume"]))
             self.store.delete_volume(int(req.json()["volume"]))
             self.heartbeat_once()  # master forgets this replica promptly
             return Response({"ok": True})
@@ -273,8 +362,14 @@ class VolumeServer:
             if v is None:
                 return Response({"error": f"volume {vid} not found"}, 404)
             garbage = v.garbage_level()
-            v.compact()
-            v.commit_compact()
+            # the commit swaps .dat/.idx files: the engine's fds would go
+            # stale, so it hands the volume back to Python for the duration
+            self._fl_unregister(vid)
+            try:
+                v.compact()
+                v.commit_compact()
+            finally:
+                self._fl_register(vid)
             self.heartbeat_once()
             return Response({"ok": True, "garbage_was": garbage})
 
@@ -282,6 +377,7 @@ class VolumeServer:
         def readonly(req: Request) -> Response:
             p = req.json()
             self.store.mark_readonly(int(p["volume"]), bool(p.get("readonly", True)))
+            self._fl_sync_flags(int(p["volume"]))
             return Response({"ok": True})
 
         @svc.route("GET", r"/ui")
@@ -326,6 +422,7 @@ class VolumeServer:
             except (ValueError, KeyError) as e:
                 return Response({"error": str(e)}, 400)
             v.configure_replication(rp)
+            self._fl_sync_flags(vid)
             return Response({"ok": True, "replication": str(rp)})
 
         @svc.route("POST", r"/admin/leave")
@@ -357,11 +454,13 @@ class VolumeServer:
             v = self.store.get_volume(vid)
             if v is None:
                 return Response({"error": f"volume {vid} not found"}, 404)
+            self._fl_unregister(vid)
             try:
                 size = v.tier_to_remote(
                     p["backend"], keep_local=bool(p.get("keepLocal", False))
                 )
             except (VolumeError, BackendError) as e:
+                self._fl_register(vid)
                 return Response({"error": str(e)}, 409)
             return Response({"ok": True, "size": size})
 
@@ -378,6 +477,7 @@ class VolumeServer:
                 v.tier_to_local()
             except (VolumeError, BackendError) as e:
                 return Response({"error": str(e)}, 409)
+            self._fl_register(vid)
             return Response({"ok": True})
 
         @svc.route("GET", r"/admin/volume/tier_info")
@@ -397,9 +497,16 @@ class VolumeServer:
             if v is None:
                 return Response({"error": f"volume {vid} not found"}, 404)
             v.readonly = True
-            base = v.base_name
-            ec_encoder.write_ec_files(base)
-            ec_encoder.write_sorted_file_from_idx(base)
+            # a native append already past the engine's readonly check could
+            # still be mid-pwrite; unregister waits it out so the encoder
+            # reads a quiescent .dat/.idx
+            self._fl_unregister(vid)
+            try:
+                base = v.base_name
+                ec_encoder.write_ec_files(base)
+                ec_encoder.write_sorted_file_from_idx(base)
+            finally:
+                self._fl_register(vid)  # readonly: native reads, proxied writes
             ec_encoder.save_volume_info(base + ".vif", version=v.version())
             return Response({"ok": True, "shards": list(range(14))})
 
@@ -445,6 +552,7 @@ class VolumeServer:
             """Delete the original volume files after EC spread
             (`command_ec_encode.go` deletes source replicas)."""
             vid = int(req.json()["volume"])
+            self._fl_unregister(vid)  # EC serving runs in Python from here on
             self.store.delete_volume(vid)
             self.heartbeat_once()
             return Response({"ok": True})
@@ -492,6 +600,7 @@ class VolumeServer:
             ec_decoder.write_dat_file(base, dat_size, shard_names)
             ec_decoder.write_idx_file_from_ec_index(base)
             v = self.store.mount_volume(vid, collection)
+            self._fl_register(vid)
             self.heartbeat_once()
             return Response({"ok": True, "size": v.size()})
 
@@ -542,6 +651,8 @@ class VolumeServer:
             (`VolumeCopy`/`CopyFile` stream in volume_server.proto)."""
             import os
 
+            if self.fastlane:  # copy streams must see the engine's appends
+                self.fastlane.drain()
             vid = int(req.query["volume"])
             ext = req.query["ext"]
             collection = req.query.get("collection", "")
@@ -746,6 +857,8 @@ class VolumeServer:
         @svc.route("GET", r"/admin/tail")
         def tail(req: Request) -> Response:
             """Needles appended after since_ns (`volume_backup.go:66`)."""
+            if self.fastlane:  # tail must see the engine's appends
+                self.fastlane.drain()
             vid = int(req.query["volume"])
             since_ns = int(req.query.get("since_ns", 0))
             v = self.store.get_volume(vid)
@@ -779,7 +892,7 @@ class VolumeServer:
             except (ValueError, AttributeError):
                 return Response({"error": f"bad fid {fid!r}"}, 400)
             try:
-                n = self.store.read(vid, key, cookie=cookie)
+                n = self._store_read(vid, key, cookie)
             except (NotFound, VolumeError) as e:
                 return Response({"error": str(e)}, 404)
             data = n.data
@@ -843,13 +956,27 @@ class VolumeServer:
         key, cookie = parse_key_hash_with_delta(req.match.group(2))
         return vid, key, cookie
 
+    def _store_read(self, vid: int, key: int, cookie: int | None):
+        """store.read with one drain-and-retry on miss: a needle the
+        fastlane engine just wrote may not be in the Python map yet."""
+        try:
+            return self.store.read(vid, key, cookie=cookie)
+        except NotFound:
+            if not self.fastlane:
+                raise
+            # retry unconditionally after the drain: the background drain
+            # loop may have applied the missing event between our miss and
+            # our drain() returning 0
+            self.fastlane.drain()
+            return self.store.read(vid, key, cookie=cookie)
+
     def _do_read(self, req: Request, head: bool) -> Response:
         try:
             vid, key, cookie = self._parse_fid(req)
         except ValueError as e:
             return Response({"error": str(e)}, 400)
         try:
-            n = self.store.read(vid, key, cookie=cookie)
+            n = self._store_read(vid, key, cookie)
         except NotFound:
             return Response(b"", 404)
         except VolumeError as e:
@@ -916,6 +1043,8 @@ class VolumeServer:
         return verify_file_jwt(self.security.write_key, token, fid)
 
     def _do_write(self, req: Request) -> Response:
+        if self.fastlane:  # overwrite checks need the engine's appends applied
+            self.fastlane.drain()
         try:
             vid, key, cookie = self._parse_fid(req)
         except ValueError as e:
@@ -989,6 +1118,8 @@ class VolumeServer:
         )
 
     def _do_delete(self, req: Request) -> Response:
+        if self.fastlane:
+            self.fastlane.drain()
         try:
             vid, key, cookie = self._parse_fid(req)
         except ValueError as e:

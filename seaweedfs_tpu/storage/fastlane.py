@@ -1,0 +1,266 @@
+"""Fastlane: the native epoll front door for the volume data plane.
+
+The C++ engine (`native/src/fastlane.cpp`) owns the hot HTTP path —
+GET/POST/PUT/DELETE of `/<vid>,<fid>` — and proxies everything else to the
+Python HTTPService, mirroring how the reference serves its data plane from
+compiled code across all cores (`weed/server/volume_server_handlers_*.go`)
+while Python keeps volume lifecycle, admin plane, and replication.
+
+Responsibilities of this wrapper:
+  * start/stop an engine in front of a backend port
+  * register volumes (dup'd .dat fd + a fresh O_APPEND .idx fd + a bulk
+    needle-map load) and keep C-side flags in sync
+  * drain the engine's append/delete event queue into the Python-side
+    needle maps (memory-only: the engine already wrote the .idx entries)
+  * lend Python's own rare appends the engine's per-volume lock + tail
+    (`Volume._append_lock` uses the hook installed here)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import threading
+
+_EVENT_SIZE = 40
+_EVENT = struct.Struct("<IIQQiIQ")  # vid, op, key, offset, size, pad, ns
+
+
+def _bind(lib) -> bool:
+    """Declare the fastlane ABI on the shared library; False if absent."""
+    try:
+        lib.sw_fl_start.restype = ctypes.c_int
+        lib.sw_fl_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.sw_fl_port.restype = ctypes.c_int
+        lib.sw_fl_port.argtypes = [ctypes.c_int]
+        lib.sw_fl_stop.restype = None
+        lib.sw_fl_stop.argtypes = [ctypes.c_int]
+        lib.sw_fl_register_volume.restype = ctypes.c_int
+        lib.sw_fl_register_volume.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_ulonglong, ctypes.c_ulonglong,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.sw_fl_load_entries.restype = ctypes.c_int
+        lib.sw_fl_load_entries.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib.sw_fl_unregister_volume.restype = ctypes.c_int
+        lib.sw_fl_unregister_volume.argtypes = [ctypes.c_int, ctypes.c_uint32]
+        lib.sw_fl_set_flags.restype = ctypes.c_int
+        lib.sw_fl_set_flags.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.sw_fl_volume_lock.restype = ctypes.c_int
+        lib.sw_fl_volume_lock.argtypes = [ctypes.c_int, ctypes.c_uint32]
+        lib.sw_fl_volume_unlock.restype = ctypes.c_int
+        lib.sw_fl_volume_unlock.argtypes = [ctypes.c_int, ctypes.c_uint32]
+        lib.sw_fl_tail_get.restype = ctypes.c_ulonglong
+        lib.sw_fl_tail_get.argtypes = [ctypes.c_int, ctypes.c_uint32]
+        lib.sw_fl_tail_set.restype = ctypes.c_int
+        lib.sw_fl_tail_set.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_ulonglong,
+            ctypes.c_ulonglong,
+        ]
+        lib.sw_fl_map_put.restype = ctypes.c_int
+        lib.sw_fl_map_put.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_ulonglong, ctypes.c_int32,
+        ]
+        lib.sw_fl_drain_events.restype = ctypes.c_long
+        lib.sw_fl_drain_events.argtypes = [
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib.sw_fl_get_stats.restype = None
+        lib.sw_fl_get_stats.argtypes = [ctypes.c_int, ctypes.c_void_p]
+        return True
+    except AttributeError:
+        return False
+
+
+def _get_lib():
+    if os.environ.get("SEAWEEDFS_TPU_DISABLE_FASTLANE") == "1":
+        return None
+    try:
+        from seaweedfs_tpu.native import lib as nlib
+    except Exception:
+        return None
+    if nlib is None:
+        return None
+    raw = nlib._lib
+    if not getattr(raw, "_fastlane_bound", False):
+        if not _bind(raw):
+            return None
+        raw._fastlane_bound = True
+    return raw
+
+
+def available() -> bool:
+    from seaweedfs_tpu.storage.types import OFFSET_BYTES
+
+    return OFFSET_BYTES == 4 and _get_lib() is not None
+
+
+class VolumeHook:
+    """Installed on a registered Volume: Python-side appends borrow the
+    engine's per-volume lock and authoritative tail."""
+
+    def __init__(self, engine: "Fastlane", vid: int) -> None:
+        self.engine = engine
+        self.vid = vid
+
+    def lock(self) -> None:
+        self.engine._lib.sw_fl_volume_lock(self.engine.handle, self.vid)
+
+    def unlock(self) -> None:
+        self.engine._lib.sw_fl_volume_unlock(self.engine.handle, self.vid)
+
+    def tail_get(self) -> int:
+        return int(self.engine._lib.sw_fl_tail_get(self.engine.handle, self.vid))
+
+    def tail_set(self, tail: int, last_ns: int) -> None:
+        self.engine._lib.sw_fl_tail_set(self.engine.handle, self.vid, tail,
+                                        last_ns)
+
+    def map_put(self, key: int, offset: int, size: int) -> None:
+        self.engine._lib.sw_fl_map_put(self.engine.handle, self.vid, key,
+                                       offset, size)
+
+    def map_del(self, key: int) -> None:
+        self.engine._lib.sw_fl_map_put(self.engine.handle, self.vid, key, 0, -1)
+
+
+class Fastlane:
+    def __init__(self, lib, handle: int) -> None:
+        self._lib = lib
+        self.handle = handle
+        self.port = int(lib.sw_fl_port(handle))
+        self._volumes: dict[int, object] = {}  # vid -> Volume (drain target)
+        self._drain_mu = threading.Lock()
+        self._buf = ctypes.create_string_buffer(_EVENT_SIZE * 4096)
+
+    @staticmethod
+    def start(host: str, port: int, backend_port: int, workers: int = 0,
+              secure_reads: bool = False,
+              secure_writes: bool = False) -> "Fastlane | None":
+        lib = _get_lib()
+        if lib is None:
+            return None
+        if workers <= 0:
+            workers = min(8, (os.cpu_count() or 2))
+        h = int(lib.sw_fl_start(host.encode(), port, backend_port, workers,
+                                1 if secure_reads else 0,
+                                1 if secure_writes else 0))
+        if h < 0:
+            return None
+        return Fastlane(lib, h)
+
+    def stop(self) -> None:
+        self._lib.sw_fl_stop(self.handle)
+        self._volumes.clear()
+
+    # --- volume lifecycle ---------------------------------------------------
+    def register_volume(self, volume, forward_writes: bool = False) -> bool:
+        """Hand a Volume's data plane to the engine. Returns False for
+        shapes the engine does not serve (tiered/remote .dat, v1)."""
+        from seaweedfs_tpu.storage.backend import DiskFile
+
+        if not isinstance(volume._dat, DiskFile):
+            return False  # remote-tiered: reads proxy to Python
+        if volume.version() not in (2, 3):
+            return False
+        dat_fd = os.dup(volume._dat._fd)
+        idx_fd = os.open(volume.base_name + ".idx",
+                         os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+        rc = self._lib.sw_fl_register_volume(
+            self.handle, volume.id, dat_fd, idx_fd, volume.version(),
+            volume._size, volume.last_append_at_ns,
+            1 if volume.readonly else 0, 1 if forward_writes else 0,
+        )
+        if rc != 0:
+            os.close(dat_fd)
+            os.close(idx_fd)
+            return False
+        self._load_map(volume)
+        volume._fl_hook = VolumeHook(self, volume.id)
+        self._volumes[volume.id] = volume
+        return True
+
+    def _load_map(self, volume) -> None:
+        import numpy as np
+
+        entries = list(volume.nm.ascending_visit())
+        n = len(entries)
+        if n == 0:
+            return
+        keys = np.fromiter((e[0] for e in entries), dtype=np.uint64, count=n)
+        offs = np.fromiter((e[1] for e in entries), dtype=np.uint64, count=n)
+        sizes = np.fromiter((e[2] for e in entries), dtype=np.int32, count=n)
+        self._lib.sw_fl_load_entries(
+            self.handle, volume.id, keys.ctypes.data, offs.ctypes.data,
+            sizes.ctypes.data, n,
+        )
+
+    def unregister_volume(self, vid: int) -> None:
+        # order matters: the C call waits out any in-flight append (whose
+        # event lands in the queue), the drain then applies every event
+        # while the volume is still a drain target, and only then does the
+        # vid stop being tracked — no acked write can slip through
+        self._lib.sw_fl_unregister_volume(self.handle, vid)
+        self.drain()
+        v = self._volumes.pop(vid, None)
+        if v is not None:
+            v._fl_hook = None
+
+    def set_flags(self, vid: int, readonly: bool, forward_writes: bool) -> None:
+        self._lib.sw_fl_set_flags(self.handle, vid, 1 if readonly else 0,
+                                  1 if forward_writes else 0)
+
+    # --- event drain --------------------------------------------------------
+    def drain(self) -> int:
+        """Apply engine-side appends/deletes to the Python needle maps
+        (memory-only — the engine already wrote .dat and .idx)."""
+        total = 0
+        with self._drain_mu:
+            while True:
+                n = int(self._lib.sw_fl_drain_events(
+                    self.handle, ctypes.addressof(self._buf), 4096))
+                if n <= 0:
+                    break
+                for i in range(n):
+                    vid, op, key, offset, size, _, ns = _EVENT.unpack_from(
+                        self._buf, i * _EVENT_SIZE)
+                    v = self._volumes.get(vid)
+                    if v is None:
+                        continue
+                    if op == 0:
+                        v.nm.apply_external(key, offset, size)
+                    else:
+                        v.nm.apply_external_delete(key, size)
+                    # _size/last_append read-modify-write must not race a
+                    # Python append's own store (Volume._append_lock holds
+                    # the same lock)
+                    end = offset + v._record_size(size if op == 0 else 0)
+                    with v._write_lock:
+                        v._size = max(v._size, end)
+                        v.last_append_at_ns = max(v.last_append_at_ns, ns)
+                total += n
+                if n < 4096:
+                    break
+        return total
+
+    def stats(self) -> dict:
+        out = (ctypes.c_ulonglong * 5)()
+        self._lib.sw_fl_get_stats(self.handle, out)
+        return {
+            "requests": int(out[0]),
+            "native_reads": int(out[1]),
+            "native_writes": int(out[2]),
+            "native_deletes": int(out[3]),
+            "proxied": int(out[4]),
+        }

@@ -106,6 +106,14 @@ class NeedleMap:
             )
             self._idx_file.flush()
 
+    # memory-only variants: the fastlane engine already appended the .idx
+    # entry; only the in-process view needs the update (storage/fastlane.py)
+    def apply_external(self, key: int, offset: int, size: int) -> None:
+        self._apply(key, offset, size)
+
+    def apply_external_delete(self, key: int, freed: int) -> None:
+        self._apply(key, 0, TOMBSTONE_FILE_SIZE)
+
     def ascending_visit(self):
         for key in sorted(self._map):
             offset, size = self._map[key]
@@ -303,6 +311,23 @@ class CompactNeedleMap:
                     )
                 )
                 self._idx_file.flush()
+
+    # memory-only variants: the fastlane engine already appended the .idx
+    # entry; only the in-process view needs the update (storage/fastlane.py)
+    def apply_external(self, key: int, offset: int, size: int) -> None:
+        with self._mu:
+            self.metrics.maximum_key = max(self.metrics.maximum_key, key)
+            if offset > 0 and size_is_valid(size):
+                if not self._set_live(key, offset, size):
+                    self.metrics.file_count += 1
+                    self._live += 1
+            else:
+                self._delete_state(key)
+
+    def apply_external_delete(self, key: int, freed: int) -> None:
+        with self._mu:
+            self.metrics.maximum_key = max(self.metrics.maximum_key, key)
+            self._delete_state(key)
 
     def ascending_visit(self):
         with self._mu:

@@ -18,6 +18,8 @@ Thread-safety: one writer lock; reads use positional os.pread.
 
 from __future__ import annotations
 
+from contextlib import contextmanager
+
 import os
 import threading
 import time
@@ -72,6 +74,7 @@ class Volume:
         self.id = volume_id
         self.base_name = volume_file_name(dir_, collection, volume_id)
         self._write_lock = threading.Lock()
+        self._fl_hook = None  # set while the fastlane engine fronts this volume
         self.readonly = False
         self.last_append_at_ns = 0
 
@@ -177,6 +180,28 @@ class Volume:
         return self.nm.content_size()
 
     # --- write path ----------------------------------------------------------
+    def _record_size(self, size: int) -> int:
+        return get_actual_size(size, self.version())
+
+    @contextmanager
+    def _append_lock(self):
+        """Python-side append critical section. With the fastlane engine
+        fronting this volume, its per-volume lock serializes against the
+        engine's own appenders and its tail is authoritative — borrow both
+        (storage/fastlane.py VolumeHook)."""
+        with self._write_lock:
+            h = self._fl_hook
+            if h is None:
+                yield None
+                return
+            h.lock()
+            try:
+                self._size = max(self._size, h.tail_get())
+                yield h
+            finally:
+                h.tail_set(self._size, self.last_append_at_ns)
+                h.unlock()
+
     def _is_unchanged(self, n: Needle) -> bool:
         """Duplicate-write suppression (`volume_write.go:32`): same id, same
         cookie, same checksum+data."""
@@ -197,7 +222,7 @@ class Volume:
         """Append a needle; returns (offset, size). (`volume_write.go:137`)"""
         if self.readonly:
             raise VolumeError(f"volume {self.id} is read only")
-        with self._write_lock:
+        with self._append_lock() as h:
             if check_cookie:
                 nv = self.nm.get(n.id)
                 if nv is not None and size_is_valid(nv[1]):
@@ -211,6 +236,8 @@ class Volume:
             self.last_append_at_ns = n.append_at_ns
             if n.size > 0 or self.version() == 1:
                 self.nm.put(n.id, offset, n.size)
+                if h is not None:
+                    h.map_put(n.id, offset, n.size)
             return offset, n.size
 
     def _append(self, n: Needle) -> int:
@@ -226,7 +253,7 @@ class Volume:
         """Returns the freed size, 0 if absent (`volume_write.go:216`)."""
         if self.readonly:
             raise VolumeError(f"volume {self.id} is read only")
-        with self._write_lock:
+        with self._append_lock() as h:
             nv = self.nm.get(n.id)
             if nv is None or not size_is_valid(nv[1]):
                 return 0
@@ -236,6 +263,8 @@ class Volume:
             offset = self._append(n)
             self.last_append_at_ns = n.append_at_ns
             self.nm.delete(n.id, offset)
+            if h is not None:
+                h.map_del(n.id)
             return freed
 
     # --- read path -----------------------------------------------------------

@@ -1,0 +1,219 @@
+"""Fastlane engine: native data plane fronting the Python volume server.
+
+Covers the coordination surfaces that the rest of the suite only exercises
+incidentally: native/Python write interleaving on one volume, vacuum's
+unregister/re-register across the file swap, restart replay of
+engine-written .idx entries, and a mixed-operation concurrency hammer.
+(`native/src/fastlane.cpp`, `storage/fastlane.py`; the reference serves
+this plane from Go — `weed/server/volume_server_handlers_*.go`.)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from seaweedfs_tpu.server.httpd import get_json, http_request, post_json
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=1)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url, port=0,
+                      pulse_seconds=1, max_volume_count=20)
+    vs.start()
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def _assign(master, **params):
+    qs = "&".join(f"{k}={v}" for k, v in params.items())
+    return get_json(f"{master.url}/dir/assign?{qs}")
+
+
+class TestFastlaneActive:
+    def test_engine_fronts_the_data_plane(self, cluster):
+        master, vs = cluster
+        if vs.fastlane is None:
+            pytest.skip("fastlane unavailable in this environment")
+        a = _assign(master)
+        url = f"http://{a['publicUrl']}/{a['fid']}"
+        st, _, body = http_request("POST", url, b"x" * 100)
+        assert st == 201 and json.loads(body)["size"] == 100
+        st, _, data = http_request("GET", url)
+        assert st == 200 and data == b"x" * 100
+        stats = vs.fastlane.stats()
+        assert stats["native_writes"] >= 1 and stats["native_reads"] >= 1
+
+    def test_native_then_python_overwrite_consistent(self, cluster):
+        """An overwrite of an engine-written needle proxies to Python —
+        both must agree on the live value, and the engine map must follow
+        Python's append."""
+        master, vs = cluster
+        if vs.fastlane is None:
+            pytest.skip("fastlane unavailable")
+        a = _assign(master)
+        url = f"http://{a['publicUrl']}/{a['fid']}"
+        assert http_request("POST", url, b"version-one")[0] == 201  # native
+        assert http_request("POST", url, b"version-two!")[0] == 201  # proxied
+        st, _, data = http_request("GET", url)  # native read, engine map
+        assert st == 200 and data == b"version-two!"
+        # Python's view agrees
+        vid = int(a["fid"].split(",")[0])
+        v = vs.store.get_volume(vid)
+        vs.fastlane.drain()
+        n = v.read_needle(v.nm.metrics.maximum_key)
+        assert n.data == b"version-two!"
+
+    def test_vacuum_under_writes_preserves_data(self, cluster):
+        """Vacuum swaps .dat/.idx files; the engine hands the volume back
+        to Python across the swap. Data written before, during-ish, and
+        after must all survive."""
+        master, vs = cluster
+        if vs.fastlane is None:
+            pytest.skip("fastlane unavailable")
+        first = _assign(master)
+        vid = int(first["fid"].split(",")[0])
+        keep: dict[str, bytes] = {}
+        drop: list[str] = []
+        i = 0
+        while len(keep) < 6 or len(drop) < 6:
+            a = _assign(master)
+            if int(a["fid"].split(",")[0]) != vid:
+                continue
+            u = f"http://{a['publicUrl']}/{a['fid']}"
+            payload = f"payload-{i}".encode() * 50
+            assert http_request("POST", u, payload)[0] == 201
+            if i % 2 == 0 and len(keep) < 6:
+                keep[u] = payload
+            elif len(drop) < 6:
+                drop.append(u)
+            i += 1
+        for u in drop:
+            assert http_request("DELETE", u)[0] == 202
+        out = post_json(f"{vs.url}/admin/vacuum", {"volume": vid})
+        assert out["ok"]
+        # engine re-registered on the fresh files: native writes/reads work
+        a = _assign(master)
+        u2 = f"http://{a['publicUrl']}/{a['fid']}"
+        assert http_request("POST", u2, b"post-vacuum")[0] == 201
+        st, _, d = http_request("GET", u2)
+        assert st == 200 and d == b"post-vacuum"
+        for u, payload in keep.items():
+            st, _, d = http_request("GET", u)
+            assert st == 200 and d == payload, u
+        for u in drop:
+            assert http_request("GET", u)[0] == 404
+
+    def test_restart_replays_engine_written_idx(self, cluster, tmp_path):
+        """Needles appended by the engine must survive a full server
+        restart via the .idx entries the engine wrote."""
+        master, vs = cluster
+        if vs.fastlane is None:
+            pytest.skip("fastlane unavailable")
+        a = _assign(master)
+        url_suffix = a["fid"]
+        u = f"http://{a['publicUrl']}/{url_suffix}"
+        assert http_request("POST", u, b"durable-bytes")[0] == 201
+        vs.stop()
+        vs2 = VolumeServer([str(tmp_path / "v")], master.url, port=0,
+                           pulse_seconds=1, max_volume_count=20)
+        vs2.start()
+        try:
+            st, _, d = http_request(
+                "GET", f"{vs2.url}/{url_suffix}")
+            assert st == 200 and d == b"durable-bytes"
+        finally:
+            vs2.stop()
+
+    def test_concurrent_mixed_operations(self, cluster):
+        """Hammer the engine from many threads with writes, reads, deletes
+        and proxied admin calls at once; verify every surviving value."""
+        master, vs = cluster
+        if vs.fastlane is None:
+            pytest.skip("fastlane unavailable")
+        n_threads, per = 8, 30
+        results: list[tuple[str, bytes]] = []
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def worker(t: int) -> None:
+            try:
+                for i in range(per):
+                    a = _assign(master)
+                    u = f"http://{a['publicUrl']}/{a['fid']}"
+                    payload = f"t{t}-i{i}-".encode() * 20
+                    st, _, body = http_request("POST", u, payload)
+                    if st != 201:
+                        raise AssertionError(f"write {st}: {body[:80]!r}")
+                    if i % 5 == 4:
+                        st, _, _ = http_request("DELETE", u)
+                        if st != 202:
+                            raise AssertionError(f"delete {st}")
+                        continue
+                    if i % 7 == 0:  # interleave proxied admin traffic
+                        http_request(f"{'GET'}", f"http://{a['publicUrl']}/status")
+                    with lock:
+                        results.append((u, payload))
+            except Exception as e:  # surface the first failure per thread
+                with lock:
+                    errors.append(f"t{t}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        for u, payload in results:
+            st, _, d = http_request("GET", u)
+            assert st == 200 and d == payload, u
+        stats = vs.fastlane.stats()
+        assert stats["native_writes"] >= n_threads * per * 0.7
+
+    def test_jwt_security_forces_python_path(self, tmp_path):
+        """With JWT signing configured the engine must not serve
+        unauthenticated writes natively — Python enforces the token."""
+        from seaweedfs_tpu.security import SecurityConfig
+
+        sec = SecurityConfig(write_key="sekrit")
+        master = MasterServer(port=0, pulse_seconds=1, security=sec)
+        master.start()
+        vs = VolumeServer([str(tmp_path / "sv")], master.url, port=0,
+                          pulse_seconds=1, security=sec)
+        vs.start()
+        try:
+            a = _assign(master)
+            u = f"http://{a['publicUrl']}/{a['fid']}"
+            st, _, _ = http_request("POST", u, b"no-token")
+            assert st == 401
+            headers = {"Authorization": f"BEARER {a['auth']}"}
+            st, _, _ = http_request("POST", u, b"with-token", headers)
+            assert st == 201
+        finally:
+            vs.stop()
+            master.stop()
+
+    def test_loadgen_binding(self, cluster):
+        """The native loadgen drives the engine end-to-end (bench path)."""
+        from seaweedfs_tpu.native import lib
+
+        master, vs = cluster
+        if vs.fastlane is None or lib is None:
+            pytest.skip("fastlane/native unavailable")
+        n = 200
+        a = get_json(master.url + f"/dir/assign?count={n}")
+        port = int(a["publicUrl"].rsplit(":", 1)[1])
+        fid = a["fid"]
+        paths = [f"/{fid}"] + [f"/{fid}_{i}" for i in range(1, n)]
+        w = lib.loadgen("127.0.0.1", port, 4, "POST", paths, bytes(512))
+        assert w["ok"] == n and w["errors"] == 0, w
+        r = lib.loadgen("127.0.0.1", port, 4, "GET", paths)
+        assert r["ok"] == n and r["errors"] == 0, r
