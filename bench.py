@@ -430,6 +430,14 @@ def bench_small_files(n: int = 20000, size: int = 1024, c: int = 16) -> dict:
                 out["write_errors"] = w["errors"]
                 out["read_errors"] = r["errors"]
                 out["engine"] = vs.fastlane.stats()
+            if master.fastlane is not None:
+                # the reference's exact write semantics: EVERY file pays a
+                # master /dir/assign round-trip before its volume POST
+                aw = native_lib.loadgen_assign_write(
+                    "127.0.0.1", master.fastlane.port, c, n, bytes(size))
+                if aw["ok"] > 0:
+                    out["write_assign_per_file_req_s"] = aw["req_per_sec"]
+                    out["write_assign_per_file_errors"] = aw["errors"]
         report = run_benchmark(master.url, n=min(n, 4000), size=size, c=c)
         out["python_client"] = {
             "write_req_s": report["write"]["req_per_sec"],

@@ -142,6 +142,17 @@ class NativeLib:
             ctypes.c_int,  # row_count
             ctypes.c_void_p,  # out (rows, row_count*block)
         ]
+        self._lib.sw_loadgen_assign_write.restype = ctypes.c_int
+        self._lib.sw_loadgen_assign_write.argtypes = [
+            ctypes.c_char_p,  # master host
+            ctypes.c_int,  # master port
+            ctypes.c_int,  # concurrent slots
+            ctypes.c_size_t,  # files
+            ctypes.c_char_p,  # assign path
+            ctypes.c_char_p,  # body
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_ulonglong),
+        ]
         self._lib.sw_loadgen.restype = ctypes.c_int
         self._lib.sw_loadgen.argtypes = [
             ctypes.c_char_p,  # host
@@ -331,6 +342,28 @@ class NativeLib:
         }
         if rc != 0:
             result["error"] = f"sw_loadgen rc={rc} (connect failure)"
+        return result
+
+    def loadgen_assign_write(self, host: str, master_port: int, conns: int,
+                             files: int, body: bytes,
+                             assign_path: str = "/dir/assign") -> dict:
+        """Per-file assign -> write load (`weed benchmark` write semantics:
+        every file pays a master round-trip for its fid, then a volume
+        POST)."""
+        out = (ctypes.c_ulonglong * 3)()
+        rc = self._lib.sw_loadgen_assign_write(
+            host.encode(), master_port, conns, files, assign_path.encode(),
+            body, len(body), out,
+        )
+        secs = out[2] / 1e9 if out[2] else 1.0
+        result = {
+            "ok": int(out[0]),
+            "errors": int(out[1]),
+            "seconds": round(secs, 3),
+            "req_per_sec": round(out[0] / secs, 1),
+        }
+        if rc != 0:
+            result["error"] = f"rc={rc} (connect failure)"
         return result
 
     def crc32c_batch(self, blobs, n: int, blob_len: int):

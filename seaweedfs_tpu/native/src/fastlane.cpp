@@ -72,7 +72,7 @@ struct NMap {
         std::vector<Slot> old;
         old.swap(slots);
         slots.resize(old.size() * 2);
-        used = live;
+        used = live = 0;  // place() recounts while replaying live entries
         for (auto& s : old)
             if (s.state == 1) place(s.key, s.off, s.size);
     }
@@ -134,6 +134,7 @@ struct Vol {
     uint32_t vid;
     int dat_fd = -1, idx_fd = -1;
     int version = 3;
+    std::atomic<bool> serving{false};  // false until the map bulk-load lands
     std::atomic<uint64_t> tail{0};
     std::atomic<uint64_t> last_ns{0};
     std::atomic<bool> readonly{false};
@@ -169,7 +170,7 @@ Engine* engine_at(int h) {
 
 struct Stats {
     std::atomic<uint64_t> requests{0}, native_reads{0}, native_writes{0},
-        native_deletes{0}, proxied{0};
+        native_deletes{0}, native_assigns{0}, proxied{0};
 };
 
 // ---------------------------------------------------------------------------
@@ -219,10 +220,22 @@ struct Worker {
     pthread_t thread;
 };
 
+// Prebuilt assign responder for one exact /dir/assign query string: the
+// Python master computes the eligible volume set + a leased file-key range
+// and installs it; the engine then mints fids round-robin without Python.
+struct AssignProfile {
+    std::vector<uint32_t> vids;
+    std::vector<std::string> tails;  // per-volume JSON after the fid field
+    std::atomic<uint64_t> next_key{0};
+    uint64_t end_key = 0;
+    std::atomic<uint64_t> rr{0};
+};
+
 struct Engine {
     int listen_fd = -1;
     int port = 0;
     int backend_port = 0;
+    uint32_t backend_ip = 0;  // where the Python service listens
     bool secure_writes = false;     // JWT configured -> proxy writes
     bool secure_reads = false;
     std::atomic<bool> running{true};
@@ -230,14 +243,23 @@ struct Engine {
     pthread_t accept_thread;
     std::shared_mutex reg_mu;
     std::unordered_map<uint32_t, std::shared_ptr<Vol>> vols;
+    std::shared_mutex assign_mu;
+    std::unordered_map<std::string, std::shared_ptr<AssignProfile>> assigns;
     std::mutex ev_mu;
     std::deque<Event> events;
     Stats stats;
 
-    std::shared_ptr<Vol> vol(uint32_t vid) {
+    // any-state lookup (registration plumbing)
+    std::shared_ptr<Vol> vol_raw(uint32_t vid) {
         std::shared_lock<std::shared_mutex> l(reg_mu);
         auto it = vols.find(vid);
         return it == vols.end() ? nullptr : it->second;
+    }
+    // request-path lookup: a volume whose map is still bulk-loading is
+    // treated as absent so its traffic proxies to Python
+    std::shared_ptr<Vol> vol(uint32_t vid) {
+        auto v = vol_raw(vid);
+        return (v && v->serving.load(std::memory_order_acquire)) ? v : nullptr;
     }
     void push_event(const Event& e) {
         std::lock_guard<std::mutex> l(ev_mu);
@@ -564,6 +586,7 @@ bool handle_write(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
         if (version == 3) { put_u64be(w, ns); }
         offset = v->tail.load(std::memory_order_relaxed);
         if (offset % 8) offset += 8 - offset % 8;
+        if (offset + total > (1ull << 35)) return false;  // 4B idx offsets
         ssize_t wr = pwrite(v->dat_fd, rec.data(), total, offset);
         if (wr != total) {
             json_response(c, 500, "Internal Server Error",
@@ -673,14 +696,14 @@ bool handle_delete(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
 // proxy to the Python backend
 // ---------------------------------------------------------------------------
 
-int backend_connect(int port) {
+int backend_connect(uint32_t ip, int port) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return -1;
     struct sockaddr_in sa;
     memset(&sa, 0, sizeof sa);
     sa.sin_family = AF_INET;
     sa.sin_port = htons(port);
-    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    sa.sin_addr.s_addr = ip;
     if (connect(fd, (struct sockaddr*)&sa, sizeof sa) != 0) {
         close(fd);
         return -1;
@@ -727,7 +750,7 @@ bool backend_launch(Engine* E, Worker* w, BackendConn* b) {
         }
         break;
     }
-    if (fd < 0) fd = backend_connect(E->backend_port);
+    if (fd < 0) fd = backend_connect(E->backend_ip, E->backend_port);
     if (fd < 0) return false;
     b->fd = fd;
     b->req_off = 0;
@@ -881,6 +904,55 @@ void on_backend_event(Engine* E, Worker* w, BackendConn* b, uint32_t events) {
 }
 
 // ---------------------------------------------------------------------------
+// native /dir/assign (master fastlane)
+// ---------------------------------------------------------------------------
+
+// fid key+cookie hex per storage/file_id.py: the 8-byte key's leading zero
+// BYTES are stripped (whole bytes, so always an even digit count), then the
+// 8 cookie digits always follow
+void format_fid_hex(uint64_t key, uint32_t cookie, char* out) {
+    static const char* hexd = "0123456789abcdef";
+    int lead = 0;
+    while (lead < 8 && ((key >> (56 - 8 * lead)) & 0xFF) == 0) lead++;
+    char* p = out;
+    for (int i = lead; i < 8; i++) {
+        uint8_t b = (key >> (56 - 8 * i)) & 0xFF;
+        *p++ = hexd[b >> 4];
+        *p++ = hexd[b & 0xF];
+    }
+    for (int i = 7; i >= 0; i--) *p++ = hexd[(cookie >> (4 * i)) & 0xF];
+    *p = 0;
+}
+
+bool handle_assign(Engine* E, Conn* c, const char* query, size_t qlen) {
+    std::shared_ptr<AssignProfile> ap;
+    {
+        std::shared_lock<std::shared_mutex> l(E->assign_mu);
+        auto it = E->assigns.find(std::string(query, qlen));
+        if (it == E->assigns.end()) return false;
+        ap = it->second;
+    }
+    uint64_t key = ap->next_key.fetch_add(1, std::memory_order_relaxed);
+    if (key >= ap->end_key) return false;  // lease spent: Python re-leases
+    size_t vi = ap->rr.fetch_add(1, std::memory_order_relaxed) % ap->vids.size();
+    // xorshift cookie seeded per call from the key + clock
+    static thread_local uint64_t rng = 0x9e3779b97f4a7c15ull ^ now_ns();
+    rng ^= rng << 13; rng ^= rng >> 7; rng ^= rng << 17;
+    uint32_t cookie = (uint32_t)(rng ^ (rng >> 32));
+    char hex[32];
+    format_fid_hex(key, cookie, hex);
+    char fid[48];
+    int fl = snprintf(fid, sizeof fid, "%u,%s", ap->vids[vi], hex);
+    std::string body = "{\"fid\": \"";
+    body.append(fid, fl);
+    body += "\", ";
+    body += ap->tails[vi];
+    json_response(c, 200, "OK", body);
+    E->stats.native_assigns++;
+    return true;
+}
+
+// ---------------------------------------------------------------------------
 // request dispatch
 // ---------------------------------------------------------------------------
 
@@ -902,6 +974,15 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
     const char* fid_end = qmark ? qmark : path_end;
     bool has_query = qmark != nullptr;
     const char* he = req + hdr_len;
+
+    if (method == "GET" && (size_t)(fid_end - path) == 11 &&
+        memcmp(path, "/dir/assign", 11) == 0) {
+        const char* q = has_query ? qmark + 1 : "";
+        size_t qlen = has_query ? (size_t)(path_end - qmark - 1) : 0;
+        if (handle_assign(E, c, q, qlen)) return;
+        proxy_request(E, w, c, req, req_len);  // miss/spent: Python (re)installs
+        return;
+    }
 
     uint32_t vid; uint64_t key; uint32_t cookie;
     bool is_fid = path < fid_end && path[0] == '/' &&
@@ -1174,8 +1255,9 @@ void* accept_main(void* arg) {
 extern "C" {
 
 // returns an engine handle (>=0); the bound port comes from sw_fl_port()
-int sw_fl_start(const char* host, int port, int backend_port, int workers,
-                int secure_reads, int secure_writes) {
+int sw_fl_start(const char* host, int port, const char* backend_host,
+                int backend_port, int workers, int secure_reads,
+                int secure_writes) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return -2;
     int one = 1;
@@ -1196,6 +1278,12 @@ int sw_fl_start(const char* host, int port, int backend_port, int workers,
     E->listen_fd = fd;
     E->port = ntohs(sa.sin_port);
     E->backend_port = backend_port;
+    E->backend_ip = htonl(INADDR_LOOPBACK);
+    if (backend_host && *backend_host &&
+        strcmp(backend_host, "0.0.0.0") != 0) {
+        uint32_t ip = inet_addr(backend_host);
+        if (ip != INADDR_NONE) E->backend_ip = ip;
+    }
     E->secure_reads = secure_reads != 0;
     E->secure_writes = secure_writes != 0;
     if (workers < 1) workers = 2;
@@ -1257,12 +1345,22 @@ int sw_fl_register_volume(int h, uint32_t vid, int dat_fd, int idx_fd,
     return 0;
 }
 
+// arms the data plane once the Python-side bulk map load has landed
+int sw_fl_volume_serving(int h, uint32_t vid) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto v = E->vol_raw(vid);
+    if (!v) return -2;
+    v->serving.store(true, std::memory_order_release);
+    return 0;
+}
+
 int sw_fl_load_entries(int h, uint32_t vid, const uint64_t* keys,
                        const uint64_t* offsets, const int32_t* sizes,
                        size_t n) {
     Engine* E = engine_at(h);
     if (!E) return -1;
-    auto v = E->vol(vid);
+    auto v = E->vol_raw(vid);
     if (!v) return -2;
     std::unique_lock<std::shared_mutex> ml(v->map_mu);
     for (size_t i = 0; i < n; i++)
@@ -1291,7 +1389,7 @@ int sw_fl_unregister_volume(int h, uint32_t vid) {
 int sw_fl_set_flags(int h, uint32_t vid, int readonly, int forward_writes) {
     Engine* E = engine_at(h);
     if (!E) return -1;
-    auto v = E->vol(vid);
+    auto v = E->vol_raw(vid);
     if (!v) return -2;
     v->readonly.store(readonly != 0);
     v->forward_writes.store(forward_writes != 0);
@@ -1301,7 +1399,7 @@ int sw_fl_set_flags(int h, uint32_t vid, int readonly, int forward_writes) {
 int sw_fl_volume_lock(int h, uint32_t vid) {
     Engine* E = engine_at(h);
     if (!E) return -1;
-    auto v = E->vol(vid);
+    auto v = E->vol_raw(vid);
     if (!v) return -2;
     v->append_mu.lock();
     return 0;
@@ -1310,7 +1408,7 @@ int sw_fl_volume_lock(int h, uint32_t vid) {
 int sw_fl_volume_unlock(int h, uint32_t vid) {
     Engine* E = engine_at(h);
     if (!E) return -1;
-    auto v = E->vol(vid);
+    auto v = E->vol_raw(vid);
     if (!v) return -2;
     v->append_mu.unlock();
     return 0;
@@ -1319,7 +1417,7 @@ int sw_fl_volume_unlock(int h, uint32_t vid) {
 unsigned long long sw_fl_tail_get(int h, uint32_t vid) {
     Engine* E = engine_at(h);
     if (!E) return 0;
-    auto v = E->vol(vid);
+    auto v = E->vol_raw(vid);
     return v ? v->tail.load() : 0;
 }
 
@@ -1327,7 +1425,7 @@ int sw_fl_tail_set(int h, uint32_t vid, unsigned long long tail,
                    unsigned long long last_ns) {
     Engine* E = engine_at(h);
     if (!E) return -1;
-    auto v = E->vol(vid);
+    auto v = E->vol_raw(vid);
     if (!v) return -2;
     v->tail.store(tail);
     if (last_ns) v->last_ns.store(last_ns);
@@ -1338,11 +1436,41 @@ int sw_fl_map_put(int h, uint32_t vid, uint64_t key, unsigned long long offset,
                   int32_t size) {
     Engine* E = engine_at(h);
     if (!E) return -1;
-    auto v = E->vol(vid);
+    auto v = E->vol_raw(vid);
     if (!v) return -2;
     std::unique_lock<std::shared_mutex> ml(v->map_mu);
     if (size > 0) v->nmap.put(key, offset, size);
     else v->nmap.del(key);
+    return 0;
+}
+
+// install/replace the assign responder for one exact query string.
+// tails: n zero-terminated JSON fragments (everything after the fid field).
+int sw_fl_assign_set(int h, const char* query, const uint32_t* vids,
+                     const char* tails, size_t n,
+                     unsigned long long key_start,
+                     unsigned long long key_end) {
+    Engine* E = engine_at(h);
+    if (!E || n == 0) return -1;
+    auto ap = std::make_shared<AssignProfile>();
+    ap->vids.assign(vids, vids + n);
+    const char* p = tails;
+    for (size_t i = 0; i < n; i++) {
+        ap->tails.emplace_back(p);
+        p += strlen(p) + 1;
+    }
+    ap->next_key.store(key_start);
+    ap->end_key = key_end;
+    std::unique_lock<std::shared_mutex> l(E->assign_mu);
+    E->assigns[query] = ap;
+    return 0;
+}
+
+int sw_fl_assign_clear(int h) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    std::unique_lock<std::shared_mutex> l(E->assign_mu);
+    E->assigns.clear();
     return 0;
 }
 
@@ -1358,14 +1486,15 @@ long sw_fl_drain_events(int h, uint8_t* out, size_t max_events) {
     return (long)n;
 }
 
-void sw_fl_get_stats(int h, unsigned long long* out5) {
+void sw_fl_get_stats(int h, unsigned long long* out6) {
     Engine* E = engine_at(h);
-    if (!E) { memset(out5, 0, 5 * sizeof(unsigned long long)); return; }
-    out5[0] = E->stats.requests.load();
-    out5[1] = E->stats.native_reads.load();
-    out5[2] = E->stats.native_writes.load();
-    out5[3] = E->stats.native_deletes.load();
-    out5[4] = E->stats.proxied.load();
+    if (!E) { memset(out6, 0, 6 * sizeof(unsigned long long)); return; }
+    out6[0] = E->stats.requests.load();
+    out6[1] = E->stats.native_reads.load();
+    out6[2] = E->stats.native_writes.load();
+    out6[3] = E->stats.native_deletes.load();
+    out6[4] = E->stats.proxied.load();
+    out6[5] = E->stats.native_assigns.load();
 }
 
 }  // extern "C"

@@ -26,6 +26,7 @@ class Request:
         self.match = match
         parsed = urllib.parse.urlparse(handler.path)
         self.path = parsed.path
+        self.raw_query = parsed.query  # exact bytes: fastlane profile keys
         self.query = {
             k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()
         }

@@ -56,6 +56,7 @@ class MasterServer:
         # raft HA (weed/server/raft_server.go): created at start() once the
         # listen port is known; None = single-master mode
         self.raft = None
+        self.fastlane = None  # native /dir/assign front door (start())
         self._peer_config = list(peers or [])
         self._raft_dir = raft_dir
         self._seq_ceiling = 0
@@ -67,14 +68,87 @@ class MasterServer:
         self._routes()
 
     # --- lifecycle -------------------------------------------------------------
-    def start(self) -> None:
+    def _start_fastlane(self) -> None:
+        """Front the master with the native engine so /dir/assign is served
+        without the GIL: Python installs per-query volume-set profiles with
+        leased file-key ranges (do_assign), the engine mints fids from them,
+        and anything else (or a spent/missing profile) proxies back here."""
+        from seaweedfs_tpu.security import tls as _tlsmod
+        from seaweedfs_tpu.storage import fastlane as fl_mod
+
+        requested = self.service.port
+        if (
+            not fl_mod.available()
+            or self.security.white_list
+            or self.security.write_key  # assigns carry JWTs: Python only
+            or _tlsmod.server_context() is not None
+        ):
+            self.service.start()
+            return
+        self.service.port = 0
         self.service.start()
+        self.fastlane = fl_mod.Fastlane.start(
+            self.service.host, requested, self.service.port,
+        )
+        if self.fastlane is None:
+            self.service.stop()
+            self.service.port = requested
+            self.service.start()
+
+    def start(self) -> None:
+        self._start_fastlane()
         if self._peer_config:
             self.enable_raft(
                 [p.rstrip("/") for p in self._peer_config
                  if p.rstrip("/") != self.url]
             )
         threading.Thread(target=self._maintenance_loop, daemon=True).start()
+
+    def _fl_assign_install(self, req, count: int, replication: str,
+                           collection: str, ttl: str, dc: str) -> None:
+        """After a Python-served assign: teach the engine this exact query.
+        The profile snapshot is the layout's current writable volume set;
+        any heartbeat clears every profile (sync is cheap, staleness isn't)."""
+        if self.fastlane is None or count != 1 or not self._is_leader():
+            return
+        import json as _json
+
+        rp = ReplicaPlacement.parse(replication)
+        lo = self.topo.layout(collection, rp, TTL.parse(ttl).to_u32())
+        entries = []
+        with lo._lock:
+            for vid in lo.writables:
+                nodes = lo.locations.get(vid, [])
+                if not nodes:
+                    continue
+                if dc and all(n.dc_name() != dc for n in nodes):
+                    continue
+                main = nodes[0]
+                tail = (
+                    f'"url": {_json.dumps(main.id)}, '
+                    f'"publicUrl": {_json.dumps(main.url)}, "count": 1, '
+                    '"replicas": ['
+                    + ", ".join(
+                        f'{{"url": {_json.dumps(n.id)}, '
+                        f'"publicUrl": {_json.dumps(n.url)}}}'
+                        for n in nodes[1:]
+                    )
+                    + "]}"
+                )
+                entries.append((vid, tail))
+        if not entries:
+            return
+        lease = 20000
+        try:
+            self._ensure_sequence_lease(lease)
+        except Exception:
+            return  # not leader / raft flux: stay on the Python path
+        start = self.topo.sequencer.next_file_id(lease)
+        self.fastlane.assign_set(req.raw_query, entries, start, start + lease)
+
+    def _fl_assign_clear(self) -> None:
+        if getattr(self, "fastlane", None) is not None:
+            self.fastlane.assign_clear()
 
     def enable_raft(self, peer_urls: list[str]) -> None:
         from seaweedfs_tpu.raft import RaftNode
@@ -155,14 +229,30 @@ class MasterServer:
         self._stop.set()
         if self.raft is not None:
             self.raft.stop()
+        if getattr(self, "fastlane", None) is not None:
+            self.fastlane.stop()
+            self.fastlane = None
         self.service.stop()
 
     @property
     def url(self) -> str:
+        if getattr(self, "fastlane", None) is not None:
+            return f"http://{self.service.host}:{self.fastlane.port}"
         return self.service.url
 
     def _maintenance_loop(self) -> None:
+        last_assigns = 0
         while not self._stop.wait(self.topo.pulse_seconds):
+            if self.raft is not None and not self.raft.is_leader():
+                self._fl_assign_clear()  # followers must not mint fids
+            if self.fastlane is not None and self.service.metrics_role:
+                # native assigns bypass the instrumented Python handler
+                n = self.fastlane.stats()["native_assigns"]
+                if n > last_assigns:
+                    self.service._m_total.labels(
+                        self.service.metrics_role, "GET", "200"
+                    ).inc(n - last_assigns)
+                    last_assigns = n
             self.topo.expire_dead_nodes()
             try:
                 self._vacuum_check()
@@ -244,6 +334,9 @@ class MasterServer:
                 return self._not_leader_response()
             hb = req.json()
             self.topo.sync_heartbeat(hb)
+            # any topology delta may change the writable set: drop every
+            # assign profile, the next Python-served assign reinstalls
+            self._fl_assign_clear()
             return Response(
                 {
                     "volume_size_limit": self.topo.volume_size_limit,
@@ -332,6 +425,9 @@ class MasterServer:
                 out["auth"] = gen_write_jwt(
                     self.security.write_key, fid, self.security.write_expires_sec
                 )
+            else:
+                self._fl_assign_install(req, count, replication, collection,
+                                        ttl, dc)
             return Response(out)
 
         svc.route("GET", r"/dir/assign")(do_assign)
@@ -525,6 +621,7 @@ class MasterServer:
         def col_delete(req: Request) -> Response:
             """Drop every volume of a collection on every server
             (`master_server_handlers_admin.go collectionDeleteHandler`)."""
+            self._fl_assign_clear()
             name = req.query.get("collection", "")
             if not name:
                 try:
@@ -561,6 +658,7 @@ class MasterServer:
 
         @svc.route("GET", r"/vol/vacuum")
         def vol_vacuum(req: Request) -> Response:
+            self._fl_assign_clear()  # volumes flip readonly during compaction
             threshold = float(req.query.get("garbageThreshold", self.garbage_threshold))
             old = self.garbage_threshold
             self.garbage_threshold = threshold

@@ -178,6 +178,8 @@ class VolumeServer:
 
     def _fl_drain_loop(self) -> None:  # pragma: no cover - timing loop
         tick = 0
+        last = {"native_reads": 0, "native_writes": 0, "native_deletes": 0,
+                "proxied": 0}
         while not self._stop.is_set():
             try:
                 self.fastlane.drain()
@@ -185,9 +187,30 @@ class VolumeServer:
                 if tick % 50 == 0:  # ~1s flag reconcile (low-disk readonly...)
                     for vid in list(self.fastlane._volumes):
                         self._fl_sync_flags(vid)
+                    self._fl_fold_metrics(last)
             except Exception:
                 pass
             self._stop.wait(0.02)
+
+    def _fl_fold_metrics(self, last: dict) -> None:
+        """Natively-served requests never reach the instrumented Python
+        handlers; fold the engine's counters into the Prometheus registry
+        so request-rate dashboards keep seeing the data plane. (Latency
+        histograms remain Python-path-only.)"""
+        svc = self.service
+        if svc.metrics_role is None:
+            return
+        stats = self.fastlane.stats()
+        for key, method, code in (
+            ("native_reads", "GET", "200"),
+            ("native_writes", "POST", "201"),
+            ("native_deletes", "DELETE", "202"),
+        ):
+            delta = stats[key] - last[key]
+            if delta > 0:
+                svc._m_total.labels(svc.metrics_role, method, code).inc(delta)
+            last[key] = stats[key]
+        last["proxied"] = stats["proxied"]  # proxied ones count in Python
 
     # --- heartbeat --------------------------------------------------------------
     def heartbeat_once(self) -> None:

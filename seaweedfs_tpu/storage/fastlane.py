@@ -32,9 +32,11 @@ def _bind(lib) -> bool:
     try:
         lib.sw_fl_start.restype = ctypes.c_int
         lib.sw_fl_start.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ]
+        lib.sw_fl_volume_serving.restype = ctypes.c_int
+        lib.sw_fl_volume_serving.argtypes = [ctypes.c_int, ctypes.c_uint32]
         lib.sw_fl_port.restype = ctypes.c_int
         lib.sw_fl_port.argtypes = [ctypes.c_int]
         lib.sw_fl_stop.restype = None
@@ -78,6 +80,13 @@ def _bind(lib) -> bool:
         ]
         lib.sw_fl_get_stats.restype = None
         lib.sw_fl_get_stats.argtypes = [ctypes.c_int, ctypes.c_void_p]
+        lib.sw_fl_assign_set.restype = ctypes.c_int
+        lib.sw_fl_assign_set.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_ulonglong, ctypes.c_ulonglong,
+        ]
+        lib.sw_fl_assign_clear.restype = ctypes.c_int
+        lib.sw_fl_assign_clear.argtypes = [ctypes.c_int]
         return True
     except AttributeError:
         return False
@@ -146,14 +155,16 @@ class Fastlane:
 
     @staticmethod
     def start(host: str, port: int, backend_port: int, workers: int = 0,
-              secure_reads: bool = False,
-              secure_writes: bool = False) -> "Fastlane | None":
+              secure_reads: bool = False, secure_writes: bool = False,
+              backend_host: str = "") -> "Fastlane | None":
         lib = _get_lib()
         if lib is None:
             return None
         if workers <= 0:
             workers = min(8, (os.cpu_count() or 2))
-        h = int(lib.sw_fl_start(host.encode(), port, backend_port, workers,
+        h = int(lib.sw_fl_start(host.encode(), port,
+                                (backend_host or host).encode(), backend_port,
+                                workers,
                                 1 if secure_reads else 0,
                                 1 if secure_writes else 0))
         if h < 0:
@@ -189,6 +200,9 @@ class Fastlane:
         self._load_map(volume)
         volume._fl_hook = VolumeHook(self, volume.id)
         self._volumes[volume.id] = volume
+        # until this call the engine proxies the volume's traffic: arming
+        # it before the bulk load would 404 existing needles
+        self._lib.sw_fl_volume_serving(self.handle, volume.id)
         return True
 
     def _load_map(self, volume) -> None:
@@ -254,8 +268,27 @@ class Fastlane:
                     break
         return total
 
+    # --- master assign profiles --------------------------------------------
+    def assign_set(self, query: str, entries: list, key_start: int,
+                   key_end: int) -> None:
+        """Install the native /dir/assign responder for one exact query
+        string. entries: [(vid, tail_json)] — tail_json is the response
+        after the fid field. [key_start, key_end) is a leased key range."""
+        import numpy as np
+
+        vids = np.fromiter((e[0] for e in entries), dtype=np.uint32,
+                           count=len(entries))
+        tails = b"".join(e[1].encode() + b"\0" for e in entries)
+        self._lib.sw_fl_assign_set(
+            self.handle, query.encode(), vids.ctypes.data, tails,
+            len(entries), key_start, key_end,
+        )
+
+    def assign_clear(self) -> None:
+        self._lib.sw_fl_assign_clear(self.handle)
+
     def stats(self) -> dict:
-        out = (ctypes.c_ulonglong * 5)()
+        out = (ctypes.c_ulonglong * 6)()
         self._lib.sw_fl_get_stats(self.handle, out)
         return {
             "requests": int(out[0]),
@@ -263,4 +296,5 @@ class Fastlane:
             "native_writes": int(out[2]),
             "native_deletes": int(out[3]),
             "proxied": int(out[4]),
+            "native_assigns": int(out[5]),
         }

@@ -157,6 +157,11 @@ class Volume:
 
     # --- stats ---------------------------------------------------------------
     def size(self) -> int:
+        h = self._fl_hook
+        if h is not None:
+            # the engine's tail is authoritative while it fronts this
+            # volume; the event drain catches _size up asynchronously
+            return max(self._size, h.tail_get())
         return self._size
 
     def file_count(self) -> int:
