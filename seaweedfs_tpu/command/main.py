@@ -7,6 +7,7 @@ exposes `run(args) -> int` and `HELP`.
 from __future__ import annotations
 
 import importlib
+import os
 import sys
 
 COMMANDS: dict[str, tuple[str, str, str]] = {
@@ -112,6 +113,11 @@ def main(argv: list[str] | None = None) -> int:
     if name not in COMMANDS:
         print(f"unknown command {name!r}; see `weed-tpu help`", file=sys.stderr)
         return 2
+    dsn = os.environ.get("SEAWEEDFS_SENTRY_DSN", "")
+    if dsn:  # reference: sentry.Init at each command's startup
+        from seaweedfs_tpu.util.sentry import init_sentry
+
+        init_sentry(dsn, environment=os.environ.get("SEAWEEDFS_ENV", ""))
     module, fn_name, _ = COMMANDS[name]
     mod = importlib.import_module(module)
     return int(getattr(mod, fn_name)(rest) or 0)

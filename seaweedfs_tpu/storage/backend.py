@@ -253,6 +253,151 @@ class S3Backend(BackendStorage):  # pragma: no cover - boto3 not in image
         ]
 
 
+class RcloneBackend(BackendStorage):
+    """Tier volumes through the `rclone` CLI to any of its ~70 remotes
+    (`weed/storage/backend/rclone_backend/rclone_backend.go` — which links
+    the rclone library; shelling the binary is the same data path rclone
+    users script). `key_template` substitutes `{key}` like the reference's
+    Go text/template key_template option."""
+
+    kind = "rclone"
+
+    def __init__(self, backend_id: str, remote_name: str,
+                 key_template: str = "{key}",
+                 rclone_binary: str = "rclone") -> None:
+        super().__init__(backend_id)
+        import shutil as _shutil
+
+        self.remote = remote_name
+        self.key_template = key_template
+        self.binary = rclone_binary
+        if _shutil.which(self.binary) is None:
+            raise BackendError(
+                f"rclone backend needs the '{self.binary}' binary on PATH"
+            )
+
+    def _target(self, key: str) -> str:
+        return f"{self.remote}:{self.key_template.format(key=key)}"
+
+    def _run(self, args: list, data: bytes | None = None) -> bytes:
+        import subprocess
+
+        try:
+            proc = subprocess.run(
+                [self.binary, *args], input=data, capture_output=True,
+                timeout=3600,
+            )
+        except subprocess.TimeoutExpired as e:
+            raise BackendError(
+                f"{self.id}: rclone {args[0]} timed out"
+            ) from e
+        if proc.returncode != 0:
+            err = BackendError(
+                f"{self.id}: rclone {args[0]} failed: "
+                f"{proc.stderr.decode(errors='replace')[:300]}"
+            )
+            err.returncode = proc.returncode
+            err.stderr = proc.stderr.decode(errors="replace")
+            raise err
+        return proc.stdout
+
+    def upload_file(self, local_path: str, key: str) -> int:
+        self._run(["copyto", local_path, self._target(key)])
+        return os.path.getsize(local_path)
+
+    def download_file(self, key: str, local_path: str) -> None:
+        self._run(["copyto", self._target(key), local_path])
+
+    def delete_file(self, key: str) -> None:
+        try:
+            self._run(["deletefile", self._target(key)])
+        except BackendError as e:
+            # only not-found is benign (rclone exit 3/4 = dir/file not
+            # found); anything else would silently orphan a remote object
+            rc = getattr(e, "returncode", None)
+            msg = getattr(e, "stderr", "").lower()
+            if rc in (3, 4) or "not found" in msg or "doesn't exist" in msg:
+                return
+            raise
+
+    def read_range(self, key: str, offset: int, size: int) -> bytes:
+        return self._run([
+            "cat", "--offset", str(offset), "--count", str(size),
+            self._target(key),
+        ])
+
+    def object_size(self, key: str) -> int:
+        import json as _json
+
+        out = self._run(["size", "--json", self._target(key)])
+        return int(_json.loads(out)["bytes"])
+
+
+class MmapFile(BackendStorageFile):
+    """mmap-backed volume file (`memory_map/memory_map_backend.go`): reads
+    are zero-syscall page-cache loads — the win for read-heavy volumes with
+    many small needles; writes go through pwrite and the mapping is
+    re-extended when the file grows past it."""
+
+    def __init__(self, path: str, create: bool = False) -> None:
+        import mmap as _mmap
+
+        self._mmap_mod = _mmap
+        self.path = path
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        self._fd = os.open(path, flags, 0o644)
+        self._map: "_mmap.mmap | None" = None
+        self._map_size = 0
+        self._lock = threading.Lock()
+        self._remap()
+
+    def _remap(self) -> None:
+        size = os.fstat(self._fd).st_size
+        if self._map is not None:
+            self._map.close()
+            self._map = None
+        if size > 0:
+            self._map = self._mmap_mod.mmap(
+                self._fd, size, prot=self._mmap_mod.PROT_READ
+            )
+        self._map_size = size
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        with self._lock:
+            end = offset + size
+            if end > self._map_size:
+                if end <= os.fstat(self._fd).st_size:
+                    self._remap()
+                else:
+                    return os.pread(self._fd, size, offset)  # racing append
+            if self._map is None:
+                return b""
+            return bytes(self._map[offset:min(end, self._map_size)])
+
+    def write_at(self, data: bytes, offset: int) -> int:
+        n = os.pwrite(self._fd, data, offset)
+        # lazily remapped on the next out-of-range read
+        return n
+
+    def truncate(self, size: int) -> None:
+        os.ftruncate(self._fd, size)
+        with self._lock:
+            self._remap()
+
+    def sync(self) -> None:
+        os.fsync(self._fd)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._map is not None:
+                self._map.close()
+                self._map = None
+        os.close(self._fd)
+
+    def file_size(self) -> int:
+        return os.fstat(self._fd).st_size
+
+
 _registry: dict[str, BackendStorage] = {}
 _registry_lock = threading.Lock()
 
@@ -265,6 +410,8 @@ def configure_backend(backend_id: str, kind: str, **kwargs) -> BackendStorage:
             b: BackendStorage = LocalObjectBackend(backend_id, kwargs["root"])
         elif kind == "s3":
             b = S3Backend(backend_id, **kwargs)
+        elif kind == "rclone":
+            b = RcloneBackend(backend_id, **kwargs)
         else:
             raise BackendError(f"unknown backend kind {kind!r}")
         _registry[backend_id] = b

@@ -179,9 +179,9 @@ class Fastlane:
     def register_volume(self, volume, forward_writes: bool = False) -> bool:
         """Hand a Volume's data plane to the engine. Returns False for
         shapes the engine does not serve (tiered/remote .dat, v1)."""
-        from seaweedfs_tpu.storage.backend import DiskFile
+        from seaweedfs_tpu.storage.backend import DiskFile, MmapFile
 
-        if not isinstance(volume._dat, DiskFile):
+        if not isinstance(volume._dat, (DiskFile, MmapFile)):
             return False  # remote-tiered: reads proxy to Python
         if volume.version() not in (2, 3):
             return False

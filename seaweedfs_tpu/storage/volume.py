@@ -99,7 +99,13 @@ class Volume:
                 )
                 with open(dat_path, "wb") as f:
                     f.write(self.super_block.to_bytes())
-            self._dat = DiskFile(dat_path)
+            if os.environ.get("SEAWEEDFS_TPU_MMAP_READS") == "1":
+                # memory_map backend option: zero-syscall page-cache reads
+                from .backend import MmapFile
+
+                self._dat = MmapFile(dat_path)
+            else:
+                self._dat = DiskFile(dat_path)
         if not is_new:
             header = self._dat.read_at(SUPER_BLOCK_SIZE, 0)
             self.super_block = SuperBlock.from_bytes(header)
