@@ -44,6 +44,10 @@ class ReplicationError(Exception):
         self.offset = offset
 
 
+class PartitionReleased(Exception):
+    """Ownership moved away mid-request; the caller must re-resolve."""
+
+
 class TopicPartition:
     """In-memory tail of one partition; segments hold the flushed prefix."""
 
@@ -55,6 +59,7 @@ class TopicPartition:
         # serializes publishes so an offset can be replicated to followers
         # BEFORE it is committed to the tail (ack-before-commit)
         self.pub_lock = threading.Lock()
+        self.released = False  # set once ownership moved away; appends must fail
         self.tail: list[dict] = []  # unflushed messages
         self.tail_start = 0  # offset of tail[0]
         self._load_flushed_extent()
@@ -93,6 +98,8 @@ class TopicPartition:
         never see the offset (`broker_grpc_pub_follow.go` semantics).
         Raises ReplicationError when followers don't ack."""
         with self.pub_lock:
+            if self.released:
+                raise PartitionReleased()
             with self.lock:
                 offset = self.tail_start + len(self.tail)
             msg = {
@@ -181,6 +188,13 @@ class BrokerServer:
         # follower replica tails: partition key -> {offset: message}
         self._replicas: dict[str, dict[int, dict]] = {}
         self._plock = threading.Lock()
+        # balancer assignment overrides cache: "ns/topic" -> (ts, dict)
+        self._assign_cache: dict[str, tuple[float, dict]] = {}
+        # sub-coordinator state for groups this broker coordinates:
+        # "ns/topic/group" -> {"members": {id: last_seen},
+        #                      "assign": {partition: id}, "version": int}
+        self._groups: dict[str, dict] = {}
+        self._glock = threading.Lock()
         # one long-lived pool for follower fan-out: per-publish executors
         # would pay thread spawn inside pub_lock and stall process exit
         import concurrent.futures as _cf
@@ -239,6 +253,9 @@ class BrokerServer:
 
     def _partition(self, ns: str, topic: str, k: int) -> TopicPartition:
         key = f"{ns}/{topic}/p{k:04d}"
+        # resolve ownership BEFORE _plock: _assignments may do a filer GET
+        # and a slow filer must not serialize every partition operation
+        owner = self._owner_of(ns, topic, k)
         with self._plock:
             tp = self._partitions.get(key)
             created = tp is None
@@ -251,7 +268,6 @@ class BrokerServer:
             # pre-created while following (e.g. by /topics/describe) and
             # only now gained ownership. A describe on a follower must NOT
             # adopt (it would fork a second flusher), hence the owner gate.
-            owner = self._owner_of(ns, topic, k)
             replica = None
             if owner is None or owner == self.url:
                 replica = self._replicas.pop(key, None)
@@ -269,7 +285,31 @@ class BrokerServer:
         ranked = self.ring.ranked_for(f"{ns}/{topic}/p{k}", 1 + r)
         return [s for s in ranked[1:] if s != self.url]
 
+    def _assignments(self, ns: str, topic: str) -> dict:
+        """Balancer-written ownership overrides (`pub_balancer/balance.go`
+        moves); cached briefly, falling back to the rendezvous ring."""
+        key = f"{ns}/{topic}"
+        now = time.time()
+        cached = self._assign_cache.get(key)
+        if cached and now - cached[0] < 2.0:
+            return cached[1]
+        out: dict = {}
+        e = self.fc.get_entry(f"{self._topic_dir(ns, topic)}/assignments.json")
+        if e is not None:
+            raw = e.get("content", "")
+            try:
+                out = json.loads(bytes.fromhex(raw)) if raw else {}
+            except ValueError:
+                out = {}
+        self._assign_cache[key] = (now, out)
+        return out
+
     def _owner_of(self, ns: str, topic: str, k: int) -> str | None:
+        assigned = self._assignments(ns, topic).get(str(k))
+        if assigned and assigned in self.ring.servers():
+            return assigned
+        # no override, or the assigned broker died: rendezvous decides
+        # (the balancer's repair pass clears dead assignments durably)
         return self.ring.server_for(f"{ns}/{topic}/p{k}")
 
     def flush_all(self) -> None:
@@ -280,6 +320,135 @@ class BrokerServer:
                 tp.flush()
             except Exception:
                 pass
+
+    def _iter_topics(self):
+        """Yield (namespace, topic) for every topic in the filer — the one
+        directory walk shared by /topics/list and the balancer."""
+        for ns_e in self.fc.list(TOPICS_DIR).get("Entries") or []:
+            if not ns_e["IsDirectory"]:
+                continue
+            ns = ns_e["FullPath"].rsplit("/", 1)[-1]
+            if ns.startswith("."):
+                continue  # .system metadata log
+            for t_e in self.fc.list(ns_e["FullPath"]).get("Entries") or []:
+                if t_e["IsDirectory"]:
+                    yield ns, t_e["FullPath"].rsplit("/", 1)[-1]
+
+    # --- pub balancer (`weed/mq/pub_balancer/`) --------------------------------
+    def _all_partitions(self) -> list[tuple[str, str, int]]:
+        out = []
+        for ns, topic in self._iter_topics():
+            conf = self._topic_conf(ns, topic)
+            if conf:
+                for k in range(conf["partition_count"]):
+                    out.append((ns, topic, k))
+        return out
+
+    def _write_assignment(self, ns: str, topic: str, k: int,
+                          broker: str | None) -> None:
+        path = f"{self._topic_dir(ns, topic)}/assignments.json"
+        assigns = dict(self._assignments(ns, topic))
+        if broker is None:
+            assigns.pop(str(k), None)
+        else:
+            assigns[str(k)] = broker
+        self.fc.put(path, json.dumps(assigns).encode(),
+                    content_type="application/json")
+        self._assign_cache.pop(f"{ns}/{topic}", None)
+
+    def _release_partition(self, ns: str, topic: str, k: int) -> None:
+        """Flush + drop the in-memory partition so a new owner adopts a
+        durable view (the move half of `balance_action.go`). pub_lock
+        serializes with in-flight publishes, and the released flag makes
+        any publisher that slipped past the owner check fail + re-resolve
+        instead of appending to the orphan."""
+        key = f"{ns}/{topic}/p{k:04d}"
+        with self._plock:
+            tp = self._partitions.pop(key, None)
+        self._assign_cache.pop(f"{ns}/{topic}", None)  # see fresh ownership
+        if tp is not None:
+            with tp.pub_lock:
+                tp.flush()
+                tp.released = True
+
+    def balance_once(self) -> dict | None:
+        """One balancing action (`balance_brokers.go`
+        BalanceTopicPartitionOnBrokers): move a partition from the most- to
+        the least-loaded broker when the spread exceeds 1; dead-broker
+        assignments are repaired first (`repair.go`)."""
+        import random as _random
+
+        from seaweedfs_tpu.server.httpd import post_json
+
+        alive = self.ring.servers()
+        parts = self._all_partitions()
+        # repair first — it matters precisely when brokers died
+        for ns, topic, k in parts:
+            assigned = self._assignments(ns, topic).get(str(k))
+            if assigned and assigned not in alive:
+                self._write_assignment(ns, topic, k, None)
+        if len(alive) < 2:
+            return None
+        loads: dict[str, list] = {b: [] for b in alive}
+        for ns, topic, k in parts:
+            owner = self._owner_of(ns, topic, k)
+            if owner in loads:
+                loads[owner].append((ns, topic, k))
+        source = max(loads, key=lambda b: len(loads[b]))
+        target = min(loads, key=lambda b: len(loads[b]))
+        if len(loads[source]) - len(loads[target]) <= 1:
+            return None
+        ns, topic, k = _random.choice(loads[source])
+        self._write_assignment(ns, topic, k, target)
+        try:
+            post_json(f"{source}/partition/release",
+                      {"namespace": ns, "topic": topic, "partition": k},
+                      timeout=10)
+        except Exception:
+            pass  # source down: the new owner adopts flushed segments
+        return {"namespace": ns, "topic": topic, "partition": k,
+                "from": source, "to": target}
+
+    # --- sub coordinator (`weed/mq/sub_coordinator/`) --------------------------
+    _MEMBER_TTL = 10.0
+
+    def _group_key(self, ns: str, topic: str, group: str) -> str:
+        return f"{ns}/{topic}/{group}"
+
+    def _group_coordinator(self, key: str) -> str | None:
+        return self.ring.server_for(f"group/{key}")
+
+    def _rebalance_group(self, state: dict, count: int) -> None:
+        """Sticky assignment (`partition_consumer_mapping.go`
+        doBalanceSticky): members keep their partitions; orphaned slots go
+        to the least-loaded members."""
+        now = time.time()
+        state["members"] = {
+            m: ts for m, ts in state["members"].items()
+            if now - ts < self._MEMBER_TTL
+        }
+        members = sorted(state["members"])
+        old = state.get("assign", {})
+        assign: dict[int, str] = {}
+        per: dict[str, int] = {m: 0 for m in members}
+        if members:
+            # cap sticky keeps at the fair ceiling — the reference's fill
+            # pass alone would leave a new joiner idle until slots free up,
+            # defeating its own "max processing power utilization" goal
+            ceiling = -(-count // len(members))
+            for k in range(count):
+                prev = old.get(k)
+                if prev in per and per[prev] < ceiling:
+                    assign[k] = prev
+                    per[prev] += 1
+            for k in range(count):
+                if k not in assign:
+                    m = min(members, key=lambda x: per[x])
+                    assign[k] = m
+                    per[m] += 1
+        if assign != old:
+            state["version"] = state.get("version", 0) + 1
+        state["assign"] = assign
 
     # --- routes ----------------------------------------------------------------
     def _routes(self) -> None:
@@ -311,19 +480,9 @@ class BrokerServer:
 
         @svc.route("GET", r"/topics/list")
         def topics_list(req: Request) -> Response:
-            topics = []
-            for ns_e in self.fc.list(TOPICS_DIR).get("Entries") or []:
-                if not ns_e["IsDirectory"]:
-                    continue
-                ns = ns_e["FullPath"].rsplit("/", 1)[-1]
-                if ns.startswith("."):
-                    continue  # .system metadata log
-                for t_e in self.fc.list(ns_e["FullPath"]).get("Entries") or []:
-                    if t_e["IsDirectory"]:
-                        topics.append(
-                            {"namespace": ns,
-                             "topic": t_e["FullPath"].rsplit("/", 1)[-1]}
-                        )
+            topics = [
+                {"namespace": ns, "topic": t} for ns, t in self._iter_topics()
+            ]
             return Response({"topics": topics})
 
         @svc.route("GET", r"/topics/describe")
@@ -360,6 +519,10 @@ class BrokerServer:
                 k = int.from_bytes(digest[:4], "big") % count
             owner = self._owner_of(ns, topic, k)
             if owner and owner != self.url:
+                # ownership moved (broker joined / balancer action): make
+                # any locally-held tail durable before pointing the client
+                # at the new owner, or it would read a truncated partition
+                self._release_partition(ns, topic, k)
                 return Response({"moved_to": owner, "partition": k}, 307)
             if conf.get("schema") is not None:
                 from seaweedfs_tpu.mq.schema import SchemaError, validate_record
@@ -418,6 +581,12 @@ class BrokerServer:
                 return Response(
                     {"error": "not enough follower acks"}, 503
                 )
+            except PartitionReleased:
+                # raced a balancer move: point the client at the new owner
+                owner = self._owner_of(ns, topic, k)
+                return Response(
+                    {"moved_to": owner or self.url, "partition": k}, 307
+                )
             return Response({"ok": True, "partition": k, "offset": offset})
 
         @svc.route("GET", r"/subscribe")
@@ -433,6 +602,7 @@ class BrokerServer:
                 return Response({"error": f"{ns}/{topic} not found"}, 404)
             owner = self._owner_of(ns, topic, k)
             if owner and owner != self.url:
+                self._release_partition(ns, topic, k)  # flush stale tail
                 return Response({"moved_to": owner}, 307)
             tp = self._partition(ns, topic, k)
             msgs = tp.read(offset, limit, wait)
@@ -473,6 +643,141 @@ class BrokerServer:
             return Response(
                 {"offsets": json.loads(bytes.fromhex(e["content"]))}
             )
+
+        @svc.route("POST", r"/balance")
+        def balance(req: Request) -> Response:
+            """Run balance actions until the spread is ≤1
+            (`pub_balancer/balance.go` loops single moves). Exclusive:
+            concurrent balancers would lose each other's assignment writes,
+            so the master's cluster lock serializes runs across brokers."""
+            from seaweedfs_tpu.server.httpd import post_json
+
+            locked = False
+            if self.master_url:
+                try:
+                    post_json(f"{self.master_url}/cluster/lock", {
+                        "name": "mq.balance", "holder": self.url,
+                        "ttl": 60,
+                    }, timeout=5)
+                    locked = True
+                except Exception:
+                    return Response(
+                        {"error": "another balance run holds the lock"}, 409
+                    )
+            try:
+                actions = []
+                for _ in range(64):
+                    act = self.balance_once()
+                    if act is None:
+                        break
+                    actions.append(act)
+            finally:
+                if locked:
+                    try:
+                        post_json(f"{self.master_url}/cluster/unlock", {
+                            "name": "mq.balance", "holder": self.url,
+                        }, timeout=5)
+                    except Exception:
+                        pass  # ttl expiry reclaims it
+            return Response({"actions": actions})
+
+        @svc.route("POST", r"/partition/release")
+        def partition_release(req: Request) -> Response:
+            p = req.json()
+            self._release_partition(
+                p.get("namespace", "default"), p["topic"], int(p["partition"])
+            )
+            return Response({"ok": True})
+
+        def _coordinator_gate(p: dict):
+            key = self._group_key(
+                p.get("namespace", "default"), p["topic"], p["group"]
+            )
+            coord = self._group_coordinator(key)
+            if coord and coord != self.url:
+                return key, Response({"moved_to": coord}, 307)
+            return key, None
+
+        @svc.route("POST", r"/consumer/join")
+        def consumer_join(req: Request) -> Response:
+            p = req.json()
+            key, moved = _coordinator_gate(p)
+            if moved:
+                return moved
+            conf = self._topic_conf(p.get("namespace", "default"), p["topic"])
+            if conf is None:
+                return Response({"error": "topic not found"}, 404)
+            instance = p.get("instance_id") or f"c-{time.time_ns():x}"
+            with self._glock:
+                state = self._groups.setdefault(
+                    key, {"members": {}, "assign": {}, "version": 0}
+                )
+                state["members"][instance] = time.time()
+                self._rebalance_group(state, conf["partition_count"])
+                mine = sorted(
+                    k for k, m in state["assign"].items() if m == instance
+                )
+                version = state["version"]
+            return Response({
+                "instance_id": instance, "version": version,
+                "partitions": mine,
+            })
+
+        @svc.route("POST", r"/consumer/leave")
+        def consumer_leave(req: Request) -> Response:
+            p = req.json()
+            key, moved = _coordinator_gate(p)
+            if moved:
+                return moved
+            conf = self._topic_conf(p.get("namespace", "default"), p["topic"])
+            with self._glock:
+                state = self._groups.get(key)
+                if state is not None:
+                    state["members"].pop(p.get("instance_id", ""), None)
+                    if conf:
+                        self._rebalance_group(state, conf["partition_count"])
+            return Response({"ok": True})
+
+        @svc.route("POST", r"/consumer/heartbeat")
+        def consumer_heartbeat(req: Request) -> Response:
+            p = req.json()
+            key, moved = _coordinator_gate(p)
+            if moved:
+                return moved
+            instance = p.get("instance_id")
+            if not instance:
+                return Response({"error": "instance_id required"}, 400)
+            conf = self._topic_conf(p.get("namespace", "default"), p["topic"])
+            with self._glock:
+                state = self._groups.get(key)
+                if state is None or conf is None:
+                    return Response({"error": "unknown group"}, 404)
+                state["members"][instance] = time.time()
+                self._rebalance_group(state, conf["partition_count"])
+                return Response({"version": state["version"]})
+
+        @svc.route("GET", r"/consumer/assignments")
+        def consumer_assignments(req: Request) -> Response:
+            p = {
+                "namespace": req.query.get("namespace", "default"),
+                "topic": req.query["topic"],
+                "group": req.query["group"],
+            }
+            key, moved = _coordinator_gate(p)
+            if moved:
+                return moved
+            instance = req.query.get("instance_id", "")
+            with self._glock:
+                state = self._groups.get(key)
+                if state is None:
+                    return Response({"error": "unknown group"}, 404)
+                mine = sorted(
+                    k for k, m in state["assign"].items() if m == instance
+                )
+                return Response({
+                    "version": state["version"], "partitions": mine,
+                    "members": sorted(state["members"]),
+                })
 
         @svc.route("POST", r"/follow/append")
         def follow_append(req: Request) -> Response:

@@ -151,6 +151,18 @@ def cmd_mq_topic_describe(env: CommandEnv, args: list[str]) -> str:
     return _json.dumps(out, indent=2)
 
 
+@command("mq.balance", "rebalance topic partitions across live brokers")
+def cmd_mq_balance(env: CommandEnv, args: list[str]) -> str:
+    out = env.post(f"{_broker_url(env)}/balance", {})
+    acts = out.get("actions", [])
+    if not acts:
+        return "already balanced"
+    return "\n".join(
+        f"moved {a['namespace']}/{a['topic']} p{a['partition']} "
+        f"{a['from']} -> {a['to']}" for a in acts
+    )
+
+
 @command("cluster.raft.ps", "show raft member status on the master(s)")
 def cmd_cluster_raft_ps(env: CommandEnv, args: list[str]) -> str:
     out = env.get(f"{env.master_url}/raft/status")

@@ -355,3 +355,133 @@ class TestSchemaTopics:
             assert tp.high_water_mark() == 1
         finally:
             b.stop()
+
+
+class TestPubBalancer:
+    """Partition rebalancing across brokers (`weed/mq/pub_balancer/`):
+    spread converges to ≤1, moves are durable assignment overrides, and a
+    dead broker's assignments get repaired."""
+
+    def test_balance_converges_and_data_survives(self, stack):
+        from seaweedfs_tpu.mq import BrokerServer
+
+        master, filer, broker = stack
+        _post(broker.url + "/topics/create",
+              {"topic": "tobalance", "partition_count": 6})
+        # publish a message to every partition while one broker owns all
+        for k in range(6):
+            status, out = _post(broker.url + "/publish", {
+                "topic": "tobalance", "partition": k, "value": f"v{k}",
+            })
+            assert status == 200, out
+        b2 = BrokerServer(filer.url, master_url=master.url, port=0,
+                          peers=[broker.url])
+        b2.start()
+        try:
+            for b in (broker, b2):
+                b.ring.set_servers([broker.url, b2.url])
+            status, out = _post(broker.url + "/balance", {})
+            assert status == 200
+            # GLOBAL spread (all topics the fixture accumulated) must be ≤1
+            counts = {broker.url: 0, b2.url: 0}
+            for ns, topic, k in broker._all_partitions():
+                owner = broker._owner_of(ns, topic, k)
+                if owner in counts:
+                    counts[owner] += 1
+            assert abs(counts[broker.url] - counts[b2.url]) <= 1, counts
+            assert out["actions"] or min(counts.values()) > 0
+            # every partition's data is readable at its (possibly new) owner
+            for k in range(6):
+                url = broker.url
+                for _ in range(3):
+                    status, out = _get(
+                        f"{url}/subscribe?topic=tobalance&partition={k}"
+                        f"&offset=0"
+                    )
+                    if status == 307:
+                        url = out["moved_to"]
+                        continue
+                    break
+                assert status == 200, out
+                assert out["messages"][0]["value"] == f"v{k}"
+            # kill b2: repair clears its assignments, rendezvous takes over
+            dead = b2.url
+            b2.stop()
+            broker.ring.set_servers([broker.url])
+            _post(broker.url + "/balance", {})
+            for k in range(6):
+                assert broker._owner_of("default", "tobalance", k) != dead
+        finally:
+            broker.ring.set_servers([broker.url])
+            try:
+                b2.stop()
+            except Exception:
+                pass
+
+
+class TestSubCoordinator:
+    """Consumer-group partition assignment (`weed/mq/sub_coordinator/`):
+    sticky rebalance across join/leave, lazy member expiry."""
+
+    def test_sticky_join_leave(self, stack):
+        master, filer, broker = stack
+        _post(broker.url + "/topics/create",
+              {"topic": "grouped", "partition_count": 4})
+        status, a = _post(broker.url + "/consumer/join", {
+            "topic": "grouped", "group": "g1", "instance_id": "alpha",
+        })
+        assert status == 200 and a["partitions"] == [0, 1, 2, 3]
+        status, b = _post(broker.url + "/consumer/join", {
+            "topic": "grouped", "group": "g1", "instance_id": "beta",
+        })
+        assert status == 200 and len(b["partitions"]) == 2
+        # alpha's refreshed view: sticky — it kept 2 of its original 4
+        status, av = _get(
+            f"{broker.url}/consumer/assignments?topic=grouped&group=g1"
+            f"&instance_id=alpha"
+        )
+        assert status == 200
+        assert len(av["partitions"]) == 2
+        assert set(av["partitions"]) | set(b["partitions"]) == {0, 1, 2, 3}
+        assert set(av["partitions"]).isdisjoint(b["partitions"])
+        assert av["version"] > a["version"]
+        # beta leaves: alpha reclaims everything, keeping its own sticky
+        kept = set(av["partitions"])
+        status, _ = _post(broker.url + "/consumer/leave", {
+            "topic": "grouped", "group": "g1", "instance_id": "beta",
+        })
+        assert status == 200
+        status, av2 = _get(
+            f"{broker.url}/consumer/assignments?topic=grouped&group=g1"
+            f"&instance_id=alpha"
+        )
+        assert av2["partitions"] == [0, 1, 2, 3]
+        assert kept <= set(av2["partitions"])
+
+    def test_member_expiry_rebalances(self, stack, monkeypatch):
+        from seaweedfs_tpu.mq.broker import BrokerServer
+
+        master, filer, broker = stack
+        _post(broker.url + "/topics/create",
+              {"topic": "expiring", "partition_count": 2})
+        _post(broker.url + "/consumer/join", {
+            "topic": "expiring", "group": "g2", "instance_id": "live",
+        })
+        _post(broker.url + "/consumer/join", {
+            "topic": "expiring", "group": "g2", "instance_id": "ghost",
+        })
+        # ghost stops heartbeating; shrink the TTL instead of sleeping
+        monkeypatch.setattr(BrokerServer, "_MEMBER_TTL", 0.05)
+        import time as _time
+
+        _time.sleep(0.1)
+        status, hb = _post(broker.url + "/consumer/heartbeat", {
+            "topic": "expiring", "group": "g2", "instance_id": "live",
+        })
+        assert status == 200
+        status, av = _get(
+            f"{broker.url}/consumer/assignments?topic=expiring&group=g2"
+            f"&instance_id=live"
+        )
+        assert av["partitions"] == [0, 1]
+        assert av["members"] == ["live"]
