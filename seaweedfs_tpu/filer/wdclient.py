@@ -12,7 +12,7 @@ import random
 import threading
 import time
 
-from seaweedfs_tpu.server.httpd import get_json, http_request, peer_url
+from seaweedfs_tpu.server.httpd import PooledHTTP, get_json, http_request, peer_url
 
 
 class WeedClient:
@@ -30,6 +30,9 @@ class WeedClient:
         self.jwt_key = jwt_key  # shared security.toml signing key
         self._vid_cache: dict[int, tuple[float, list[str]]] = {}
         self._lock = threading.Lock()
+        # keep-alive for the hot data-plane hops (assign, chunk upload,
+        # chunk fetch) — urllib's conn-per-call dominates small chunks
+        self._pool = PooledHTTP()
 
     # --- assignment -------------------------------------------------------------
     def assign(
@@ -62,8 +65,8 @@ class WeedClient:
         last_err: Exception | None = None
         for _ in range(len(self.masters) + 2):
             try:
-                status, _, body = http_request(
-                    "GET", self.master_url + path_qs, timeout=30
+                status, _, body = self._pool.request(
+                    "GET", self.master_url + path_qs
                 )
                 data = _json.loads(body) if body else {}
             except Exception as e:
@@ -150,7 +153,10 @@ class WeedClient:
         url = f"{peer_url(location)}/{fid}"
         if ttl:
             url += f"?ttl={ttl}"
-        status, _, body = http_request("POST", url, data, headers)
+        # fid-addressed uploads are idempotent: safe to retry a stale
+        # keep-alive socket that died while this client sat idle
+        status, _, body = self._pool.request("POST", url, data, headers,
+                                             idempotent=True)
         if status >= 300:
             raise IOError(f"upload {fid} -> {status}: {body[:200]!r}")
         import json
@@ -163,7 +169,7 @@ class WeedClient:
         random.shuffle(urls)
         for url in urls:
             headers = {"Range": range_header} if range_header else {}
-            status, _, body = http_request("GET", url, headers=headers)
+            status, _, body = self._pool.request("GET", url, headers=headers)
             if status in (200, 206):
                 return body
             last_err = IOError(f"GET {url} -> {status}")

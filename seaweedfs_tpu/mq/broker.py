@@ -190,6 +190,8 @@ class BrokerServer:
         self._plock = threading.Lock()
         # balancer assignment overrides cache: "ns/topic" -> (ts, dict)
         self._assign_cache: dict[str, tuple[float, dict]] = {}
+        # fenced partitions: mid-move quiesce (key -> fence deadline)
+        self._fenced: dict[str, float] = {}
         # sub-coordinator state for groups this broker coordinates:
         # "ns/topic/group" -> {"members": {id: last_seen},
         #                      "assign": {partition: id}, "version": int}
@@ -356,13 +358,20 @@ class BrokerServer:
                     content_type="application/json")
         self._assign_cache.pop(f"{ns}/{topic}", None)
 
-    def _release_partition(self, ns: str, topic: str, k: int) -> None:
+    def _release_partition(self, ns: str, topic: str, k: int,
+                           fence: bool = False) -> bool:
         """Flush + drop the in-memory partition so a new owner adopts a
         durable view (the move half of `balance_action.go`). pub_lock
         serializes with in-flight publishes, and the released flag makes
         any publisher that slipped past the owner check fail + re-resolve
-        instead of appending to the orphan."""
+        instead of appending to the orphan. With fence=True the partition
+        also rejects publishes (503) until unfenced — the balancer holds
+        the fence across its assignment write so the target can never
+        adopt a stale extent. Returns whether a partition was held."""
         key = f"{ns}/{topic}/p{k:04d}"
+        if fence:
+            self._fenced[key] = time.time() + 10.0  # auto-expires: no
+            # permanent 503s if the balancer dies mid-move
         with self._plock:
             tp = self._partitions.pop(key, None)
         self._assign_cache.pop(f"{ns}/{topic}", None)  # see fresh ownership
@@ -370,6 +379,20 @@ class BrokerServer:
             with tp.pub_lock:
                 tp.flush()
                 tp.released = True
+        return tp is not None
+
+    def _is_fenced(self, ns: str, topic: str, k: int) -> bool:
+        key = f"{ns}/{topic}/p{k:04d}"
+        deadline = self._fenced.get(key)
+        if deadline is None:
+            return False
+        if time.time() > deadline:
+            self._fenced.pop(key, None)
+            return False
+        return True
+
+    def _unfence(self, ns: str, topic: str, k: int) -> None:
+        self._fenced.pop(f"{ns}/{topic}/p{k:04d}", None)
 
     def balance_once(self) -> dict | None:
         """One balancing action (`balance_brokers.go`
@@ -399,13 +422,26 @@ class BrokerServer:
         if len(loads[source]) - len(loads[target]) <= 1:
             return None
         ns, topic, k = _random.choice(loads[source])
+        # move protocol: fence the source (new publishes 503 immediately),
+        # quiesce in-flight stragglers until no local partition remains,
+        # only THEN make the assignment durable, and unfence — the target
+        # can never adopt an extent missing an acked message
+        try:
+            for _ in range(5):
+                out = post_json(f"{source}/partition/release",
+                                {"namespace": ns, "topic": topic,
+                                 "partition": k, "fence": True}, timeout=10)
+                if not out.get("had"):
+                    break
+        except Exception:
+            pass  # source down: its flushed segments are all there is
         self._write_assignment(ns, topic, k, target)
         try:
-            post_json(f"{source}/partition/release",
+            post_json(f"{source}/partition/unfence",
                       {"namespace": ns, "topic": topic, "partition": k},
                       timeout=10)
         except Exception:
-            pass  # source down: the new owner adopts flushed segments
+            pass  # fences self-expire after 10s
         return {"namespace": ns, "topic": topic, "partition": k,
                 "from": source, "to": target}
 
@@ -517,6 +553,11 @@ class BrokerServer:
             else:
                 digest = hashlib.sha1(key.encode()).digest()
                 k = int.from_bytes(digest[:4], "big") % count
+            if self._is_fenced(ns, topic, k):
+                return Response(
+                    {"error": "partition moving, retry", "retry": True}, 503,
+                    headers={"Retry-After": "1"},
+                )
             owner = self._owner_of(ns, topic, k)
             if owner and owner != self.url:
                 # ownership moved (broker joined / balancer action): make
@@ -684,9 +725,17 @@ class BrokerServer:
         @svc.route("POST", r"/partition/release")
         def partition_release(req: Request) -> Response:
             p = req.json()
-            self._release_partition(
-                p.get("namespace", "default"), p["topic"], int(p["partition"])
+            had = self._release_partition(
+                p.get("namespace", "default"), p["topic"], int(p["partition"]),
+                fence=bool(p.get("fence")),
             )
+            return Response({"ok": True, "had": had})
+
+        @svc.route("POST", r"/partition/unfence")
+        def partition_unfence(req: Request) -> Response:
+            p = req.json()
+            self._unfence(p.get("namespace", "default"), p["topic"],
+                          int(p["partition"]))
             return Response({"ok": True})
 
         def _coordinator_gate(p: dict):

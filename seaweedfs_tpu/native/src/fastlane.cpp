@@ -186,6 +186,9 @@ struct Conn {
     std::string out;     // pending response bytes
     size_t out_off = 0;
     bool want_close = false;
+    bool sent_continue = false;  // answered Expect: 100-continue this request
+    size_t chunk_scan = 0;       // chunked decode: resume position in `in`
+    std::string chunk_body;      // chunked decode: body decoded so far
     BackendConn* upstream = nullptr;  // pending proxied request, if any
     time_t last_active = 0;
 };
@@ -196,6 +199,7 @@ struct Conn {
 struct BackendConn {
     int kind = 1;
     int fd = -1;
+    bool counted = false;     // holds a slot under the backend cap
     Conn* client = nullptr;   // null if the client went away mid-flight
     std::string req;          // original request bytes (kept for one retry)
     size_t req_off = 0;       // send progress
@@ -213,6 +217,8 @@ struct Worker {
     int epfd = -1;
     std::vector<int> idle_backends;   // keep-alive conns to Python, not in epoll
     std::vector<BackendConn*> pending;  // in-flight proxied requests
+    size_t capped_inflight = 0;         // pending entries counted under the cap
+    std::deque<BackendConn*> waiting;   // queued: backend concurrency capped
     std::mutex conns_mu;            // acceptor adds, worker removes
     std::vector<Conn*> conns;       // for idle sweep / teardown
     std::vector<Conn*> graveyard;   // closed this loop pass; freed next pass
@@ -236,6 +242,9 @@ struct Engine {
     int port = 0;
     int backend_port = 0;
     uint32_t backend_ip = 0;  // where the Python service listens
+    // ceiling on concurrent proxied requests per worker: a GIL-bound
+    // backend serves N requests faster than 4N threads convoying
+    size_t max_backend = 16;
     bool secure_writes = false;     // JWT configured -> proxy writes
     bool secure_reads = false;
     std::atomic<bool> running{true};
@@ -722,6 +731,7 @@ void backend_finish(Worker* w, BackendConn* b, bool reusable) {
         if (w->pending[i] == b) {
             w->pending[i] = w->pending.back();
             w->pending.pop_back();
+            if (b->counted) w->capped_inflight--;
             break;
         }
     if (b->fd >= 0) {
@@ -775,19 +785,55 @@ bool backend_launch(Engine* E, Worker* w, BackendConn* b) {
     return true;
 }
 
-void proxy_request(Engine* E, Worker* w, Conn* c, const char* req, size_t len) {
+// bypass_cap: long-poll endpoints (meta subscriptions) park cheaply in a
+// Python thread for up to 30s — counting them against the backend cap
+// would let a couple of subscribers starve every other request
+void proxy_request(Engine* E, Worker* w, Conn* c, const char* req, size_t len,
+                   bool bypass_cap = false) {
     auto* b = new BackendConn();
     b->client = c;
     b->req.assign(req, len);
+    b->started = time(nullptr);
+    b->counted = !bypass_cap;
+    c->upstream = b;  // halts further request processing on this client
+    if (b->counted && w->capped_inflight >= E->max_backend) {
+        w->waiting.push_back(b);  // dispatched as in-flight requests finish
+        return;
+    }
     if (!backend_launch(E, w, b)) {
+        c->upstream = nullptr;
         delete b;
         json_response(c, 502, "Bad Gateway",
                       "{\"error\": \"backend unavailable\"}");
         c->want_close = true;
         return;
     }
-    c->upstream = b;  // halts further request processing on this client
+    if (b->counted) w->capped_inflight++;
     w->pending.push_back(b);
+}
+
+// dispatch queued proxied requests into freed backend slots
+void drain_waiting(Engine* E, Worker* w) {
+    while (!w->waiting.empty() && w->capped_inflight < E->max_backend) {
+        BackendConn* b = w->waiting.front();
+        w->waiting.pop_front();
+        if (b->client == nullptr) {  // client vanished while queued
+            w->back_graveyard.push_back(b);
+            continue;
+        }
+        if (!backend_launch(E, w, b)) {
+            Conn* c = b->client;
+            c->upstream = nullptr;
+            json_response(c, 502, "Bad Gateway",
+                          "{\"error\": \"backend unavailable\"}");
+            c->want_close = true;
+            flush_out(w, c);
+            w->back_graveyard.push_back(b);
+            continue;
+        }
+        w->capped_inflight++;
+        w->pending.push_back(b);
+    }
 }
 
 // deliver the completed (or failed) proxy response to the client and resume
@@ -808,6 +854,7 @@ void backend_complete(Engine* E, Worker* w, BackendConn* b, bool ok,
         }
     }
     backend_finish(w, b, reusable);
+    drain_waiting(E, w);
     if (c != nullptr) {
         if (!c->want_close) process_buffered(E, w, c);
         flush_out(w, c);
@@ -819,6 +866,12 @@ bool backend_parse(BackendConn* b) {
     if (b->hdr_end == 0) {
         size_t he = b->resp.find("\r\n\r\n");
         if (he == std::string::npos) return false;
+        // interim 1xx responses (100 Continue to a forwarded Expect
+        // header) precede the real one: drop and keep parsing
+        if (b->resp.compare(0, 9, "HTTP/1.1 ") == 0 && b->resp[9] == '1') {
+            b->resp.erase(0, he + 4);
+            return backend_parse(b);
+        }
         b->hdr_end = he + 4;
         const char* hb = b->resp.data();
         const char* hend = hb + b->hdr_end;
@@ -984,6 +1037,21 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
         return;
     }
 
+    // long-poll surfaces: filer meta subscriptions and any wait= query
+    bool bypass_cap = false;
+    if ((size_t)(fid_end - path) >= 10 && memcmp(path, "/__meta__/", 10) == 0)
+        bypass_cap = true;
+    else if (has_query) {
+        size_t qn = (size_t)(path_end - qmark - 1);
+        const char* q = qmark + 1;
+        for (size_t i = 0; i + 5 <= qn; i++)
+            if (memcmp(q + i, "wait=", 5) == 0 &&
+                (i == 0 || q[i - 1] == '&')) {
+                bypass_cap = true;
+                break;
+            }
+    }
+
     uint32_t vid; uint64_t key; uint32_t cookie;
     bool is_fid = path < fid_end && path[0] == '/' &&
                   parse_fid(path + 1, fid_end, &vid, &key, &cookie);
@@ -1037,11 +1105,11 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
                 !v->forward_writes.load()) {
                 if (handle_delete(E, c, v, key, cookie)) return;
             }
-            proxy_request(E, w, c, req, req_len);
+            proxy_request(E, w, c, req, req_len, bypass_cap);
             return;
         }
     }
-    proxy_request(E, w, c, req, req_len);
+    proxy_request(E, w, c, req, req_len, bypass_cap);
 }
 
 // ---------------------------------------------------------------------------
@@ -1054,8 +1122,8 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
 void close_conn(Worker* w, Conn* c) {
     if (c->fd >= 0) {
         if (c->upstream != nullptr) {
-            // orphan the in-flight proxy; it completes into the void and
-            // its backend conn is not reused (response must drain fully)
+            // orphan the in-flight (or still-queued) proxy; it completes
+            // into the void and its backend conn is not reused
             c->upstream->client = nullptr;
             c->upstream = nullptr;
         }
@@ -1097,6 +1165,57 @@ void flush_out(Worker* w, Conn* c) {
     epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
 }
 
+// A chunked request body (curl -T -, streaming clients) carries no
+// Content-Length; decode it and rebuild the request with one so both the
+// native handlers and the Python backend (which only frames by length)
+// can serve it. Returns 1 when a rebuilt request replaced c->in's head,
+// 0 when more bytes are needed, -1 on a framing error.
+int dechunk_request(Conn* c, size_t hdr_len) {
+    // resume from the prior scan position: re-walking every chunk per
+    // read event would be O(n^2) on large streamed uploads
+    size_t pos = c->chunk_scan ? c->chunk_scan : hdr_len;
+    for (;;) {
+        size_t le = c->in.find("\r\n", pos);
+        if (le == std::string::npos) { c->chunk_scan = pos; return 0; }
+        size_t chunk = strtoull(c->in.c_str() + pos, nullptr, 16);
+        size_t data_at = le + 2;
+        if (chunk == 0) {
+            // optional trailers end with a blank line
+            size_t fin = c->in.find("\r\n\r\n", le);
+            size_t end;
+            if (c->in.compare(le, 4, "\r\n\r\n") == 0) end = le + 4;
+            else if (fin != std::string::npos) end = fin + 4;
+            else { c->chunk_scan = pos; return 0; }
+            // rebuild: headers minus Transfer-Encoding, plus Content-Length
+            std::string head(c->in, 0, hdr_len - 2);  // keep one CRLF off
+            std::string rebuilt;
+            size_t line = 0;
+            while (line < head.size()) {
+                size_t eol = head.find("\r\n", line);
+                if (eol == std::string::npos) eol = head.size();
+                if (strncasecmp(head.c_str() + line, "transfer-encoding:",
+                                18) != 0)
+                    rebuilt.append(head, line, eol + 2 - line);
+                line = eol + 2;
+            }
+            char clh[48];
+            snprintf(clh, sizeof clh, "Content-Length: %zu\r\n\r\n",
+                     c->chunk_body.size());
+            rebuilt += clh;
+            rebuilt += c->chunk_body;
+            c->in.replace(0, end, rebuilt);
+            c->chunk_scan = 0;
+            c->chunk_body.clear();
+            return 1;
+        }
+        if (chunk > (1ull << 31)) return -1;
+        if (c->in.size() < data_at + chunk + 2) { c->chunk_scan = pos; return 0; }
+        c->chunk_body.append(c->in, data_at, chunk);
+        pos = data_at + chunk + 2;
+        if (c->chunk_body.size() > (1ull << 31)) return -1;
+    }
+}
+
 // drain complete buffered requests; stops while a proxied request is in
 // flight (responses must stay ordered per connection)
 void process_buffered(Engine* E, Worker* w, Conn* c) {
@@ -1107,6 +1226,25 @@ void process_buffered(Engine* E, Worker* w, Conn* c) {
             return;
         }
         size_t hdr_len = hdr_end + 4;
+        // clients streaming a body often wait for 100 Continue first
+        if (!c->sent_continue) {
+            std::string expect = find_header(
+                c->in.data(), c->in.data() + hdr_len, "expect");
+            if (strncasecmp(expect.c_str(), "100-", 4) == 0) {
+                c->sent_continue = true;
+                c->out += "HTTP/1.1 100 Continue\r\n\r\n";
+                flush_out(w, c);
+                if (c->fd < 0) return;
+            }
+        }
+        std::string te = find_header(c->in.data(), c->in.data() + hdr_len,
+                                     "transfer-encoding");
+        if (strcasecmp(te.c_str(), "chunked") == 0) {
+            int rc = dechunk_request(c, hdr_len);
+            if (rc == 0) return;          // need more chunks
+            if (rc < 0) { close_conn(w, c); return; }
+            continue;  // re-parse the rebuilt, length-framed request
+        }
         std::string cl = find_header(c->in.data(), c->in.data() + hdr_len,
                                      "content-length");
         size_t body_len = cl.empty() ? 0 : strtoull(cl.c_str(), nullptr, 10);
@@ -1116,6 +1254,7 @@ void process_buffered(Engine* E, Worker* w, Conn* c) {
         dispatch(E, w, c, c->in.data(), req_len, hdr_len,
                  c->in.data() + hdr_len, body_len);
         c->in.erase(0, req_len);
+        c->sent_continue = false;
     }
 }
 
@@ -1206,6 +1345,8 @@ void* worker_main(void* arg) {
     }
     for (auto* b : w->pending) { if (b->fd >= 0) close(b->fd); delete b; }
     w->pending.clear();
+    for (auto* b : w->waiting) delete b;
+    w->waiting.clear();
     for (auto* b : w->back_graveyard) delete b;
     w->back_graveyard.clear();
     for (int fd : w->idle_backends) close(fd);
@@ -1257,7 +1398,7 @@ extern "C" {
 // returns an engine handle (>=0); the bound port comes from sw_fl_port()
 int sw_fl_start(const char* host, int port, const char* backend_host,
                 int backend_port, int workers, int secure_reads,
-                int secure_writes) {
+                int secure_writes, int max_backend) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return -2;
     int one = 1;
@@ -1286,6 +1427,7 @@ int sw_fl_start(const char* host, int port, const char* backend_host,
     }
     E->secure_reads = secure_reads != 0;
     E->secure_writes = secure_writes != 0;
+    if (max_backend > 0) E->max_backend = (size_t)max_backend;
     if (workers < 1) workers = 2;
     if (workers > 32) workers = 32;
     E->workers.resize(workers);

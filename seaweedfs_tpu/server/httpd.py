@@ -391,9 +391,13 @@ class PooledHTTP:
     equivalent for the data-plane hot paths. Honors process mTLS."""
 
     def __init__(self, timeout: float = 30.0) -> None:
+        import weakref
+
         self._tl = threading.local()
         self.timeout = timeout
-        self._all: set = set()  # every conn, all threads (for close())
+        # weak: a dead handler thread's conns must not be pinned forever —
+        # GC of its thread-local dict lets the sockets finalize
+        self._all = weakref.WeakSet()
         self._all_mu = threading.Lock()
 
     def request(
@@ -402,6 +406,7 @@ class PooledHTTP:
         url: str,
         body: bytes | None = None,
         headers: dict | None = None,
+        idempotent: bool = False,
     ) -> tuple[int, dict, bytes]:
         import http.client
         import ssl as _ssl
@@ -413,10 +418,10 @@ class PooledHTTP:
             pool = self._tl.conns = {}
         path = u.path + (f"?{u.query}" if u.query else "")
         last: Exception | None = None
-        # stale-socket retry only for idempotent methods: a POST may have
-        # been fully processed before the kept-alive socket died, and a
-        # blind re-send would duplicate its side effect
-        attempts = (0, 1) if method in ("GET", "HEAD") else (0,)
+        # stale-socket retry only when a re-send cannot duplicate a side
+        # effect: GET/HEAD always; writes only when the caller declares
+        # them idempotent (fid-addressed chunk uploads are)
+        attempts = (0, 1) if method in ("GET", "HEAD") or idempotent else (0,)
         for attempt in attempts:
             conn = pool.get(key)
             if conn is None:

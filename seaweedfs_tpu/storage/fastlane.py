@@ -33,7 +33,7 @@ def _bind(lib) -> bool:
         lib.sw_fl_start.restype = ctypes.c_int
         lib.sw_fl_start.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ]
         lib.sw_fl_volume_serving.restype = ctypes.c_int
         lib.sw_fl_volume_serving.argtypes = [ctypes.c_int, ctypes.c_uint32]
@@ -156,7 +156,8 @@ class Fastlane:
     @staticmethod
     def start(host: str, port: int, backend_port: int, workers: int = 0,
               secure_reads: bool = False, secure_writes: bool = False,
-              backend_host: str = "") -> "Fastlane | None":
+              backend_host: str = "",
+              max_backend: int = 0) -> "Fastlane | None":
         lib = _get_lib()
         if lib is None:
             return None
@@ -166,7 +167,7 @@ class Fastlane:
                                 (backend_host or host).encode(), backend_port,
                                 workers,
                                 1 if secure_reads else 0,
-                                1 if secure_writes else 0))
+                                1 if secure_writes else 0, max_backend))
         if h < 0:
             return None
         return Fastlane(lib, h)

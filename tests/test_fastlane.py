@@ -259,3 +259,55 @@ class TestFastlaneActive:
         assert w["ok"] == n and w["errors"] == 0, w
         r = lib.loadgen("127.0.0.1", port, 4, "GET", paths)
         assert r["ok"] == n and r["errors"] == 0, r
+
+
+class TestFilerFront:
+    """The filer's engine front is a concurrency governor: client bursts
+    multiplex onto few Python threads, and long-poll meta subscriptions
+    bypass the cap so they cannot starve regular traffic."""
+
+    def test_longpolls_do_not_starve_data_path(self, tmp_path):
+        import threading
+        import time as _time
+
+        from seaweedfs_tpu.server.filer import FilerServer
+        from seaweedfs_tpu.server.httpd import http_request
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume import VolumeServer
+
+        m = MasterServer(port=0, pulse_seconds=1)
+        m.start()
+        v = VolumeServer([str(tmp_path / "v")], m.url, port=0,
+                         pulse_seconds=1)
+        v.start()
+        f = FilerServer(m.url, port=0)
+        f.start()
+        try:
+            if f.fastlane is None:
+                pytest.skip("fastlane unavailable")
+            cursor = _time.time_ns()
+            pollers = [
+                threading.Thread(
+                    target=http_request,
+                    args=("GET",
+                          f"{f.url}/__meta__/events?since_ns={cursor}"
+                          f"&wait=8"),
+                    kwargs={"timeout": 30}, daemon=True,
+                )
+                for _ in range(4)  # > max_backend=2: would starve if counted
+            ]
+            for t in pollers:
+                t.start()
+            _time.sleep(0.3)  # let the long-polls park
+            t0 = _time.time()
+            st, _, _ = http_request("PUT", f"{f.url}/starve/x.txt",
+                                    b"payload", timeout=5)
+            assert st in (200, 201)
+            st, _, data = http_request("GET", f"{f.url}/starve/x.txt",
+                                       timeout=5)
+            assert st == 200 and data == b"payload"
+            assert _time.time() - t0 < 4, "data path starved by long-polls"
+        finally:
+            f.stop()
+            v.stop()
+            m.stop()
