@@ -200,6 +200,7 @@ struct BackendConn {
     int kind = 1;
     int fd = -1;
     bool counted = false;     // holds a slot under the backend cap
+    bool head_request = false;  // HEAD: response framing carries no body
     Conn* client = nullptr;   // null if the client went away mid-flight
     std::string req;          // original request bytes (kept for one retry)
     size_t req_off = 0;       // send progress
@@ -795,6 +796,7 @@ void proxy_request(Engine* E, Worker* w, Conn* c, const char* req, size_t len,
     b->req.assign(req, len);
     b->started = time(nullptr);
     b->counted = !bypass_cap;
+    b->head_request = len >= 5 && memcmp(req, "HEAD ", 5) == 0;
     c->upstream = b;  // halts further request processing on this client
     if (b->counted && w->capped_inflight >= E->max_backend) {
         w->waiting.push_back(b);  // dispatched as in-flight requests finish
@@ -879,7 +881,11 @@ bool backend_parse(BackendConn* b) {
         std::string te = find_header(hb, hend, "transfer-encoding");
         std::string ch = find_header(hb, hend, "connection");
         b->backend_close = strcasecmp(ch.c_str(), "close") == 0;
-        if (!cl.empty()) {
+        if (b->head_request) {
+            // HEAD responses advertise the entity size but ship no body
+            b->body_mode = 1;
+            b->body_need = b->hdr_end;
+        } else if (!cl.empty()) {
             b->body_mode = 1;
             b->body_need = b->hdr_end + strtoull(cl.c_str(), nullptr, 10);
         } else if (strcasecmp(te.c_str(), "chunked") == 0) {
@@ -1177,6 +1183,7 @@ int dechunk_request(Conn* c, size_t hdr_len) {
     for (;;) {
         size_t le = c->in.find("\r\n", pos);
         if (le == std::string::npos) { c->chunk_scan = pos; return 0; }
+        if (!isxdigit((unsigned char)c->in[pos])) return -1;  // malformed
         size_t chunk = strtoull(c->in.c_str() + pos, nullptr, 16);
         size_t data_at = le + 2;
         if (chunk == 0) {
@@ -1193,8 +1200,13 @@ int dechunk_request(Conn* c, size_t hdr_len) {
             while (line < head.size()) {
                 size_t eol = head.find("\r\n", line);
                 if (eol == std::string::npos) eol = head.size();
+                // drop TE and any client Content-Length: keeping the
+                // latter would leave two conflicting lengths in the
+                // rebuilt request (smuggling/desync vector)
                 if (strncasecmp(head.c_str() + line, "transfer-encoding:",
-                                18) != 0)
+                                18) != 0 &&
+                    strncasecmp(head.c_str() + line, "content-length:",
+                                15) != 0)
                     rebuilt.append(head, line, eol + 2 - line);
                 line = eol + 2;
             }
@@ -1332,6 +1344,27 @@ void* worker_main(void* arg) {
                     stuck.push_back(b);
             }
             for (auto* b : stuck) backend_complete(E, w, b, false, false, false);
+            // queued (capped) requests age out too: wedged in-flight
+            // requests must not hang queued clients without a response
+            std::vector<BackendConn*> stale_q;
+            for (auto* b : w->waiting)
+                if (b->client == nullptr || now - b->started > 600)
+                    stale_q.push_back(b);
+            for (auto* b : stale_q) {
+                for (size_t i = 0; i < w->waiting.size(); i++)
+                    if (w->waiting[i] == b) {
+                        w->waiting.erase(w->waiting.begin() + i);
+                        break;
+                    }
+                if (b->client) {
+                    b->client->upstream = nullptr;
+                    json_response(b->client, 504, "Gateway Timeout",
+                                  "{\"error\": \"backend queue timeout\"}");
+                    b->client->want_close = true;
+                    flush_out(w, b->client);
+                }
+                w->back_graveyard.push_back(b);
+            }
             for (auto* b : w->back_graveyard) delete b;
             w->back_graveyard.clear();
         }

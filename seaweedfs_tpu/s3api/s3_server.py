@@ -91,8 +91,19 @@ class S3Server:
         self._iam_subscriber = None
         self._routes()
 
+    def _start_fastlane(self) -> None:
+        """Same engine front as the filer: a concurrency governor
+        multiplexing client connections onto a capped backend."""
+        from seaweedfs_tpu.storage import fastlane as fl_mod
+
+        self.fastlane = fl_mod.front_service(
+            self.service,
+            guard_active=getattr(self.service, "guard", None) is not None,
+            workers=1, max_backend=2,
+        )
+
     def start(self) -> None:
-        self.service.start()
+        self._start_fastlane()
         try:
             self.fc.mkdir(BUCKETS_DIR)
         except IOError:
@@ -118,10 +129,15 @@ class S3Server:
             self._sweep_stop.set()
         if self._iam_subscriber is not None:
             self._iam_subscriber.stop()
+        if getattr(self, "fastlane", None) is not None:
+            self.fastlane.stop()
+            self.fastlane = None
         self.service.stop()
 
     @property
     def url(self) -> str:
+        if getattr(self, "fastlane", None) is not None:
+            return f"http://{self.service.host}:{self.fastlane.port}"
         return self.service.url
 
     # --- IAM config hot reload (`auth_credentials_subscribe.go`) ---------------

@@ -127,38 +127,18 @@ class FilerServer:
         self._routes()
 
     def _start_fastlane(self) -> None:
-        """Front the filer with the native engine as a pure concurrency
-        governor: it parses HTTP and multiplexes any number of client
-        connections onto a few keep-alive backend connections, so a burst
-        of clients doesn't fan into a GIL thread convoy (the filer serves
-        everything in Python — there is no native handler here)."""
-        from seaweedfs_tpu.security import tls as _tlsmod
+        """Front the filer with the engine as a concurrency governor: any
+        number of client connections multiplex onto max_backend=2 Python
+        handlers (one running + one parked in internal I/O — measured 4-5x
+        over uncapped at 16 connections on the GIL), and long-poll meta
+        subscriptions bypass the cap. All handling stays in Python."""
         from seaweedfs_tpu.storage import fastlane as fl_mod
 
-        self.fastlane = None
-        requested = self.service.port
-        if (
-            not fl_mod.available()
-            or getattr(self.service, "guard", None) is not None
-            or _tlsmod.server_context() is not None
-        ):
-            self.service.start()
-            return
-        self.service.port = 0
-        self.service.start()
-        # max_backend=2: the backend is GIL-bound, so two Python handlers
-        # (one running + one parked in internal I/O) beat a thread convoy —
-        # measured 4-5x over uncapped at 16 client connections
-        # workers=1: the cap is per engine worker; one worker keeps the
-        # measured cap semantics exact (the backend is one GIL anyway)
-        self.fastlane = fl_mod.Fastlane.start(
-            self.service.host, requested, self.service.port, workers=1,
-            max_backend=2,
+        self.fastlane = fl_mod.front_service(
+            self.service,
+            guard_active=getattr(self.service, "guard", None) is not None,
+            workers=1, max_backend=2,
         )
-        if self.fastlane is None:
-            self.service.stop()
-            self.service.port = requested
-            self.service.start()
 
     def start(self) -> None:
         import threading

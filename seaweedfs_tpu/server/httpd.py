@@ -460,8 +460,11 @@ class PooledHTTP:
     def close(self) -> None:
         """Close every connection this pool ever opened, across threads
         (worker threads exit without closing their thread-locals)."""
+        import weakref
+
         with self._all_mu:
-            conns, self._all = self._all, set()
+            conns = list(self._all)
+            self._all = weakref.WeakSet()
         for conn in conns:
             try:
                 conn.close()

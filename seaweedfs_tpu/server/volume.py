@@ -72,30 +72,14 @@ class VolumeServer:
     def _start_fastlane(self) -> None:
         """Put the native epoll engine (storage/fastlane.py) in front of the
         Python service: it serves data-plane GET/POST/PUT/DELETE across all
-        cores and proxies everything else here. Python keeps the requested
-        port's role by moving to an ephemeral backend port."""
-        from seaweedfs_tpu.security import tls as _tlsmod
+        cores and proxies everything else here."""
         from seaweedfs_tpu.storage import fastlane as fl_mod
 
-        requested = self.service.port  # 0 = ephemeral, fine either way
-        if (
-            not fl_mod.available()
-            or self.security.white_list      # Guard checks stay in Python
-            or _tlsmod.server_context() is not None  # engine is plain TCP
-        ):
-            self.service.start()
-            return
-        self.service.port = 0
-        self.service.start()
         secure = bool(self.security.write_key or self.security.read_key)
-        self.fastlane = fl_mod.Fastlane.start(
-            self._host, requested, self.service.port,
+        self.fastlane = fl_mod.front_service(
+            self.service, guard_active=bool(self.security.white_list),
             secure_reads=secure, secure_writes=secure,
         )
-        if self.fastlane is None:  # bind failure: plain Python on requested
-            self.service.stop()
-            self.service.port = requested
-            self.service.start()
 
     @property
     def data_port(self) -> int:

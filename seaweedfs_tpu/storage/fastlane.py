@@ -299,3 +299,35 @@ class Fastlane:
             "proxied": int(out[4]),
             "native_assigns": int(out[5]),
         }
+
+
+def front_service(service, guard_active: bool = False, workers: int = 0,
+                  max_backend: int = 0, secure_reads: bool = False,
+                  secure_writes: bool = False) -> "Fastlane | None":
+    """Start `service` (an HTTPService) behind an engine front when the
+    environment allows, else plainly on its requested port. Shared by the
+    volume, filer, and S3 servers — one copy of the gate checks and the
+    ephemeral-backend/bind-fallback dance. Returns the engine or None;
+    the service is started either way."""
+    from seaweedfs_tpu.security import tls as _tlsmod
+
+    requested = service.port
+    if (
+        not available()
+        or guard_active
+        or _tlsmod.server_context() is not None  # engine is plain TCP
+    ):
+        service.start()
+        return None
+    service.port = 0
+    service.start()
+    engine = Fastlane.start(
+        service.host, requested, service.port, workers=workers,
+        secure_reads=secure_reads, secure_writes=secure_writes,
+        max_backend=max_backend,
+    )
+    if engine is None:  # bind failure: plain Python on the requested port
+        service.stop()
+        service.port = requested
+        service.start()
+    return engine
