@@ -49,6 +49,9 @@
 #include <vector>
 
 extern "C" uint32_t sw_crc32c_update(uint32_t crc, const char* data, size_t len);
+extern "C" void sw_hmac_sha256(const uint8_t* key, size_t key_len,
+                               const uint8_t* data, size_t len,
+                               uint8_t out[32]);
 
 namespace {
 
@@ -248,6 +251,7 @@ struct Engine {
     size_t max_backend = 16;
     bool secure_writes = false;     // JWT configured -> proxy writes
     bool secure_reads = false;
+    std::string jwt_write_key;      // non-empty: verify HS256 write JWTs natively
     std::atomic<bool> running{true};
     std::deque<Worker> workers;  // deque: Worker holds mutexes, never moves
     pthread_t accept_thread;
@@ -963,6 +967,95 @@ void on_backend_event(Engine* E, Worker* w, BackendConn* b, uint32_t events) {
 }
 
 // ---------------------------------------------------------------------------
+// HS256 write-JWT verification (`weed/security/jwt.go`; Python mirror
+// security/jwt.py). The engine only accepts tokens it can fully verify;
+// anything else proxies to Python, which produces the exact 401 bodies.
+// ---------------------------------------------------------------------------
+
+int b64url_decode(const char* in, size_t n, uint8_t* out, size_t cap) {
+    struct Table {
+        int8_t t[256];
+        Table() {
+            memset(t, -1, sizeof t);
+            const char* az = "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+                             "abcdefghijklmnopqrstuvwxyz0123456789-_";
+            for (int i = 0; i < 64; i++) t[(uint8_t)az[i]] = (int8_t)i;
+        }
+    };
+    static const Table tbl;  // C++11 magic static: thread-safe init
+    const int8_t* T = tbl.t;
+    uint32_t acc = 0;
+    int bits = 0;
+    size_t o = 0;
+    for (size_t i = 0; i < n; i++) {
+        int8_t v = T[(uint8_t)in[i]];
+        if (v < 0) return -1;
+        acc = (acc << 6) | (uint32_t)v;
+        bits += 6;
+        if (bits >= 8) {
+            bits -= 8;
+            if (o >= cap) return -1;
+            out[o++] = (uint8_t)(acc >> bits);
+        }
+    }
+    return (int)o;
+}
+
+// verify "BEARER <jwt>" against the write key and the request's base fid
+// ("<vid>,<hexkey+cookie>" with any _delta stripped). Wildcard fid claims
+// ("") are accepted, as the filer's tokens use them.
+bool jwt_write_ok(Engine* E, const std::string& auth, const char* fid_path,
+                  size_t fid_len) {
+    if (E->jwt_write_key.empty()) return true;
+    if (strncasecmp(auth.c_str(), "BEARER ", 7) != 0) return false;
+    const char* tok = auth.c_str() + 7;
+    const char* dot1 = strchr(tok, '.');
+    if (!dot1) return false;
+    const char* dot2 = strchr(dot1 + 1, '.');
+    if (!dot2) return false;
+    // signature check first (constant-time-ish compare)
+    uint8_t want[32], got[40];
+    sw_hmac_sha256((const uint8_t*)E->jwt_write_key.data(),
+                   E->jwt_write_key.size(), (const uint8_t*)tok,
+                   (size_t)(dot2 - tok), want);
+    int got_n = b64url_decode(dot2 + 1, strlen(dot2 + 1), got, sizeof got);
+    if (got_n != 32) return false;
+    uint8_t diff = 0;
+    for (int i = 0; i < 32; i++) diff |= want[i] ^ got[i];
+    if (diff) return false;
+    // claims: {"fid":"...","exp":N} (our own compact encoder)
+    uint8_t payload[512];
+    int pn = b64url_decode(dot1 + 1, (size_t)(dot2 - dot1 - 1), payload,
+                           sizeof payload - 1);
+    if (pn < 0) return false;
+    payload[pn] = 0;
+    const char* ps = (const char*)payload;
+    const char* fp = strstr(ps, "\"fid\":");
+    if (!fp) return false;
+    fp += 6;
+    while (*fp == ' ') fp++;
+    if (*fp != '"') return false;
+    fp++;
+    const char* fe = strchr(fp, '"');
+    if (!fe) return false;
+    size_t claim_len = (size_t)(fe - fp);
+    if (claim_len != 0) {  // empty claim = wildcard token
+        // base fid: strip any _delta suffix from the request's fid part
+        size_t base_len = fid_len;
+        for (size_t i = 0; i < fid_len; i++)
+            if (fid_path[i] == '_' || fid_path[i] == '.') { base_len = i; break; }
+        if (claim_len != base_len || memcmp(fp, fid_path, base_len) != 0)
+            return false;
+    }
+    const char* ep = strstr(ps, "\"exp\":");
+    if (ep) {
+        long long exp = atoll(ep + 6);
+        if (exp > 0 && (long long)time(nullptr) > exp) return false;
+    }
+    return true;
+}
+
+// ---------------------------------------------------------------------------
 // native /dir/assign (master fastlane)
 // ---------------------------------------------------------------------------
 
@@ -1091,7 +1184,11 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
                 std::shared_lock<std::shared_mutex> l(v->map_mu);
                 exists = v->nmap.get(key, &off_, &size_) && size_ > 0;
             }
-            if (v && !has_query && !multipart && !jpg && !exists &&
+            bool jwt_ok = true;
+            if (!E->jwt_write_key.empty())
+                jwt_ok = jwt_write_ok(E, find_header(req, he, "authorization"),
+                                      path + 1, (size_t)(fid_end - path - 1));
+            if (v && !has_query && !multipart && !jpg && !exists && jwt_ok &&
                 !E->secure_writes && !v->readonly.load() &&
                 !v->forward_writes.load()) {
                 std::string mime = ctype;
@@ -1107,8 +1204,12 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
             return;
         }
         if (method == "DELETE") {
-            if (v && !has_query && !E->secure_writes && !v->readonly.load() &&
-                !v->forward_writes.load()) {
+            bool jwt_ok = true;
+            if (!E->jwt_write_key.empty())
+                jwt_ok = jwt_write_ok(E, find_header(req, he, "authorization"),
+                                      path + 1, (size_t)(fid_end - path - 1));
+            if (v && !has_query && jwt_ok && !E->secure_writes &&
+                !v->readonly.load() && !v->forward_writes.load()) {
                 if (handle_delete(E, c, v, key, cookie)) return;
             }
             proxy_request(E, w, c, req, req_len, bypass_cap);
@@ -1431,7 +1532,8 @@ extern "C" {
 // returns an engine handle (>=0); the bound port comes from sw_fl_port()
 int sw_fl_start(const char* host, int port, const char* backend_host,
                 int backend_port, int workers, int secure_reads,
-                int secure_writes, int max_backend) {
+                int secure_writes, int max_backend,
+                const char* jwt_write_key) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return -2;
     int one = 1;
@@ -1461,6 +1563,9 @@ int sw_fl_start(const char* host, int port, const char* backend_host,
     E->secure_reads = secure_reads != 0;
     E->secure_writes = secure_writes != 0;
     if (max_backend > 0) E->max_backend = (size_t)max_backend;
+    // fixed before any worker/accept thread exists: workers read it
+    // lock-free on the request path
+    if (jwt_write_key && *jwt_write_key) E->jwt_write_key = jwt_write_key;
     if (workers < 1) workers = 2;
     if (workers > 32) workers = 32;
     E->workers.resize(workers);

@@ -80,7 +80,9 @@ def verify_file_jwt(key: bytes | str, token: str, fid: str) -> bool:
     (an empty fid claim is a wildcard token, as in the reference's filer JWT)."""
     try:
         claims = decode_jwt(key, token)
-    except JwtError:
+    except Exception:
+        # malformed base64/JSON from a hostile token must read as
+        # unauthorized, not a 500
         return False
     claimed = claims.get("fid", "")
     return claimed == "" or claimed == fid

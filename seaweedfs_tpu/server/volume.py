@@ -75,10 +75,13 @@ class VolumeServer:
         cores and proxies everything else here."""
         from seaweedfs_tpu.storage import fastlane as fl_mod
 
-        secure = bool(self.security.write_key or self.security.read_key)
+        # the write key rides into sw_fl_start so it is in place before
+        # the engine accepts its first connection: writes stay native when
+        # the token verifies; invalid/missing tokens proxy to Python for
+        # the exact 401 (reads carry no JWT check in the Python path)
         self.fastlane = fl_mod.front_service(
             self.service, guard_active=bool(self.security.white_list),
-            secure_reads=secure, secure_writes=secure,
+            jwt_write_key=self.security.write_key or "",
         )
 
     @property

@@ -34,6 +34,7 @@ def _bind(lib) -> bool:
         lib.sw_fl_start.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p,
         ]
         lib.sw_fl_volume_serving.restype = ctypes.c_int
         lib.sw_fl_volume_serving.argtypes = [ctypes.c_int, ctypes.c_uint32]
@@ -156,8 +157,8 @@ class Fastlane:
     @staticmethod
     def start(host: str, port: int, backend_port: int, workers: int = 0,
               secure_reads: bool = False, secure_writes: bool = False,
-              backend_host: str = "",
-              max_backend: int = 0) -> "Fastlane | None":
+              backend_host: str = "", max_backend: int = 0,
+              jwt_write_key: str = "") -> "Fastlane | None":
         lib = _get_lib()
         if lib is None:
             return None
@@ -167,7 +168,8 @@ class Fastlane:
                                 (backend_host or host).encode(), backend_port,
                                 workers,
                                 1 if secure_reads else 0,
-                                1 if secure_writes else 0, max_backend))
+                                1 if secure_writes else 0, max_backend,
+                                jwt_write_key.encode()))
         if h < 0:
             return None
         return Fastlane(lib, h)
@@ -303,7 +305,8 @@ class Fastlane:
 
 def front_service(service, guard_active: bool = False, workers: int = 0,
                   max_backend: int = 0, secure_reads: bool = False,
-                  secure_writes: bool = False) -> "Fastlane | None":
+                  secure_writes: bool = False,
+                  jwt_write_key: str = "") -> "Fastlane | None":
     """Start `service` (an HTTPService) behind an engine front when the
     environment allows, else plainly on its requested port. Shared by the
     volume, filer, and S3 servers — one copy of the gate checks and the
@@ -324,7 +327,7 @@ def front_service(service, guard_active: bool = False, workers: int = 0,
     engine = Fastlane.start(
         service.host, requested, service.port, workers=workers,
         secure_reads=secure_reads, secure_writes=secure_writes,
-        max_backend=max_backend,
+        max_backend=max_backend, jwt_write_key=jwt_write_key,
     )
     if engine is None:  # bind failure: plain Python on the requested port
         service.stop()

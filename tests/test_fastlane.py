@@ -178,10 +178,12 @@ class TestFastlaneActive:
         stats = vs.fastlane.stats()
         assert stats["native_writes"] >= n_threads * per * 0.7
 
-    def test_jwt_security_forces_python_path(self, tmp_path):
-        """With JWT signing configured the engine must not serve
-        unauthenticated writes natively — Python enforces the token."""
+    def test_jwt_verified_natively(self, tmp_path):
+        """With JWT signing configured: a valid master-signed token keeps
+        the native write path (engine verifies HS256 itself); missing,
+        forged, cross-fid, and expired tokens all fall to Python's 401."""
         from seaweedfs_tpu.security import SecurityConfig
+        from seaweedfs_tpu.security.jwt import gen_write_jwt
 
         sec = SecurityConfig(write_key="sekrit")
         master = MasterServer(port=0, pulse_seconds=1, security=sec)
@@ -194,12 +196,61 @@ class TestFastlaneActive:
             u = f"http://{a['publicUrl']}/{a['fid']}"
             st, _, _ = http_request("POST", u, b"no-token")
             assert st == 401
+            before = vs.fastlane.stats()["native_writes"] if vs.fastlane else 0
             headers = {"Authorization": f"BEARER {a['auth']}"}
             st, _, _ = http_request("POST", u, b"with-token", headers)
             assert st == 201
+            if vs.fastlane is not None:
+                assert vs.fastlane.stats()["native_writes"] == before + 1, \
+                    "valid token should keep the native path"
+            # forged signature -> 401 via Python
+            bad = a["auth"][:-4] + ("AAAA" if a["auth"][-4:] != "AAAA"
+                                    else "BBBB")
+            st, _, _ = http_request(
+                "POST", u, b"x", {"Authorization": f"BEARER {bad}"})
+            assert st == 401
+            # token for a DIFFERENT fid -> 401
+            other = gen_write_jwt("sekrit", "999,deadbeef01")
+            st, _, _ = http_request(
+                "POST", u, b"x", {"Authorization": f"BEARER {other}"})
+            assert st == 401
+            # expired token -> 401
+            expired = gen_write_jwt("sekrit", a["fid"], expires_sec=-5)
+            st, _, _ = http_request(
+                "POST", u, b"x", {"Authorization": f"BEARER {expired}"})
+            assert st == 401
+            # delete with a valid token, natively
+            st, _, _ = http_request("DELETE", u, headers=headers)
+            assert st == 202
         finally:
             vs.stop()
             master.stop()
+
+    def test_native_hmac_matches_python(self):
+        """The engine's HMAC-SHA256 must agree with hashlib bit for bit."""
+        import ctypes
+        import hashlib
+        import hmac as pyhmac
+
+        from seaweedfs_tpu.native import lib
+
+        if lib is None:
+            pytest.skip("native unavailable")
+        raw = lib._lib
+        raw.sw_hmac_sha256.restype = None
+        raw.sw_hmac_sha256.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        for key, msg in [
+            (b"k", b"message"),
+            (b"x" * 100, b"y" * 1000),  # key > block size: pre-hashed
+            (b"", b""),
+            (b"sekrit", b"header.payload"),
+        ]:
+            out = ctypes.create_string_buffer(32)
+            raw.sw_hmac_sha256(key, len(key), msg, len(msg), out)
+            assert out.raw == pyhmac.new(key, msg, hashlib.sha256).digest()
 
     def test_native_assign_profiles(self, cluster):
         """The master engine mints fids from installed profiles; they must
