@@ -1,0 +1,253 @@
+// Standalone sanitizer harness for the fastlane engine — the native-code
+// arm of the suite's race-detection strategy (tests/test_fastlane_tsan.py
+// builds this with -fsanitize=thread / address and runs it).
+//
+// It stands up a real engine (plus a trivial in-process backend server),
+// registers a volume on scratch files, then hammers it from concurrent
+// client threads with interleaved native writes/reads/deletes, proxied
+// requests, Python-side-style lock/tail/map calls, drains, and
+// register/unregister churn — the exact cross-thread surfaces the Python
+// suite exercises through servers, minus Python.
+//
+// Build: g++ -std=c++17 -fsanitize=thread -DSW_FASTLANE_SANITY_MAIN \
+//        fastlane_sanity.cpp fastlane.cpp crc32c.cpp sha256.cpp ... -o t
+#ifdef SW_FASTLANE_SANITY_MAIN
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int sw_fl_start(const char* host, int port, const char* backend_host,
+                int backend_port, int workers, int secure_reads,
+                int secure_writes, int max_backend,
+                const char* jwt_write_key);
+int sw_fl_port(int h);
+void sw_fl_stop(int h);
+int sw_fl_register_volume(int h, uint32_t vid, int dat_fd, int idx_fd,
+                          int version, unsigned long long tail,
+                          unsigned long long last_append_ns, int readonly,
+                          int forward_writes);
+int sw_fl_volume_serving(int h, uint32_t vid);
+int sw_fl_unregister_volume(int h, uint32_t vid);
+int sw_fl_set_flags(int h, uint32_t vid, int readonly, int forward_writes);
+int sw_fl_volume_lock(int h, uint32_t vid);
+int sw_fl_volume_unlock(int h, uint32_t vid);
+unsigned long long sw_fl_tail_get(int h, uint32_t vid);
+int sw_fl_tail_set(int h, uint32_t vid, unsigned long long tail,
+                   unsigned long long last_ns);
+int sw_fl_map_put(int h, uint32_t vid, uint64_t key,
+                  unsigned long long offset, int32_t size);
+long sw_fl_drain_events(int h, uint8_t* out, size_t max_events);
+void sw_fl_get_stats(int h, unsigned long long* out6);
+}
+
+namespace {
+
+// minimal backend: accepts, answers every request with a tiny 200
+void backend_loop(int listen_fd, std::atomic<bool>* running) {
+    while (running->load()) {
+        int fd = accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) continue;
+        std::thread([fd, running] {
+            char buf[8192];
+            std::string in;
+            while (running->load()) {
+                ssize_t n = recv(fd, buf, sizeof buf, 0);
+                if (n <= 0) break;
+                in.append(buf, n);
+                size_t he;
+                while ((he = in.find("\r\n\r\n")) != std::string::npos) {
+                    size_t cl = 0;
+                    const char* f = strcasestr(in.c_str(), "content-length:");
+                    if (f && f < in.c_str() + he)
+                        cl = strtoull(f + 15, nullptr, 10);
+                    if (in.size() < he + 4 + cl) break;
+                    in.erase(0, he + 4 + cl);
+                    const char* resp =
+                        "HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok";
+                    if (send(fd, resp, strlen(resp), MSG_NOSIGNAL) <= 0)
+                        break;
+                }
+            }
+            close(fd);
+        }).detach();
+    }
+}
+
+int tcp_listen(int* port_out) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in sa;
+    memset(&sa, 0, sizeof sa);
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    bind(fd, (struct sockaddr*)&sa, sizeof sa);
+    listen(fd, 64);
+    socklen_t sl = sizeof sa;
+    getsockname(fd, (struct sockaddr*)&sa, &sl);
+    *port_out = ntohs(sa.sin_port);
+    return fd;
+}
+
+int dial(int port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in sa;
+    memset(&sa, 0, sizeof sa);
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(port);
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    if (connect(fd, (struct sockaddr*)&sa, sizeof sa) != 0) {
+        close(fd);
+        return -1;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    return fd;
+}
+
+// one keep-alive request; returns the status code or -1
+int do_req(int fd, const std::string& req) {
+    if (send(fd, req.data(), req.size(), MSG_NOSIGNAL) <= 0) return -1;
+    std::string resp;
+    char buf[8192];
+    for (;;) {
+        ssize_t n = recv(fd, buf, sizeof buf, 0);
+        if (n <= 0) return -1;
+        resp.append(buf, n);
+        size_t he = resp.find("\r\n\r\n");
+        if (he == std::string::npos) continue;
+        size_t cl = 0;
+        const char* f = strcasestr(resp.c_str(), "content-length:");
+        if (f && f < resp.c_str() + he) cl = strtoull(f + 15, nullptr, 10);
+        if (resp.size() >= he + 4 + cl)
+            return atoi(resp.c_str() + 9);
+    }
+}
+
+}  // namespace
+
+int main() {
+    std::atomic<bool> running{true};
+    int backend_port = 0;
+    int backend_fd = tcp_listen(&backend_port);
+    std::thread bt(backend_loop, backend_fd, &running);
+
+    int h = sw_fl_start("127.0.0.1", 0, "127.0.0.1", backend_port, 4, 0, 0,
+                        8, "");
+    if (h < 0) { fprintf(stderr, "engine start failed\n"); return 1; }
+    int port = sw_fl_port(h);
+
+    char dat_path[] = "/tmp/fl_sanity_dat_XXXXXX";
+    char idx_path[] = "/tmp/fl_sanity_idx_XXXXXX";
+    int dat_fd = mkstemp(dat_path);
+    int idx_fd = mkstemp(idx_path);
+    // superblock filler so offsets are nonzero like a real volume
+    uint8_t super[8] = {0};
+    (void)!write(dat_fd, super, 8);
+    fcntl(idx_fd, F_SETFL, O_APPEND);
+    sw_fl_register_volume(h, 7, dup(dat_fd), dup(idx_fd), 3, 8, 0, 0, 0);
+    sw_fl_volume_serving(h, 7);
+
+    const int THREADS = 6, OPS = 400;
+    std::atomic<uint64_t> next_key{1};
+    std::atomic<int> errors{0};
+    std::vector<std::thread> ts;
+    for (int t = 0; t < THREADS; t++) {
+        ts.emplace_back([&, t] {
+            int fd = dial(port);
+            if (fd < 0) { errors++; return; }
+            char req[512];
+            for (int i = 0; i < OPS; i++) {
+                uint64_t key = next_key.fetch_add(1);
+                int n = snprintf(req, sizeof req,
+                                 "POST /7,%llxdeadbeef HTTP/1.1\r\nHost: x\r\n"
+                                 "Content-Length: 64\r\n\r\n",
+                                 (unsigned long long)key);
+                std::string r(req, n);
+                r.append(64, (char)('a' + t));
+                int st = do_req(fd, r);
+                if (st != 201 && st != 200) { errors++; break; }
+                n = snprintf(req, sizeof req,
+                             "GET /7,%llxdeadbeef HTTP/1.1\r\nHost: x\r\n\r\n",
+                             (unsigned long long)key);
+                st = do_req(fd, std::string(req, n));
+                if (st != 200) { errors++; break; }
+                if (i % 7 == 0) {  // proxied path
+                    st = do_req(fd, "GET /status HTTP/1.1\r\nHost: x\r\n\r\n");
+                    if (st != 200) { errors++; break; }
+                }
+                if (i % 5 == 0) {
+                    n = snprintf(req, sizeof req,
+                                 "DELETE /7,%llxdeadbeef HTTP/1.1\r\n"
+                                 "Host: x\r\n\r\n",
+                                 (unsigned long long)key);
+                    if (do_req(fd, std::string(req, n)) != 202) {
+                        errors++;
+                        break;
+                    }
+                }
+            }
+            close(fd);
+        });
+    }
+    // Python-side style interleaving: drains, flag flips, lock/tail hooks
+    std::thread admin([&] {
+        uint8_t evbuf[40 * 256];
+        for (int i = 0; i < 300; i++) {
+            sw_fl_drain_events(h, evbuf, 256);
+            sw_fl_set_flags(h, 7, 0, 0);
+            sw_fl_volume_lock(h, 7);
+            unsigned long long tail = sw_fl_tail_get(h, 7);
+            sw_fl_tail_set(h, 7, tail, 0);
+            sw_fl_volume_unlock(h, 7);
+            sw_fl_map_put(h, 7, 1000000 + i, 8, 0);  // hole/put churn
+            usleep(1000);
+        }
+    });
+    for (auto& th : ts) th.join();
+    admin.join();
+
+    unsigned long long stats[6];
+    sw_fl_get_stats(h, stats);
+    fprintf(stderr,
+            "requests=%llu native_writes=%llu native_reads=%llu "
+            "deletes=%llu proxied=%llu errors=%d\n",
+            stats[0], stats[2], stats[1], stats[3], stats[4], errors.load());
+
+    // register/unregister churn against live traffic already stopped;
+    // exercise the lifecycle surface once more
+    sw_fl_unregister_volume(h, 7);
+    sw_fl_register_volume(h, 7, dup(dat_fd), dup(idx_fd), 3,
+                          sw_fl_tail_get(h, 7), 0, 0, 0);
+    sw_fl_volume_serving(h, 7);
+    sw_fl_unregister_volume(h, 7);
+
+    sw_fl_stop(h);
+    running.store(false);
+    shutdown(backend_fd, SHUT_RDWR);
+    close(backend_fd);
+    bt.join();
+    close(dat_fd);
+    close(idx_fd);
+    unlink(dat_path);
+    unlink(idx_path);
+
+    if (errors.load() != 0) return 2;
+    if (stats[2] < (unsigned long long)(THREADS * OPS * 0.9)) return 3;
+    fprintf(stderr, "fastlane sanity OK\n");
+    return 0;
+}
+
+#endif  // SW_FASTLANE_SANITY_MAIN

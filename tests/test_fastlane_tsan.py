@@ -1,0 +1,51 @@
+"""Sanitizer builds of the fastlane engine — the native-code arm of the
+race-detection strategy (SURVEY §5; the reference leans on Go's -race).
+
+Builds `native/src/fastlane_sanity.cpp` (a standalone harness that stands
+up a real engine + backend and hammers it from concurrent threads) with
+ThreadSanitizer and AddressSanitizer and requires a clean exit: any data
+race, use-after-free, or leak in the engine fails the build's run.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+SRC = os.path.join(os.path.dirname(__file__), "..", "seaweedfs_tpu",
+                   "native", "src")
+FILES = ["fastlane_sanity.cpp", "fastlane.cpp", "crc32c.cpp", "sha256.cpp"]
+
+
+def _build_and_run(tmp_path, sanitizer: str) -> None:
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    out = str(tmp_path / f"fl_{sanitizer.replace(',', '_')}")
+    cmd = [
+        "g++", "-O1", "-g", "-std=c++17", f"-fsanitize={sanitizer}",
+        "-DSW_FASTLANE_SANITY_MAIN",
+        *[os.path.join(SRC, f) for f in FILES],
+        "-o", out, "-lpthread",
+    ]
+    build = subprocess.run(cmd, capture_output=True, timeout=300)
+    if build.returncode != 0:
+        pytest.skip(f"sanitizer build unavailable: "
+                    f"{build.stderr.decode()[:200]}")
+    env = dict(os.environ,
+               TSAN_OPTIONS="halt_on_error=1 exitcode=66",
+               ASAN_OPTIONS="detect_leaks=1 exitcode=66")
+    run = subprocess.run([out], capture_output=True, timeout=300, env=env)
+    tail = run.stderr.decode(errors="replace")[-3000:]
+    assert run.returncode == 0, f"{sanitizer} run rc={run.returncode}:\n{tail}"
+    assert "fastlane sanity OK" in tail
+
+
+class TestSanitizers:
+    def test_thread_sanitizer(self, tmp_path):
+        _build_and_run(tmp_path, "thread")
+
+    def test_address_sanitizer(self, tmp_path):
+        _build_and_run(tmp_path, "address,undefined")
