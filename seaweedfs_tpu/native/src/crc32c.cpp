@@ -15,20 +15,25 @@
 namespace {
 
 uint32_t tables[8][256];
-bool tables_ready = false;
 
+// C++11 magic static: thread-safe one-time build (the old plain-bool
+// guard was a data race when several engine workers hashed concurrently
+// on the table fallback path)
 void init_tables() {
-    if (tables_ready) return;
-    const uint32_t poly = 0x82F63B78u;
-    for (uint32_t i = 0; i < 256; i++) {
-        uint32_t c = i;
-        for (int k = 0; k < 8; k++) c = (c & 1) ? (c >> 1) ^ poly : c >> 1;
-        tables[0][i] = c;
-    }
-    for (int t = 1; t < 8; t++)
-        for (uint32_t i = 0; i < 256; i++)
-            tables[t][i] = tables[t - 1][i] >> 8 ^ tables[0][tables[t - 1][i] & 0xFF];
-    tables_ready = true;
+    static const bool built = [] {
+        const uint32_t poly = 0x82F63B78u;
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; k++) c = (c & 1) ? (c >> 1) ^ poly : c >> 1;
+            tables[0][i] = c;
+        }
+        for (int t = 1; t < 8; t++)
+            for (uint32_t i = 0; i < 256; i++)
+                tables[t][i] =
+                    tables[t - 1][i] >> 8 ^ tables[0][tables[t - 1][i] & 0xFF];
+        return true;
+    }();
+    (void)built;
 }
 
 } // namespace

@@ -138,8 +138,12 @@ int do_req(int fd, const std::string& req) {
 
 }  // namespace
 
+// process-lifetime flag: detached backend threads may outlive main()'s
+// frame, so this must not live on main's stack
+static std::atomic<bool> g_running{true};
+
 int main() {
-    std::atomic<bool> running{true};
+    std::atomic<bool>& running = g_running;
     int backend_port = 0;
     int backend_fd = tcp_listen(&backend_port);
     std::thread bt(backend_loop, backend_fd, &running);
@@ -212,7 +216,9 @@ int main() {
             unsigned long long tail = sw_fl_tail_get(h, 7);
             sw_fl_tail_set(h, 7, tail, 0);
             sw_fl_volume_unlock(h, 7);
-            sw_fl_map_put(h, 7, 1000000 + i, 8, 0);  // hole/put churn
+            // put + delete churn: both sides of the map_mu surface
+            sw_fl_map_put(h, 7, 1000000 + i, 4096 + 8 * i, 128);
+            sw_fl_map_put(h, 7, 1000000 + i, 0, 0);
             usleep(1000);
         }
     });
@@ -228,9 +234,10 @@ int main() {
 
     // register/unregister churn against live traffic already stopped;
     // exercise the lifecycle surface once more
+    unsigned long long final_tail = sw_fl_tail_get(h, 7);
     sw_fl_unregister_volume(h, 7);
     sw_fl_register_volume(h, 7, dup(dat_fd), dup(idx_fd), 3,
-                          sw_fl_tail_get(h, 7), 0, 0, 0);
+                          final_tail, 0, 0, 0);
     sw_fl_volume_serving(h, 7);
     sw_fl_unregister_volume(h, 7);
 
