@@ -377,10 +377,14 @@ def bench_cdc_dedup(gib: int = 8) -> dict:
     dt = time.perf_counter() - t0
     window_rates.sort()
     best_quartile = window_rates[3 * len(window_rates) // 4]
+    # headline stays WALL-CLOCK (comparable with earlier rounds' numbers);
+    # the p75 window is a companion diagnostic only — the workload mixes
+    # cheap duplicate-heavy and expensive unique uploads, so a windowed
+    # max would select the easy uploads, not just quiet-host stretches
     return {
         "gib_streamed": round(total / 1024**3, 2),
-        "gbps": round(best_quartile / 1e9, 3),
-        "gbps_wall": round(total / dt / 1e9, 3),
+        "gbps": round(total / dt / 1e9, 3),
+        "gbps_p75_window": round(best_quartile / 1e9, 3),
         "chunks": n_chunks,
         "dedup_chunk_pct": round(100.0 * dup_chunks / max(1, n_chunks), 1),
         "dedup_byte_pct": round(100.0 * dup_bytes / max(1, total), 1),
@@ -508,11 +512,12 @@ def bench_hash_1m_4k(
         w = time.perf_counter() - t0
         total_dt += w
         best_dt_rate = max(best_dt_rate, per_window * 4096 / w)
-    out["native_batch_gbps"] = round(best_dt_rate / 1e9, 3)
-    out["native_batch_gbps_wall"] = round(
-        total_blobs * 4096 / total_dt / 1e9, 3
-    )
-    out["native_batch_mhashes_s"] = round(best_dt_rate / 4096 / 1e6, 3)
+    # headline stays WALL-CLOCK for comparability with earlier rounds;
+    # the best homogeneous window is the noise diagnostic
+    wall_rate = total_blobs * 4096 / total_dt
+    out["native_batch_gbps"] = round(wall_rate / 1e9, 3)
+    out["native_batch_gbps_best_window"] = round(best_dt_rate / 1e9, 3)
+    out["native_batch_mhashes_s"] = round(wall_rate / 4096 / 1e6, 3)
     out["seconds_for_1m"] = round(total_dt, 2)
 
     # device kernels, device-resident sample (chip-side rate; transfers are

@@ -362,3 +362,73 @@ class TestFilerFront:
             f.stop()
             v.stop()
             m.stop()
+
+    def test_chunked_request_body_through_front(self, tmp_path):
+        """Streaming clients (curl -T -) send chunked bodies with no
+        Content-Length; the front decodes them and rewrites the request
+        so both native handlers and the Python backend can frame it.
+        Conflicting client Content-Length headers must be dropped."""
+        import socket
+
+        from seaweedfs_tpu.server.filer import FilerServer
+        from seaweedfs_tpu.server.httpd import http_request
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume import VolumeServer
+
+        m = MasterServer(port=0, pulse_seconds=1)
+        m.start()
+        v = VolumeServer([str(tmp_path / "v")], m.url, port=0,
+                         pulse_seconds=1)
+        v.start()
+        f = FilerServer(m.url, port=0)
+        f.start()
+        try:
+            if f.fastlane is None:
+                pytest.skip("fastlane unavailable")
+            port = int(f.url.rsplit(":", 1)[1])
+
+            def raw(request: bytes) -> bytes:
+                s = socket.create_connection(("127.0.0.1", port), timeout=10)
+                s.sendall(request)
+                s.settimeout(10)
+                out = b""
+                while b"\r\n\r\n" not in out:
+                    piece = s.recv(4096)
+                    if not piece:  # server closed: fail, don't spin
+                        break
+                    out += piece
+                s.close()
+                return out
+
+            body = b"hello " * 200
+            chunks = b""
+            for off in range(0, len(body), 100):
+                piece = body[off:off + 100]
+                chunks += f"{len(piece):x}\r\n".encode() + piece + b"\r\n"
+            chunks += b"0\r\n\r\n"
+            resp = raw(b"PUT /chunked/a.bin HTTP/1.1\r\nHost: t\r\n"
+                       b"Transfer-Encoding: chunked\r\n\r\n" + chunks)
+            assert b"201" in resp.split(b"\r\n", 1)[0], resp[:100]
+            st, _, data = http_request("GET", f"{f.url}/chunked/a.bin")
+            assert st == 200 and data == body
+            # smuggling probe: conflicting Content-Length must be ignored
+            resp = raw(b"PUT /chunked/b.bin HTTP/1.1\r\nHost: t\r\n"
+                       b"Content-Length: 0\r\n"
+                       b"Transfer-Encoding: chunked\r\n\r\n"
+                       b"5\r\nhello\r\n0\r\n\r\n")
+            assert b"201" in resp.split(b"\r\n", 1)[0], resp[:100]
+            st, _, data = http_request("GET", f"{f.url}/chunked/b.bin")
+            assert st == 200 and data == b"hello"
+            # malformed chunk size: the connection closes, nothing stored
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            s.sendall(b"PUT /chunked/c.bin HTTP/1.1\r\nHost: t\r\n"
+                      b"Transfer-Encoding: chunked\r\n\r\nzz\r\n\r\n")
+            s.settimeout(5)
+            assert s.recv(4096) == b""  # closed without desync
+            s.close()
+            st, _, _ = http_request("GET", f"{f.url}/chunked/c.bin")
+            assert st == 404
+        finally:
+            f.stop()
+            v.stop()
+            m.stop()
