@@ -16,3 +16,4 @@ Behavioral port of `weed/mq` (`broker/broker_server.go:53`,
 """
 
 from seaweedfs_tpu.mq.broker import BrokerServer, TopicPartition  # noqa: F401
+from seaweedfs_tpu.mq.client import Consumer, MQError, Publisher  # noqa: F401

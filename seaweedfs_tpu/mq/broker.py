@@ -179,8 +179,13 @@ class BrokerServer:
     def __init__(self, filer_url: str, master_url: str = "",
                  host: str = "127.0.0.1", port: int = 17777,
                  peers: list[str] | None = None) -> None:
+        from seaweedfs_tpu.server.httpd import peer_url
+
         self.fc = FilerClient(filer_url)
-        self.master_url = master_url.rstrip("/") if master_url else ""
+        # scheme-qualify: the CLI passes bare host:port, and a silent
+        # registration failure would leave the broker invisible to
+        # cluster/ps (and every client using master discovery)
+        self.master_url = peer_url(master_url).rstrip("/") if master_url else ""
         self.service = HTTPService(host, port)
         self.ring = LockRing()
         self._static_peers = list(peers or [])

@@ -485,3 +485,58 @@ class TestSubCoordinator:
         )
         assert av["partitions"] == [0, 1]
         assert av["members"] == ["live"]
+
+
+class TestClientLibrary:
+    """Publisher/Consumer client library (`weed/mq/client/pub_client/`,
+    `sub_client/`): discovery via the master, redirect-following, group
+    membership, offset resume."""
+
+    def test_publish_consume_commit_resume(self, stack):
+        from seaweedfs_tpu.mq import Consumer, Publisher
+
+        master, filer, broker = stack
+        pub = Publisher(master_url=master.url)
+        pub.create_topic("clienttest", partition_count=3)
+        for i in range(30):
+            out = pub.publish("clienttest", {"n": i}, key=f"k{i}")
+            assert out["ok"]
+        c1 = Consumer("clienttest", "cg", master_url=master.url,
+                      instance_id="one")
+        assert c1.partitions == [0, 1, 2]
+        msgs = c1.poll()
+        assert len(msgs) == 30
+        assert sorted(m["value"]["n"] for m in msgs) == list(range(30))
+        c1.commit()
+        # a new consumer instance in the same group resumes committed
+        # offsets: nothing is redelivered
+        c1.close()
+        c2 = Consumer("clienttest", "cg", master_url=master.url,
+                      instance_id="two")
+        assert c2.poll() == []
+        # new messages flow to the resumed consumer
+        pub.publish("clienttest", {"n": 99}, key="fresh")
+        msgs = c2.poll()
+        assert [m["value"]["n"] for m in msgs] == [99]
+        c2.close()
+
+    def test_two_consumers_partition_split(self, stack):
+        from seaweedfs_tpu.mq import Consumer, Publisher
+
+        master, filer, broker = stack
+        pub = Publisher(master_url=master.url)
+        pub.create_topic("splittest", partition_count=4)
+        a = Consumer("splittest", "g2", master_url=master.url,
+                     instance_id="a")
+        b = Consumer("splittest", "g2", master_url=master.url,
+                     instance_id="b")
+        a._heartbeat()  # pick up the post-join rebalance
+        assert sorted(a.partitions + b.partitions) == [0, 1, 2, 3]
+        assert set(a.partitions).isdisjoint(b.partitions)
+        for k in range(4):
+            pub.publish("splittest", f"v{k}", partition=k)
+        seen = {m["partition"] for m in a.poll()} | {
+            m["partition"] for m in b.poll()}
+        assert seen == {0, 1, 2, 3}
+        a.close()
+        b.close()
