@@ -430,7 +430,7 @@ void json_response(Conn* c, int status, const char* reason,
 // ---------------------------------------------------------------------------
 
 bool handle_read(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
-                 uint32_t cookie, bool head) {
+                 uint32_t cookie, bool head, const std::string& range) {
     uint64_t off; int32_t size;
     {
         std::shared_lock<std::shared_mutex> l(v->map_mu);
@@ -541,14 +541,57 @@ bool handle_read(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
     }
     if (flags & 0x01) extra += "Content-Encoding: gzip\r\n";  // IS_COMPRESSED
     std::string ctype = mime.empty() ? "application/octet-stream" : mime;
+    // single-range slicing (server/volume.py _do_read semantics; multi-part
+    // ranges were already filtered to the proxy by the caller)
+    int status = 200;
+    const char* out_p = (const char*)data;
+    size_t out_n = data_size;
+    if (!range.empty() && range.rfind("bytes=", 0) == 0) {
+        const char* spec = range.c_str() + 6;
+        const char* dash = strchr(spec, '-');
+        // RFC 7233: ignore unintelligible specs (non-numeric parts) —
+        // the Python handler applies the same rule
+        bool valid = dash != nullptr;
+        for (const char* q = spec; valid && q < dash; q++)
+            if (!isdigit((unsigned char)*q)) valid = false;
+        for (const char* q = dash ? dash + 1 : spec; valid && *q; q++)
+            if (!isdigit((unsigned char)*q)) valid = false;
+        if (valid && dash == spec && !*(dash + 1))
+            valid = false;  // bare "bytes=-"
+        if (valid) {
+            long long start, end;
+            if (dash != spec) {  // "start-" or "start-end"
+                start = atoll(spec);
+                end = *(dash + 1) ? atoll(dash + 1)
+                                  : (long long)data_size - 1;
+            } else {             // "-suffix": last N bytes
+                long long sfx = atoll(dash + 1);
+                start = (long long)data_size - sfx;
+                if (start < 0) start = 0;
+                end = (long long)data_size - 1;
+            }
+            if (end > (long long)data_size - 1) end = (long long)data_size - 1;
+            if (start <= end) {
+                char cr[96];
+                snprintf(cr, sizeof cr,
+                         "Content-Range: bytes %lld-%lld/%u\r\n", start, end,
+                         data_size);
+                extra += cr;
+                out_p = (const char*)data + start;
+                out_n = (size_t)(end - start + 1);
+                status = 206;
+            }
+        }
+    }
     if (head) {
         char hint[64];
-        snprintf(hint, sizeof hint, "Content-Length-Hint: %u\r\n", data_size);
+        snprintf(hint, sizeof hint, "Content-Length-Hint: %zu\r\n", out_n);
         extra += hint;
-        append_response(c, 200, "OK", ctype, extra, "", 0, false);
+        append_response(c, status, status == 206 ? "Partial Content" : "OK",
+                        ctype, extra, "", 0, false);
     } else {
-        append_response(c, 200, "OK", ctype, extra, (const char*)data,
-                        data_size, false);
+        append_response(c, status, status == 206 ? "Partial Content" : "OK",
+                        ctype, extra, out_p, out_n, false);
     }
     E->stats.native_reads++;
     return true;
@@ -1157,11 +1200,14 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
     if (is_fid) {
         auto v = E->vol(vid);
         if (method == "GET" || method == "HEAD") {
-            bool range = !find_header(req, he, "range").empty();
-            if (v && !has_query && !range && !E->secure_reads) {
-                if (handle_read(E, c, v, key, cookie, method == "HEAD")) return;
+            std::string range = find_header(req, he, "range");
+            bool multi = range.find(',') != std::string::npos;
+            if (v && !has_query && !multi && !E->secure_reads) {
+                if (handle_read(E, c, v, key, cookie, method == "HEAD",
+                                range))
+                    return;
             }
-            proxy_request(E, w, c, req, req_len);
+            proxy_request(E, w, c, req, req_len, bypass_cap);
             return;
         }
         if (method == "POST" || method == "PUT") {

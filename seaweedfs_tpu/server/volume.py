@@ -1026,12 +1026,21 @@ class VolumeServer:
         rng = req.headers.get("Range")
         status = 200
         if rng and rng.startswith("bytes=") and "," not in rng:
-            spec = rng[6:]
-            start_s, _, end_s = spec.partition("-")
-            start = int(start_s) if start_s else max(0, len(data) - int(end_s))
-            end = int(end_s) if end_s and start_s else len(data) - 1
+            # RFC 7233: an unintelligible Range is ignored (200 full body),
+            # never a 500 — and the dash is mandatory. Same semantics as
+            # the engine's native range path (fastlane.cpp handle_read).
+            try:
+                spec = rng[6:]
+                if "-" not in spec:
+                    raise ValueError(rng)
+                start_s, _, end_s = spec.partition("-")
+                start = (int(start_s) if start_s
+                         else max(0, len(data) - int(end_s)))
+                end = int(end_s) if end_s and start_s else len(data) - 1
+            except ValueError:
+                start, end = 0, -1  # ignore the malformed header
             end = min(end, len(data) - 1)
-            if start <= end:
+            if 0 <= start <= end:
                 headers["Content-Range"] = f"bytes {start}-{end}/{len(data)}"
                 data = data[start : end + 1]
                 status = 206
