@@ -597,6 +597,75 @@ bool handle_read(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
     return true;
 }
 
+// first file part of a multipart/form-data body (filename= present) —
+// mirrors httpd.py Request.multipart_file. Returns false if no file part
+// (caller proxies; Python answers exactly as before).
+bool multipart_first_file(const std::string& ctype, const char* body,
+                          size_t body_len, std::string* filename,
+                          std::string* part_type, const char** data,
+                          size_t* data_len) {
+    size_t bpos = ctype.find("boundary=");
+    if (bpos == std::string::npos) return false;
+    std::string boundary = ctype.substr(bpos + 9);
+    if (!boundary.empty() && boundary[0] == '"') {
+        size_t endq = boundary.find('"', 1);
+        boundary = boundary.substr(1, endq == std::string::npos
+                                          ? std::string::npos : endq - 1);
+    } else {
+        size_t semi = boundary.find(';');
+        if (semi != std::string::npos) boundary = boundary.substr(0, semi);
+    }
+    if (boundary.empty()) return false;
+    std::string delim = "--" + boundary;
+    // raw-memory scan: no copy of the (possibly multi-MB) upload body
+    const char* end = body + body_len;
+    const char* pos = (const char*)memmem(body, body_len, delim.data(),
+                                          delim.size());
+    while (pos != nullptr) {
+        pos += delim.size();
+        const char* hdr_end = (const char*)memmem(pos, (size_t)(end - pos),
+                                                  "\r\n\r\n", 4);
+        if (!hdr_end) break;
+        std::string head(pos, (size_t)(hdr_end - pos));
+        const char* dstart = hdr_end + 4;
+        const char* dend = (const char*)memmem(
+            dstart, (size_t)(end - dstart), delim.data(), delim.size());
+        if (!dend) break;
+        size_t plen = (size_t)(dend - dstart);
+        // part data ends before the CRLF preceding the next delimiter
+        if (plen >= 2 && dend[-2] == '\r' && dend[-1] == '\n') plen -= 2;
+        size_t fpos = head.find("filename=\"");
+        if (fpos != std::string::npos) {
+            size_t fend = head.find('"', fpos + 10);
+            if (fend == std::string::npos) return false;
+            *filename = head.substr(fpos + 10, fend - fpos - 10);
+            part_type->clear();
+            size_t ct = 0;
+            // case-insensitive Content-Type scan within the part head
+            for (size_t i = 0; i + 13 <= head.size(); i++)
+                if (strncasecmp(head.c_str() + i, "content-type:", 13) == 0) {
+                    ct = i + 13;
+                    break;
+                }
+            if (ct) {
+                size_t eol = head.find('\r', ct);
+                if (eol == std::string::npos) eol = head.size();
+                while (ct < eol && (head[ct] == ' ' || head[ct] == '\t'))
+                    ct++;
+                while (eol > ct &&
+                       (head[eol - 1] == ' ' || head[eol - 1] == '\t'))
+                    eol--;
+                *part_type = head.substr(ct, eol - ct);
+            }
+            *data = dstart;
+            *data_len = plen;
+            return true;
+        }
+        pos = dend;
+    }
+    return false;
+}
+
 // ---------------------------------------------------------------------------
 // native write / delete
 // ---------------------------------------------------------------------------
@@ -1211,19 +1280,8 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
             return;
         }
         if (method == "POST" || method == "PUT") {
-            std::string ctype = find_header(req, he, "content-type");
-            bool multipart = ctype.rfind("multipart/", 0) == 0;
-            std::string fname = find_header(req, he, "x-file-name");
-            bool jpg = false;
-            {
-                std::string lower = fname;
-                for (auto& ch : lower) ch = tolower(ch);
-                if (lower.size() >= 4 &&
-                    (lower.rfind(".jpg") == lower.size() - 4 ||
-                     (lower.size() >= 5 && lower.rfind(".jpeg") == lower.size() - 5)))
-                    jpg = true;
-                if (ctype == "image/jpeg") jpg = true;
-            }
+            // cheap gates first: a request the proxy will take anyway
+            // must not pay body parsing
             bool exists = false;
             if (v) {
                 uint64_t off_; int32_t size_;
@@ -1234,19 +1292,57 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
             if (!E->jwt_write_key.empty())
                 jwt_ok = jwt_write_ok(E, find_header(req, he, "authorization"),
                                       path + 1, (size_t)(fid_end - path - 1));
-            if (v && !has_query && !multipart && !jpg && !exists && jwt_ok &&
-                !E->secure_writes && !v->readonly.load() &&
-                !v->forward_writes.load()) {
-                std::string mime = ctype;
+            bool gates_ok = v && !has_query && !exists && jwt_ok &&
+                            !E->secure_writes && !v->readonly.load() &&
+                            !v->forward_writes.load();
+            if (!gates_ok) {
+                proxy_request(E, w, c, req, req_len, bypass_cap);
+                return;
+            }
+            std::string ctype = find_header(req, he, "content-type");
+            std::string fname = find_header(req, he, "x-file-name");
+            const char* wdata = body;
+            size_t wlen = body_len;
+            std::string mime = ctype;
+            bool is_multipart = ctype.rfind("multipart/form-data", 0) == 0;
+            bool unsupported =
+                !is_multipart && ctype.rfind("multipart/", 0) == 0;
+            if (is_multipart) {
+                // curl -F / browser-form uploads: extract the file part
+                // natively (the reference's own clients upload this way)
+                std::string part_name, part_type;
+                if (multipart_first_file(ctype, body, body_len, &part_name,
+                                         &part_type, &wdata, &wlen)) {
+                    fname = part_name;
+                    mime = part_type;
+                } else {
+                    unsupported = true;  // no file part: Python's error path
+                }
+            } else {
+                // header-mime branch only (volume.py _do_write): form and
+                // json defaults are transport noise, not the blob's type
                 if (mime == "application/json" ||
-                    mime == "application/x-www-form-urlencoded" ||
-                    mime == "application/octet-stream" || mime.size() >= 256)
+                    mime == "application/x-www-form-urlencoded")
                     mime.clear();
-                if (handle_write(E, c, v, key, cookie, body, body_len, fname,
+            }
+            bool jpg = false;
+            {
+                std::string lower = fname;
+                for (auto& ch : lower) ch = tolower(ch);
+                if (lower.size() >= 4 &&
+                    (lower.rfind(".jpg") == lower.size() - 4 ||
+                     (lower.size() >= 5 && lower.rfind(".jpeg") == lower.size() - 5)))
+                    jpg = true;
+                if (mime == "image/jpeg") jpg = true;
+            }
+            if (!unsupported && !jpg) {
+                if (mime == "application/octet-stream" || mime.size() >= 256)
+                    mime.clear();  // common needle-set rule (both branches)
+                if (handle_write(E, c, v, key, cookie, wdata, wlen, fname,
                                  mime))
                     return;
             }
-            proxy_request(E, w, c, req, req_len);
+            proxy_request(E, w, c, req, req_len, bypass_cap);
             return;
         }
         if (method == "DELETE") {

@@ -1034,6 +1034,13 @@ class VolumeServer:
                 if "-" not in spec:
                     raise ValueError(rng)
                 start_s, _, end_s = spec.partition("-")
+                # strict digits only (int() would accept '+', '_', spaces
+                # and unicode digits the native path rejects)
+                if (start_s and not start_s.isascii()) or \
+                        (end_s and not end_s.isascii()) or \
+                        (start_s and not start_s.isdigit()) or \
+                        (end_s and not end_s.isdigit()):
+                    raise ValueError(rng)
                 start = (int(start_s) if start_s
                          else max(0, len(data) - int(end_s)))
                 end = int(end_s) if end_s and start_s else len(data) - 1
