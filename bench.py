@@ -467,6 +467,56 @@ def bench_small_files(n: int = 20000, size: int = 1024, c: int = 16) -> dict:
     return out
 
 
+def bench_filer_small_files(n: int = 20000, size: int = 1024, c: int = 16) -> dict:
+    """Filer-path small files (VERDICT r4 next #3): write/read req/s THROUGH
+    the filer (path namespace -> chunk on a volume -> entry in the store),
+    driven by the native epoll loadgen so the measurement isn't client-bound.
+    The reference's equivalent hot path is
+    `weed/server/filer_server_handlers_write_autochunk.go:26-155`."""
+    import random
+
+    from seaweedfs_tpu.native import lib as native_lib
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    d = os.path.join(BENCH_DIR, "filerfiles")
+    os.makedirs(d, exist_ok=True)
+    out: dict = {"files": n, "size": size, "concurrency": c}
+    master = vs = filer = None
+    try:
+        master = MasterServer(port=0, pulse_seconds=1)
+        master.start()
+        vs = VolumeServer([d], master.url, port=0, pulse_seconds=1,
+                          max_volume_count=20)
+        vs.start()
+        filer = FilerServer(master_url=master.url, port=0)
+        filer.start()
+        if native_lib is None:
+            out["error"] = "skipped: native lib unavailable"
+            return out
+        port = int(filer.url.rsplit(":", 1)[1])
+        paths = [f"/bench/f{i}" for i in range(n)]
+        w = native_lib.loadgen("127.0.0.1", port, c, "POST", paths,
+                               bytes(size))
+        random.Random(3).shuffle(paths)
+        r = native_lib.loadgen("127.0.0.1", port, c, "GET", paths)
+        if w["ok"] > 0 and r["ok"] > 0:  # never publish error-path speed
+            out["write_req_s"] = w["req_per_sec"]
+            out["read_req_s"] = r["req_per_sec"]
+            out["write_errors"] = w["errors"]
+            out["read_errors"] = r["errors"]
+        else:
+            out["error"] = f"loadgen failed: ok w={w['ok']} r={r['ok']}"
+        if filer.fastlane is not None:
+            out["engine"] = filer.fastlane.stats()
+    finally:
+        for s in (filer, vs, master):
+            if s is not None:
+                s.stop()
+    return out
+
+
 def bench_hash_1m_4k(
     total_blobs: int = 1_000_000, slab: int = 65536, device: bool = True
 ) -> dict:
@@ -560,7 +610,7 @@ def main() -> None:
     from seaweedfs_tpu.ops.rs_kernel import pick_pipeline_backend
 
     backend = pick_pipeline_backend()
-    extra = {
+    detail = {
         "backend": backend,
         "baseline_seq_table_gbps": round(seq_table, 3),
         "baseline_seq_gfni_gbps": round(seq_gfni, 3),
@@ -569,85 +619,171 @@ def main() -> None:
     }
     # device benches run under a watchdog: the TPU relay on this host has
     # been observed to wedge entirely, and a hung bench reports nothing.
-    # After the first timeout the remaining device sections are skipped —
-    # a wedged link won't heal mid-run, and each abandoned probe thread
-    # parks on the backend-init lock anyway.
-    from seaweedfs_tpu.ops.device_probe import run_with_timeout
+    # The status probe (bounded retries) decides up-front whether device
+    # sections run; a down link is a reported FACT in the record, not a
+    # missing key (VERDICT r4 weak #2).
+    from seaweedfs_tpu.ops.device_probe import (
+        probe_device_status,
+        run_with_timeout,
+    )
 
-    device_dead = False
-    try:
-        extra["device_kernel_gbps"] = round(
-            run_with_timeout(bench_device_kernel, 120), 3
-        )
-    except Exception as e:  # no chip attached / link wedged
-        extra["device_kernel_gbps"] = None
-        extra["device_kernel_error"] = str(e)[:120]
-        device_dead = True
+    dev = probe_device_status()
+    detail["device_status"] = dev
+    device_dead = dev["status"] == "down"
     if device_dead:
-        extra["device_pipeline_e2e_gbps"] = None
-        extra["device_pipeline_error"] = "skipped: device link down"
+        detail["device_kernel_gbps"] = None
+        detail["device_kernel_error"] = "skipped: device " + dev["status"]
     else:
         try:
-            extra["device_pipeline_e2e_gbps"] = round(
+            detail["device_kernel_gbps"] = round(
+                run_with_timeout(bench_device_kernel, 120), 3
+            )
+        except Exception as e:  # link wedged after the probe passed
+            detail["device_kernel_gbps"] = None
+            detail["device_kernel_error"] = str(e)[:120]
+            device_dead = True
+    if device_dead or dev["status"] == "relay-degraded":
+        # a degraded relay cannot win the e2e pipeline; don't spend 2x120s
+        detail["device_pipeline_e2e_gbps"] = None
+        detail["device_pipeline_error"] = "skipped: device " + (
+            "down" if device_dead else dev["status"]
+        )
+    else:
+        try:
+            detail["device_pipeline_e2e_gbps"] = round(
                 run_with_timeout(
                     lambda: bench_device_pipeline(staging_base), 120
                 ),
                 3,
             )
         except Exception as e:
-            extra["device_pipeline_e2e_gbps"] = None
-            extra["device_pipeline_error"] = str(e)[:120]
+            detail["device_pipeline_e2e_gbps"] = None
+            detail["device_pipeline_error"] = str(e)[:120]
             device_dead = True
     try:
-        extra["hash_1m_4k"] = bench_hash_1m_4k(
+        detail["hash_1m_4k"] = bench_hash_1m_4k(
             device=not device_dead
         )  # BASELINE config 3
     except Exception as e:
-        extra["hash_1m_4k"] = {"error": str(e)[:120]}
+        detail["hash_1m_4k"] = {"error": str(e)[:120]}
+    if device_dead:
+        detail["hash_1m_4k"].setdefault(
+            "device_batch_error", "skipped: device down"
+        )
     try:
-        extra["ec_rebuild"] = bench_rebuild(staging_base)  # BASELINE config 2
+        detail["ec_rebuild"] = bench_rebuild(staging_base)  # BASELINE config 2
     except Exception as e:
-        extra["ec_rebuild"] = {"error": str(e)[:120]}
+        detail["ec_rebuild"] = {"error": str(e)[:120]}
     try:
-        extra["cdc_dedup"] = bench_cdc_dedup()  # BASELINE config 4
+        detail["cdc_dedup"] = bench_cdc_dedup()  # BASELINE config 4
     except Exception as e:
-        extra["cdc_dedup"] = {"error": str(e)[:120]}
+        detail["cdc_dedup"] = {"error": str(e)[:120]}
     try:
-        extra["small_files"] = bench_small_files()  # BASELINE.md rows 1-2
+        detail["small_files"] = bench_small_files()  # BASELINE.md rows 1-2
     except Exception as e:
-        extra["small_files"] = {"error": str(e)[:120]}
-    extra["note"] = (
+        detail["small_files"] = {"error": str(e)[:120]}
+    try:
+        detail["filer_small_files"] = bench_filer_small_files()
+    except Exception as e:
+        detail["filer_small_files"] = {"error": str(e)[:120]}
+    detail["note"] = (
         "value is the real shell ec.encode verb, disk-to-shards, 1GiB volume,"
         " best of 3. vs_baseline divides by baseline_seq_gfni_gbps: the"
         " reference's exact architecture (single-thread 256KB"
         " read->encode->write loop, ec_encoder.go:132-137) running the"
         " strongest CPU kernel this host has (GFNI/AVX-512 — klauspost-class,"
         " same instruction family klauspost's asm uses), end-to-end on the"
-        " same volume. The old r1 scalar-table divisor is kept as"
-        " baseline_seq_table_gbps for continuity. The verb itself runs the"
-        " fused single-pass engine: mmap'd .dat -> GFNI registers ->"
-        " NT-stores into mmap'd shards, one memory pass, no pread/pwrite"
-        " copies. The TPU autotune path measures the host<->device link"
-        " first; this host's chip sits behind a ~30MB/s relay"
-        " (device_pipeline_e2e_gbps), so the host engine carries the verb"
-        " while device_kernel_gbps shows the chip-side ceiling. Trial 1"
-        " carries ~0.45s of first-touch cost for the 1.5GB of new shard"
-        " pages (this microVM's free-page reporting makes first-touch"
-        " ~1.2us/page); any encode implementation pays that once per fresh"
-        " file set, and trials 2+ recycle the pages."
+        " same volume. The verb runs the fused single-pass engine: mmap'd"
+        " .dat -> GFNI registers -> NT-stores into mmap'd shards, one memory"
+        " pass. BASELINE's 10x target assumed the chip could carry the verb;"
+        " the verb is DRAM-bandwidth-bound on the host (~2.6GB of traffic at"
+        " ~10-12GB/s) and this host's chip link (device_status) has never"
+        " sustained more than ~30MB/s, so the remaining multiple is only"
+        " reachable through the device path when a real link exists —"
+        " device_kernel_gbps shows the chip-side ceiling when up. Trial 1"
+        " pays the microVM's fresh-page first-touch cost once per file set."
     )
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_full.json"), "w") as f:
+        json.dump(_drop_nonfinite(detail), f, indent=1, allow_nan=False)
+
+    print(summary_line(verb_gbps, seq_gfni, backend, verb_info, dev, detail))
+
+
+def summary_line(
+    verb_gbps: float, seq_gfni: float, backend: str, verb_info: dict,
+    dev: dict, detail: dict,
+) -> str:
+    """Final line: compact scalars only (<1.5KB — the driver records a
+    2,000-char tail of stdout and parses the last line; r4's full-detail
+    line hit 2,584 chars and the round recorded parsed:null)."""
     vs = verb_gbps / seq_gfni if seq_gfni == seq_gfni and seq_gfni > 0 else 0.0
-    print(
-        json.dumps(
-            {
-                "metric": "ec.encode",
-                "value": round(verb_gbps, 3),
-                "unit": "GB/s",
-                "vs_baseline": round(vs, 2),
-                "extra": extra,
-            }
-        )
-    )
+    hsh = detail.get("hash_1m_4k", {})
+    reb = detail.get("ec_rebuild", {})
+    cdc = detail.get("cdc_dedup", {})
+    sf = detail.get("small_files", {})
+    fsf = detail.get("filer_small_files", {})
+    pyc = sf.get("python_client", {})
+    summary = {
+        "metric": "ec.encode",
+        "value": round(verb_gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(vs, 2),
+        "extra": {
+            "backend": backend,
+            "baseline_seq_gfni_gbps": round(seq_gfni, 3),
+            "trial_seconds": verb_info.get("trial_seconds"),
+            "device_status": dev["status"],
+            "device_h2d_mbps": dev["h2d_mbps"],
+            "device_kernel_gbps": detail.get("device_kernel_gbps"),
+            "device_pipeline_e2e_gbps": detail.get("device_pipeline_e2e_gbps"),
+            "ec_rebuild_gbps": reb.get("gbps"),
+            "ec_rebuild_trials": reb.get("trial_seconds"),
+            "hash_mhashes_s": hsh.get("native_batch_mhashes_s"),
+            "hash_gbps": hsh.get("native_batch_gbps"),
+            "hash_device_gbps": hsh.get("device_batch_gbps"),
+            "hash_device_error": (hsh.get("device_batch_error") or "")[:60]
+            or None,
+            "cdc_gbps": cdc.get("gbps"),
+            "cdc_gbps_p75": cdc.get("gbps_p75_window"),
+            "sf_write_req_s": sf.get("write_req_s"),
+            "sf_read_req_s": sf.get("read_req_s"),
+            "sf_assign_write_req_s": sf.get("write_assign_per_file_req_s"),
+            "py_write_req_s": pyc.get("write_req_s"),
+            "py_read_req_s": pyc.get("read_req_s"),
+            "filer_write_req_s": fsf.get("write_req_s"),
+            "filer_read_req_s": fsf.get("read_req_s"),
+            "note": "host GFNI engine carries the verb (DRAM-bound ~4GB/s;"
+            " chip link has never exceeded ~30MB/s — see device_status);"
+            " full per-config detail in BENCH_full.json",
+        },
+    }
+    summary = _drop_nonfinite(summary)
+    # allow_nan=False: a NaN/Infinity that slipped through would emit
+    # non-RFC-8259 JSON and a strict driver-side parser records parsed:null
+    # — the exact round-4 failure this line exists to prevent
+    line = json.dumps(summary, allow_nan=False)
+    if len(line) > 1500:  # hard guard: never hand the driver an unparseable tail
+        summary["extra"] = {
+            "device_status": dev["status"],
+            "note": "summary truncated; see BENCH_full.json",
+        }
+        line = json.dumps(summary, allow_nan=False)
+    return line
+
+
+def _drop_nonfinite(x):
+    """NaN/Infinity -> None, recursively (json.dumps would emit them as
+    bare NaN/Infinity tokens, which strict JSON parsers reject)."""
+    import math
+
+    if isinstance(x, float) and not math.isfinite(x):
+        return None
+    if isinstance(x, dict):
+        return {k: _drop_nonfinite(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_drop_nonfinite(v) for v in x]
+    return x
 
 
 if __name__ == "__main__":

@@ -1,0 +1,87 @@
+"""The round record must be parseable: bench.py's final stdout line is all
+the driver keeps (2,000-char tail), and round 4 lost its headline to an
+oversized line. These tests pin the compact-summary contract and the
+device-status probe shape (VERDICT r4 next #1)."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import bench
+from seaweedfs_tpu.ops.device_probe import probe_device_status
+
+
+def _representative_detail() -> dict:
+    # worst-case realistic payload: every field populated, long error string
+    return {
+        "hash_1m_4k": {
+            "native_batch_mhashes_s": 0.464,
+            "native_batch_gbps": 1.901,
+            "device_batch_error": "x" * 300,
+        },
+        "ec_rebuild": {"gbps": 3.141, "trial_seconds": [0.318, 0.322, 0.319]},
+        "cdc_dedup": {"gbps": 2.105, "gbps_p75_window": 2.207},
+        "small_files": {
+            "write_req_s": 61712.4,
+            "read_req_s": 95558.1,
+            "write_assign_per_file_req_s": 12114.9,
+            "python_client": {"write_req_s": 3036.5, "read_req_s": 5751.2},
+        },
+        "filer_small_files": {"write_req_s": 15123.4, "read_req_s": 41234.5},
+        "device_kernel_gbps": 123.456,
+        "device_pipeline_e2e_gbps": 0.031,
+    }
+
+
+def test_summary_line_is_compact_and_parseable():
+    line = bench.summary_line(
+        verb_gbps=4.227,
+        seq_gfni=1.832,
+        backend="native",
+        verb_info={"trial_seconds": [0.256, 0.256, 0.254]},
+        dev={"status": "relay-degraded", "h2d_mbps": 29.7, "attempts": 1},
+        detail=_representative_detail(),
+    )
+    assert len(line) <= 1500, f"summary line {len(line)} chars > 1500"
+    parsed = json.loads(line)
+    assert parsed["metric"] == "ec.encode"
+    assert parsed["value"] == 4.227
+    assert parsed["vs_baseline"] == 2.31
+    assert parsed["extra"]["device_status"] == "relay-degraded"
+    assert parsed["extra"]["ec_rebuild_gbps"] == 3.141
+    assert parsed["extra"]["filer_write_req_s"] == 15123.4
+    assert parsed["extra"]["hash_device_gbps"] is None  # error went elsewhere
+    assert len(parsed["extra"]["hash_device_error"]) <= 60
+
+
+def test_summary_line_survives_empty_detail():
+    # every sub-bench failed: the line must still parse and carry the status
+    line = bench.summary_line(
+        verb_gbps=0.0,
+        seq_gfni=float("nan"),
+        backend="python",
+        verb_info={},
+        dev={"status": "down", "h2d_mbps": None, "attempts": 3},
+        detail={},
+    )
+    # strict RFC-8259 parse: a bare NaN token (json.dumps default for
+    # float('nan')) must never reach the driver
+    parsed = json.loads(line, parse_constant=lambda t: (_ for _ in ()).throw(
+        AssertionError(f"non-strict JSON token {t!r} in summary line")))
+    assert len(line) <= 1500
+    assert parsed["extra"]["device_status"] == "down"
+    assert parsed["extra"]["baseline_seq_gfni_gbps"] is None
+    assert parsed["vs_baseline"] == 0.0
+
+
+def test_probe_device_status_shape():
+    # under the CPU-forced test env there is no accelerator: status must be
+    # a reported fact with the attempt count, never an exception
+    st = probe_device_status(retries=0, timeout=10.0)
+    assert st["status"] in ("up", "relay-degraded", "down")
+    assert "h2d_mbps" in st and "attempts" in st
+    assert st["attempts"] >= 1
