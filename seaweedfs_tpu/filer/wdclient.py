@@ -17,7 +17,8 @@ from seaweedfs_tpu.server.httpd import PooledHTTP, get_json, http_request, peer_
 
 class WeedClient:
     def __init__(
-        self, master_url: str, cache_ttl: float = 30.0, jwt_key: str = ""
+        self, master_url: str, cache_ttl: float = 30.0, jwt_key: str = "",
+        read_jwt_key: str = "",
     ) -> None:
         # comma-separated master list; requests follow raft leader hints
         # (`wdclient/masterclient.go` leader failover)
@@ -28,6 +29,10 @@ class WeedClient:
         self.master_url = self.masters[0]
         self.cache_ttl = cache_ttl
         self.jwt_key = jwt_key  # shared security.toml signing key
+        # jwt.signing.read key: the filer signs read tokens from its own
+        # copy, as the reference does (`weed/security/jwt.go`
+        # GenJwtForVolumeServer with the read key)
+        self.read_jwt_key = read_jwt_key
         self._vid_cache: dict[int, tuple[float, list[str]]] = {}
         self._lock = threading.Lock()
         # keep-alive for the hot data-plane hops (assign, chunk upload,
@@ -167,8 +172,15 @@ class WeedClient:
         last_err: Exception | None = None
         urls = self.lookup_file_id(file_id)
         random.shuffle(urls)
+        auth = ""
+        if self.read_jwt_key:
+            from seaweedfs_tpu.security.jwt import gen_read_jwt
+
+            auth = gen_read_jwt(self.read_jwt_key, file_id)
         for url in urls:
             headers = {"Range": range_header} if range_header else {}
+            if auth:
+                headers["Authorization"] = f"BEARER {auth}"
             status, _, body = self._pool.request("GET", url, headers=headers)
             if status in (200, 206):
                 return body

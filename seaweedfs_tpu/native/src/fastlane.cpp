@@ -22,6 +22,7 @@
 
 #include <arpa/inet.h>
 #include <ctype.h>
+#include <dlfcn.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <netinet/in.h>
@@ -39,6 +40,7 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <deque>
 #include <memory>
@@ -54,6 +56,127 @@ extern "C" void sw_hmac_sha256(const uint8_t* key, size_t key_len,
                                uint8_t out[32]);
 
 namespace {
+
+// ---------------------------------------------------------------------------
+// TLS via dlopen'd OpenSSL 3 (this image ships libssl.so.3 but no headers).
+// The engine terminates mTLS itself (`weed/security/tls.go` semantics:
+// client certs REQUIRED, allowed-commonNames gate per request) so hardened
+// clusters keep the native data plane instead of falling back to the
+// GIL-bound Python proxy. Only the stable OpenSSL C ABI is used; every
+// symbol is resolved at runtime and a resolution failure makes sw_fl_start
+// report TLS-unavailable so Python serves TLS itself.
+// ---------------------------------------------------------------------------
+
+// stable ABI constants (openssl/ssl.h, openssl/obj_mac.h)
+constexpr int kSSL_FILETYPE_PEM = 1;
+constexpr int kSSL_VERIFY_PEER = 0x01;
+constexpr int kSSL_VERIFY_FAIL_IF_NO_PEER_CERT = 0x02;
+constexpr int kSSL_CTRL_MODE = 33;
+constexpr long kSSL_MODE_ENABLE_PARTIAL_WRITE = 0x1;
+constexpr long kSSL_MODE_ACCEPT_MOVING_WRITE_BUFFER = 0x2;
+constexpr int kSSL_ERROR_WANT_READ = 2;
+constexpr int kSSL_ERROR_WANT_WRITE = 3;
+constexpr int kNID_commonName = 13;
+
+struct TlsApi {
+    void* (*TLS_server_method)();
+    void* (*SSL_CTX_new)(void*);
+    void (*SSL_CTX_free)(void*);
+    int (*SSL_CTX_use_certificate_chain_file)(void*, const char*);
+    int (*SSL_CTX_use_PrivateKey_file)(void*, const char*, int);
+    int (*SSL_CTX_load_verify_locations)(void*, const char*, const char*);
+    void (*SSL_CTX_set_verify)(void*, int, void*);
+    long (*SSL_CTX_ctrl)(void*, int, long, void*);
+    void* (*SSL_new)(void*);
+    void (*SSL_free)(void*);
+    int (*SSL_set_fd)(void*, int);
+    void (*SSL_set_accept_state)(void*);
+    int (*SSL_do_handshake)(void*);
+    int (*SSL_read)(void*, void*, int);
+    int (*SSL_write)(void*, const void*, int);
+    int (*SSL_get_error)(const void*, int);
+    int (*SSL_shutdown)(void*);
+    void* (*SSL_get1_peer_certificate)(const void*);
+    void* (*X509_get_subject_name)(const void*);
+    int (*X509_NAME_get_text_by_NID)(void*, int, char*, int);
+    void (*X509_free)(void*);
+    bool ok = false;
+};
+
+std::atomic<TlsApi*> g_tls_api{nullptr};
+
+TlsApi* tls_api() {
+    // lock-free once resolved: every TLS read/write on every worker calls
+    // this, and a shared mutex here would serialize the whole data plane
+    TlsApi* ready = g_tls_api.load(std::memory_order_acquire);
+    if (ready != nullptr) return ready->ok ? ready : nullptr;
+    static TlsApi api;
+    static pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+    static bool tried = false;
+    pthread_mutex_lock(&mu);
+    if (!tried) {
+        tried = true;
+        void* ssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+        if (!ssl) ssl = dlopen("libssl.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+        void* crypto = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+        if (!crypto) crypto = dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+        if (ssl && crypto) {
+            bool all = true;
+            auto S = [&](const char* n) -> void* {
+                void* p = dlsym(ssl, n);
+                if (!p) p = dlsym(crypto, n);
+                if (!p) all = false;
+                return p;
+            };
+            *(void**)&api.TLS_server_method = S("TLS_server_method");
+            *(void**)&api.SSL_CTX_new = S("SSL_CTX_new");
+            *(void**)&api.SSL_CTX_free = S("SSL_CTX_free");
+            *(void**)&api.SSL_CTX_use_certificate_chain_file =
+                S("SSL_CTX_use_certificate_chain_file");
+            *(void**)&api.SSL_CTX_use_PrivateKey_file =
+                S("SSL_CTX_use_PrivateKey_file");
+            *(void**)&api.SSL_CTX_load_verify_locations =
+                S("SSL_CTX_load_verify_locations");
+            *(void**)&api.SSL_CTX_set_verify = S("SSL_CTX_set_verify");
+            *(void**)&api.SSL_CTX_ctrl = S("SSL_CTX_ctrl");
+            *(void**)&api.SSL_new = S("SSL_new");
+            *(void**)&api.SSL_free = S("SSL_free");
+            *(void**)&api.SSL_set_fd = S("SSL_set_fd");
+            *(void**)&api.SSL_set_accept_state = S("SSL_set_accept_state");
+            *(void**)&api.SSL_do_handshake = S("SSL_do_handshake");
+            *(void**)&api.SSL_read = S("SSL_read");
+            *(void**)&api.SSL_write = S("SSL_write");
+            *(void**)&api.SSL_get_error = S("SSL_get_error");
+            *(void**)&api.SSL_shutdown = S("SSL_shutdown");
+            // OpenSSL 3 renamed it (get1 = caller owns the ref); 1.1 name
+            // has identical semantics for our use
+            void* g = dlsym(ssl, "SSL_get1_peer_certificate");
+            if (!g) g = dlsym(ssl, "SSL_get_peer_certificate");
+            if (!g) all = false;
+            *(void**)&api.SSL_get1_peer_certificate = g;
+            *(void**)&api.X509_get_subject_name = S("X509_get_subject_name");
+            *(void**)&api.X509_NAME_get_text_by_NID =
+                S("X509_NAME_get_text_by_NID");
+            *(void**)&api.X509_free = S("X509_free");
+            api.ok = all;
+        }
+        g_tls_api.store(&api, std::memory_order_release);
+    }
+    pthread_mutex_unlock(&mu);
+    return api.ok ? &api : nullptr;
+}
+
+// '*'-wildcard match, same semantics as security/tls.py compile_cn_pattern
+bool glob_match(const char* pat, const char* s) {
+    if (*pat == 0) return *s == 0;
+    if (*pat == '*') {
+        for (const char* t = s;; t++) {
+            if (glob_match(pat + 1, t)) return true;
+            if (*t == 0) return false;
+        }
+    }
+    return *pat == *s && glob_match(pat + 1, s + 1);
+}
 
 // ---------------------------------------------------------------------------
 // needle map: open addressing, u64 key -> (offset bytes u64, size i32)
@@ -194,6 +317,9 @@ struct Conn {
     std::string chunk_body;      // chunked decode: body decoded so far
     BackendConn* upstream = nullptr;  // pending proxied request, if any
     time_t last_active = 0;
+    void* ssl = nullptr;  // OpenSSL SSL* when the engine terminates TLS
+    int tls_hs = 0;       // 0 plaintext, 1 handshaking, 2 established
+    bool cn_ok = true;    // false: CA-valid cert, disallowed CommonName
 };
 
 // One in-flight proxied request to the Python backend. The worker never
@@ -252,6 +378,9 @@ struct Engine {
     bool secure_writes = false;     // JWT configured -> proxy writes
     bool secure_reads = false;
     std::string jwt_write_key;      // non-empty: verify HS256 write JWTs natively
+    std::string jwt_read_key;       // non-empty: verify read JWTs natively too
+    void* tls_ctx = nullptr;        // OpenSSL SSL_CTX* (engine-terminated mTLS)
+    std::vector<std::string> allowed_cns;  // '*'-glob CommonName allow-list
     std::atomic<bool> running{true};
     std::deque<Worker> workers;  // deque: Worker holds mutexes, never moves
     pthread_t accept_thread;
@@ -308,6 +437,40 @@ uint64_t get_u64be(const uint8_t* p) {
 bool set_nonblock(int fd) {
     int fl = fcntl(fd, F_GETFL, 0);
     return fl >= 0 && fcntl(fd, F_SETFL, fl | O_NONBLOCK) == 0;
+}
+
+// TLS-aware client-socket IO. Returns >0 bytes moved, 0 peer closed,
+// -1 would-block (retry on the next read event), -2 hard error,
+// -3 would-block on WRITE (TLS renegotiation/KeyUpdate with a full send
+// buffer: the caller must arm EPOLLOUT or the conn stalls).
+int conn_read(Conn* c, char* buf, int n) {
+    if (c->ssl == nullptr) {
+        ssize_t r = recv(c->fd, buf, n, 0);
+        if (r > 0) return (int)r;
+        if (r == 0) return 0;
+        return (errno == EAGAIN || errno == EWOULDBLOCK) ? -1 : -2;
+    }
+    TlsApi* T = tls_api();
+    int r = T->SSL_read(c->ssl, buf, n);
+    if (r > 0) return r;
+    int e = T->SSL_get_error(c->ssl, r);
+    if (e == kSSL_ERROR_WANT_READ) return -1;
+    if (e == kSSL_ERROR_WANT_WRITE) return -3;
+    return r == 0 ? 0 : -2;  // clean TLS shutdown reads as EOF
+}
+
+int conn_write(Conn* c, const char* buf, int n) {
+    if (c->ssl == nullptr) {
+        ssize_t r = send(c->fd, buf, n, MSG_NOSIGNAL);
+        if (r >= 0) return (int)r;
+        return (errno == EAGAIN || errno == EWOULDBLOCK) ? -1 : -2;
+    }
+    TlsApi* T = tls_api();
+    int r = T->SSL_write(c->ssl, buf, n);
+    if (r > 0) return r;
+    int e = T->SSL_get_error(c->ssl, r);
+    if (e == kSSL_ERROR_WANT_READ || e == kSSL_ERROR_WANT_WRITE) return -1;
+    return -2;
 }
 
 // case-insensitive header lookup inside [hdr_begin, hdr_end); returns value
@@ -1113,12 +1276,14 @@ int b64url_decode(const char* in, size_t n, uint8_t* out, size_t cap) {
     return (int)o;
 }
 
-// verify "BEARER <jwt>" against the write key and the request's base fid
+// verify "BEARER <jwt>" against `key` and the request's base fid
 // ("<vid>,<hexkey+cookie>" with any _delta stripped). Wildcard fid claims
-// ("") are accepted, as the filer's tokens use them.
-bool jwt_write_ok(Engine* E, const std::string& auth, const char* fid_path,
-                  size_t fid_len) {
-    if (E->jwt_write_key.empty()) return true;
+// ("") are accepted, as the filer's tokens use them. Shared by the write
+// path (jwt.signing.key) and the read path (jwt.signing.read.key) —
+// `weed/server/volume_server_handlers.go:33-75` checks both the same way.
+bool jwt_fid_ok(const std::string& key, const std::string& auth,
+                const char* fid_path, size_t fid_len) {
+    if (key.empty()) return true;
     if (strncasecmp(auth.c_str(), "BEARER ", 7) != 0) return false;
     const char* tok = auth.c_str() + 7;
     const char* dot1 = strchr(tok, '.');
@@ -1127,9 +1292,8 @@ bool jwt_write_ok(Engine* E, const std::string& auth, const char* fid_path,
     if (!dot2) return false;
     // signature check first (constant-time-ish compare)
     uint8_t want[32], got[40];
-    sw_hmac_sha256((const uint8_t*)E->jwt_write_key.data(),
-                   E->jwt_write_key.size(), (const uint8_t*)tok,
-                   (size_t)(dot2 - tok), want);
+    sw_hmac_sha256((const uint8_t*)key.data(), key.size(),
+                   (const uint8_t*)tok, (size_t)(dot2 - tok), want);
     int got_n = b64url_decode(dot2 + 1, strlen(dot2 + 1), got, sizeof got);
     if (got_n != 32) return false;
     uint8_t diff = 0;
@@ -1225,6 +1389,13 @@ bool handle_assign(Engine* E, Conn* c, const char* query, size_t qlen) {
 void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
               size_t hdr_len, const char* body, size_t body_len) {
     E->stats.requests++;
+    if (!c->cn_ok) {
+        // CA-valid client cert with a disallowed CommonName: same per-request
+        // 403 surface the Python gate produces (httpd.py _dispatch)
+        json_response(c, 403, "Forbidden",
+                      "{\"error\": \"client certificate CN not allowed\"}");
+        return;
+    }
     const char* line_end = (const char*)memchr(req, '\r', hdr_len);
     if (!line_end) { c->want_close = true; return; }
     const char* sp1 = (const char*)memchr(req, ' ', line_end - req);
@@ -1271,7 +1442,17 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
         if (method == "GET" || method == "HEAD") {
             std::string range = find_header(req, he, "range");
             bool multi = range.find(',') != std::string::npos;
-            if (v && !has_query && !multi && !E->secure_reads) {
+            // secure_reads with a key: verify the read JWT natively so
+            // hardened clusters keep the native plane; a missing/invalid
+            // token proxies to Python for its exact 401 body. ?jwt= query
+            // tokens also proxy (has_query), header tokens stay native.
+            bool read_ok = !E->secure_reads;
+            if (!read_ok && !E->jwt_read_key.empty())
+                read_ok = jwt_fid_ok(E->jwt_read_key,
+                                     find_header(req, he, "authorization"),
+                                     path + 1,
+                                     (size_t)(fid_end - path - 1));
+            if (v && !has_query && !multi && read_ok) {
                 if (handle_read(E, c, v, key, cookie, method == "HEAD",
                                 range))
                     return;
@@ -1290,7 +1471,8 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
             }
             bool jwt_ok = true;
             if (!E->jwt_write_key.empty())
-                jwt_ok = jwt_write_ok(E, find_header(req, he, "authorization"),
+                jwt_ok = jwt_fid_ok(E->jwt_write_key,
+                                    find_header(req, he, "authorization"),
                                       path + 1, (size_t)(fid_end - path - 1));
             bool gates_ok = v && !has_query && !exists && jwt_ok &&
                             !E->secure_writes && !v->readonly.load() &&
@@ -1348,7 +1530,8 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
         if (method == "DELETE") {
             bool jwt_ok = true;
             if (!E->jwt_write_key.empty())
-                jwt_ok = jwt_write_ok(E, find_header(req, he, "authorization"),
+                jwt_ok = jwt_fid_ok(E->jwt_write_key,
+                                    find_header(req, he, "authorization"),
                                       path + 1, (size_t)(fid_end - path - 1));
             if (v && !has_query && jwt_ok && !E->secure_writes &&
                 !v->readonly.load() && !v->forward_writes.load()) {
@@ -1376,6 +1559,12 @@ void close_conn(Worker* w, Conn* c) {
             c->upstream->client = nullptr;
             c->upstream = nullptr;
         }
+        if (c->ssl != nullptr) {
+            TlsApi* T = tls_api();
+            T->SSL_shutdown(c->ssl);  // best-effort close_notify
+            T->SSL_free(c->ssl);
+            c->ssl = nullptr;
+        }
         epoll_ctl(w->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
         close(c->fd);
         c->fd = -1;
@@ -1392,10 +1581,11 @@ void close_conn(Worker* w, Conn* c) {
 
 void flush_out(Worker* w, Conn* c) {
     while (c->out_off < c->out.size()) {
-        ssize_t n = send(c->fd, c->out.data() + c->out_off,
-                         c->out.size() - c->out_off, MSG_NOSIGNAL);
+        int n = conn_write(c, c->out.data() + c->out_off,
+                           (int)std::min(c->out.size() - c->out_off,
+                                         (size_t)1 << 20));
         if (n > 0) { c->out_off += n; continue; }
-        if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        if (n == -1) {
             struct epoll_event ev;
             ev.events = EPOLLIN | EPOLLOUT;
             ev.data.ptr = c;
@@ -1513,18 +1703,69 @@ void process_buffered(Engine* E, Worker* w, Conn* c) {
     }
 }
 
+// drive a pending TLS handshake; afterwards either tls_hs==2 (established,
+// CN checked) or the conn is closed or still handshaking (tls_hs==1)
+void tls_handshake_step(Engine* E, Worker* w, Conn* c) {
+    TlsApi* T = tls_api();
+    int r = T->SSL_do_handshake(c->ssl);
+    if (r == 1) {
+        c->tls_hs = 2;
+        if (!E->allowed_cns.empty()) {
+            // per-request 403 on CN mismatch (same surface the Python gate
+            // produces) — the handshake itself already proved CA validity
+            c->cn_ok = false;
+            void* cert = T->SSL_get1_peer_certificate(c->ssl);
+            if (cert != nullptr) {
+                char cn[256] = {0};
+                void* name = T->X509_get_subject_name(cert);
+                if (name != nullptr &&
+                    T->X509_NAME_get_text_by_NID(name, kNID_commonName, cn,
+                                                 sizeof cn) > 0) {
+                    for (const auto& pat : E->allowed_cns)
+                        if (glob_match(pat.c_str(), cn)) {
+                            c->cn_ok = true;
+                            break;
+                        }
+                }
+                T->X509_free(cert);
+            }
+        }
+        struct epoll_event ev;
+        ev.events = EPOLLIN;
+        ev.data.ptr = c;
+        epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+        return;
+    }
+    int e = T->SSL_get_error(c->ssl, r);
+    if (e == kSSL_ERROR_WANT_READ || e == kSSL_ERROR_WANT_WRITE) {
+        struct epoll_event ev;
+        ev.events = e == kSSL_ERROR_WANT_WRITE ? (EPOLLIN | EPOLLOUT)
+                                               : EPOLLIN;
+        ev.data.ptr = c;
+        epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+        return;
+    }
+    close_conn(w, c);  // bad cert, protocol error, or peer gave up
+}
+
 void on_readable(Engine* E, Worker* w, Conn* c) {
     char buf[65536];
     for (;;) {
-        ssize_t n = recv(c->fd, buf, sizeof buf, 0);
+        int n = conn_read(c, buf, sizeof buf);
         if (n > 0) {
             c->in.append(buf, n);
             if (c->in.size() > (1ull << 31)) { close_conn(w, c); return; }
             continue;
         }
-        if (n == 0) { close_conn(w, c); return; }
-        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
-        close_conn(w, c);
+        if (n == -1) break;
+        if (n == -3) {  // SSL_read blocked on WRITE: wake on writability
+            struct epoll_event ev;
+            ev.events = EPOLLIN | EPOLLOUT;
+            ev.data.ptr = c;
+            epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+            break;
+        }
+        close_conn(w, c);  // EOF or error
         return;
     }
     c->last_active = time(nullptr);
@@ -1552,11 +1793,19 @@ void* worker_main(void* arg) {
             Conn* c = (Conn*)evs[i].data.ptr;
             if (c->fd < 0) continue;  // closed earlier in this batch
             if (evs[i].events & (EPOLLHUP | EPOLLERR)) { close_conn(w, c); continue; }
+            if (c->tls_hs == 1) {
+                tls_handshake_step(E, w, c);
+                if (c->fd < 0 || c->tls_hs != 2) continue;
+                // fall through: the handshake's last flight may have
+                // arrived together with the first request bytes
+            }
             if (evs[i].events & EPOLLOUT) {
                 flush_out(w, c);
                 if (c->fd < 0) continue;
             }
-            if (evs[i].events & EPOLLIN) on_readable(E, w, c);
+            // EPOLLOUT (without EPOLLIN) also retries reads: a TLS read
+            // that blocked on WRITE (conn_read -3) resumes on writability
+            if (evs[i].events & (EPOLLIN | EPOLLOUT)) on_readable(E, w, c);
         }
         {
             std::lock_guard<std::mutex> l(w->conns_mu);
@@ -1614,7 +1863,11 @@ void* worker_main(void* arg) {
     }
     {
         std::lock_guard<std::mutex> l(w->conns_mu);
-        for (auto* c : w->conns) { if (c->fd >= 0) close(c->fd); delete c; }
+        for (auto* c : w->conns) {
+            if (c->ssl != nullptr) tls_api()->SSL_free(c->ssl);
+            if (c->fd >= 0) close(c->fd);
+            delete c;
+        }
         w->conns.clear();
         for (auto* c : w->graveyard) delete c;
         w->graveyard.clear();
@@ -1651,6 +1904,14 @@ void* accept_main(void* arg) {
         Conn* c = new Conn();
         c->fd = fd;
         c->last_active = time(nullptr);
+        if (E->tls_ctx != nullptr) {
+            TlsApi* T = tls_api();
+            c->ssl = T->SSL_new(E->tls_ctx);
+            if (c->ssl == nullptr) { close(fd); delete c; continue; }
+            T->SSL_set_fd(c->ssl, fd);
+            T->SSL_set_accept_state(c->ssl);
+            c->tls_hs = 1;  // handshake driven by epoll events
+        }
         struct epoll_event ev;
         ev.events = EPOLLIN;
         ev.data.ptr = c;
@@ -1671,13 +1932,45 @@ void* accept_main(void* arg) {
 
 extern "C" {
 
-// returns an engine handle (>=0); the bound port comes from sw_fl_port()
+// returns an engine handle (>=0); the bound port comes from sw_fl_port().
+// tls_cert non-empty turns on engine-terminated mTLS (client certs
+// REQUIRED, CA = tls_ca, optional comma-separated '*'-glob CN allow-list);
+// -4/-5 = TLS requested but unavailable/misconfigured, so the caller can
+// fall back to serving TLS from Python.
 int sw_fl_start(const char* host, int port, const char* backend_host,
                 int backend_port, int workers, int secure_reads,
                 int secure_writes, int max_backend,
-                const char* jwt_write_key) {
+                const char* jwt_write_key, const char* jwt_read_key,
+                const char* tls_cert, const char* tls_key,
+                const char* tls_ca, const char* tls_allowed_cns) {
+    void* tls_ctx = nullptr;
+    if (tls_cert && *tls_cert) {
+        TlsApi* T = tls_api();
+        if (T == nullptr) return -4;  // no OpenSSL runtime on this host
+        tls_ctx = T->SSL_CTX_new(T->TLS_server_method());
+        if (tls_ctx == nullptr) return -4;
+        if (T->SSL_CTX_use_certificate_chain_file(tls_ctx, tls_cert) != 1 ||
+            T->SSL_CTX_use_PrivateKey_file(tls_ctx, tls_key,
+                                           kSSL_FILETYPE_PEM) != 1 ||
+            (tls_ca && *tls_ca &&
+             T->SSL_CTX_load_verify_locations(tls_ctx, tls_ca, nullptr) != 1)) {
+            T->SSL_CTX_free(tls_ctx);
+            return -5;
+        }
+        T->SSL_CTX_set_verify(
+            tls_ctx, kSSL_VERIFY_PEER | kSSL_VERIFY_FAIL_IF_NO_PEER_CERT,
+            nullptr);
+        // partial writes: flush_out retries from a moving offset
+        T->SSL_CTX_ctrl(tls_ctx, kSSL_CTRL_MODE,
+                        kSSL_MODE_ENABLE_PARTIAL_WRITE |
+                            kSSL_MODE_ACCEPT_MOVING_WRITE_BUFFER,
+                        nullptr);
+    }
     int fd = socket(AF_INET, SOCK_STREAM, 0);
-    if (fd < 0) return -2;
+    if (fd < 0) {
+        if (tls_ctx) tls_api()->SSL_CTX_free(tls_ctx);
+        return -2;
+    }
     int one = 1;
     setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
     struct sockaddr_in sa;
@@ -1688,6 +1981,7 @@ int sw_fl_start(const char* host, int port, const char* backend_host,
     if (bind(fd, (struct sockaddr*)&sa, sizeof sa) != 0 ||
         listen(fd, 1024) != 0) {
         close(fd);
+        if (tls_ctx) tls_api()->SSL_CTX_free(tls_ctx);
         return -3;
     }
     socklen_t sl = sizeof sa;
@@ -1705,9 +1999,22 @@ int sw_fl_start(const char* host, int port, const char* backend_host,
     E->secure_reads = secure_reads != 0;
     E->secure_writes = secure_writes != 0;
     if (max_backend > 0) E->max_backend = (size_t)max_backend;
-    // fixed before any worker/accept thread exists: workers read it
+    // fixed before any worker/accept thread exists: workers read these
     // lock-free on the request path
     if (jwt_write_key && *jwt_write_key) E->jwt_write_key = jwt_write_key;
+    if (jwt_read_key && *jwt_read_key) E->jwt_read_key = jwt_read_key;
+    E->tls_ctx = tls_ctx;
+    if (tls_allowed_cns && *tls_allowed_cns) {
+        const char* p = tls_allowed_cns;
+        while (*p) {
+            const char* comma = strchr(p, ',');
+            size_t n = comma ? (size_t)(comma - p) : strlen(p);
+            while (n > 0 && (*p == ' ' || *p == '\t')) { p++; n--; }
+            while (n > 0 && (p[n - 1] == ' ' || p[n - 1] == '\t')) n--;
+            if (n > 0) E->allowed_cns.emplace_back(p, n);
+            p = comma ? comma + 1 : p + n;
+        }
+    }
     if (workers < 1) workers = 2;
     if (workers > 32) workers = 32;
     E->workers.resize(workers);
@@ -1744,6 +2051,7 @@ void sw_fl_stop(int h) {
         pthread_join(w.thread, nullptr);
         close(w.epfd);
     }
+    if (E->tls_ctx != nullptr) tls_api()->SSL_CTX_free(E->tls_ctx);
     delete E;
 }
 

@@ -33,7 +33,9 @@ extern "C" {
 int sw_fl_start(const char* host, int port, const char* backend_host,
                 int backend_port, int workers, int secure_reads,
                 int secure_writes, int max_backend,
-                const char* jwt_write_key);
+                const char* jwt_write_key, const char* jwt_read_key,
+                const char* tls_cert, const char* tls_key,
+                const char* tls_ca, const char* tls_allowed_cns);
 int sw_fl_port(int h);
 void sw_fl_stop(int h);
 int sw_fl_register_volume(int h, uint32_t vid, int dat_fd, int idx_fd,
@@ -149,7 +151,7 @@ int main() {
     std::thread bt(backend_loop, backend_fd, &running);
 
     int h = sw_fl_start("127.0.0.1", 0, "127.0.0.1", backend_port, 4, 0, 0,
-                        8, "");
+                        8, "", "", "", "", "", "");
     if (h < 0) { fprintf(stderr, "engine start failed\n"); return 1; }
     int port = sw_fl_port(h);
 

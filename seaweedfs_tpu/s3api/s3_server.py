@@ -137,7 +137,8 @@ class S3Server:
     @property
     def url(self) -> str:
         if getattr(self, "fastlane", None) is not None:
-            return f"http://{self.service.host}:{self.fastlane.port}"
+            scheme = "https" if self.fastlane.tls else "http"
+            return f"{scheme}://{self.service.host}:{self.fastlane.port}"
         return self.service.url
 
     # --- IAM config hot reload (`auth_credentials_subscribe.go`) ---------------

@@ -47,12 +47,13 @@ class TLSConfig:
 _SERVER_CTX: ssl.SSLContext | None = None
 _CLIENT_CTX: ssl.SSLContext | None = None
 _ALLOWED_CNS: list[str] = []
+_CFG: TLSConfig | None = None  # file paths retained for the native engine
 
 
 def configure(cfg: TLSConfig) -> None:
     """Install mutual TLS process-wide (like the reference's security.toml:
     every listener and every outbound client in the process)."""
-    global _SERVER_CTX, _CLIENT_CTX, _ALLOWED_CNS
+    global _SERVER_CTX, _CLIENT_CTX, _ALLOWED_CNS, _CFG
     if cfg.partially_set:
         # fail CLOSED: a typo'd [tls] section must not silently run the
         # cluster as plaintext HTTP (the reference errors on cert-load
@@ -75,6 +76,7 @@ def configure(cfg: TLSConfig) -> None:
     client.verify_mode = ssl.CERT_REQUIRED
     _SERVER_CTX = server
     _CLIENT_CTX = client
+    _CFG = cfg
     _ALLOWED_CNS = [
         compile_cn_pattern(s.strip())
         for s in cfg.allowed_common_names.split(",")
@@ -83,10 +85,17 @@ def configure(cfg: TLSConfig) -> None:
 
 
 def reset() -> None:
-    global _SERVER_CTX, _CLIENT_CTX, _ALLOWED_CNS
+    global _SERVER_CTX, _CLIENT_CTX, _ALLOWED_CNS, _CFG
     _SERVER_CTX = None
     _CLIENT_CTX = None
     _ALLOWED_CNS = []
+    _CFG = None
+
+
+def current_config() -> TLSConfig | None:
+    """The installed TLSConfig (file paths included) — the native engine
+    loads certs itself, so it needs paths, not wrapped SSLContexts."""
+    return _CFG
 
 
 def server_context() -> ssl.SSLContext | None:

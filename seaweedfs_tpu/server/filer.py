@@ -73,7 +73,8 @@ class FilerServer:
         self.security = security or SecurityConfig()
         self.filer = Filer(make_store(store_kind, store_path))
         self.filer.notification_queue = notification_queue
-        self.client = WeedClient(master_url, jwt_key=self.security.write_key)
+        self.client = WeedClient(master_url, jwt_key=self.security.write_key,
+                                 read_jwt_key=self.security.read_key)
         self.chunk_size = chunk_size_mb * 1024 * 1024
         self.default_replication = default_replication
         self.collection = collection
@@ -186,7 +187,8 @@ class FilerServer:
     @property
     def url(self) -> str:
         if getattr(self, "fastlane", None) is not None:
-            return f"http://{self.service.host}:{self.fastlane.port}"
+            scheme = "https" if self.fastlane.tls else "http"
+            return f"{scheme}://{self.service.host}:{self.fastlane.port}"
         return self.service.url
 
     # --- upload pipeline --------------------------------------------------------

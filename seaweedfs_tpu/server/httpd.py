@@ -276,11 +276,16 @@ class HTTPService:
             do_OPTIONS = do_PROPFIND = do_PROPPATCH = do_MKCOL = _handle
             do_MOVE = do_COPY = do_LOCK = do_UNLOCK = _handle
 
-        ctx = _tls.server_context()
+        # plain_backend: this listener sits BEHIND the native engine, which
+        # terminates mTLS and enforces the CN gate itself; serve plaintext
+        # on loopback only (never on an external interface)
+        plain_backend = getattr(self, "plain_backend", False)
+        ctx = None if plain_backend else _tls.server_context()
         self._tls_on = ctx is not None
         self._allowed_cns = _tls.allowed_cn_patterns()
+        bind_host = "127.0.0.1" if plain_backend else self.host
         if ctx is None:
-            self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+            self._httpd = ThreadingHTTPServer((bind_host, self.port), Handler)
         else:
             # mTLS on every listener (`weed/security/tls.go` semantics).
             # The accepted socket is wrapped WITHOUT handshaking: the

@@ -73,27 +73,16 @@ class MasterServer:
         without the GIL: Python installs per-query volume-set profiles with
         leased file-key ranges (do_assign), the engine mints fids from them,
         and anything else (or a spent/missing profile) proxies back here."""
-        from seaweedfs_tpu.security import tls as _tlsmod
         from seaweedfs_tpu.storage import fastlane as fl_mod
 
-        requested = self.service.port
-        if (
-            not fl_mod.available()
-            or self.security.white_list
-            or self.security.write_key  # assigns carry JWTs: Python only
-            or _tlsmod.server_context() is not None
-        ):
-            self.service.start()
-            return
-        self.service.port = 0
-        self.service.start()
-        self.fastlane = fl_mod.Fastlane.start(
-            self.service.host, requested, self.service.port,
+        # write_key counts as a bail-out: assigns mint per-fid JWTs, which
+        # only the Python handler can sign. mTLS does NOT bail — the engine
+        # terminates it natively (front_service's TLS branch).
+        self.fastlane = fl_mod.front_service(
+            self.service,
+            guard_active=bool(self.security.white_list
+                              or self.security.write_key),
         )
-        if self.fastlane is None:
-            self.service.stop()
-            self.service.port = requested
-            self.service.start()
 
     def start(self) -> None:
         self._start_fastlane()
@@ -237,7 +226,8 @@ class MasterServer:
     @property
     def url(self) -> str:
         if getattr(self, "fastlane", None) is not None:
-            return f"http://{self.service.host}:{self.fastlane.port}"
+            scheme = "https" if self.fastlane.tls else "http"
+            return f"{scheme}://{self.service.host}:{self.fastlane.port}"
         return self.service.url
 
     def _maintenance_loop(self) -> None:

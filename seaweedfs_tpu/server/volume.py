@@ -75,13 +75,15 @@ class VolumeServer:
         cores and proxies everything else here."""
         from seaweedfs_tpu.storage import fastlane as fl_mod
 
-        # the write key rides into sw_fl_start so it is in place before
-        # the engine accepts its first connection: writes stay native when
-        # the token verifies; invalid/missing tokens proxy to Python for
-        # the exact 401 (reads carry no JWT check in the Python path)
+        # the signing keys ride into sw_fl_start so they are in place before
+        # the engine accepts its first connection: reads/writes stay native
+        # when the token verifies; invalid/missing tokens proxy to Python
+        # for the exact 401
         self.fastlane = fl_mod.front_service(
             self.service, guard_active=bool(self.security.white_list),
             jwt_write_key=self.security.write_key or "",
+            jwt_read_key=self.security.read_key or "",
+            secure_reads=bool(self.security.read_key),
         )
 
     @property
@@ -135,7 +137,8 @@ class VolumeServer:
     @property
     def url(self) -> str:
         if self.fastlane:
-            return f"http://{self._host}:{self.fastlane.port}"
+            scheme = "https" if self.fastlane.tls else "http"
+            return f"{scheme}://{self._host}:{self.fastlane.port}"
         return self.service.url
 
     # --- fastlane lifecycle -----------------------------------------------------
@@ -901,6 +904,10 @@ class VolumeServer:
                 key, cookie = parse_key_hash_with_delta(rest)
             except (ValueError, AttributeError):
                 return Response({"error": f"bad fid {fid!r}"}, 400)
+            # /query returns needle CONTENT: it is a read and must demand
+            # the same token the GET path does, or secured reads leak
+            if not self._file_jwt_ok(req, self.security.read_key, fid):
+                return Response({"error": "unauthorized"}, 401)
             try:
                 n = self._store_read(vid, key, cookie)
             except (NotFound, VolumeError) as e:
@@ -985,6 +992,8 @@ class VolumeServer:
             vid, key, cookie = self._parse_fid(req)
         except ValueError as e:
             return Response({"error": str(e)}, 400)
+        if not self._check_read_jwt(req):
+            return Response({"error": "unauthorized"}, 401)
         try:
             n = self._store_read(vid, key, cookie)
         except NotFound:
@@ -1056,17 +1065,31 @@ class VolumeServer:
             return Response(b"", status, headers, content_type=mime)
         return Response(data, status, headers, content_type=mime)
 
-    def _check_write_jwt(self, req: Request) -> bool:
-        """Demand the master-signed per-fileId token when a signing key is
-        configured (`volume_server_handlers.go:33-75` maybeCheckJwtAuthorization)."""
-        if not self.security.write_key:
+    def _file_jwt_ok(self, req: Request, key: str, fid: str) -> bool:
+        """One fid-bound token check for reads AND writes
+        (`volume_server_handlers.go:33-75` maybeCheckJwtAuthorization),
+        shared so the claim-matching rule cannot drift between the two —
+        or from the engine's native jwt_fid_ok (fastlane.cpp), which strips
+        both the multi-count `_N` suffix and any `.ext` the same way."""
+        if not key:
             return True
-        # multi-count assignments append _N to the fid; the master signed the
-        # base fid, so verify against that (weed/operation assign_file_id)
-        base = req.match.group(2).split("_")[0]
-        fid = f"{req.match.group(1)},{base}"
+        base = fid.split("_")[0].split(".")[0]
         token = token_from_request(req.headers, req.query)
-        return verify_file_jwt(self.security.write_key, token, fid)
+        return verify_file_jwt(key, token, base)
+
+    def _check_read_jwt(self, req: Request) -> bool:
+        """Demand a read token when jwt.signing.read is configured —
+        `volume_server_handlers.go:33-46` (GET/HEAD). The engine verifies
+        the same tokens natively (fastlane.cpp jwt_fid_ok) so secured reads
+        stay on the native plane; this is the proxy/fallback path."""
+        fid = f"{req.match.group(1)},{req.match.group(2)}"
+        return self._file_jwt_ok(req, self.security.read_key, fid)
+
+    def _check_write_jwt(self, req: Request) -> bool:
+        # multi-count assignments append _N to the fid; the master signed
+        # the base fid (weed/operation assign_file_id)
+        fid = f"{req.match.group(1)},{req.match.group(2)}"
+        return self._file_jwt_ok(req, self.security.write_key, fid)
 
     def _do_write(self, req: Request) -> Response:
         if self.fastlane:  # overwrite checks need the engine's appends applied

@@ -34,7 +34,8 @@ def _bind(lib) -> bool:
         lib.sw_fl_start.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
         ]
         lib.sw_fl_volume_serving.restype = ctypes.c_int
         lib.sw_fl_volume_serving.argtypes = [ctypes.c_int, ctypes.c_uint32]
@@ -146,9 +147,10 @@ class VolumeHook:
 
 
 class Fastlane:
-    def __init__(self, lib, handle: int) -> None:
+    def __init__(self, lib, handle: int, tls: bool = False) -> None:
         self._lib = lib
         self.handle = handle
+        self.tls = tls  # engine terminates mTLS itself: URLs are https
         self.port = int(lib.sw_fl_port(handle))
         self._volumes: dict[int, object] = {}  # vid -> Volume (drain target)
         self._drain_mu = threading.Lock()
@@ -158,7 +160,9 @@ class Fastlane:
     def start(host: str, port: int, backend_port: int, workers: int = 0,
               secure_reads: bool = False, secure_writes: bool = False,
               backend_host: str = "", max_backend: int = 0,
-              jwt_write_key: str = "") -> "Fastlane | None":
+              jwt_write_key: str = "", jwt_read_key: str = "",
+              tls_cert: str = "", tls_key: str = "", tls_ca: str = "",
+              tls_allowed_cns: str = "") -> "Fastlane | None":
         lib = _get_lib()
         if lib is None:
             return None
@@ -169,10 +173,12 @@ class Fastlane:
                                 workers,
                                 1 if secure_reads else 0,
                                 1 if secure_writes else 0, max_backend,
-                                jwt_write_key.encode()))
+                                jwt_write_key.encode(), jwt_read_key.encode(),
+                                tls_cert.encode(), tls_key.encode(),
+                                tls_ca.encode(), tls_allowed_cns.encode()))
         if h < 0:
             return None
-        return Fastlane(lib, h)
+        return Fastlane(lib, h, tls=bool(tls_cert))
 
     def stop(self) -> None:
         self._lib.sw_fl_stop(self.handle)
@@ -305,32 +311,48 @@ class Fastlane:
 
 def front_service(service, guard_active: bool = False, workers: int = 0,
                   max_backend: int = 0, secure_reads: bool = False,
-                  secure_writes: bool = False,
-                  jwt_write_key: str = "") -> "Fastlane | None":
+                  secure_writes: bool = False, jwt_write_key: str = "",
+                  jwt_read_key: str = "") -> "Fastlane | None":
     """Start `service` (an HTTPService) behind an engine front when the
     environment allows, else plainly on its requested port. Shared by the
-    volume, filer, and S3 servers — one copy of the gate checks and the
-    ephemeral-backend/bind-fallback dance. Returns the engine or None;
-    the service is started either way."""
+    master, volume, filer, and S3 servers — one copy of the gate checks and
+    the ephemeral-backend/bind-fallback dance. Returns the engine or None;
+    the service is started either way.
+
+    With process-wide mTLS configured (`weed/security/tls.go` semantics)
+    the ENGINE terminates TLS: client certs are required against the CA,
+    the CommonName allow-list is enforced per request in C++, and the
+    Python backend listens in plaintext on loopback only (the engine is
+    the sole front door — same trust model as the reference's
+    -filer.localSocket plaintext listener for same-host peers)."""
     from seaweedfs_tpu.security import tls as _tlsmod
 
     requested = service.port
-    if (
-        not available()
-        or guard_active
-        or _tlsmod.server_context() is not None  # engine is plain TCP
-    ):
+    tls_cfg = _tlsmod.current_config()
+    if not available() or guard_active:
         service.start()
         return None
+    tls_kwargs = {}
+    if tls_cfg is not None and tls_cfg.enabled:
+        service.plain_backend = True  # engine owns the TLS handshake
+        tls_kwargs = dict(
+            backend_host="127.0.0.1",
+            tls_cert=tls_cfg.cert, tls_key=tls_cfg.key, tls_ca=tls_cfg.ca,
+            tls_allowed_cns=tls_cfg.allowed_common_names,
+        )
     service.port = 0
     service.start()
     engine = Fastlane.start(
         service.host, requested, service.port, workers=workers,
         secure_reads=secure_reads, secure_writes=secure_writes,
         max_backend=max_backend, jwt_write_key=jwt_write_key,
+        jwt_read_key=jwt_read_key, **tls_kwargs,
     )
-    if engine is None:  # bind failure: plain Python on the requested port
+    if engine is None:
+        # bind failure / no OpenSSL runtime / bad certs: Python serves
+        # (with TLS itself, when configured) on the requested port
         service.stop()
+        service.plain_backend = False
         service.port = requested
         service.start()
     return engine

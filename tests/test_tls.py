@@ -149,6 +149,24 @@ def test_mtls_cluster_end_to_end(pki, tmp_path):
             f"{master.url}/dir/assign", context=good, timeout=5
         ).read()
         assert b"fid" in out
+
+        # the ENGINE terminates TLS (VERDICT r4 next #2): a hardened
+        # cluster must keep the native data plane, not fall back to the
+        # Python proxy. Direct volume write+read over mTLS must bump the
+        # engine's native counters.
+        if vol.fastlane is not None:
+            import json as _json
+
+            a = _json.loads(out)
+            url = f"https://{a['publicUrl']}/{a['fid']}"
+            req = urllib.request.Request(url, data=b"tls-native",
+                                         method="POST")
+            assert urllib.request.urlopen(req, context=good,
+                                          timeout=5).status == 201
+            got = urllib.request.urlopen(url, context=good, timeout=5)
+            assert got.read() == b"tls-native"
+            st = vol.fastlane.stats()
+            assert st["native_writes"] >= 1 and st["native_reads"] >= 1
     finally:
         filer.stop()
         vol.stop()
