@@ -104,6 +104,14 @@ class WeedClient:
             self._vid_cache[vid] = (now + self.cache_ttl, urls)
         return urls
 
+    def lookup_cached(self, vid: int) -> list[str] | None:
+        """Cache-only peek: never touches the network. For callers running
+        under locks that must not block on master latency."""
+        now = time.time()
+        with self._lock:
+            hit = self._vid_cache.get(vid)
+            return hit[1] if hit and hit[0] > now else None
+
     def lookup_file_id(self, file_id: str) -> list[str]:
         vid = int(file_id.split(",")[0])
         return [f"{peer_url(u)}/{file_id}" for u in self.lookup(vid)]

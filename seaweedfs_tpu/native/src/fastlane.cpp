@@ -54,6 +54,9 @@ extern "C" uint32_t sw_crc32c_update(uint32_t crc, const char* data, size_t len)
 extern "C" void sw_hmac_sha256(const uint8_t* key, size_t key_len,
                                const uint8_t* data, size_t len,
                                uint8_t out[32]);
+extern "C" void sw_md5_batch_var(const unsigned char* const* ptrs,
+                                 const size_t* lens, size_t n,
+                                 unsigned char* out);
 
 namespace {
 
@@ -322,9 +325,11 @@ struct Conn {
     bool cn_ok = true;    // false: CA-valid cert, disallowed CommonName
 };
 
-// One in-flight proxied request to the Python backend. The worker never
-// blocks on it: the backend socket sits in the same epoll and this struct
-// is the parse state machine for its response.
+// One in-flight upstream request. The worker never blocks on it: the
+// upstream socket sits in the same epoll and this struct is the parse
+// state machine for its response. Targets the Python backend by default;
+// filer mode also points these at volume servers (chunk uploads, read
+// relays) — `mode` picks the completion handler.
 struct BackendConn {
     int kind = 1;
     int fd = -1;
@@ -341,11 +346,21 @@ struct BackendConn {
     bool backend_close = false;
     bool retried = false;
     time_t started = 0;
+    uint32_t target_ip = 0;   // 0 = engine's default Python backend
+    int target_port = 0;
+    int mode = 0;             // 0 proxy, 1 filer chunk upload, 2 filer relay
+    // filer-write context (mode 1) / relay fallback (mode 2)
+    std::string f_path, f_fid, f_mime, f_md5hex;
+    uint64_t f_size = 0;
+    uint64_t f_mtime = 0;
+    std::string client_req;   // original client request (mode-2 fallback)
 };
 
 struct Worker {
     int epfd = -1;
     std::vector<int> idle_backends;   // keep-alive conns to Python, not in epoll
+    // keep-alive conns to other targets (volume servers), keyed ip<<16|port
+    std::unordered_map<uint64_t, std::vector<int>> idle_targets;
     std::vector<BackendConn*> pending;  // in-flight proxied requests
     size_t capped_inflight = 0;         // pending entries counted under the cap
     std::deque<BackendConn*> waiting;   // queued: backend concurrency capped
@@ -365,6 +380,38 @@ struct AssignProfile {
     std::atomic<uint64_t> next_key{0};
     uint64_t end_key = 0;
     std::atomic<uint64_t> rr{0};
+};
+
+// ---------------------------------------------------------------------------
+// filer mode: native small-file write path + path->location read cache
+// (VERDICT r4 next #3 — the filer was GIL-capped at ~3k req/s while the
+// volume plane it feeds does 60k/95k). Reference hot path:
+// `weed/server/filer_server_handlers_write_autochunk.go:26-155`.
+// ---------------------------------------------------------------------------
+
+// one cached file location: either inline bytes (small content, served
+// straight from memory) or a single plain chunk on a volume server
+// (served by natively relaying to that server's engine)
+struct FilerCacheEnt {
+    uint32_t ip = 0;
+    int port = 0;
+    std::string fid;
+    std::string inline_data;  // non-empty => inline entry
+    std::string mime, md5_hex;
+    uint64_t size = 0;
+    uint64_t mtime = 0;  // seconds
+};
+
+// leased fid range from the master (one /dir/assign?count=N): the engine
+// mints fids locally so a native write costs zero master round-trips
+struct FilerLease {
+    uint32_t vol_ip = 0;
+    int vol_port = 0;
+    uint32_t vid = 0;
+    uint32_t cookie = 0;
+    std::atomic<uint64_t> next_key{0};
+    uint64_t end_key = 0;
+    std::string auth;  // Authorization value for uploads ("" = none)
 };
 
 struct Engine {
@@ -391,6 +438,24 @@ struct Engine {
     std::mutex ev_mu;
     std::deque<Event> events;
     Stats stats;
+
+    // --- filer mode ---
+    std::atomic<bool> filer_mode{false};
+    size_t filer_chunk_limit = 4 << 20;  // larger bodies proxy (multi-chunk)
+    size_t filer_inline_limit = 2048;    // SMALL_CONTENT_LIMIT (filer.py)
+    bool filer_compress = false;  // Python would compress some mimes >inline
+    int filer_journal_fd = -1;
+    std::mutex filer_mu;                 // journal append + event frames
+    std::deque<std::string> filer_events;
+    size_t filer_events_bytes = 0;
+    std::shared_mutex fcache_mu;
+    std::unordered_map<std::string, std::shared_ptr<FilerCacheEnt>> fcache;
+    size_t fcache_inline_bytes = 0;
+    std::deque<std::string> fcache_fifo;  // inline eviction order
+    std::shared_mutex flease_mu;
+    std::shared_ptr<FilerLease> flease;
+    std::string filer_read_auth;  // wildcard read JWT for relays (guarded
+                                  // by flease_mu; refreshed with the lease)
 
     // any-state lookup (registration plumbing)
     std::shared_ptr<Vol> vol_raw(uint32_t vid) {
@@ -563,6 +628,34 @@ int64_t actual_size(int32_t size, int version) {
     return 16 + size + 4 + (version == 3 ? 8 : 0) + padding_len(size, version);
 }
 
+// RFC 7233 single-range parse shared by every native read surface.
+// Returns 0 valid (start/end set), -1 unintelligible (serve full entity,
+// both the Python handlers and handle_read ignore such specs), 1 valid
+// syntax but unsatisfiable (start past end after clamping).
+int parse_range_spec(const std::string& range, uint64_t total,
+                     long long* start, long long* end) {
+    if (range.rfind("bytes=", 0) != 0) return -1;
+    const char* spec = range.c_str() + 6;
+    const char* dash = strchr(spec, '-');
+    if (dash == nullptr) return -1;
+    for (const char* q = spec; q < dash; q++)
+        if (!isdigit((unsigned char)*q)) return -1;
+    for (const char* q = dash + 1; *q; q++)
+        if (!isdigit((unsigned char)*q)) return -1;
+    if (dash == spec && !*(dash + 1)) return -1;  // bare "bytes=-"
+    if (dash != spec) {  // "start-" or "start-end"
+        *start = atoll(spec);
+        *end = *(dash + 1) ? atoll(dash + 1) : (long long)total - 1;
+    } else {  // "-suffix": last N bytes
+        long long sfx = atoll(dash + 1);
+        *start = (long long)total - sfx;
+        if (*start < 0) *start = 0;
+        *end = (long long)total - 1;
+    }
+    if (*end > (long long)total - 1) *end = (long long)total - 1;
+    return *start <= *end ? 0 : 1;
+}
+
 void append_response(Conn* c, int status, const char* reason,
                      const std::string& ctype,
                      const std::string& extra_headers,
@@ -709,41 +802,18 @@ bool handle_read(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
     int status = 200;
     const char* out_p = (const char*)data;
     size_t out_n = data_size;
-    if (!range.empty() && range.rfind("bytes=", 0) == 0) {
-        const char* spec = range.c_str() + 6;
-        const char* dash = strchr(spec, '-');
-        // RFC 7233: ignore unintelligible specs (non-numeric parts) —
-        // the Python handler applies the same rule
-        bool valid = dash != nullptr;
-        for (const char* q = spec; valid && q < dash; q++)
-            if (!isdigit((unsigned char)*q)) valid = false;
-        for (const char* q = dash ? dash + 1 : spec; valid && *q; q++)
-            if (!isdigit((unsigned char)*q)) valid = false;
-        if (valid && dash == spec && !*(dash + 1))
-            valid = false;  // bare "bytes=-"
-        if (valid) {
-            long long start, end;
-            if (dash != spec) {  // "start-" or "start-end"
-                start = atoll(spec);
-                end = *(dash + 1) ? atoll(dash + 1)
-                                  : (long long)data_size - 1;
-            } else {             // "-suffix": last N bytes
-                long long sfx = atoll(dash + 1);
-                start = (long long)data_size - sfx;
-                if (start < 0) start = 0;
-                end = (long long)data_size - 1;
-            }
-            if (end > (long long)data_size - 1) end = (long long)data_size - 1;
-            if (start <= end) {
-                char cr[96];
-                snprintf(cr, sizeof cr,
-                         "Content-Range: bytes %lld-%lld/%u\r\n", start, end,
-                         data_size);
-                extra += cr;
-                out_p = (const char*)data + start;
-                out_n = (size_t)(end - start + 1);
-                status = 206;
-            }
+    if (!range.empty()) {
+        long long start, end;
+        // unintelligible or unsatisfiable specs serve the full entity
+        // (volume.py _do_read applies the same rule)
+        if (parse_range_spec(range, data_size, &start, &end) == 0) {
+            char cr[96];
+            snprintf(cr, sizeof cr, "Content-Range: bytes %lld-%lld/%u\r\n",
+                     start, end, data_size);
+            extra += cr;
+            out_p = (const char*)data + start;
+            out_n = (size_t)(end - start + 1);
+            status = 206;
         }
     }
     if (head) {
@@ -1016,31 +1086,45 @@ void backend_finish(Worker* w, BackendConn* b, bool reusable) {
         }
     if (b->fd >= 0) {
         epoll_ctl(w->epfd, EPOLL_CTL_DEL, b->fd, nullptr);
-        if (reusable && w->idle_backends.size() < 8)
+        if (b->target_ip != 0) {  // non-default target: pool per (ip,port)
+            auto& pool = w->idle_targets[((uint64_t)b->target_ip << 16) |
+                                         (uint16_t)b->target_port];
+            if (reusable && pool.size() < 8)
+                pool.push_back(b->fd);
+            else
+                close(b->fd);
+        } else if (reusable && w->idle_backends.size() < 8) {
             w->idle_backends.push_back(b->fd);
-        else
+        } else {
             close(b->fd);
+        }
         b->fd = -1;
     }
     w->back_graveyard.push_back(b);
 }
 
-// launch (or relaunch, on retry) the backend request; never blocks
+// launch (or relaunch, on retry) the upstream request; never blocks
 bool backend_launch(Engine* E, Worker* w, BackendConn* b) {
+    uint32_t ip = b->target_ip ? b->target_ip : E->backend_ip;
+    int port = b->target_ip ? b->target_port : E->backend_port;
+    std::vector<int>* pool = &w->idle_backends;
+    if (b->target_ip != 0)
+        pool = &w->idle_targets[((uint64_t)b->target_ip << 16) |
+                                (uint16_t)b->target_port];
     int fd = -1;
-    while (!w->idle_backends.empty()) {  // pooled keep-alive conn if healthy
-        fd = w->idle_backends.back();
-        w->idle_backends.pop_back();
+    while (!pool->empty()) {  // pooled keep-alive conn if healthy
+        fd = pool->back();
+        pool->pop_back();
         char probe;
         ssize_t r = recv(fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
         if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
-            close(fd);  // backend silently closed this pooled conn
+            close(fd);  // peer silently closed this pooled conn
             fd = -1;
             continue;
         }
         break;
     }
-    if (fd < 0) fd = backend_connect(E->backend_ip, E->backend_port);
+    if (fd < 0) fd = backend_connect(ip, port);
     if (fd < 0) return false;
     b->fd = fd;
     b->req_off = 0;
@@ -1117,10 +1201,15 @@ void drain_waiting(Engine* E, Worker* w) {
     }
 }
 
-// deliver the completed (or failed) proxy response to the client and resume
-// its request pipeline
+void filer_upload_finish(Engine* E, Worker* w, BackendConn* b, bool ok);
+void filer_relay_finish(Engine* E, Worker* w, BackendConn* b, bool ok);
+
+// deliver the completed (or failed) upstream response and resume the
+// client's request pipeline; filer-mode conns have their own finishers
 void backend_complete(Engine* E, Worker* w, BackendConn* b, bool ok,
                       bool client_keep, bool reusable) {
+    if (b->mode == 1) { filer_upload_finish(E, w, b, ok); return; }
+    if (b->mode == 2) { filer_relay_finish(E, w, b, ok); return; }
     Conn* c = b->client;
     if (c != nullptr) {
         c->upstream = nullptr;
@@ -1381,6 +1470,466 @@ bool handle_assign(Engine* E, Conn* c, const char* query, size_t qlen) {
 }
 
 // ---------------------------------------------------------------------------
+// filer-mode plumbing
+// ---------------------------------------------------------------------------
+
+// entry frame, shared by the journal (crash replay) and the Python drain:
+// u32 frame_len | u8 kind (0 chunk, 1 inline) | u8 pad[3] | u64 size |
+// u64 mtime_sec | char md5_hex[32] | u16 path_len | u16 fid_len |
+// u16 mime_len | u16 content_len | path | fid | mime | content
+std::string filer_frame(uint8_t kind, uint64_t size, uint64_t mtime,
+                        const char md5_hex[32], const std::string& path,
+                        const std::string& fid, const std::string& mime,
+                        const char* content, size_t content_len) {
+    uint32_t total = 4 + 4 + 8 + 8 + 32 + 8 + (uint32_t)path.size() +
+                     (uint32_t)fid.size() + (uint32_t)mime.size() +
+                     (uint32_t)content_len;
+    std::string f;
+    f.reserve(total);
+    auto le32 = [&](uint32_t v) { f.append((const char*)&v, 4); };
+    auto le64 = [&](uint64_t v) { f.append((const char*)&v, 8); };
+    auto le16 = [&](uint16_t v) { f.append((const char*)&v, 2); };
+    le32(total);
+    f.push_back((char)kind);
+    f.append(3, '\0');
+    le64(size);
+    le64(mtime);
+    f.append(md5_hex, 32);
+    le16((uint16_t)path.size());
+    le16((uint16_t)fid.size());
+    le16((uint16_t)mime.size());
+    le16((uint16_t)content_len);
+    f += path;
+    f += fid;
+    f += mime;
+    if (content_len) f.append(content, content_len);
+    return f;
+}
+
+void md5_hex_of(const char* data, size_t len, char out_hex[33]) {
+    unsigned char digest[16];
+    const unsigned char* ptr = (const unsigned char*)data;
+    size_t l = len;
+    sw_md5_batch_var(&ptr, &l, 1, digest);
+    static const char* hexd = "0123456789abcdef";
+    for (int i = 0; i < 16; i++) {
+        out_hex[2 * i] = hexd[digest[i] >> 4];
+        out_hex[2 * i + 1] = hexd[digest[i] & 0xF];
+    }
+    out_hex[32] = 0;
+}
+
+// journal-before-ack (the filer analog of the volume engine writing .idx
+// before acking): append the frame, then queue it for the Python drain.
+// Returns false when the event backlog says Python stalled — the caller
+// must proxy instead of acking writes nobody will ever apply.
+bool filer_commit(Engine* E, const std::string& frame) {
+    std::lock_guard<std::mutex> l(E->filer_mu);
+    if (E->filer_events.size() >= 100000) return false;  // backpressure
+    if (E->filer_journal_fd >= 0) {
+        off_t before = lseek(E->filer_journal_fd, 0, SEEK_END);
+        ssize_t wr = write(E->filer_journal_fd, frame.data(), frame.size());
+        if (wr != (ssize_t)frame.size()) {
+            // a torn frame mid-file would desynchronize crash replay once
+            // later frames append after it — cut it off before proxying
+            if (before >= 0) {
+                if (ftruncate(E->filer_journal_fd, before) != 0) {
+                    // can't restore a clean tail: stop journaling (and
+                    // with it all native writes) rather than corrupt it
+                    close(E->filer_journal_fd);
+                    E->filer_journal_fd = -1;
+                    E->filer_mode.store(false, std::memory_order_release);
+                }
+            }
+            return false;
+        }
+    }
+    E->filer_events.push_back(frame);
+    E->filer_events_bytes += frame.size();
+    return true;
+}
+
+void fcache_put(Engine* E, const std::string& path,
+                std::shared_ptr<FilerCacheEnt> ent) {
+    std::unique_lock<std::shared_mutex> l(E->fcache_mu);
+    auto old = E->fcache.find(path);
+    if (old != E->fcache.end() && !old->second->inline_data.empty())
+        E->fcache_inline_bytes -= old->second->inline_data.size();
+    if (!ent->inline_data.empty())
+        E->fcache_inline_bytes += ent->inline_data.size();
+    E->fcache_fifo.push_back(path);
+    E->fcache[path] = std::move(ent);
+    // FIFO-approx eviction, bounding BOTH inline payload bytes and the
+    // total entry count (chunk-backed entries cost a few hundred bytes
+    // each and a busy filer touches millions of paths). Evicted paths
+    // just fall back to the Python read path. A re-put path appears in
+    // the FIFO twice, so its first pop may drop a fresh entry — a cache
+    // miss, not an error.
+    while ((E->fcache_inline_bytes > (128u << 20) ||
+            E->fcache.size() > 1000000) &&
+           !E->fcache_fifo.empty()) {
+        const std::string& victim = E->fcache_fifo.front();
+        auto it = E->fcache.find(victim);
+        if (it != E->fcache.end() && victim != path) {
+            if (!it->second->inline_data.empty())
+                E->fcache_inline_bytes -= it->second->inline_data.size();
+            E->fcache.erase(it);
+        }
+        E->fcache_fifo.pop_front();
+    }
+}
+
+void fcache_del(Engine* E, const std::string& path) {
+    std::unique_lock<std::shared_mutex> l(E->fcache_mu);
+    if (path.empty()) {
+        E->fcache.clear();
+        E->fcache_fifo.clear();
+        E->fcache_inline_bytes = 0;
+        return;
+    }
+    auto it = E->fcache.find(path);
+    if (it != E->fcache.end()) {
+        if (!it->second->inline_data.empty())
+            E->fcache_inline_bytes -= it->second->inline_data.size();
+        E->fcache.erase(it);
+    }
+}
+
+// serve a cached INLINE entry straight from memory: ETag/304, single
+// Range, Content-Type — the same surface filer.py _do_read produces
+void filer_serve_inline(Engine* E, Conn* c,
+                        const std::shared_ptr<FilerCacheEnt>& ent,
+                        const char* req, size_t hdr_len, bool head) {
+    const char* he = req + hdr_len;
+    std::string etag = "\"" + ent->md5_hex + "\"";
+    std::string extra = "Accept-Ranges: bytes\r\nETag: " + etag + "\r\n";
+    {
+        char lm[64];
+        time_t t = (time_t)ent->mtime;
+        struct tm g;
+        gmtime_r(&t, &g);
+        strftime(lm, sizeof lm, "Last-Modified: %a, %d %b %Y %H:%M:%S GMT\r\n",
+                 &g);
+        extra += lm;
+    }
+    std::string inm = find_header(req, he, "if-none-match");
+    std::string ctype =
+        ent->mime.empty() ? "application/octet-stream" : ent->mime;
+    if (!inm.empty() && inm == etag) {
+        append_response(c, 304, "Not Modified", ctype, extra, "", 0, false);
+        E->stats.native_reads++;
+        return;
+    }
+    const std::string& data = ent->inline_data;
+    int status = 200;
+    size_t off = 0, n = data.size();
+    std::string range = find_header(req, he, "range");
+    if (!range.empty() && range.find(',') == std::string::npos) {
+        long long start, end;
+        int rr = parse_range_spec(range, data.size(), &start, &end);
+        if (rr == 1) {  // valid syntax, unsatisfiable: filer.py sends 416
+            char cr[64];
+            snprintf(cr, sizeof cr, "Content-Range: bytes */%zu\r\n",
+                     data.size());
+            append_response(c, 416, "Range Not Satisfiable", "", cr, "", 0,
+                            false);
+            E->stats.native_reads++;
+            return;
+        }
+        if (rr == 0) {
+            char cr[96];
+            snprintf(cr, sizeof cr, "Content-Range: bytes %lld-%lld/%zu\r\n",
+                     start, end, data.size());
+            extra += cr;
+            off = (size_t)start;
+            n = (size_t)(end - start + 1);
+            status = 206;
+        }
+    }
+    if (head) {
+        char cl[64];
+        snprintf(cl, sizeof cl, "X-File-Size: %zu\r\n", data.size());
+        extra += cl;
+    }
+    append_response(c, status, status == 206 ? "Partial Content" : "OK",
+                    ctype, extra, data.data() + off, n, head);
+    E->stats.native_reads++;
+}
+
+// finish a native filer write once the entry is journaled: cache + respond
+void filer_write_ack(Engine* E, Conn* c, const std::string& path,
+                     uint64_t size, const char* md5_hex) {
+    std::string base = path.substr(path.rfind('/') + 1);
+    std::string body = "{\"name\": \"";
+    json_escape(base, body);
+    char tail[96];
+    snprintf(tail, sizeof tail, "\", \"size\": %llu, \"md5\": \"%.32s\"}",
+             (unsigned long long)size, md5_hex);
+    body += tail;
+    json_response(c, 201, "Created", body);
+    E->stats.native_writes++;
+}
+
+// mode-1 completion: the volume server answered the chunk upload
+void filer_upload_finish(Engine* E, Worker* w, BackendConn* b, bool ok) {
+    Conn* c = b->client;
+    int status = 0;
+    if (ok && b->resp.size() > 12 && memcmp(b->resp.data(), "HTTP/1.1 ", 9) == 0)
+        status = atoi(b->resp.c_str() + 9);
+    bool good = ok && status == 201;
+    uint64_t mtime = (uint64_t)time(nullptr);
+    if (good) {
+        std::string frame =
+            filer_frame(0, b->f_size, mtime, b->f_md5hex.c_str(), b->f_path,
+                        b->f_fid, b->f_mime, nullptr, 0);
+        good = filer_commit(E, frame);
+    }
+    if (good) {
+        auto ent = std::make_shared<FilerCacheEnt>();
+        ent->ip = b->target_ip;
+        ent->port = b->target_port;
+        ent->fid = b->f_fid;
+        ent->mime = b->f_mime;
+        ent->md5_hex = b->f_md5hex;
+        ent->size = b->f_size;
+        ent->mtime = mtime;
+        fcache_put(E, b->f_path, std::move(ent));
+    }
+    if (c != nullptr) {
+        c->upstream = nullptr;
+        if (good) {
+            filer_write_ack(E, c, b->f_path, b->f_size, b->f_md5hex.c_str());
+        } else {
+            json_response(c, 500, "Internal Server Error",
+                          "{\"error\": \"chunk upload failed\"}");
+            c->want_close = true;
+        }
+    }
+    backend_finish(w, b, ok && !b->backend_close);
+    if (c != nullptr) {
+        if (!c->want_close) process_buffered(E, w, c);
+        flush_out(w, c);
+    }
+}
+
+void proxy_request(Engine* E, Worker* w, Conn* c, const char* req, size_t len,
+                   bool bypass_cap);
+
+// mode-2 completion: relay the volume response, ETag rewritten to the
+// entry's md5 (what the Python filer serves); on any failure drop the
+// cache entry and replay the original request through the Python path
+void filer_relay_finish(Engine* E, Worker* w, BackendConn* b, bool ok) {
+    Conn* c = b->client;
+    int status = 0;
+    if (ok && b->resp.size() > 12 && memcmp(b->resp.data(), "HTTP/1.1 ", 9) == 0)
+        status = atoi(b->resp.c_str() + 9);
+    if (ok && (status == 200 || status == 206 || status == 304) &&
+        b->hdr_end != 0) {
+        if (c != nullptr) {
+            c->upstream = nullptr;
+            // rewrite the ETag header inside the buffered head
+            std::string head = b->resp.substr(0, b->hdr_end);
+            size_t p = 0;
+            bool replaced = false;
+            while (p < head.size()) {
+                size_t eol = head.find("\r\n", p);
+                if (eol == std::string::npos) break;
+                if (strncasecmp(head.c_str() + p, "etag:", 5) == 0) {
+                    head.replace(p, eol - p, "ETag: \"" + b->f_md5hex + "\"");
+                    replaced = true;
+                    break;
+                }
+                p = eol + 2;
+            }
+            if (!replaced)
+                head.insert(head.size() - 2,
+                            "ETag: \"" + b->f_md5hex + "\"\r\n");
+            if (b->f_mtime) {  // filer.py also serves Last-Modified
+                char lm[64];
+                time_t t = (time_t)b->f_mtime;
+                struct tm g;
+                gmtime_r(&t, &g);
+                strftime(lm, sizeof lm,
+                         "Last-Modified: %a, %d %b %Y %H:%M:%S GMT\r\n", &g);
+                head.insert(head.size() - 2, lm);
+            }
+            c->out += head;
+            c->out.append(b->resp, b->hdr_end,
+                          b->resp.size() - b->hdr_end);
+            E->stats.native_reads++;
+        }
+        backend_finish(w, b, !b->backend_close);
+        drain_waiting(E, w);
+        if (c != nullptr) {
+            if (!c->want_close) process_buffered(E, w, c);
+            flush_out(w, c);
+        }
+        return;
+    }
+    // miss/moved/error: forget the location and let Python serve it
+    fcache_del(E, b->f_path);
+    std::string original = std::move(b->client_req);
+    backend_finish(w, b, false);
+    drain_waiting(E, w);
+    if (c != nullptr) {
+        c->upstream = nullptr;
+        proxy_request(E, w, c, original.data(), original.size(), false);
+        flush_out(w, c);
+    }
+}
+
+// native filer write: inline entries commit synchronously; chunk-backed
+// entries mint a leased fid and upload to the volume engine async.
+// Returns false when any gate says the Python path must take it.
+bool handle_filer_write(Engine* E, Worker* w, Conn* c,
+                        const std::string& path, const char* req,
+                        size_t hdr_len, const char* body, size_t body_len) {
+    const char* he = req + hdr_len;
+    std::string ctype = find_header(req, he, "content-type");
+    const char* data = body;
+    size_t dlen = body_len;
+    std::string mime = ctype;
+    if (ctype.rfind("multipart/form-data", 0) == 0) {
+        std::string pn, pt;
+        if (!multipart_first_file(ctype, body, body_len, &pn, &pt, &data,
+                                  &dlen))
+            return false;
+        mime = pt;
+    } else if (ctype.rfind("multipart/", 0) == 0) {
+        return false;
+    }
+    if (mime == "application/x-www-form-urlencoded") mime.clear();
+    if (mime.size() >= 250 || mime.find_first_of("\r\n") != std::string::npos)
+        return false;
+    if (path.size() > 60000) return false;  // frame lengths are u16
+    if (dlen <= E->filer_inline_limit) {
+        // small-content inlining (filer.py SMALL_CONTENT_LIMIT): no volume
+        // hop at all — journal, cache, ack
+        char md5hex[33];
+        md5_hex_of(data, dlen, md5hex);
+        uint64_t mtime = (uint64_t)time(nullptr);
+        std::string frame =
+            filer_frame(1, dlen, mtime, md5hex, path, "", mime, data, dlen);
+        if (!filer_commit(E, frame)) return false;
+        auto ent = std::make_shared<FilerCacheEnt>();
+        ent->inline_data.assign(data, dlen);
+        ent->mime = mime;
+        ent->md5_hex = md5hex;
+        ent->size = dlen;
+        ent->mtime = mtime;
+        fcache_put(E, path, std::move(ent));
+        filer_write_ack(E, c, path, dlen, md5hex);
+        return true;
+    }
+    if (dlen > E->filer_chunk_limit) return false;  // multi-chunk: Python
+    if (E->filer_compress && !mime.empty() &&
+        mime != "application/octet-stream")
+        return false;  // Python would consider compressing this mime
+    std::shared_ptr<FilerLease> L;
+    {
+        std::shared_lock<std::shared_mutex> l(E->flease_mu);
+        L = E->flease;
+    }
+    if (!L) return false;
+    uint64_t key = L->next_key.fetch_add(1, std::memory_order_relaxed);
+    if (key >= L->end_key) return false;  // lease spent: Python re-leases
+    char hex[32];
+    format_fid_hex(key, L->cookie, hex);
+    char fid[48];
+    int fl = snprintf(fid, sizeof fid, "%u,%s", L->vid, hex);
+    char md5hex[33];
+    md5_hex_of(data, dlen, md5hex);
+    auto* b = new BackendConn();
+    b->client = c;
+    b->mode = 1;
+    b->target_ip = L->vol_ip;
+    b->target_port = L->vol_port;
+    b->f_path = path;
+    b->f_fid.assign(fid, fl);
+    b->f_mime = mime;
+    b->f_md5hex = md5hex;
+    b->f_size = dlen;
+    b->started = time(nullptr);
+    std::string& r = b->req;
+    r.reserve(dlen + 256 + path.size());
+    r = "POST /";
+    r.append(fid, fl);
+    r += " HTTP/1.1\r\nHost: v\r\n";
+    std::string base = path.substr(path.rfind('/') + 1);
+    if (!base.empty() && base.size() < 250 &&
+        base.find_first_of("\r\n") == std::string::npos) {
+        r += "X-File-Name: ";
+        r += base;
+        r += "\r\n";
+    }
+    if (!mime.empty()) {
+        r += "Content-Type: ";
+        r += mime;
+        r += "\r\n";
+    }
+    if (!L->auth.empty()) {
+        r += "Authorization: ";
+        r += L->auth;
+        r += "\r\n";
+    }
+    char cl[48];
+    snprintf(cl, sizeof cl, "Content-Length: %zu\r\n\r\n", dlen);
+    r += cl;
+    r.append(data, dlen);
+    c->upstream = b;
+    if (!backend_launch(E, w, b)) {
+        c->upstream = nullptr;
+        delete b;
+        return false;  // volume unreachable: Python's error surface
+    }
+    w->pending.push_back(b);
+    return true;
+}
+
+// native filer read of a chunk-backed entry: relay to the volume engine
+void filer_relay_launch(Engine* E, Worker* w, Conn* c,
+                        const std::shared_ptr<FilerCacheEnt>& ent,
+                        const std::string& path, const char* req,
+                        size_t req_len, size_t hdr_len) {
+    auto* b = new BackendConn();
+    b->client = c;
+    b->mode = 2;
+    b->target_ip = ent->ip;
+    b->target_port = ent->port;
+    b->f_path = path;
+    b->f_md5hex = ent->md5_hex;
+    b->f_mtime = ent->mtime;
+    b->client_req.assign(req, req_len);
+    b->started = time(nullptr);
+    std::string& r = b->req;
+    r = "GET /" + ent->fid + " HTTP/1.1\r\nHost: v\r\n";
+    const char* he = req + hdr_len;
+    std::string range = find_header(req, he, "range");
+    if (!range.empty()) {
+        r += "Range: ";
+        r += range;
+        r += "\r\n";
+    }
+    {
+        std::shared_lock<std::shared_mutex> l(E->flease_mu);
+        if (!E->filer_read_auth.empty()) {
+            r += "Authorization: ";
+            r += E->filer_read_auth;
+            r += "\r\n";
+        }
+    }
+    r += "\r\n";
+    c->upstream = b;
+    if (!backend_launch(E, w, b)) {
+        c->upstream = nullptr;
+        delete b;
+        proxy_request(E, w, c, req, req_len, false);
+        return;
+    }
+    w->pending.push_back(b);
+}
+
+// ---------------------------------------------------------------------------
 // request dispatch
 // ---------------------------------------------------------------------------
 
@@ -1432,6 +1981,53 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
                 bypass_cap = true;
                 break;
             }
+    }
+
+    // filer mode: serve the path namespace natively where the cache/lease
+    // allow; every gate failure falls through to the Python proxy below.
+    // Percent-escapes and dot-segments would need Python's normalize();
+    // such paths (rare) always proxy so cache keys stay canonical.
+    if (E->filer_mode.load(std::memory_order_relaxed) && !has_query &&
+        path < fid_end && path[0] == '/' && fid_end[-1] != '/' &&
+        !((size_t)(fid_end - path) >= 3 && memcmp(path, "/__", 3) == 0)) {
+        std::string pstr(path, fid_end - path);
+        bool canonical = pstr.find('%') == std::string::npos &&
+                         pstr.find("//") == std::string::npos &&
+                         pstr.find("/./") == std::string::npos &&
+                         pstr.find("/../") == std::string::npos;
+        if (canonical && (method == "GET" || method == "HEAD")) {
+            std::shared_ptr<FilerCacheEnt> ent;
+            {
+                std::shared_lock<std::shared_mutex> l(E->fcache_mu);
+                auto it = E->fcache.find(pstr);
+                if (it != E->fcache.end()) ent = it->second;
+            }
+            if (ent != nullptr) {
+                if (!ent->inline_data.empty()) {
+                    filer_serve_inline(E, c, ent, req, hdr_len,
+                                       method == "HEAD");
+                    return;
+                }
+                std::string range = find_header(req, he, "range");
+                bool multi = range.find(',') != std::string::npos;
+                std::string inm = find_header(req, he, "if-none-match");
+                if (!inm.empty() && inm == "\"" + ent->md5_hex + "\"") {
+                    append_response(c, 304, "Not Modified", "",
+                                    "ETag: " + inm + "\r\n", "", 0, false);
+                    E->stats.native_reads++;
+                    return;
+                }
+                if (method == "GET" && !multi) {
+                    filer_relay_launch(E, w, c, ent, pstr, req, req_len,
+                                       hdr_len);
+                    return;
+                }
+            }
+        } else if (canonical && (method == "POST" || method == "PUT")) {
+            if (handle_filer_write(E, w, c, pstr, req, hdr_len, body,
+                                   body_len))
+                return;
+        }
     }
 
     uint32_t vid; uint64_t key; uint32_t cookie;
@@ -1832,7 +2428,12 @@ void* worker_main(void* arg) {
             std::vector<BackendConn*> stuck;
             for (auto* b : w->pending) {
                 long age = now - b->started;
-                if ((b->client == nullptr && age > 75) || age > 3600)
+                // the hour-long allowance is for proxied ADMIN operations
+                // (vacuum, ec encode); filer chunk uploads/relays are
+                // small-blob volume hops that answer in milliseconds —
+                // a wedged one must fail the client fast
+                long limit = b->mode != 0 ? 30 : 3600;
+                if ((b->client == nullptr && age > 75) || age > limit)
                     stuck.push_back(b);
             }
             for (auto* b : stuck) backend_complete(E, w, b, false, false, false);
@@ -1880,6 +2481,9 @@ void* worker_main(void* arg) {
     w->back_graveyard.clear();
     for (int fd : w->idle_backends) close(fd);
     w->idle_backends.clear();
+    for (auto& kv : w->idle_targets)
+        for (int fd : kv.second) close(fd);
+    w->idle_targets.clear();
     return nullptr;
 }
 
@@ -2052,6 +2656,7 @@ void sw_fl_stop(int h) {
         close(w.epfd);
     }
     if (E->tls_ctx != nullptr) tls_api()->SSL_CTX_free(E->tls_ctx);
+    if (E->filer_journal_fd >= 0) close(E->filer_journal_fd);
     delete E;
 }
 
@@ -2201,6 +2806,134 @@ int sw_fl_assign_clear(int h) {
     if (!E) return -1;
     std::unique_lock<std::shared_mutex> l(E->assign_mu);
     E->assigns.clear();
+    return 0;
+}
+
+// --- filer mode --------------------------------------------------------------
+
+// turn on the native filer paths. journal_path: entry WAL appended before
+// every native-write ack (crash replay); "" disables journaling (memory
+// stores). compress: the Python pipeline would compress compressible
+// mimes, so chunk-backed native writes restrict to incompressible ones.
+int sw_fl_filer_enable(int h, const char* journal_path,
+                       unsigned long long chunk_limit, int compress) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    if (journal_path && *journal_path) {
+        int fd = open(journal_path, O_WRONLY | O_APPEND | O_CREAT, 0644);
+        if (fd < 0) return -2;
+        E->filer_journal_fd = fd;
+    }
+    if (chunk_limit > 0) E->filer_chunk_limit = (size_t)chunk_limit;
+    E->filer_compress = compress != 0;
+    E->filer_mode.store(true, std::memory_order_release);
+    return 0;
+}
+
+int sw_fl_filer_lease_set(int h, const char* vol_host, int vol_port,
+                          uint32_t vid, uint32_t cookie,
+                          unsigned long long key_start,
+                          unsigned long long key_end, const char* upload_auth,
+                          const char* read_auth) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto L = std::make_shared<FilerLease>();
+    L->vol_ip = htonl(INADDR_LOOPBACK);
+    if (vol_host && *vol_host && strcmp(vol_host, "0.0.0.0") != 0) {
+        uint32_t ip = inet_addr(vol_host);
+        if (ip == INADDR_NONE) return -2;  // hostname: Python path only
+        L->vol_ip = ip;
+    }
+    L->vol_port = vol_port;
+    L->vid = vid;
+    L->cookie = cookie;
+    L->next_key.store(key_start);
+    L->end_key = key_end;
+    if (upload_auth && *upload_auth) L->auth = upload_auth;
+    std::unique_lock<std::shared_mutex> l(E->flease_mu);
+    E->flease = std::move(L);
+    E->filer_read_auth = read_auth && *read_auth ? read_auth : "";
+    return 0;
+}
+
+unsigned long long sw_fl_filer_lease_remaining(int h) {
+    Engine* E = engine_at(h);
+    if (!E) return 0;
+    std::shared_ptr<FilerLease> L;
+    {
+        std::shared_lock<std::shared_mutex> l(E->flease_mu);
+        L = E->flease;
+    }
+    if (!L) return 0;
+    uint64_t next = L->next_key.load(std::memory_order_relaxed);
+    return next >= L->end_key ? 0 : L->end_key - next;
+}
+
+int sw_fl_filer_cache_put(int h, const char* path, const char* host,
+                          int port, const char* fid, const char* mime,
+                          const char* md5_hex, unsigned long long size,
+                          unsigned long long mtime, const void* inline_data,
+                          size_t inline_len) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto ent = std::make_shared<FilerCacheEnt>();
+    if (inline_len > 0) {
+        ent->inline_data.assign((const char*)inline_data, inline_len);
+    } else {
+        ent->ip = htonl(INADDR_LOOPBACK);
+        if (host && *host && strcmp(host, "0.0.0.0") != 0) {
+            uint32_t ip = inet_addr(host);
+            if (ip == INADDR_NONE) return -2;
+            ent->ip = ip;
+        }
+        ent->port = port;
+        ent->fid = fid ? fid : "";
+        if (ent->fid.empty()) return -3;
+    }
+    ent->mime = mime ? mime : "";
+    ent->md5_hex = md5_hex ? md5_hex : "";
+    ent->size = size;
+    ent->mtime = mtime;
+    fcache_put(E, path, std::move(ent));
+    return 0;
+}
+
+int sw_fl_filer_cache_del(int h, const char* path) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    fcache_del(E, path ? path : "");
+    return 0;
+}
+
+// pop queued entry frames into `out` (whole frames only); returns bytes
+long sw_fl_filer_drain(int h, uint8_t* out, size_t cap) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    std::lock_guard<std::mutex> l(E->filer_mu);
+    size_t off = 0;
+    while (!E->filer_events.empty()) {
+        const std::string& f = E->filer_events.front();
+        if (off + f.size() > cap) break;
+        memcpy(out + off, f.data(), f.size());
+        off += f.size();
+        E->filer_events_bytes -= f.size();
+        E->filer_events.pop_front();
+    }
+    return (long)off;
+}
+
+// truncate the journal once Python has applied everything it drained.
+// Refuses (returns pending count) while frames are still queued — those
+// would be lost to a crash between truncate and their drain.
+long sw_fl_filer_journal_reset(int h) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    std::lock_guard<std::mutex> l(E->filer_mu);
+    if (!E->filer_events.empty()) return (long)E->filer_events.size();
+    if (E->filer_journal_fd >= 0) {
+        if (ftruncate(E->filer_journal_fd, 0) != 0) return -2;
+        lseek(E->filer_journal_fd, 0, SEEK_SET);
+    }
     return 0;
 }
 

@@ -24,6 +24,7 @@ import time
 import urllib.parse
 
 from seaweedfs_tpu.util import cipher as cipher_util
+from seaweedfs_tpu.util import glog
 from seaweedfs_tpu.util.compression import decompress_data, maybe_compress_data
 
 from seaweedfs_tpu.filer import Attributes, Entry, FileChunk, Filer
@@ -128,18 +129,55 @@ class FilerServer:
         self._routes()
 
     def _start_fastlane(self) -> None:
-        """Front the filer with the engine as a concurrency governor: any
-        number of client connections multiplex onto max_backend=2 Python
-        handlers (one running + one parked in internal I/O — measured 4-5x
-        over uncapped at 16 connections on the GIL), and long-poll meta
-        subscriptions bypass the cap. All handling stays in Python."""
+        """Front the filer with the engine. Proxied (Python) requests ride a
+        max_backend=2 concurrency governor (measured 4-5x over uncapped at
+        16 connections on the GIL); long-poll meta subscriptions bypass the
+        cap. On top of that, FILER MODE serves the hot path natively
+        (VERDICT r4 next #3; reference hot path
+        `filer_server_handlers_write_autochunk.go:26-155`):
+          * writes <= SMALL_CONTENT_LIMIT: inline entry — md5 + journal
+            append + ack in C++, zero volume hops
+          * larger single-chunk writes: fid minted from a master lease the
+            Python side refreshes, chunk POSTed to the volume engine, entry
+            journaled before the ack
+          * reads: path -> location cache (inline bytes served from memory;
+            chunk-backed relayed to the volume engine with the entry's
+            ETag), invalidated/refreshed by the meta-log subscriber
+        The journal is replayed into the store on startup (crash safety),
+        and drained frames become real entries via Filer.create_entry."""
         from seaweedfs_tpu.storage import fastlane as fl_mod
 
         self.fastlane = fl_mod.front_service(
             self.service,
             guard_active=getattr(self.service, "guard", None) is not None,
-            workers=1, max_backend=2,
+            max_backend=2,
         )
+        self._fl_filer_on = False
+        if self.fastlane is None or self.cipher or self.dedup:
+            # cipher/dedup transform chunks in ways only Python implements
+            return
+        import tempfile
+
+        if self.filer.store.__class__.__name__ == "MemoryStore":
+            journal = ""  # store dies with the process; a WAL buys nothing
+        else:
+            base = getattr(self.filer.store, "path", None)
+            d = os.path.dirname(base) if base else tempfile.gettempdir()
+            journal = os.path.join(d, "filer_native.journal")
+            self._fl_replay_journal(journal)
+        rc = self.fastlane._lib.sw_fl_filer_enable(
+            self.fastlane.handle, journal.encode(), self.chunk_size,
+            1 if self.compress else 0,
+        )
+        if rc != 0:
+            return
+        self._fl_journal_path = journal
+        if journal:
+            self.fastlane._lib.sw_fl_filer_journal_reset(self.fastlane.handle)
+        self._fl_filer_on = True
+        self._fl_drain_mu = __import__("threading").Lock()
+        self._fl_buf = __import__("ctypes").create_string_buffer(1 << 20)
+        self.filer.subscribe(self._fl_on_meta)
 
     def start(self) -> None:
         import threading
@@ -152,6 +190,245 @@ class FilerServer:
         self._register_once()
         t = threading.Thread(target=self._register_loop, daemon=True)
         t.start()
+        if self._fl_filer_on:
+            try:
+                self._fl_lease_refresh()
+            except Exception:
+                pass  # master not ready: the loop retries
+            threading.Thread(target=self._fl_filer_loop, daemon=True).start()
+
+    # --- native filer mode (engine-side writes/reads) -------------------------
+    _FL_FRAME_HDR = __import__("struct").Struct("<IB3xQQ32sHHHH")
+
+    def _fl_parse_frames(self, buf: bytes):
+        """Entry frames as written by fastlane.cpp filer_frame()."""
+        hdr = self._FL_FRAME_HDR
+        off = 0
+        while off + hdr.size <= len(buf):
+            (total, kind, size, mtime, md5, plen, flen, mlen,
+             clen) = hdr.unpack_from(buf, off)
+            if total < hdr.size or off + total > len(buf):
+                break  # torn tail (crash mid-append): stop cleanly
+            p = off + hdr.size
+            path = buf[p:p + plen].decode("utf-8", "replace"); p += plen
+            fid = buf[p:p + flen].decode(); p += flen
+            mime = buf[p:p + mlen].decode("utf-8", "replace"); p += mlen
+            content = bytes(buf[p:p + clen])
+            yield kind, size, mtime, md5.decode(), path, fid, mime, content
+            off += total
+
+    def _fl_replay_journal(self, path: str) -> None:
+        """Crash recovery: acked native writes whose entries never reached
+        the store (process died before the drain) are re-applied from the
+        journal — the filer analog of .idx replay on volume load."""
+        try:
+            with open(path, "rb") as f:
+                buf = f.read()
+        except FileNotFoundError:
+            return
+        for frame in self._fl_parse_frames(buf):
+            self._fl_apply(*frame)
+
+    def _fl_apply(self, kind: int, size: int, mtime: int, md5: str,
+                  path: str, fid: str, mime: str, content: bytes) -> None:
+        entry = Entry(full_path=path)
+        entry.attributes.mime = mime
+        entry.attributes.file_size = size
+        entry.attributes.mtime = float(mtime)
+        entry.attributes.md5 = md5
+        if kind == 1:
+            entry.content = content
+        else:
+            entry.chunks = [FileChunk(
+                file_id=fid, offset=0, size=size, etag=md5,
+                modified_ts_ns=int(mtime * 1_000_000_000),
+            )]
+        # parents carry the WRITE's timestamp, not the drain's — a lazily
+        # applied entry must not make its directory look newer than its
+        # contents (age-based sweeps like s3.clean.uploads compare mtimes)
+        missing = []
+        p = path.rsplit("/", 1)[0] or "/"
+        while p != "/" and self.filer.find_entry(p) is None:
+            missing.append(p)
+            p = p.rsplit("/", 1)[0] or "/"
+        for d in reversed(missing):
+            de = Entry(full_path=d, is_directory=True,
+                       attributes=Attributes(mode=0o755))
+            de.attributes.mtime = de.attributes.crtime = float(mtime)
+            try:
+                self.filer.create_entry(de)
+            except FilerError:
+                break
+        old = self.filer.find_entry(path)
+        try:
+            freed = self.filer.create_entry(entry)
+        except FilerError:
+            # the store rejected an acked native write (e.g. the path is a
+            # directory): the engine cache must not keep serving a phantom
+            # — no meta event fires on a failed create, so purge directly
+            self.fastlane._lib.sw_fl_filer_cache_del(
+                self.fastlane.handle, path.encode())
+            glog.warning("native write to %s rejected by store; dropped",
+                         path)
+            return
+        # journal replay is idempotent: never reclaim the very chunk this
+        # frame records (a replayed frame sees itself as the old entry)
+        new_fids = {c.file_id for c in entry.chunks}
+        if old is not None and old.hard_link_id:
+            self._reclaim_chunks(
+                [c for c in freed if c.file_id not in new_fids])
+        elif old is not None and old.chunks:
+            self._reclaim_chunks(
+                [c for c in old.chunks if c.file_id not in new_fids])
+
+    def _fl_filer_drain(self, once: bool = False) -> int:
+        """Apply engine-journaled entries to the store (read-your-writes:
+        the Python read/write/delete handlers call this first). once=True
+        processes a single buffer so the caller can interleave other
+        housekeeping (lease refresh) during a heavy backlog."""
+        if not getattr(self, "_fl_filer_on", False):
+            return 0
+        import ctypes
+
+        total = 0
+        with self._fl_drain_mu:
+            while True:
+                n = int(self.fastlane._lib.sw_fl_filer_drain(
+                    self.fastlane.handle, ctypes.addressof(self._fl_buf),
+                    len(self._fl_buf)))
+                if n <= 0:
+                    break
+                for fr in self._fl_parse_frames(self._fl_buf.raw[:n]):
+                    self._fl_apply(*fr)
+                    total += 1
+                if once:
+                    break
+        return total
+
+    def _fl_lease_refresh(self, count: int = 20000) -> None:
+        """Fetch a count=N fid lease from the master and install it: the
+        engine then mints fids locally, so a native write costs zero master
+        round-trips (the master-side equivalent of its own native assign
+        profiles). Wildcard upload/read JWTs are minted from the filer's
+        key copies, as the reference filer signs its own volume tokens."""
+        from seaweedfs_tpu.storage.file_id import parse_needle_id_cookie
+
+        if self.fastlane.tls:
+            # under mTLS the volume engine only speaks TLS and the filer
+            # engine's upstream connections are plain TCP: chunk uploads
+            # go through Python (inline writes stay native — no volume
+            # hop). A native TLS *client* in the engine would lift this.
+            return
+        a = self.client.assign(
+            count=count, replication=self.default_replication,
+            collection=self.collection,
+        )
+        if a.get("error"):
+            return
+        vid_s, _, key_hash = a["fid"].partition(",")
+        key, cookie = parse_needle_id_cookie(key_hash)
+        loc = a.get("publicUrl") or a.get("url")
+        host, _, port = loc.rpartition(":")
+        upload_auth = read_auth = ""
+        from seaweedfs_tpu.security.jwt import encode_jwt
+
+        if self.security.write_key:
+            tok = encode_jwt(self.security.write_key,
+                             {"fid": "", "exp": int(time.time()) + 3600})
+            upload_auth = f"BEARER {tok}"
+        if self.security.read_key:
+            tok = encode_jwt(self.security.read_key,
+                             {"fid": "", "exp": int(time.time()) + 3600})
+            read_auth = f"BEARER {tok}"
+        self.fastlane._lib.sw_fl_filer_lease_set(
+            self.fastlane.handle, host.encode(), int(port), int(vid_s),
+            cookie, key, key + count, upload_auth.encode(),
+            read_auth.encode(),
+        )
+
+    def _fl_filer_loop(self) -> None:  # pragma: no cover - timing loop
+        while not self._register_stop.is_set():
+            try:
+                applied = 0
+                while True:
+                    # lease first, one drain buffer at a time: a heavy
+                    # write backlog must not starve the fid lease (native
+                    # writes fall back to the slow proxy when it runs dry)
+                    rem = int(self.fastlane._lib.sw_fl_filer_lease_remaining(
+                        self.fastlane.handle))
+                    if rem < 5000:
+                        self._fl_lease_refresh()
+                    got = self._fl_filer_drain(once=True)
+                    applied += got
+                    if got == 0:
+                        break
+                if applied and getattr(self, "_fl_journal_path", ""):
+                    # refuses (harmlessly) if new frames queued meanwhile
+                    self.fastlane._lib.sw_fl_filer_journal_reset(
+                        self.fastlane.handle)
+            except Exception:
+                pass
+            self._register_stop.wait(0.02)
+
+    def _fl_on_meta(self, ev) -> None:
+        """Meta-log subscriber keeping the engine's path cache coherent:
+        every local mutation re-puts (still natively servable) or deletes
+        (anything the native path cannot serve) the affected paths.
+
+        Runs SYNCHRONOUSLY under the Filer lock (_notify), so it must
+        never block on the network — volume locations come from the vid
+        cache only (peek). A peek miss just deletes the cache entry; the
+        first Python-served read re-populates it from outside the lock
+        (_fl_cache_push in _do_read)."""
+        if not getattr(self, "_fl_filer_on", False) or self.fastlane is None:
+            return
+        old, new = ev.old_entry, ev.new_entry
+        if old is not None and (new is None
+                                or old.full_path != new.full_path):
+            self.fastlane._lib.sw_fl_filer_cache_del(
+                self.fastlane.handle, old.full_path.encode())
+        if new is not None:
+            self._fl_cache_push(new, blocking_lookup=False)
+
+    def _fl_cache_push(self, entry, blocking_lookup: bool) -> None:
+        """Install (or purge) one entry in the engine's path cache.
+        blocking_lookup=True may resolve the chunk's volume over HTTP and
+        must only be used outside the Filer lock (the read path)."""
+        lib, h = self.fastlane._lib, self.fastlane.handle
+        path = entry.full_path
+        a = entry.attributes
+        if (entry.is_directory or a.ttl_sec > 0 or entry.hard_link_id
+                or not a.md5):
+            lib.sw_fl_filer_cache_del(h, path.encode())
+            return
+        if entry.content:
+            lib.sw_fl_filer_cache_put(
+                h, path.encode(), b"", 0, b"", (a.mime or "").encode(),
+                a.md5.encode(), len(entry.content), int(a.mtime),
+                bytes(entry.content), len(entry.content),
+            )
+            return
+        ch = entry.chunks[0] if len(entry.chunks) == 1 else None
+        if (ch is not None and not ch.cipher_key and not ch.is_compressed
+                and not ch.is_chunk_manifest and ch.offset == 0
+                and not self.fastlane.tls):  # relay is plain TCP
+            try:
+                vid = int(ch.file_id.split(",")[0])
+                locs = self.client.lookup_cached(vid)
+                if locs is None and blocking_lookup:
+                    locs = self.client.lookup(vid)
+                if locs:
+                    host, _, port = locs[0].rpartition(":")
+                    rc = lib.sw_fl_filer_cache_put(
+                        h, path.encode(), host.encode(), int(port),
+                        ch.file_id.encode(), (a.mime or "").encode(),
+                        a.md5.encode(), ch.size, int(a.mtime), None, 0,
+                    )
+                    if rc == 0:
+                        return
+            except Exception:
+                pass
+        lib.sw_fl_filer_cache_del(h, path.encode())
 
     def _register_once(self) -> None:
         """Announce to the master's cluster membership (`cluster.go` rides
@@ -583,6 +860,8 @@ class FilerServer:
         # (`weed/server/filer_grpc_server_sub_meta.go`)
         @svc.route("GET", r"/__meta__/events")
         def meta_events(req: Request) -> Response:
+            # native-write entries only become meta events when applied
+            self._fl_filer_drain()
             since = int(req.query.get("since_ns", 0))
             limit = int(req.query.get("limit", 1024))
             wait = float(req.query.get("wait", 0))
@@ -699,6 +978,10 @@ class FilerServer:
         return out
 
     def _do_write(self, req: Request) -> Response:
+        # read-your-writes across the native/Python boundary: overwrite
+        # detection below must see entries the engine acked but Python
+        # hasn't applied yet (same for reads and deletes)
+        self._fl_filer_drain()
         path = normalize(urllib.parse.unquote(req.path))
         signatures = self._parse_signatures(req)
         if "mv.from" in req.query:
@@ -876,6 +1159,7 @@ class FilerServer:
         }
 
     def _do_read(self, req: Request, head: bool) -> Response:
+        self._fl_filer_drain()
         path = normalize(urllib.parse.unquote(req.path))
         entry = self.filer.find_entry(path)
         if entry is None:
@@ -900,6 +1184,11 @@ class FilerServer:
                     entry = self._remote_cache_entry(entry)
                 except (FilerError, OSError) as e:
                     return Response({"error": f"remote fetch: {e}"}, 502)
+        # a Python-served read is the out-of-lock chance to (re)populate
+        # the engine's path cache (the meta-log subscriber can only peek
+        # at volume locations; here a blocking lookup is safe)
+        if getattr(self, "_fl_filer_on", False) and self.fastlane is not None:
+            self._fl_cache_push(entry, blocking_lookup=True)
         etag = entry.attributes.md5 or str(entry.attributes.mtime)
         headers = {
             "ETag": f'"{etag}"',
@@ -1006,6 +1295,7 @@ class FilerServer:
         )
 
     def _do_delete(self, req: Request) -> Response:
+        self._fl_filer_drain()
         path = normalize(urllib.parse.unquote(req.path))
         recursive = req.query.get("recursive") == "true"
         try:

@@ -89,6 +89,33 @@ def _bind(lib) -> bool:
         ]
         lib.sw_fl_assign_clear.restype = ctypes.c_int
         lib.sw_fl_assign_clear.argtypes = [ctypes.c_int]
+        lib.sw_fl_filer_enable.restype = ctypes.c_int
+        lib.sw_fl_filer_enable.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_ulonglong, ctypes.c_int,
+        ]
+        lib.sw_fl_filer_lease_set.restype = ctypes.c_int
+        lib.sw_fl_filer_lease_set.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_ulonglong, ctypes.c_ulonglong,
+            ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.sw_fl_filer_lease_remaining.restype = ctypes.c_ulonglong
+        lib.sw_fl_filer_lease_remaining.argtypes = [ctypes.c_int]
+        lib.sw_fl_filer_cache_put.restype = ctypes.c_int
+        lib.sw_fl_filer_cache_put.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_ulonglong, ctypes.c_ulonglong, ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.sw_fl_filer_cache_del.restype = ctypes.c_int
+        lib.sw_fl_filer_cache_del.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.sw_fl_filer_drain.restype = ctypes.c_long
+        lib.sw_fl_filer_drain.argtypes = [
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib.sw_fl_filer_journal_reset.restype = ctypes.c_long
+        lib.sw_fl_filer_journal_reset.argtypes = [ctypes.c_int]
         return True
     except AttributeError:
         return False

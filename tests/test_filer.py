@@ -131,6 +131,9 @@ class TestFilerHTTP:
         status, headers, body = http_request("GET", url)
         assert status == 200 and body == b"small content"
         assert headers["Content-Type"] == "text/plain"
+        # white-box store access: native-mode writes apply on drain (every
+        # HTTP read/write drains first; direct Filer access must too)
+        filer._fl_filer_drain()
         entry = filer.filer.find_entry("/notes/hello.txt")
         assert entry.content == b"small content"  # inlined, no chunks
         assert not entry.chunks

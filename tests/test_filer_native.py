@@ -1,0 +1,185 @@
+"""Native filer mode (VERDICT r4 next #3): the engine serves the filer's
+hot path — inline writes with zero volume hops, leased-fid chunk uploads,
+and a path->location read cache invalidated by the meta-log — while the
+Python side stays authoritative via journal replay + drain.
+
+Reference hot path: `weed/server/filer_server_handlers_write_autochunk.go:26-155`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.server.filer import FilerServer
+from seaweedfs_tpu.server.httpd import http_request
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    m = MasterServer(port=0, pulse_seconds=1)
+    m.start()
+    v = VolumeServer([str(tmp_path / "v")], m.url, port=0, pulse_seconds=1)
+    v.start()
+    yield m, v, str(tmp_path)
+    v.stop()
+    m.stop()
+
+
+def _filer(cluster, **kw):
+    m, _, _ = cluster
+    f = FilerServer(m.url, port=0, **kw)
+    f.start()
+    return f
+
+
+class TestNativeFilerPath:
+    def test_inline_and_chunk_served_natively(self, cluster):
+        f = _filer(cluster)
+        if not f._fl_filer_on:
+            f.stop()
+            pytest.skip("engine unavailable")
+        try:
+            # inline (<= SMALL_CONTENT_LIMIT): no volume hop at all
+            st, _, body = http_request("POST", f.url + "/a/small.txt",
+                                       b"tiny", {"Content-Type": "text/plain"})
+            assert st == 201
+            assert json.loads(body)["md5"]
+            st, hdrs, body = http_request("GET", f.url + "/a/small.txt")
+            assert st == 200 and body == b"tiny"
+            assert hdrs["Content-Type"] == "text/plain"
+            # chunk-backed (> inline limit): leased fid + native upload
+            payload = os.urandom(64 * 1024)
+            st, _, body = http_request("POST", f.url + "/a/big.bin", payload)
+            assert st == 201
+            md5 = json.loads(body)["md5"]
+            st, hdrs, body = http_request("GET", f.url + "/a/big.bin")
+            assert st == 200 and body == payload
+            assert hdrs["ETag"] == f'"{md5}"'  # entry md5, not the chunk CRC
+            assert "Last-Modified" in hdrs
+            # ranged read rides the relay
+            st, _, body = http_request("GET", f.url + "/a/big.bin",
+                                       headers={"Range": "bytes=100-199"})
+            assert st == 206 and body == payload[100:200]
+            # conditional read short-circuits in the engine
+            st, _, _ = http_request("GET", f.url + "/a/big.bin",
+                                    headers={"If-None-Match": f'"{md5}"'})
+            assert st == 304
+            stats = f.fastlane.stats()
+            assert stats["native_writes"] == 2
+            assert stats["native_reads"] >= 4
+            # the drained entries are real store entries (metadata surface)
+            st, _, body = http_request(
+                "GET", f.url + "/a/big.bin?metadata=true")
+            d = json.loads(body)
+            assert d["attributes"]["file_size"] == len(payload)
+            assert len(d["chunks"]) == 1
+        finally:
+            f.stop()
+
+    def test_meta_log_invalidates_cache(self, cluster):
+        f = _filer(cluster)
+        if not f._fl_filer_on:
+            f.stop()
+            pytest.skip("engine unavailable")
+        try:
+            st, _, _ = http_request("POST", f.url + "/c/x.bin", b"q" * 5000)
+            assert st == 201
+            # delete through the Python path: the meta-log subscriber must
+            # purge the native cache or reads would serve a ghost
+            st, _, _ = http_request("DELETE", f.url + "/c/x.bin")
+            assert st in (200, 204)
+            st, _, _ = http_request("GET", f.url + "/c/x.bin")
+            assert st == 404
+            # rename invalidates the old path and serves the new one
+            st, _, _ = http_request("POST", f.url + "/c/a.bin", b"r" * 5000)
+            assert st == 201
+            st, _, _ = http_request(
+                "POST", f.url + "/c/b.bin?mv.from=/c/a.bin", b"")
+            assert st == 200
+            st, _, _ = http_request("GET", f.url + "/c/a.bin")
+            assert st == 404
+            st, _, body = http_request("GET", f.url + "/c/b.bin")
+            assert st == 200 and body == b"r" * 5000
+            # overwrite through the native path replaces the cached blob
+            st, _, _ = http_request("POST", f.url + "/c/b.bin", b"s" * 4000)
+            assert st == 201
+            st, _, body = http_request("GET", f.url + "/c/b.bin")
+            assert st == 200 and body == b"s" * 4000
+        finally:
+            f.stop()
+
+    def test_journal_replay_after_crash(self, cluster, tmp_path):
+        """An acked native write whose entry never reached the store (the
+        process died before the drain) is recovered from the journal —
+        the filer analog of .idx replay on volume load."""
+        store = str(tmp_path / "filer_store")
+        os.makedirs(store, exist_ok=True)
+        f1 = _filer(cluster, store_kind="lsm", store_path=store)
+        if not f1._fl_filer_on:
+            f1.stop()
+            pytest.skip("engine unavailable")
+        try:
+            # simulate a Python stall: nothing drains, entries live only in
+            # the engine journal
+            f1._fl_filer_on_real = f1._fl_filer_drain
+            f1._fl_filer_drain = lambda *a, **k: 0
+            st, _, _ = http_request("POST", f1.url + "/crash/keep.txt",
+                                    b"survives")
+            assert st == 201
+            payload = os.urandom(10000)
+            st, _, _ = http_request("POST", f1.url + "/crash/keep.bin",
+                                    payload)
+            assert st == 201
+            assert f1.filer.find_entry("/crash/keep.txt") is None  # stalled
+        finally:
+            f1.stop()  # crash: frames never applied
+
+        f2 = _filer(cluster, store_kind="lsm", store_path=store)
+        try:
+            e = f2.filer.find_entry("/crash/keep.txt")
+            assert e is not None and e.content == b"survives"
+            st, _, body = http_request("GET", f2.url + "/crash/keep.bin")
+            assert st == 200 and body == payload
+        finally:
+            f2.stop()
+
+    def test_secured_cluster_stays_native(self, cluster, tmp_path):
+        """jwt.signing + jwt.signing.read configured: the filer signs its
+        own upload/read tokens (as the reference filer does) and the whole
+        filer data path stays on the engines."""
+        from seaweedfs_tpu.security import SecurityConfig
+
+        m, v, _ = cluster
+        v.stop()
+        sec = SecurityConfig(write_key="w-secret", read_key="r-secret")
+        v2 = VolumeServer([str(tmp_path / "v2")], m.url, port=0,
+                          pulse_seconds=1, security=sec)
+        v2.start()
+        f = FilerServer(m.url, port=0, security=sec)
+        f.start()
+        if not f._fl_filer_on:
+            f.stop()
+            v2.stop()
+            pytest.skip("engine unavailable")
+        try:
+            payload = os.urandom(30000)
+            st, _, _ = http_request("POST", f.url + "/sec/x.bin", payload)
+            assert st == 201
+            st, _, body = http_request("GET", f.url + "/sec/x.bin")
+            assert st == 200 and body == payload
+            stats = f.fastlane.stats()
+            assert stats["native_writes"] >= 1 and stats["native_reads"] >= 1
+            # and the volume itself served those natively (JWTs verified
+            # in its engine, not the Python proxy)
+            vstats = v2.fastlane.stats() if v2.fastlane else {}
+            if vstats:
+                assert vstats["native_writes"] >= 1
+                assert vstats["native_reads"] >= 1
+        finally:
+            f.stop()
+            v2.stop()
