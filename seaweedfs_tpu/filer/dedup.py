@@ -38,9 +38,35 @@ class DedupIndex:
         self._cache: OrderedDict[str, dict] = OrderedDict()
         self._cache_size = cache_size
         self._mu = threading.Lock()
+        self._seed: bytes | None = None
         self.hits = 0
         self.misses = 0
         self.bytes_saved = 0
+
+    @property
+    def seed(self) -> bytes:
+        """Per-store 16-byte secret keying the SW128 identity hash:
+        without it an attacker could construct offline collisions and make
+        a victim's upload dedup to attacker-chosen bytes. Generated once,
+        persisted beside the index so keys stay stable for the store's
+        lifetime."""
+        if self._seed is None:
+            path = f"{DEDUP_DIR}/.seed"
+            e = self.filer.find_entry(path)
+            if e is not None and len(e.content) == 16:
+                self._seed = bytes(e.content)
+            else:
+                import os as _os
+
+                from seaweedfs_tpu.filer import Entry
+
+                s = _os.urandom(16)
+                ent = Entry(full_path=path)
+                ent.content = s
+                ent.attributes.file_size = 16
+                self.filer.create_entry(ent)
+                self._seed = s
+        return self._seed
 
     @staticmethod
     def _path(key: str) -> str:

@@ -98,6 +98,19 @@ class NativeLib:
             ctypes.c_size_t,
             ctypes.c_void_p,  # out uint32[n]
         ]
+        self._lib.sw_fast128.restype = None
+        self._lib.sw_fast128.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.c_void_p,
+        ]
+        self._lib.sw_fast128_spans.restype = None
+        self._lib.sw_fast128_spans.argtypes = [
+            ctypes.c_void_p,  # base buffer
+            ctypes.c_void_p,  # cuts size_t[n] (exclusive ends)
+            ctypes.c_size_t,
+            ctypes.c_char_p,  # 16-byte seed or None
+            ctypes.c_void_p,  # out (n, 16)
+        ]
         self._lib.sw_gf256_matmul2d.restype = None
         self._lib.sw_gf256_matmul2d.argtypes = [
             ctypes.c_char_p,  # matrix rows*cols
@@ -305,6 +318,56 @@ class NativeLib:
             crcs.ctypes.data,
         )
         return digests, crcs
+
+    def md5_spans(self, buf, offs, lens):
+        """MD5 of arbitrary (offset, length) spans of one buffer — the
+        dedup path hashes ONLY the chunks that missed the index (their
+        upload ETags); identity keys come from fast128_spans."""
+        import numpy as np
+
+        arr = np.frombuffer(buf, dtype=np.uint8) if not isinstance(
+            buf, np.ndarray
+        ) else buf
+        o = np.asarray(offs, dtype=np.uintp)
+        l = np.asarray(lens, dtype=np.uintp)
+        n = len(o)
+        digests = np.empty((n, 16), dtype=np.uint8)
+        self._lib.sw_md5_batch_spans(
+            arr.ctypes.data, o.ctypes.data, l.ctypes.data, n,
+            digests.ctypes.data,
+        )
+        return digests
+
+    def fast128(self, data: bytes, seed: bytes = b"") -> bytes:
+        """SW128 of one buffer (16 bytes) — the dedup identity hash.
+        seed: per-store 16-byte secret (defends against offline collision
+        construction); empty = the unseeded golden form."""
+        import numpy as np
+
+        arr = np.frombuffer(data, dtype=np.uint8) if not isinstance(
+            data, np.ndarray) else data
+        out = np.empty(16, dtype=np.uint8)
+        self._lib.sw_fast128(arr.ctypes.data, arr.nbytes, seed or None,
+                             out.ctypes.data)
+        return out.tobytes()
+
+    def fast128_spans(self, buf, cuts, seed: bytes = b""):
+        """SW128 per CDC span of one contiguous buffer (cuts = exclusive
+        ends). Returns (n, 16) uint8 — the dedup index identity keys,
+        ~2.5x cheaper than the MD5 span batch (ops/hash_service.span_keys)."""
+        import numpy as np
+
+        arr = np.frombuffer(buf, dtype=np.uint8) if not isinstance(
+            buf, np.ndarray
+        ) else buf
+        ends = np.asarray(cuts, dtype=np.uintp)
+        n = len(ends)
+        out = np.empty((n, 16), dtype=np.uint8)
+        self._lib.sw_fast128_spans(
+            arr.ctypes.data, ends.ctypes.data, n, seed or None,
+            out.ctypes.data,
+        )
+        return out
 
     def gear_boundaries(self, data, gear, mask: int, min_size: int,
                         max_size: int):

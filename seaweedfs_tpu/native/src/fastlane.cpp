@@ -400,6 +400,7 @@ struct FilerCacheEnt {
     std::string mime, md5_hex;
     uint64_t size = 0;
     uint64_t mtime = 0;  // seconds
+    uint64_t seq = 0;    // FIFO generation: stale queue entries are no-ops
 };
 
 // leased fid range from the master (one /dir/assign?count=N): the engine
@@ -451,7 +452,8 @@ struct Engine {
     std::shared_mutex fcache_mu;
     std::unordered_map<std::string, std::shared_ptr<FilerCacheEnt>> fcache;
     size_t fcache_inline_bytes = 0;
-    std::deque<std::string> fcache_fifo;  // inline eviction order
+    uint64_t fcache_seq = 0;
+    std::deque<std::pair<std::string, uint64_t>> fcache_fifo;  // (path, seq)
     std::shared_mutex flease_mu;
     std::shared_ptr<FilerLease> flease;
     std::string filer_read_auth;  // wildcard read JWT for relays (guarded
@@ -1557,25 +1559,36 @@ void fcache_put(Engine* E, const std::string& path,
         E->fcache_inline_bytes -= old->second->inline_data.size();
     if (!ent->inline_data.empty())
         E->fcache_inline_bytes += ent->inline_data.size();
-    E->fcache_fifo.push_back(path);
+    ent->seq = ++E->fcache_seq;
+    E->fcache_fifo.emplace_back(path, ent->seq);
     E->fcache[path] = std::move(ent);
-    // FIFO-approx eviction, bounding BOTH inline payload bytes and the
-    // total entry count (chunk-backed entries cost a few hundred bytes
-    // each and a busy filer touches millions of paths). Evicted paths
-    // just fall back to the Python read path. A re-put path appears in
-    // the FIFO twice, so its first pop may drop a fresh entry — a cache
-    // miss, not an error.
-    while ((E->fcache_inline_bytes > (128u << 20) ||
-            E->fcache.size() > 1000000) &&
-           !E->fcache_fifo.empty()) {
-        const std::string& victim = E->fcache_fifo.front();
-        auto it = E->fcache.find(victim);
-        if (it != E->fcache.end() && victim != path) {
-            if (!it->second->inline_data.empty())
-                E->fcache_inline_bytes -= it->second->inline_data.size();
-            E->fcache.erase(it);
-        }
+    // FIFO eviction, bounding inline payload bytes AND total entry count
+    // (chunk-backed entries cost a few hundred bytes each and a busy
+    // filer touches millions of paths). A re-put leaves its old FIFO
+    // slot behind as a stale (path, seq) pair — the seq check makes
+    // popping it a no-op, and the queue itself is compacted whenever it
+    // outgrows the live set so overwrite churn cannot leak queue slots.
+    int budget = 64;  // amortized: each put cleans at most 64 queue slots
+    while (!E->fcache_fifo.empty() && budget-- > 0) {
+        bool over_bytes = E->fcache_inline_bytes > (128u << 20);
+        bool over_count = E->fcache.size() > 1000000;
+        bool over_fifo =
+            E->fcache_fifo.size() > 2 * E->fcache.size() + 1024;
+        if (!over_bytes && !over_count && !over_fifo) break;
+        auto victim = std::move(E->fcache_fifo.front());
         E->fcache_fifo.pop_front();
+        auto it = E->fcache.find(victim.first);
+        if (it != E->fcache.end() && it->second->seq == victim.second) {
+            if (over_bytes || over_count) {
+                if (!it->second->inline_data.empty())
+                    E->fcache_inline_bytes -= it->second->inline_data.size();
+                E->fcache.erase(it);
+            } else {
+                // compaction only: rotate the live head to the back so the
+                // stale slots behind it become poppable
+                E->fcache_fifo.push_back(std::move(victim));
+            }
+        }
     }
 }
 
@@ -1822,9 +1835,28 @@ bool handle_filer_write(Engine* E, Worker* w, Conn* c,
         return true;
     }
     if (dlen > E->filer_chunk_limit) return false;  // multi-chunk: Python
-    if (E->filer_compress && !mime.empty() &&
-        mime != "application/octet-stream")
-        return false;  // Python would consider compressing this mime
+    if (E->filer_compress) {
+        // the Python pipeline compresses by mime AND by extension
+        // (util/compression.py is_compressable_file_type); anything its
+        // heuristic might gzip must take the Python path
+        if (!mime.empty() && mime != "application/octet-stream")
+            return false;
+        size_t dot = path.rfind('.');
+        size_t slash = path.rfind('/');
+        if (dot != std::string::npos &&
+            (slash == std::string::npos || dot > slash)) {
+            std::string ext = path.substr(dot);
+            for (auto& ch : ext) ch = (char)tolower((unsigned char)ch);
+            static const char* kTextExt[] = {
+                ".csv", ".txt", ".json", ".xml", ".html", ".htm", ".css",
+                ".js", ".log", ".md", ".yaml", ".yml", ".toml", ".svg",
+                ".conf", ".ini", ".py", ".go", ".java", ".c", ".cpp", ".h",
+                ".rs", ".ts", ".sql", ".sh", ".pdf",
+            };
+            for (const char* t : kTextExt)
+                if (ext == t) return false;
+        }
+    }
     std::shared_ptr<FilerLease> L;
     {
         std::shared_lock<std::shared_mutex> l(E->flease_mu);
@@ -2016,6 +2048,22 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
                                     "ETag: " + inm + "\r\n", "", 0, false);
                     E->stats.native_reads++;
                     return;
+                }
+                if (!range.empty() && !multi) {
+                    // unsatisfiable ranges 416 here (filer.py semantics);
+                    // the volume engine would serve the full entity and
+                    // the answer must not depend on cache state
+                    long long rs, re2;
+                    if (parse_range_spec(range, ent->size, &rs, &re2) == 1) {
+                        char cr[64];
+                        snprintf(cr, sizeof cr,
+                                 "Content-Range: bytes */%llu\r\n",
+                                 (unsigned long long)ent->size);
+                        append_response(c, 416, "Range Not Satisfiable", "",
+                                        cr, "", 0, false);
+                        E->stats.native_reads++;
+                        return;
+                    }
                 }
                 if (method == "GET" && !multi) {
                     filer_relay_launch(E, w, c, ent, pstr, req, req_len,

@@ -226,6 +226,43 @@ class HashService:
         md5, crc = _hash_one(data)
         return binascii.hexlify(md5).decode(), crc
 
+    def span_keys(self, buf, cuts, seed: bytes = b"") -> list[str]:
+        """Dedup identity keys per CDC span, function-prefixed:
+        "x<hex32>" = SW128 keyed by the caller's per-store seed (native
+        kernel, ~2.5x the MD5 span batch on this host), "f<hex32>" = MD5
+        fallback when the native lib is absent. The prefix keeps the two
+        key spaces disjoint — a store written by one backend and served by
+        the other simply stops cross-deduping instead of mixing hash
+        functions under one key."""
+        if not cuts:
+            return []
+        lib = _native_lib()
+        if lib is not None and hasattr(lib, "fast128_spans"):
+            digests = lib.fast128_spans(buf, cuts, seed)
+            return [
+                "x" + binascii.hexlify(digests[i].tobytes()).decode()
+                for i in range(len(cuts))
+            ]
+        return ["f" + h for h, _ in self.hash_spans(buf, cuts)]
+
+    def md5_spans(self, buf, ranges: list[tuple[int, int]]) -> list[str]:
+        """MD5 hex per (offset, length) span — one lockstep native batch,
+        scalar fallback. The dedup path uses this for index MISSES only."""
+        if not ranges:
+            return []
+        lib = _native_lib()
+        if lib is not None and hasattr(lib, "md5_spans"):
+            digests = lib.md5_spans(buf, [r[0] for r in ranges],
+                                    [r[1] for r in ranges])
+            return [
+                binascii.hexlify(digests[i].tobytes()).decode()
+                for i in range(len(ranges))
+            ]
+        mv = memoryview(buf)
+        return [
+            hashlib.md5(bytes(mv[o:o + n])).hexdigest() for o, n in ranges
+        ]
+
     def hash_spans(self, buf, cuts) -> list[tuple[str, int]]:
         """Synchronous batch over CDC spans of one contiguous buffer:
         returns [(md5 hex, crc32c)] per chunk, cuts being exclusive ends.

@@ -340,11 +340,20 @@ class FilerServer:
             tok = encode_jwt(self.security.read_key,
                              {"fid": "", "exp": int(time.time()) + 3600})
             read_auth = f"BEARER {tok}"
-        self.fastlane._lib.sw_fl_filer_lease_set(
+        rc = int(self.fastlane._lib.sw_fl_filer_lease_set(
             self.fastlane.handle, host.encode(), int(port), int(vid_s),
             cookie, key, key + count, upload_auth.encode(),
             read_auth.encode(),
-        )
+        ))
+        if rc != 0:
+            # e.g. the volume registered by hostname (the engine needs an
+            # IP): chunk writes stay on the Python path. Without a backoff
+            # the 20ms loop would burn a count=20000 master assignment per
+            # tick forever.
+            self._fl_lease_backoff_until = time.monotonic() + 30.0
+            glog.warning(
+                "filer native lease rejected by engine (rc=%s, volume %s);"
+                " chunk writes stay on the Python path", rc, loc)
 
     def _fl_filer_loop(self) -> None:  # pragma: no cover - timing loop
         while not self._register_stop.is_set():
@@ -356,8 +365,15 @@ class FilerServer:
                     # writes fall back to the slow proxy when it runs dry)
                     rem = int(self.fastlane._lib.sw_fl_filer_lease_remaining(
                         self.fastlane.handle))
-                    if rem < 5000:
-                        self._fl_lease_refresh()
+                    if rem < 5000 and time.monotonic() >= getattr(
+                            self, "_fl_lease_backoff_until", 0.0):
+                        try:
+                            self._fl_lease_refresh()
+                        except Exception:
+                            # master down/unreachable: same 30s backoff so
+                            # the 20ms loop doesn't hammer it
+                            self._fl_lease_backoff_until = (
+                                time.monotonic() + 30.0)
                     got = self._fl_filer_drain(once=True)
                     applied += got
                     if got == 0:
@@ -531,10 +547,13 @@ class FilerServer:
         mime: str = "", filename: str = "",
     ) -> tuple[list[FileChunk], str]:
         """Dedup write path (filer/dedup.py, BASELINE config 4): cut at
-        content-defined boundaries, batch-hash every chunk, upload only the
-        chunks whose (md5,len) key is new; known chunks reference the
-        already-stored fileId. Boundaries follow content, so shifted or
-        partially-edited re-uploads still dedup."""
+        content-defined boundaries, key every chunk by its SW128 identity
+        hash (span_keys — ~3.5x cheaper than MD5), and upload only the
+        chunks whose (identity, length) key is new; known chunks reference
+        the already-stored fileId, reusing the MD5 ETag recorded at insert.
+        MD5 runs ONLY over index misses (their upload ETags) — on a dup-
+        heavy stream almost no MD5 is paid at all. Boundaries follow
+        content, so shifted or partially-edited re-uploads still dedup."""
         from seaweedfs_tpu.ops import cdc
 
         ext = os.path.splitext(filename)[1]
@@ -546,17 +565,15 @@ class FilerServer:
             backend=cdc.pick_backend(),
         )
         hash_svc = get_hash_service()
-        # one zero-copy native batch for every chunk's md5+crc (lockstep
-        # kernels, GIL released once); bytes are sliced only for the chunks
-        # that actually need uploading
-        span_hashes = hash_svc.hash_spans(memoryview(data), cuts)
-        chunks: list[FileChunk] = []
-        offset = 0
         idx = self.dedup_index
+        keys = hash_svc.span_keys(memoryview(data), cuts, seed=idx.seed)
+        # pass 1: classify against the index; collect the miss spans
+        recs: list[dict | None] = []
+        miss_ranges: list[tuple[int, int]] = []
         prev = 0
-        for c, (etag, _crc) in zip(cuts, span_hashes):
+        for c, khash in zip(cuts, keys):
             ln = c - prev
-            key = f"{etag}-{ln:x}"
+            key = f"{khash}-{ln:x}"
             rec = idx.lookup(key)
             if rec is not None:
                 # linearize vs gc: record the fid as freshly referenced, or
@@ -566,18 +583,32 @@ class FilerServer:
                         rec = None
                     else:
                         self._dedup_recent[rec["fid"]] = time.monotonic()
+            recs.append(rec)
+            if rec is None:
+                miss_ranges.append((prev, ln))
+            prev = c
+        # pass 2: one MD5 batch over ONLY the missed spans (upload ETags)
+        miss_md5s = iter(hash_svc.md5_spans(memoryview(data), miss_ranges))
+        chunks: list[FileChunk] = []
+        offset = 0
+        prev = 0
+        for c, khash, rec in zip(cuts, keys, recs):
+            ln = c - prev
+            key = f"{khash}-{ln:x}"
             if rec is not None:
                 idx.hits += 1
                 idx.bytes_saved += ln
                 chunks.append(
                     FileChunk(
                         file_id=rec["fid"], offset=offset, size=ln,
-                        modified_ts_ns=time.time_ns(), etag=etag,
+                        modified_ts_ns=time.time_ns(),
+                        etag=rec.get("etag", ""),
                         is_compressed=bool(rec.get("z")),
                     )
                 )
             else:
                 idx.misses += 1
+                etag = next(miss_md5s)
                 piece = data[prev:c]  # bytes materialized only for uploads
                 payload, compressed = (
                     maybe_compress_data(piece, mime, ext) if self.compress
@@ -599,7 +630,16 @@ class FilerServer:
                     with self._dedup_mu:
                         self._dedup_condemned.discard(key)
                         self._dedup_recent[out["fid"]] = time.monotonic()
-                    idx.insert(key, {"fid": out["fid"], "z": int(compressed)})
+                    # shadow entry keyed by the chunk's MD5: lets
+                    # _dedup_managed answer "is this fid index-owned?" from
+                    # chunk metadata alone (it has no content to re-hash).
+                    # Shadow FIRST: its lifetime must cover the primary's,
+                    # or a crash window would leave a primary whose blob
+                    # overwrite-reclaim no longer recognizes as shared.
+                    idx.insert(f"m{etag}-{ln:x}",
+                               {"fid": out["fid"], "p": key})
+                    idx.insert(key, {"fid": out["fid"], "z": int(compressed),
+                                     "etag": etag})
             prev = c
             offset += ln
         return chunks, md5.hexdigest()
@@ -1079,11 +1119,19 @@ class FilerServer:
     def _dedup_managed(self, chunk: FileChunk) -> bool:
         """True when the chunk's blob is owned by the dedup index — other
         entries may reference the same fid, so delete/overwrite must not
-        reclaim it (`fs.dedup.gc` does, once nothing references it)."""
+        reclaim it (`fs.dedup.gc` does, once nothing references it).
+        Consults the MD5-keyed shadow entry ("m<md5>-<len>", written next
+        to every SW128 primary) because chunk metadata carries only the
+        MD5 ETag; legacy md5-primary keys (pre-SW128 indexes) still match
+        via the bare-key fallback."""
         if not self.dedup or not chunk.etag:
             return False
-        rec = self.dedup_index.lookup(f"{chunk.etag}-{chunk.size:x}")
-        return rec is not None and rec.get("fid") == chunk.file_id
+        for key in (f"m{chunk.etag}-{chunk.size:x}",
+                    f"{chunk.etag}-{chunk.size:x}"):
+            rec = self.dedup_index.lookup(key)
+            if rec is not None and rec.get("fid") == chunk.file_id:
+                return True
+        return False
 
     def dedup_gc(self) -> dict:
         """Walk the namespace, then drop every index entry (and delete its
@@ -1124,6 +1172,20 @@ class FilerServer:
             fid = rec.get("fid", "")
             if not fid or fid in referenced:
                 continue
+            # Shadow entries ("m<md5>-<len>") must OUTLIVE their primary —
+            # a shadow removed while the primary still hands out the fid
+            # would let overwrite-reclaim delete a shared blob. They are
+            # only swept here once their primary is gone (crash orphans).
+            is_shadow = key.startswith("m") and len(key) > 33
+            if is_shadow:
+                primary = rec.get("p", "")
+                if primary and self.dedup_index.lookup(primary) is not None:
+                    continue  # primary alive: the pair drops together below
+                try:
+                    self.dedup_index.remove(key)
+                except Exception:
+                    errors += 1
+                continue
             with self._dedup_mu:
                 # referenced (or re-inserted) since the walk began: keep
                 ts = self._dedup_recent.get(fid)
@@ -1138,6 +1200,15 @@ class FilerServer:
             except Exception:
                 errors += 1
                 continue
+            # the paired shadow goes with its primary (etag recorded at
+            # insert); failure just leaves an orphan the next gc sweeps
+            etag = rec.get("etag", "")
+            if etag:
+                try:
+                    self.dedup_index.remove(
+                        f"m{etag}-{key.rsplit('-', 1)[1]}")
+                except Exception:
+                    pass
             try:
                 self.client.delete(fid)
             except Exception:
@@ -1208,13 +1279,20 @@ class FilerServer:
         if rng and rng.startswith("bytes=") and "," not in rng:
             spec = rng[6:]
             s, _, e = spec.partition("-")
-            start = int(s) if s else max(0, size - int(e))
-            end = int(e) if e and s else size - 1
-            end = min(end, size - 1)
-            if start > end:
-                return Response(b"", 416, {"Content-Range": f"bytes */{size}"})
-            status = 206
-            headers["Content-Range"] = f"bytes {start}-{end}/{size}"
+            try:
+                start = int(s) if s else max(0, size - int(e))
+                end = int(e) if e and s else size - 1
+            except ValueError:
+                # RFC 7233: unintelligible specs are ignored (full entity)
+                # — same rule as the native paths (parse_range_spec)
+                start, end = 0, size - 1
+            else:
+                end = min(end, size - 1)
+                if start > end:
+                    return Response(
+                        b"", 416, {"Content-Range": f"bytes */{size}"})
+                status = 206
+                headers["Content-Range"] = f"bytes {start}-{end}/{size}"
         if head:
             headers["X-File-Size"] = str(size)
             headers["Content-Length"] = str(size)

@@ -167,3 +167,58 @@ class TestDedupGC:
         from seaweedfs_tpu.shell.registry import COMMANDS
 
         assert "fs.dedup.gc" in COMMANDS
+
+
+class TestSw128KeysAndShadows:
+    """SW128 identity keys (seeded per store) + MD5 shadow entries: the
+    primary keys dedup lookups; the shadow lets _dedup_managed recognize
+    index-owned fids from chunk metadata alone and must outlive it."""
+
+    def test_primary_and_shadow_entries(self, dedup_cluster):
+        import tests.test_dedup as td
+
+        _, _, filer, _ = dedup_cluster
+        data = os.urandom(120 * 1024)
+        _put(filer, "/k1.bin", data)
+        keys = [k for k, _ in filer.dedup_index.iter_records()]
+        primaries = [k for k in keys if k.startswith("x")]
+        shadows = [k for k in keys if k.startswith("m") and len(k) > 33]
+        assert primaries and shadows
+        # every primary records the MD5 etag its shadow is keyed by
+        for k, rec in filer.dedup_index.iter_records():
+            if k.startswith("x"):
+                assert rec.get("etag"), k
+                ln = k.rsplit("-", 1)[1]
+                assert f"m{rec['etag']}-{ln}" in keys
+        # _dedup_managed answers via the shadow (metadata-only check)
+        chunk = _fid_chunks(filer, "/k1.bin")[0]
+        assert filer._dedup_managed(chunk)
+
+    def test_seed_persists_and_keys_are_store_specific(self, dedup_cluster):
+        _, _, filer, _ = dedup_cluster
+        s1 = filer.dedup_index.seed
+        assert len(s1) == 16
+        assert filer.dedup_index.seed == s1  # cached + persisted
+        e = filer.filer.find_entry("/etc/dedup/.seed")
+        assert e is not None and bytes(e.content) == s1
+
+    def test_gc_drops_shadow_with_primary(self, dedup_cluster):
+        import json
+        import time
+
+        _, _, filer, _ = dedup_cluster
+        data = os.urandom(100 * 1024)
+        _put(filer, "/g1.bin", data)
+        assert http_request("DELETE", f"{filer.url}/g1.bin")[0] == 204
+        time.sleep(1.2)
+        status, _, body = http_request(
+            "POST", f"{filer.url}/__dedup__/gc", b"")
+        assert status == 200 and json.loads(body)["dropped"] >= 1
+        left = [k for k, _ in filer.dedup_index.iter_records()]
+        assert not [k for k in left if k.startswith("x")]
+        assert not [k for k in left if k.startswith("m") and len(k) > 33]
+
+
+def _fid_chunks(filer, path):
+    e = filer.filer.find_entry(path)
+    return list(e.chunks)

@@ -248,3 +248,75 @@ def test_crc_interleaved_batches_match_oracle():
     _, crcs2 = lib.md5_crc_batch_var(blobs)
     for i, b in enumerate(blobs):
         assert int(crcs2[i]) == crc_mod.crc32c(b), i
+
+
+class TestFast128:
+    """SW128 — the dedup identity hash (native/src/fast128.cpp). Keys
+    persist in the filer store, so the function is a STABILITY CONTRACT:
+    the golden vectors here must never change (a behavior change needs a
+    new key prefix in hash_service.span_keys instead)."""
+
+    GOLDENS = {
+        b"": "33e3e03153b370ad09fc69b2f5458347",
+        b"hello world": "c45b2fa4798b614d6ef52c3d1a90a788",
+        b"hello worle": "d1ddba86ba4300cd658d38d5e1028a75",
+    }
+
+    def _lib(self):
+        import pytest
+
+        from seaweedfs_tpu.native import lib
+
+        if lib is None or not hasattr(lib, "fast128"):
+            pytest.skip("native lib unavailable")
+        return lib
+
+    def test_golden_vectors_pinned(self):
+        lib = self._lib()
+        for data, want in self.GOLDENS.items():
+            assert lib.fast128(data).hex() == want
+        # length-extension of zeros must differ (len is folded in)
+        assert lib.fast128(b"\0" * 64) != lib.fast128(b"\0" * 65)
+        assert lib.fast128(b"\0") != lib.fast128(b"")
+
+    def test_spans_match_whole_buffer(self):
+        import numpy as np
+
+        lib = self._lib()
+        rng = np.random.RandomState(3)
+        data = rng.randint(0, 256, size=300000, dtype=np.uint8)
+        cuts = [63, 64, 65, 4096, 100001, 300000]
+        spans = lib.fast128_spans(data, cuts)
+        prev = 0
+        for i, cut in enumerate(cuts):
+            assert spans[i].tobytes() == lib.fast128(
+                data[prev:cut].tobytes()), f"span {i}"
+            prev = cut
+
+    def test_bit_sensitivity(self):
+        # every single-bit flip in a 1KB buffer must change the hash
+        import numpy as np
+
+        lib = self._lib()
+        rng = np.random.RandomState(5)
+        base = rng.randint(0, 256, size=1024, dtype=np.uint8)
+        h0 = lib.fast128(base.tobytes())
+        seen = {h0}
+        for byte in range(0, 1024, 37):
+            for bit in (0, 3, 7):
+                mod = base.copy()
+                mod[byte] ^= 1 << bit
+                h = lib.fast128(mod.tobytes())
+                assert h not in seen, f"collision at byte {byte} bit {bit}"
+                seen.add(h)
+
+    def test_span_keys_prefixing(self):
+        import numpy as np
+
+        from seaweedfs_tpu.ops.hash_service import get_hash_service
+
+        svc = get_hash_service()
+        data = np.arange(10000, dtype=np.uint64).view(np.uint8)
+        keys = svc.span_keys(data, [1000, 80000])
+        assert len(keys) == 2
+        assert all(k[0] in ("x", "f") and len(k) == 33 for k in keys)
