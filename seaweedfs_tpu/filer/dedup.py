@@ -38,6 +38,7 @@ class DedupIndex:
         self._cache: OrderedDict[str, dict] = OrderedDict()
         self._cache_size = cache_size
         self._mu = threading.Lock()
+        self._seed_mu = threading.Lock()
         self._seed: bytes | None = None
         self.hits = 0
         self.misses = 0
@@ -47,10 +48,17 @@ class DedupIndex:
     def seed(self) -> bytes:
         """Per-store 16-byte secret keying the SW128 identity hash:
         without it an attacker could construct offline collisions and make
-        a victim's upload dedup to attacker-chosen bytes. Generated once,
-        persisted beside the index so keys stay stable for the store's
-        lifetime."""
-        if self._seed is None:
+        a victim's upload dedup to attacker-chosen bytes. Generated once
+        under a lock (two racing first-uploads must not mint different
+        seeds — the in-memory one would diverge from the persisted one and
+        every key written this session would be unmatchable after
+        restart), persisted beside the index so keys stay stable for the
+        store's lifetime."""
+        if self._seed is not None:
+            return self._seed
+        with self._seed_mu:
+            if self._seed is not None:
+                return self._seed
             path = f"{DEDUP_DIR}/.seed"
             e = self.filer.find_entry(path)
             if e is not None and len(e.content) == 16:

@@ -364,22 +364,27 @@ class BrokerServer:
         self._assign_cache.pop(f"{ns}/{topic}", None)
 
     def _release_partition(self, ns: str, topic: str, k: int,
-                           fence: bool = False) -> bool:
+                           fence: bool = False, ttl: float = 10.0) -> bool:
         """Flush + drop the in-memory partition so a new owner adopts a
         durable view (the move half of `balance_action.go`). pub_lock
         serializes with in-flight publishes, and the released flag makes
         any publisher that slipped past the owner check fail + re-resolve
         instead of appending to the orphan. With fence=True the partition
-        also rejects publishes (503) until unfenced — the balancer holds
-        the fence across its assignment write so the target can never
-        adopt a stale extent. Returns whether a partition was held."""
+        also rejects publishes (503) until unfenced — the balancer RENEWS
+        the fence on every release round (each call resets the deadline)
+        and takes a long lease for the assignment-write phase, so a slow
+        filer cannot outlive it; a dead balancer's fence releases via the
+        owner check in _is_fenced. Returns whether a partition was held."""
         key = f"{ns}/{topic}/p{k:04d}"
         if fence:
-            self._fenced[key] = time.time() + 10.0  # auto-expires: no
-            # permanent 503s if the balancer dies mid-move
+            self._fenced[key] = time.time() + ttl
         with self._plock:
             tp = self._partitions.pop(key, None)
-        self._assign_cache.pop(f"{ns}/{topic}", None)  # see fresh ownership
+        if tp is not None or fence:
+            # a no-op release on a non-owner (every misrouted publish)
+            # must not bust the assignment cache — that would force a
+            # filer GET of assignments.json per misrouted request
+            self._assign_cache.pop(f"{ns}/{topic}", None)
         if tp is not None:
             with tp.pub_lock:
                 tp.flush()
@@ -392,7 +397,20 @@ class BrokerServer:
         if deadline is None:
             return False
         if time.time() > deadline:
+            # lease lapsed: release-on-crash via OWNER CHECK, not blindly.
+            # If the durable assignment says another broker owns this
+            # partition, the move completed (or is completing) — stay out
+            # of the write path (the publish handler will redirect). Only
+            # when the assignment still points here (or nowhere) did the
+            # balancer die mid-move, and serving resumes safely.
+            self._assign_cache.pop(f"{ns}/{topic}", None)
+            try:
+                self._owner_of(ns, topic, k)  # re-read the durable truth
+            except Exception:
+                return True  # filer unreachable: stay safe, stay fenced
             self._fenced.pop(key, None)
+            # the publish handler's owner check (now against the fresh
+            # assignment) redirects if the move completed elsewhere
             return False
         return True
 
@@ -428,9 +446,14 @@ class BrokerServer:
             return None
         ns, topic, k = _random.choice(loads[source])
         # move protocol: fence the source (new publishes 503 immediately),
-        # quiesce in-flight stragglers until no local partition remains,
-        # only THEN make the assignment durable, and unfence — the target
-        # can never adopt an extent missing an acked message
+        # quiesce in-flight stragglers until no local partition remains —
+        # every round RENEWS the fence lease — then take one LONG lease
+        # (60s) covering the durable assignment write, and unfence. The
+        # target can never adopt an extent missing an acked message, and a
+        # source that outlives an expired short lease re-checks the durable
+        # assignment before serving (_is_fenced owner check), so a slow
+        # filer between quiesce and write cannot strand acked publishes.
+        source_down = False
         try:
             for _ in range(5):
                 out = post_json(f"{source}/partition/release",
@@ -439,14 +462,32 @@ class BrokerServer:
                 if not out.get("had"):
                     break
         except Exception:
-            pass  # source down: its flushed segments are all there is
+            source_down = True  # its flushed segments are all there is
+        if not source_down:
+            # write-phase lease: the fc.put below may stall on a slow
+            # filer; the fence must outlive it. Taken OUTSIDE the quiesce
+            # try — if the source is alive but won't grant the long lease,
+            # ABORT the move rather than write under a 10s fence that a
+            # stall could outlive (double-serve window).
+            try:
+                post_json(f"{source}/partition/release",
+                          {"namespace": ns, "topic": topic, "partition": k,
+                           "fence": True, "ttl": 60.0}, timeout=10)
+            except Exception:
+                try:
+                    post_json(f"{source}/partition/unfence",
+                              {"namespace": ns, "topic": topic,
+                               "partition": k}, timeout=10)
+                except Exception:
+                    pass
+                return None
         self._write_assignment(ns, topic, k, target)
         try:
             post_json(f"{source}/partition/unfence",
                       {"namespace": ns, "topic": topic, "partition": k},
                       timeout=10)
         except Exception:
-            pass  # fences self-expire after 10s
+            pass  # fence releases via the owner check once it expires
         return {"namespace": ns, "topic": topic, "partition": k,
                 "from": source, "to": target}
 
@@ -732,7 +773,7 @@ class BrokerServer:
             p = req.json()
             had = self._release_partition(
                 p.get("namespace", "default"), p["topic"], int(p["partition"]),
-                fence=bool(p.get("fence")),
+                fence=bool(p.get("fence")), ttl=float(p.get("ttl", 10.0)),
             )
             return Response({"ok": True, "had": had})
 

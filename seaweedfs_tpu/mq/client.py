@@ -163,16 +163,54 @@ class Consumer(_Base):
             if k in committed:
                 self._offsets[k] = committed[k]
 
-    def _heartbeat(self) -> None:
-        out = self._follow("POST", "/consumer/heartbeat", {
+    @staticmethod
+    def _is_unknown_group(e: Exception) -> bool:
+        return "404" in str(e) and "unknown group" in str(e)
+
+    def _rejoin(self) -> None:
+        """The group coordinator moved (broker join/leave changes the hash
+        ring) or restarted: group state is coordinator-memory, so the new
+        coordinator answers 404 'unknown group'. Re-join under the SAME
+        instance id and continue — a routine membership change must not
+        kill the consumer. Offsets: partitions kept across the re-join
+        resume from the local position (no re-delivery); gained ones adopt
+        the group's committed offsets (at-least-once, as on any rebalance)."""
+        self._owner_memo.pop(self._coord, None)
+        kept = set(self.partitions)
+        out = self._follow("POST", "/consumer/join", {
             "namespace": self.namespace, "topic": self.topic,
             "group": self.group, "instance_id": self.instance_id,
         }, memo_key=self._coord)
+        self.version = out["version"]
+        self.partitions = out["partitions"]
+        self._polled &= set(self.partitions)
+        gained = [k for k in self.partitions if k not in kept]
+        if gained:
+            self._load_committed(gained)
+        self._last_hb = time.time()
+
+    def _heartbeat(self) -> None:
+        try:
+            out = self._follow("POST", "/consumer/heartbeat", {
+                "namespace": self.namespace, "topic": self.topic,
+                "group": self.group, "instance_id": self.instance_id,
+            }, memo_key=self._coord)
+        except MQError as e:
+            if self._is_unknown_group(e):
+                self._rejoin()
+                return
+            raise
         if out.get("version", self.version) != self.version:
             qs = self._qs(namespace=self.namespace, topic=self.topic,
                           group=self.group, instance_id=self.instance_id)
-            a = self._follow("GET", f"/consumer/assignments?{qs}",
-                             memo_key=self._coord)
+            try:
+                a = self._follow("GET", f"/consumer/assignments?{qs}",
+                                 memo_key=self._coord)
+            except MQError as e:
+                if self._is_unknown_group(e):
+                    self._rejoin()
+                    return
+                raise
             gained = [k for k in a["partitions"] if k not in self.partitions]
             self.version = a["version"]
             self.partitions = a["partitions"]
@@ -214,13 +252,30 @@ class Consumer(_Base):
     def commit(self) -> None:
         """Persist offsets ONLY for partitions this instance consumed —
         writing the whole join-time snapshot would overwrite other
-        members' newer commits."""
-        for k in sorted(self._polled & set(self.partitions)):
-            self._follow("POST", "/offsets/commit", {
+        members' newer commits. Survives a coordinator move mid-commit
+        (re-join once, retry the partition on the new coordinator)."""
+        for k in sorted(self._polled):
+            # membership re-checked FRESH each iteration: a mid-loop
+            # _rejoin may shrink self.partitions, and committing for a
+            # partition now owned elsewhere would regress the new owner's
+            # offsets
+            if k not in self.partitions:
+                continue
+            payload = {
                 "namespace": self.namespace, "topic": self.topic,
                 "group": self.group, "partition": k,
                 "offset": self._offsets[k],
-            }, memo_key=self._coord)
+            }
+            try:
+                self._follow("POST", "/offsets/commit", payload,
+                             memo_key=self._coord)
+            except MQError as e:
+                if not self._is_unknown_group(e):
+                    raise
+                self._rejoin()
+                if k in self.partitions:
+                    self._follow("POST", "/offsets/commit", payload,
+                                 memo_key=self._coord)
 
     def close(self) -> None:
         try:

@@ -61,11 +61,16 @@ class RaftNode:
         snapshot_fn: Callable[[], dict] | None = None,
         restore_fn: Callable[[dict], None] | None = None,
         compact_threshold: int = 256,
+        on_demote: Callable[[], None] | None = None,
     ) -> None:
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.apply_fn = apply_fn
         self.state_dir = state_dir
+        # fired synchronously when this node stops being leader: the master
+        # clears its native assign profiles here so a demoted leader never
+        # keeps minting fids from stale topology (ADVICE r4)
+        self.on_demote = on_demote
         self.heartbeat_interval = heartbeat_interval
         self.election_timeout = election_timeout
         self.rpc = rpc or _default_rpc
@@ -189,6 +194,7 @@ class RaftNode:
         self._persist()
 
     def _become_follower(self, term: int, leader: str | None = None) -> None:
+        was_leader = self.role == "leader"
         self.role = "follower"
         if term > self.current_term:
             self.current_term = term
@@ -196,6 +202,11 @@ class RaftNode:
         if leader:
             self.leader_id = leader
         self._persist()
+        if was_leader and self.on_demote is not None:
+            try:
+                self.on_demote()
+            except Exception:
+                pass  # demotion hooks must never break the raft transition
 
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:

@@ -567,9 +567,14 @@ class FilerServer:
         hash_svc = get_hash_service()
         idx = self.dedup_index
         keys = hash_svc.span_keys(memoryview(data), cuts, seed=idx.seed)
-        # pass 1: classify against the index; collect the miss spans
-        recs: list[dict | None] = []
+        # pass 1: classify against the index; collect the miss spans.
+        # A key repeating WITHIN this upload is a miss only once — later
+        # occurrences defer to the first one's insert (sentinel "defer"),
+        # preserving intra-upload dedup across the two-pass split.
+        DEFER = "defer"
+        recs: list[dict | str | None] = []
         miss_ranges: list[tuple[int, int]] = []
+        seen_this_upload: set[str] = set()
         prev = 0
         for c, khash in zip(cuts, keys):
             ln = c - prev
@@ -583,9 +588,12 @@ class FilerServer:
                         rec = None
                     else:
                         self._dedup_recent[rec["fid"]] = time.monotonic()
+            if rec is None and key in seen_this_upload:
+                rec = DEFER
             recs.append(rec)
             if rec is None:
                 miss_ranges.append((prev, ln))
+                seen_this_upload.add(key)
             prev = c
         # pass 2: one MD5 batch over ONLY the missed spans (upload ETags)
         miss_md5s = iter(hash_svc.md5_spans(memoryview(data), miss_ranges))
@@ -595,7 +603,16 @@ class FilerServer:
         for c, khash, rec in zip(cuts, keys, recs):
             ln = c - prev
             key = f"{khash}-{ln:x}"
-            if rec is not None:
+            defer_md5 = None
+            if rec is DEFER:
+                # repeat of an earlier chunk in this same upload: its
+                # first occurrence has inserted by now (or was TTL'd /
+                # condemned — then upload this occurrence individually)
+                rec = idx.lookup(key)
+                if rec is None:
+                    defer_md5 = hash_svc.md5_spans(
+                        memoryview(data), [(prev, ln)])[0]
+            if rec is not None and not isinstance(rec, str):
                 idx.hits += 1
                 idx.bytes_saved += ln
                 chunks.append(
@@ -608,7 +625,7 @@ class FilerServer:
                 )
             else:
                 idx.misses += 1
-                etag = next(miss_md5s)
+                etag = defer_md5 if defer_md5 is not None else next(miss_md5s)
                 piece = data[prev:c]  # bytes materialized only for uploads
                 payload, compressed = (
                     maybe_compress_data(piece, mime, ext) if self.compress
@@ -1129,8 +1146,19 @@ class FilerServer:
         for key in (f"m{chunk.etag}-{chunk.size:x}",
                     f"{chunk.etag}-{chunk.size:x}"):
             rec = self.dedup_index.lookup(key)
-            if rec is not None and rec.get("fid") == chunk.file_id:
+            if rec is None:
+                continue
+            if rec.get("fid") == chunk.file_id:
                 return True
+            # racing first-uploads of the same content can leave the
+            # shadow pointing at the loser's fid while the primary (the
+            # fid dup-hits actually hand out) holds the winner's — follow
+            # the shadow's primary pointer so the winner stays protected
+            primary = rec.get("p")
+            if primary:
+                prec = self.dedup_index.lookup(primary)
+                if prec is not None and prec.get("fid") == chunk.file_id:
+                    return True
         return False
 
     def dedup_gc(self) -> dict:

@@ -145,6 +145,10 @@ class MasterServer:
         self.raft = RaftNode(
             self.url, peer_urls, self._raft_apply, state_dir=self._raft_dir,
             snapshot_fn=self._raft_snapshot, restore_fn=self._raft_restore,
+            # clear native assign profiles the instant leadership is lost —
+            # waiting for the next maintenance tick would let the engine
+            # keep minting fids from stale topology for up to pulse_seconds
+            on_demote=self._fl_assign_clear,
         )
         self.topo.vid_allocator = lambda: self.raft.propose(
             {"type": "next_volume_id"}

@@ -222,3 +222,18 @@ class TestSw128KeysAndShadows:
 def _fid_chunks(filer, path):
     e = filer.filer.find_entry(path)
     return list(e.chunks)
+
+
+def test_intra_upload_dedup(dedup_cluster):
+    """A single file repeating the same block must not upload the block
+    once per occurrence (VM-image shape): the two-pass classifier defers
+    repeats to the first occurrence's index insert."""
+    _, _, filer, _ = dedup_cluster
+    block = os.urandom(32 * 1024)
+    data = block * 6  # CDC boundaries realign within repeats
+    _put(filer, "/rep.bin", data)
+    fids = _fids(filer, "/rep.bin")
+    # strictly fewer blobs than chunks: repeats referenced, not re-uploaded
+    assert len(set(fids)) < len(fids)
+    assert filer.dedup_index.bytes_saved > 0
+    assert _get(filer, "/rep.bin") == (200, data)

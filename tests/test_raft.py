@@ -350,3 +350,22 @@ class TestSequenceLeaseTermSync:
         b = m.topo.sequencer.next_file_id(1)
         assert b >= 500_000, f"id {b} reuses range B already issued"
         assert b > a
+
+
+def test_demotion_fires_on_demote_hook():
+    """A demoted leader must drop its native assign profiles synchronously
+    (master wires _fl_assign_clear here) — not at the next maintenance
+    tick, during which the engine would mint fids from stale topology."""
+    from seaweedfs_tpu.raft import RaftNode
+
+    fired = []
+    n = RaftNode("n1", [], lambda c: None, rpc=lambda *a, **k: {},
+                 on_demote=lambda: fired.append(1))
+    with n.mu:
+        n.role = "leader"
+        n._become_follower(5, leader="n2")
+    assert fired == [1]
+    # follower -> follower does not re-fire
+    with n.mu:
+        n._become_follower(6)
+    assert fired == [1]
