@@ -248,9 +248,104 @@ class IdentityAccessManagement:
             return self._auth_header(method, path, query_pairs, headers, auth, body)
         if q.get("X-Amz-Algorithm") == "AWS4-HMAC-SHA256":
             return self._auth_presigned(method, path, query_pairs, headers)
-        if auth.startswith("AWS "):  # SigV2 — not supported, explicit error
-            raise err("NotImplemented", "Signature V2 is not supported")
+        if auth.startswith("AWS "):
+            if ":" not in auth:  # truncated V2 header must not fall through
+                raise err("AuthorizationHeaderMalformed", auth)
+            return self._auth_v2_header(method, path, query_pairs, headers,
+                                        auth)
+        if "Signature" in q and "AWSAccessKeyId" in q and "Expires" in q:
+            return self._auth_v2_presigned(method, path, query_pairs, headers)
         return self.anonymous_identity()
+
+    # --- Signature V2 (`weed/s3api/auth_signature_v2.go:64`) ------------------
+    # StringToSign = Method \n Content-MD5 \n Content-Type \n Date \n
+    #                CanonicalizedAmzHeaders CanonicalizedResource
+    # signature = base64(HMAC-SHA1(secret, StringToSign)); header form
+    # "AWS <akid>:<sig>", presigned form ?AWSAccessKeyId&Expires&Signature
+    # (Expires replaces Date in the string to sign).
+
+    # subresources included in the canonicalized resource, per the V2 spec
+    _V2_SUBRESOURCES = (
+        "acl", "delete", "lifecycle", "location", "logging", "notification",
+        "partNumber", "policy", "requestPayment", "response-cache-control",
+        "response-content-disposition", "response-content-encoding",
+        "response-content-language", "response-content-type",
+        "response-expires", "tagging", "torrent", "uploadId", "uploads",
+        "versionId", "versioning", "versions", "website", "cors",
+    )
+
+    @classmethod
+    def _v2_canonical_resource(cls, path: str,
+                               query_pairs: list[tuple[str, str]]) -> str:
+        sub = []
+        for k, v in query_pairs:
+            if k in cls._V2_SUBRESOURCES:
+                sub.append(f"{k}={v}" if v else k)
+        out = path or "/"
+        if sub:
+            out += "?" + "&".join(sorted(sub))
+        return out
+
+    @staticmethod
+    def _v2_canonical_amz_headers(headers: dict) -> str:
+        amz = {}
+        for k, v in headers.items():
+            lk = k.lower()
+            if lk.startswith("x-amz-"):
+                amz[lk] = " ".join(v.split())
+        return "".join(f"{k}:{amz[k]}\n" for k in sorted(amz))
+
+    def _v2_string_to_sign(self, method: str, path: str,
+                           query_pairs: list[tuple[str, str]],
+                           headers: dict, date_slot: str) -> str:
+        return (
+            f"{method}\n{headers.get('content-md5', '')}\n"
+            f"{headers.get('content-type', '')}\n{date_slot}\n"
+            f"{self._v2_canonical_amz_headers(headers)}"
+            f"{self._v2_canonical_resource(path, query_pairs)}"
+        )
+
+    @staticmethod
+    def _v2_sign(secret: str, string_to_sign: str) -> str:
+        import base64
+
+        return base64.b64encode(
+            hmac.new(secret.encode(), string_to_sign.encode(),
+                     hashlib.sha1).digest()
+        ).decode()
+
+    def _auth_v2_header(self, method, path, query_pairs, headers,
+                        auth) -> Identity:
+        akid, _, given = auth[4:].partition(":")
+        if not akid or not given:
+            raise err("AuthorizationHeaderMalformed", auth)
+        ident, secret = self.lookup(akid)
+        # with x-amz-date present the Date slot is EMPTY (the header is
+        # already covered by the canonicalized amz headers)
+        date_slot = "" if "x-amz-date" in headers else headers.get("date", "")
+        sts = self._v2_string_to_sign(method, path, query_pairs, headers,
+                                      date_slot)
+        if not hmac.compare_digest(self._v2_sign(secret, sts), given):
+            raise err("SignatureDoesNotMatch", "v2 signature mismatch")
+        return ident
+
+    def _auth_v2_presigned(self, method, path, query_pairs,
+                           headers) -> Identity:
+        q = dict(query_pairs)
+        akid = q["AWSAccessKeyId"]
+        expires = q["Expires"]
+        try:
+            if time.time() > int(expires):
+                raise err("AccessDenied", "Request has expired")
+        except ValueError:
+            raise err("AccessDenied", f"invalid Expires {expires!r}")
+        ident, secret = self.lookup(akid)
+        sts = self._v2_string_to_sign(method, path, query_pairs, headers,
+                                      expires)
+        if not hmac.compare_digest(self._v2_sign(secret, sts),
+                                   q["Signature"]):
+            raise err("SignatureDoesNotMatch", "v2 presigned mismatch")
+        return ident
 
     def _parse_credential(self, cred: str) -> tuple[str, str, str, str]:
         # <access-key>/<yyyymmdd>/<region>/<service>/aws4_request

@@ -144,6 +144,8 @@ def arn(bucket: str, key: str = "") -> str:
 _POST_EXEMPT = {
     "file", "policy", "x-amz-signature", "success_action_status",
     "x-amz-algorithm", "x-amz-credential", "x-amz-date",
+    # Signature V2 POST-policy auth fields (auth_signature_v2.go)
+    "awsaccesskeyid", "signature",
 }
 
 

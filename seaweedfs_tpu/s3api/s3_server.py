@@ -308,6 +308,10 @@ class S3Server:
     @staticmethod
     def _s3_action_name(method: str, bucket: str, key: str, q: dict) -> str:
         """Canonical AWS action name for policy matching."""
+        if "acl" in q:
+            kind = "Object" if key else "Bucket"
+            return {"GET": f"s3:Get{kind}Acl",
+                    "PUT": f"s3:Put{kind}Acl"}.get(method, f"s3:Get{kind}Acl")
         if "policy" in q:
             return {"GET": "s3:GetBucketPolicy", "PUT": "s3:PutBucketPolicy",
                     "DELETE": "s3:DeleteBucketPolicy"}.get(method, "s3:GetBucketPolicy")
@@ -383,7 +387,19 @@ class S3Server:
             if m == "PUT":
                 if "versioning" in q:
                     return self._put_bucket_versioning(bucket, req.body)
-                return self._put_bucket(bucket)
+                if "acl" in q:
+                    return self._put_acl(req, ident, bucket)
+                grants = self._parse_request_acl(req, ident)
+                resp = self._put_bucket(bucket)
+                if grants is None:
+                    # record the creator as owner even without ACL headers
+                    # so GET ?acl reports a stable owner, not the caller
+                    from . import acl as acl_mod
+
+                    grants = acl_mod.grants_from_canned(
+                        "private", ident.account_id)
+                self._apply_acl(ident.account_id, bucket, None, grants)
+                return resp
             if m == "DELETE":
                 return self._delete_bucket(bucket)
             if m == "HEAD":
@@ -400,7 +416,7 @@ class S3Server:
                 if "versions" in q:
                     return self._list_object_versions(bucket, q)
                 if "acl" in q:
-                    return self._canned_acl(ident)
+                    return self._get_acl(ident, bucket)
                 return self._list_objects(req, bucket, q)
         else:
             if "uploadId" in q:
@@ -422,10 +438,19 @@ class S3Server:
                     return self._put_tagging(path, req.body)
                 if m == "DELETE":
                     return self._delete_tagging(path)
+            if "acl" in q:
+                if m == "GET":
+                    return self._get_acl(ident, bucket, key)
+                if m == "PUT":
+                    return self._put_acl(req, ident, bucket, key)
             if m == "PUT":
+                grants = self._parse_request_acl(req, ident)
                 if req.headers.get("x-amz-copy-source"):
-                    return self._copy_object(req, bucket, key)
-                return self._put_object(req, bucket, key)
+                    resp = self._copy_object(req, bucket, key)
+                else:
+                    resp = self._put_object(req, bucket, key)
+                self._apply_acl(ident.account_id, bucket, key, grants)
+                return resp
             if m in ("GET", "HEAD"):
                 if "versionId" in q:
                     return self._get_object_version(
@@ -799,16 +824,93 @@ class S3Server:
         walk(base, "")
         return removed
 
-    def _canned_acl(self, ident) -> Response:
-        owner = (
-            f"<Owner><ID>{escape(ident.account_id)}</ID></Owner>"
-            "<AccessControlList><Grant><Grantee "
-            'xmlns:xsi="http://www.w3.org/2001/XMLSchema-instance" '
-            'xsi:type="CanonicalUser">'
-            f"<ID>{escape(ident.account_id)}</ID></Grantee>"
-            "<Permission>FULL_CONTROL</Permission></Grant></AccessControlList>"
-        )
-        return xml_response("AccessControlPolicy", owner)
+    # --- ACLs (`s3api_acl_helper.go:33-93`) -----------------------------------
+    # Stored as extended attributes on the bucket/object entries, like the
+    # other bucket metadata. GET serves the stored ACP (default: owner
+    # FULL_CONTROL); PUT accepts canned/grant headers or an
+    # AccessControlPolicy body, fully validated.
+
+    _EXT_ACL = "s3-acl"
+
+    def _acl_entry(self, bucket: str, key: str | None):
+        if key is None:
+            return self._bucket_path(bucket), self._require_bucket(bucket)
+        path = self._object_path(bucket, key)
+        entry = self.fc.get_entry(path)
+        if entry is None:
+            raise err("NoSuchKey", key)
+        return path, entry
+
+    def _acl_owner(self, bucket: str, key: str | None, ident) -> str:
+        """The resource's recorded owner: its own stored ACP, else the
+        BUCKET's stored ACP (objects inherit the bucket owner), else the
+        requester (pre-ACL resources with no record of their creator)."""
+        from . import acl as acl_mod
+
+        _, entry = self._acl_entry(bucket, key)
+        raw = (entry.get("extended") or {}).get(self._EXT_ACL)
+        if not raw and key is not None:
+            _, bentry = self._acl_entry(bucket, None)
+            raw = (bentry.get("extended") or {}).get(self._EXT_ACL)
+        if raw:
+            return acl_mod.loads(raw)[0]
+        return ident.account_id
+
+    def _get_acl(self, ident, bucket: str, key: str | None = None) -> Response:
+        from . import acl as acl_mod
+
+        _, entry = self._acl_entry(bucket, key)
+        raw = (entry.get("extended") or {}).get(self._EXT_ACL)
+        if raw:
+            owner, grants = acl_mod.loads(raw)
+        else:
+            owner = self._acl_owner(bucket, key, ident)
+            grants = [{"type": "CanonicalUser", "value": owner,
+                       "perm": "FULL_CONTROL"}]
+        return xml_response("AccessControlPolicy",
+                            acl_mod.acp_to_xml_inner(owner, grants))
+
+    def _put_acl(self, req: Request, ident, bucket: str,
+                 key: str | None = None) -> Response:
+        from . import acl as acl_mod
+
+        owner = self._acl_owner(bucket, key, ident)
+        grants = self._parse_request_acl(req, ident)
+        if grants is None:
+            if not req.body:
+                # bare PUT ?acl: private (owner-only), as on AWS
+                grants = acl_mod.grants_from_canned("private", owner)
+            else:
+                owner_in, grants = acl_mod.acp_from_xml(req.body)
+                # AWS rejects an ACP whose Owner differs from the
+                # resource's actual owner — accepting it would let any
+                # writer spoof ownership
+                if owner_in and owner_in != owner:
+                    raise err("AccessDenied",
+                              "ACP owner does not match resource owner")
+        self._apply_acl(owner, bucket, key, grants)
+        return Response(b"", 200)
+
+    def _parse_request_acl(self, req: Request, ident) -> list | None:
+        """Validate x-amz-acl / x-amz-grant-* headers on PUT bucket/object
+        BEFORE the write happens (bad grants must fail the request without
+        side effects); returns the grants or None when absent."""
+        from . import acl as acl_mod
+
+        headers = {k.lower(): v for k, v in req.headers.items()}
+        return acl_mod.extract_acl(headers, ident.account_id,
+                                   bucket_owner_id=ident.account_id)
+
+    def _apply_acl(self, owner: str, bucket: str, key: str | None,
+                   grants: list | None) -> None:
+        from . import acl as acl_mod
+
+        if grants is None:
+            return
+        path, entry = self._acl_entry(bucket, key)
+        entry.setdefault("extended", {})[self._EXT_ACL] = acl_mod.dumps(
+            owner, grants)
+        self.fc.put_entry(path, entry)
 
     def _post_policy_upload(self, req: Request, bucket: str) -> Response:
         """POST object via browser form (sigv4-HTTPPOSTConstructPolicy):
@@ -833,23 +935,37 @@ class S3Server:
         policy_b64 = fields_ci.get("policy", "")
         if not policy_b64:
             raise err("AccessDenied", "POST without policy is not allowed")
-        if fields_ci.get("x-amz-algorithm") != "AWS4-HMAC-SHA256":
-            raise err("MalformedPOSTRequest", "unsupported x-amz-algorithm")
-        cred = fields_ci.get("x-amz-credential", "")
-        parts = cred.split("/")
-        if len(parts) != 5 or parts[3] != "s3" or parts[4] != "aws4_request":
-            raise err("MalformedPOSTRequest", f"bad credential {cred!r}")
-        akid, date, region = parts[0], parts[1], parts[2]
-        ident, secret = self.iam.lookup(akid)
-        want = hmac_mod.new(
-            signing_key(secret, date, region, "s3"),
-            policy_b64.encode(),
-            hashlib.sha256,
-        ).hexdigest()
-        if not hmac_mod.compare_digest(
-            want, fields_ci.get("x-amz-signature", "")
-        ):
-            raise err("SignatureDoesNotMatch", "post policy signature")
+        if ("awsaccesskeyid" in fields_ci
+                and "x-amz-algorithm" not in fields_ci):
+            # POST-policy V2 (`auth_signature_v2.go` DoesPolicySignatureV2
+            # Match): signature = base64(HMAC-SHA1(secret, policy_b64))
+            akid = fields_ci["awsaccesskeyid"]
+            ident, secret = self.iam.lookup(akid)
+            if not hmac_mod.compare_digest(
+                self.iam._v2_sign(secret, policy_b64),
+                fields_ci.get("signature", ""),
+            ):
+                raise err("SignatureDoesNotMatch", "post policy v2 signature")
+        else:
+            if fields_ci.get("x-amz-algorithm") != "AWS4-HMAC-SHA256":
+                raise err("MalformedPOSTRequest",
+                          "unsupported x-amz-algorithm")
+            cred = fields_ci.get("x-amz-credential", "")
+            parts = cred.split("/")
+            if (len(parts) != 5 or parts[3] != "s3"
+                    or parts[4] != "aws4_request"):
+                raise err("MalformedPOSTRequest", f"bad credential {cred!r}")
+            akid, date, region = parts[0], parts[1], parts[2]
+            ident, secret = self.iam.lookup(akid)
+            want = hmac_mod.new(
+                signing_key(secret, date, region, "s3"),
+                policy_b64.encode(),
+                hashlib.sha256,
+            ).hexdigest()
+            if not hmac_mod.compare_digest(
+                want, fields_ci.get("x-amz-signature", "")
+            ):
+                raise err("SignatureDoesNotMatch", "post policy signature")
         try:
             doc = json.loads(base64.b64decode(policy_b64))
             bucket_policy.check_post_policy(

@@ -1,0 +1,338 @@
+"""S3 Signature V2 (header + presigned + POST-policy-V2) and the ACL
+grant model (canned ACLs, x-amz-grant-* headers, AccessControlPolicy).
+
+References: `weed/s3api/auth_signature_v2.go:64`,
+`weed/s3api/s3api_acl_helper.go:33-93`.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import time
+import urllib.parse
+from email.utils import formatdate
+
+import pytest
+
+from seaweedfs_tpu.s3api import S3Client, S3Server
+from seaweedfs_tpu.server.filer import FilerServer
+from seaweedfs_tpu.server.httpd import http_request
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+AKID, SECRET = "adminKey", "adminSecret"
+IDENTITIES = {
+    "identities": [
+        {
+            "name": "admin",
+            "credentials": [{"accessKey": AKID, "secretKey": SECRET}],
+            "actions": ["Admin"],
+        },
+    ]
+}
+
+_SUBRESOURCES = {"acl", "uploads", "uploadId", "tagging", "versioning",
+                 "versions", "policy", "lifecycle", "location", "delete"}
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("s3v2")
+    master = MasterServer(port=0, pulse_seconds=1)
+    master.start()
+    vol = VolumeServer([str(tmp / "v0")], master.url, port=0,
+                       pulse_seconds=1, max_volume_count=30)
+    vol.start()
+    filer = FilerServer(master.url, port=0, chunk_size_mb=1)
+    filer.start()
+    s3 = S3Server(filer.url, port=0, config=IDENTITIES)
+    s3.start()
+    yield s3
+    s3.stop()
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+@pytest.fixture(scope="module")
+def admin(stack):
+    return S3Client(stack.url, AKID, SECRET)
+
+
+def _v2_sign(secret: str, sts: str) -> str:
+    return base64.b64encode(
+        hmac.new(secret.encode(), sts.encode(), hashlib.sha1).digest()
+    ).decode()
+
+
+def _v2_resource(path: str, query: str) -> str:
+    sub = []
+    for part in (query or "").split("&"):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        if k in _SUBRESOURCES:
+            sub.append(f"{k}={v}" if v else k)
+    return path + ("?" + "&".join(sorted(sub)) if sub else "")
+
+
+def _v2_request(base: str, method: str, path: str, query: str = "",
+                body: bytes = b"", ctype: str = "",
+                amz: dict | None = None, secret: str = SECRET):
+    """A stock V2-signing client (what boto2 / old SDKs send)."""
+    date = formatdate(usegmt=True)
+    if body and not ctype:
+        # sign the Content-Type actually sent (urllib would otherwise add
+        # a default one the signature didn't cover)
+        ctype = "application/octet-stream"
+    headers = {"Date": date}
+    if ctype:
+        headers["Content-Type"] = ctype
+    amz = dict(amz or {})
+    headers.update(amz)
+    canon_amz = "".join(
+        f"{k.lower()}:{v}\n" for k, v in sorted(
+            (k.lower(), v) for k, v in amz.items())
+    )
+    sts = (f"{method}\n\n{ctype}\n{date}\n{canon_amz}"
+           f"{_v2_resource(path, query)}")
+    headers["Authorization"] = f"AWS {AKID}:{_v2_sign(secret, sts)}"
+    url = base + path + (f"?{query}" if query else "")
+    return http_request(method, url, body or None, headers)
+
+
+class TestSigV2:
+    def test_header_roundtrip(self, stack, admin):
+        admin.create_bucket("v2b")
+        st, _, _ = _v2_request(stack.url, "PUT", "/v2b/hello.txt",
+                               body=b"v2 payload", ctype="text/plain")
+        assert st == 200
+        st, _, body = _v2_request(stack.url, "GET", "/v2b/hello.txt")
+        assert st == 200 and body == b"v2 payload"
+        # subresource is part of the canonicalized resource
+        st, _, body = _v2_request(stack.url, "GET", "/v2b", query="acl")
+        assert st == 200 and b"AccessControlPolicy" in body
+
+    def test_amz_headers_signed(self, stack, admin):
+        admin.create_bucket("v2amz")
+        st, _, _ = _v2_request(
+            stack.url, "PUT", "/v2amz/m.bin", body=b"x",
+            amz={"x-amz-meta-color": "blue"})
+        assert st == 200
+
+    def test_wrong_secret_rejected(self, stack, admin):
+        admin.create_bucket("v2bad")
+        st, _, body = _v2_request(stack.url, "GET", "/v2bad/any",
+                                  secret="not-the-secret")
+        assert st == 403 and b"SignatureDoesNotMatch" in body
+
+    def test_presigned_get(self, stack, admin):
+        admin.create_bucket("v2pre")
+        admin.put_object("v2pre", "p.txt", b"presigned v2")
+        expires = str(int(time.time()) + 120)
+        sts = f"GET\n\n\n{expires}\n/v2pre/p.txt"
+        sig = _v2_sign(SECRET, sts)
+        url = (f"{stack.url}/v2pre/p.txt?AWSAccessKeyId={AKID}"
+               f"&Expires={expires}"
+               f"&Signature={urllib.parse.quote(sig, safe='')}")
+        st, _, body = http_request("GET", url)
+        assert st == 200 and body == b"presigned v2"
+
+    def test_presigned_expired(self, stack, admin):
+        admin.create_bucket("v2exp")
+        admin.put_object("v2exp", "p.txt", b"x")
+        expires = str(int(time.time()) - 5)
+        sig = _v2_sign(SECRET, f"GET\n\n\n{expires}\n/v2exp/p.txt")
+        url = (f"{stack.url}/v2exp/p.txt?AWSAccessKeyId={AKID}"
+               f"&Expires={expires}"
+               f"&Signature={urllib.parse.quote(sig, safe='')}")
+        st, _, body = http_request("GET", url)
+        assert st == 403
+
+    def test_post_policy_v2_upload(self, stack, admin):
+        import json
+
+        admin.create_bucket("v2post")
+        policy = base64.b64encode(json.dumps({
+            "expiration": "2099-01-01T00:00:00Z",
+            "conditions": [{"bucket": "v2post"},
+                           ["starts-with", "$key", "up/"]],
+        }).encode()).decode()
+        sig = base64.b64encode(hmac.new(
+            SECRET.encode(), policy.encode(), hashlib.sha1).digest()).decode()
+        boundary = "xyzFORM"
+        fields = [("key", "up/f.bin"), ("AWSAccessKeyId", AKID),
+                  ("policy", policy), ("signature", sig)]
+        parts = []
+        for name, value in fields:
+            parts.append(f"--{boundary}\r\nContent-Disposition: form-data;"
+                         f' name="{name}"\r\n\r\n{value}\r\n'.encode())
+        parts.append(f"--{boundary}\r\nContent-Disposition: form-data;"
+                     f' name="file"; filename="f.bin"\r\n'
+                     f"Content-Type: application/octet-stream"
+                     f"\r\n\r\n".encode() + b"V2POSTDATA\r\n")
+        parts.append(f"--{boundary}--\r\n".encode())
+        body = b"".join(parts)
+        st, _, resp = http_request(
+            "POST", f"{stack.url}/v2post", body,
+            {"Content-Type": f"multipart/form-data; boundary={boundary}"})
+        assert st == 204, resp
+        assert admin.get_object("v2post", "up/f.bin") == b"V2POSTDATA"
+        # wrong signature rejected
+        bad = body.replace(sig.encode(), b"AAAA" + sig.encode()[4:])
+        st, _, resp = http_request(
+            "POST", f"{stack.url}/v2post", bad,
+            {"Content-Type": f"multipart/form-data; boundary={boundary}"})
+        assert st == 403
+
+
+class TestAclGrantModel:
+    def _get_acl_xml(self, admin, bucket, key=None):
+        path = f"/{bucket}/{key}" if key else f"/{bucket}"
+        st, _, body = admin.request("GET", path, query={"acl": ""})
+        assert st == 200
+        return body.decode()
+
+    def test_canned_public_read(self, stack, admin):
+        admin.create_bucket("aclb")
+        st, _, _ = admin.request(
+            "PUT", "/aclb", query={"acl": ""},
+            headers={"x-amz-acl": "public-read"})
+        assert st == 200
+        xml = self._get_acl_xml(admin, "aclb")
+        assert "AllUsers" in xml and "READ" in xml
+        assert "FULL_CONTROL" in xml  # owner grant always present
+
+    def test_grant_headers_matrix(self, stack, admin):
+        admin.create_bucket("aclg")
+        st, _, _ = admin.request(
+            "PUT", "/aclg", query={"acl": ""},
+            headers={
+                "x-amz-grant-read":
+                    'id="alice", uri="http://acs.amazonaws.com/groups/'
+                    'global/AuthenticatedUsers"',
+                "x-amz-grant-full-control": 'id="bob"',
+                "x-amz-grant-write-acp":
+                    'emailAddress="ops@example.com"',
+            })
+        assert st == 200
+        xml = self._get_acl_xml(admin, "aclg")
+        assert "alice" in xml and "AuthenticatedUsers" in xml
+        assert "bob" in xml and "FULL_CONTROL" in xml
+        assert "ops@example.com" in xml and "WRITE_ACP" in xml
+
+    def test_invalid_grants_rejected(self, stack, admin):
+        admin.create_bucket("aclx")
+        # unknown group URI
+        st, _, body = admin.request(
+            "PUT", "/aclx", query={"acl": ""},
+            headers={"x-amz-grant-read": 'uri="http://evil.example/all"'})
+        assert st == 400 and b"InvalidArgument" in body
+        # malformed grantee token
+        st, _, body = admin.request(
+            "PUT", "/aclx", query={"acl": ""},
+            headers={"x-amz-grant-read": "justaname"})
+        assert st == 400 and b"InvalidArgument" in body
+        # bad email
+        st, _, body = admin.request(
+            "PUT", "/aclx", query={"acl": ""},
+            headers={"x-amz-grant-read": 'emailAddress="not-an-email"'})
+        assert st == 400 and b"InvalidArgument" in body
+        # canned + grant headers together
+        st, _, body = admin.request(
+            "PUT", "/aclx", query={"acl": ""},
+            headers={"x-amz-acl": "private",
+                     "x-amz-grant-read": 'id="alice"'})
+        assert st == 400 and b"InvalidRequest" in body
+        # invalid canned value
+        st, _, body = admin.request(
+            "PUT", "/aclx", query={"acl": ""},
+            headers={"x-amz-acl": "world-writable"})
+        assert st == 400 and b"InvalidArgument" in body
+
+    def test_object_acl_roundtrip_xml(self, stack, admin):
+        admin.create_bucket("aclo")
+        admin.put_object("aclo", "o.txt", b"acl me")
+        acp = (
+            '<AccessControlPolicy>'
+            "<Owner><ID>admin</ID></Owner><AccessControlList>"
+            '<Grant><Grantee xmlns:xsi="http://www.w3.org/2001/'
+            'XMLSchema-instance" xsi:type="CanonicalUser">'
+            "<ID>admin</ID></Grantee>"
+            "<Permission>FULL_CONTROL</Permission></Grant>"
+            '<Grant><Grantee xmlns:xsi="http://www.w3.org/2001/'
+            'XMLSchema-instance" xsi:type="Group">'
+            "<URI>http://acs.amazonaws.com/groups/global/AllUsers</URI>"
+            "</Grantee><Permission>READ</Permission></Grant>"
+            "</AccessControlList></AccessControlPolicy>"
+        ).encode()
+        st, _, _ = admin.request("PUT", "/aclo/o.txt", query={"acl": ""},
+                                 body=acp)
+        assert st == 200
+        xml = self._get_acl_xml(admin, "aclo", "o.txt")
+        assert "AllUsers" in xml and "READ" in xml
+        # object acl on a missing key 404s
+        st, _, body = admin.request("GET", "/aclo/missing", query={"acl": ""})
+        assert st == 404
+
+    def test_put_object_with_canned_acl_header(self, stack, admin):
+        admin.create_bucket("aclput")
+        st, _, _ = admin.request(
+            "PUT", "/aclput/obj.bin", body=b"data",
+            headers={"x-amz-acl": "public-read"})
+        assert st == 200
+        xml = self._get_acl_xml(admin, "aclput", "obj.bin")
+        assert "AllUsers" in xml
+
+    def test_default_acl_owner_full_control(self, stack, admin):
+        admin.create_bucket("acldef")
+        xml = self._get_acl_xml(admin, "acldef")
+        assert "FULL_CONTROL" in xml
+
+
+class TestReviewHardening:
+    def test_malformed_aws_header_rejected(self, stack, admin):
+        st, _, body = http_request(
+            "GET", f"{stack.url}/", headers={"Authorization": "AWS adminKey"})
+        assert st == 400 and b"AuthorizationHeaderMalformed" in body
+
+    def test_acp_owner_spoof_rejected(self, stack, admin):
+        admin.create_bucket("aclown")
+        admin.put_object("aclown", "o.txt", b"x")
+        acp = (
+            "<AccessControlPolicy><Owner><ID>intruder</ID></Owner>"
+            "<AccessControlList/></AccessControlPolicy>"
+        ).encode()
+        st, _, body = admin.request("PUT", "/aclown/o.txt",
+                                    query={"acl": ""}, body=acp)
+        assert st == 403 and b"AccessDenied" in body
+
+    def test_owner_stable_across_callers(self, stack, admin):
+        admin.create_bucket("aclstable")
+        xml = admin.request("GET", "/aclstable", query={"acl": ""})[2]
+        assert b"<ID>admin</ID>" in xml  # creator recorded at PUT bucket
+        # objects inherit the bucket owner when they carry no own ACP
+        admin.put_object("aclstable", "k.txt", b"x")
+        xml = admin.request("GET", "/aclstable/k.txt", query={"acl": ""})[2]
+        assert b"<ID>admin</ID>" in xml
+
+    def test_copy_object_acl_headers(self, stack, admin):
+        admin.create_bucket("aclcopy")
+        admin.put_object("aclcopy", "src.txt", b"copy me")
+        st, _, _ = admin.request(
+            "PUT", "/aclcopy/dst.txt",
+            headers={"x-amz-copy-source": "/aclcopy/src.txt",
+                     "x-amz-acl": "public-read"})
+        assert st == 200
+        xml = admin.request("GET", "/aclcopy/dst.txt",
+                            query={"acl": ""})[2].decode()
+        assert "AllUsers" in xml
+        # invalid grants on copy fail before any write
+        st, _, body = admin.request(
+            "PUT", "/aclcopy/dst2.txt",
+            headers={"x-amz-copy-source": "/aclcopy/src.txt",
+                     "x-amz-grant-read": "bogus"})
+        assert st == 400
+        assert admin.head_object("aclcopy", "dst2.txt") is None
