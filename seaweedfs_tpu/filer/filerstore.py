@@ -21,6 +21,26 @@ class FilerStore:
 
     name = "abstract"
 
+    @staticmethod
+    def split_path(full_path: str) -> tuple[str, str]:
+        """ONE root convention for every store: the root entry "/" lives
+        under (directory "/", name "/") — and because of that, stores
+        whose listing is a scan over (directory, name) rows or a key
+        prefix MUST exclude the root entry when listing "/" (it is not
+        its own child; see list_should_skip). Three stores previously had
+        private near-copies of this helper with divergent root handling,
+        which made etcd/sql/redis list "/" inside itself."""
+        if full_path == "/":
+            return "/", "/"
+        d, _, n = full_path.rpartition("/")
+        return d or "/", n
+
+    @staticmethod
+    def list_should_skip(dir_path: str, entry: Entry) -> bool:
+        """True for the root self-row when listing "/" (shared by every
+        store whose storage model would otherwise return it)."""
+        return entry.full_path == dir_path
+
     def insert_entry(self, entry: Entry) -> None:
         raise NotImplementedError
 
@@ -135,6 +155,10 @@ class SqliteStore(FilerStore):
 
     @staticmethod
     def _split(full_path: str) -> tuple[str, str]:
+        # NOT split_path: sqlite's persisted rows key the root under
+        # directory "" (pre-dating the shared convention), and changing
+        # the key would orphan the root row in every existing database.
+        # The "" directory also keeps the root out of "/" listings.
         if full_path == "/":
             return "", "/"
         d, _, n = full_path.rpartition("/")
@@ -225,6 +249,10 @@ def make_store(kind: str, path: str | None = None) -> FilerStore:
         from .stores_gated import RedisStore
 
         return RedisStore()
+    if kind == "etcd":
+        from .etcd import EtcdStore
+
+        return EtcdStore(path) if path else EtcdStore()
     if kind == "mysql":
         from .stores_gated import MysqlStore
 

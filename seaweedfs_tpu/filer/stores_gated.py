@@ -6,7 +6,11 @@ most need external client libraries. This build ships the same SPI surface:
 the embedded stores (memory, sqlite, leveldb-style KV) are always available,
 and the network-DB stores below instantiate when their driver is importable
 — otherwise they raise a clear configuration error at startup, mirroring a
-missing build tag in the reference.
+missing build tag in the reference. All of them run the full store
+contract suite in CI against in-process fakes (tests/fake_redis.py,
+tests/fake_dbapi.py — a sqlite-backed DB-API shim injected as
+pymysql/psycopg2, exercising the real import-and-connect path and the
+%s placeholder dialect).
 
 SQL stores share AbstractSqlStore (`weed/filer/abstract_sql/
 abstract_sql_store.go`): one table keyed by (dirhash, name) with a
@@ -72,12 +76,8 @@ class AbstractSqlStore(FilerStore):
     def update_entry(self, entry: Entry) -> None:
         self.insert_entry(entry)
 
-    @staticmethod
-    def _split(path: str) -> tuple[str, str]:
-        if path == "/":
-            return "/", "/"  # root row matches Entry.parent/name for "/"
-        d, _, name = path.rpartition("/")
-        return d, name
+    # one root convention for every store (see FilerStore.split_path)
+    _split = staticmethod(FilerStore.split_path)
 
     def find_entry(self, path: str) -> Entry | None:
         d, name = self._split(path)
@@ -111,6 +111,8 @@ class AbstractSqlStore(FilerStore):
         out = []
         for (blob,) in cur.fetchall():
             e = Entry.from_dict(json.loads(blob))
+            if self.list_should_skip(dir_path, e):
+                continue  # the root self-row is not its own child
             if start_from:
                 if e.name < start_from or (e.name == start_from
                                            and not inclusive):
@@ -124,7 +126,7 @@ class AbstractSqlStore(FilerStore):
         self.conn.close()
 
 
-class MysqlStore(AbstractSqlStore):  # pragma: no cover - driver not in image
+class MysqlStore(AbstractSqlStore):
     placeholder = "%s"
 
     def __init__(self, host="127.0.0.1", port=3306, user="root",
@@ -141,7 +143,7 @@ class MysqlStore(AbstractSqlStore):  # pragma: no cover - driver not in image
         ))
 
 
-class PostgresStore(AbstractSqlStore):  # pragma: no cover
+class PostgresStore(AbstractSqlStore):
     placeholder = "%s"
 
     def __init__(self, host="127.0.0.1", port=5432, user="postgres",
@@ -158,7 +160,7 @@ class PostgresStore(AbstractSqlStore):  # pragma: no cover
         ))
 
 
-class RedisStore(FilerStore):  # pragma: no cover - driver not in image
+class RedisStore(FilerStore):
     """Path -> entry-json hash layout (`weed/filer/redis2/`)."""
 
     def __init__(self, host="127.0.0.1", port=6379, db=0, client=None) -> None:
@@ -202,7 +204,7 @@ class RedisStore(FilerStore):  # pragma: no cover - driver not in image
             e = self.find_entry(
                 dir_path.rstrip("/") + "/" + name.decode()
             )
-            if e is not None:
+            if e is not None and not self.list_should_skip(dir_path, e):
                 out.append(e)
             if len(out) >= limit:
                 break

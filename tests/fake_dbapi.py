@@ -1,0 +1,67 @@
+"""sqlite-backed fakes of the pymysql / psycopg2 DB-API modules.
+
+Installed into sys.modules so MysqlStore/PostgresStore exercise their
+REAL import-and-connect paths and the %s-placeholder AbstractSqlStore
+dialect against a working database — the gated stores run the full
+store contract suite instead of sitting behind `pragma: no cover`."""
+
+from __future__ import annotations
+
+import sqlite3
+import sys
+import types
+
+
+class _Cursor:
+    def __init__(self, cur: sqlite3.Cursor) -> None:
+        self._cur = cur
+
+    def execute(self, sql: str, params=()):
+        return self._cur.execute(sql.replace("%s", "?"), params)
+
+    def fetchone(self):
+        return self._cur.fetchone()
+
+    def fetchall(self):
+        return self._cur.fetchall()
+
+
+class _Connection:
+    def __init__(self, db_path: str) -> None:
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+
+    def cursor(self) -> _Cursor:
+        return _Cursor(self._conn.cursor())
+
+    def commit(self) -> None:
+        self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+def _module(name: str, db_path: str) -> types.ModuleType:
+    mod = types.ModuleType(name)
+    if name == "psycopg2":
+        def connect(host="", port=0, user="", password="", dbname=""):
+            return _Connection(db_path)
+    else:
+        def connect(host="", port=0, user="", password="", database=""):
+            return _Connection(db_path)
+    mod.connect = connect
+    return mod
+
+
+def install(name: str, db_path: str = ":memory:"):
+    """Put a fake `pymysql` or `psycopg2` into sys.modules; returns a
+    callable that removes it again."""
+    saved = sys.modules.get(name)
+    sys.modules[name] = _module(name, db_path)
+
+    def uninstall():
+        if saved is None:
+            sys.modules.pop(name, None)
+        else:
+            sys.modules[name] = saved
+
+    return uninstall

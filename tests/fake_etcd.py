@@ -1,0 +1,79 @@
+"""In-process fake etcd speaking the v3 HTTP/JSON gRPC-gateway surface
+EtcdStore uses (`/v3/kv/put|range|deleterange`, base64 keys/values,
+prefix range_end, KEY-ascending sort) — the store contract suite runs
+against it so 'etcd' is a tested backend, not an untrusted gate."""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeEtcd:
+    def __init__(self) -> None:
+        self.kv: dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silent
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                key = base64.b64decode(payload.get("key", ""))
+                range_end = base64.b64decode(payload.get("range_end", ""))
+                if self.path == "/v3/kv/put":
+                    value = base64.b64decode(payload.get("value", ""))
+                    with fake._lock:
+                        fake.kv[key] = value
+                    out = {}
+                elif self.path == "/v3/kv/range":
+                    with fake._lock:
+                        if range_end:
+                            keys = sorted(
+                                k for k in fake.kv
+                                if key <= k < range_end
+                            )
+                        else:
+                            keys = [key] if key in fake.kv else []
+                        limit = int(payload.get("limit", 0) or 0)
+                        if limit:
+                            keys = keys[:limit]
+                        out = {"kvs": [
+                            {"key": base64.b64encode(k).decode(),
+                             "value": base64.b64encode(fake.kv[k]).decode()}
+                            for k in keys
+                        ], "count": str(len(keys))}
+                elif self.path == "/v3/kv/deleterange":
+                    with fake._lock:
+                        if range_end:
+                            victims = [k for k in fake.kv
+                                       if key <= k < range_end]
+                        else:
+                            victims = [key] if key in fake.kv else []
+                        for k in victims:
+                            fake.kv.pop(k, None)
+                    out = {"deleted": str(len(victims))}
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.endpoint = f"127.0.0.1:{self._httpd.server_address[1]}"
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -16,7 +16,8 @@ from seaweedfs_tpu.server.volume import VolumeServer
 
 
 @pytest.fixture(
-    params=["memory", "sqlite", "abstract_sql", "leveldb", "lsm", "redis"]
+    params=["memory", "sqlite", "abstract_sql", "leveldb", "lsm", "redis",
+            "mysql", "postgres", "etcd"]
 )
 def store(request, tmp_path):
     if request.param == "memory":
@@ -45,6 +46,29 @@ def store(request, tmp_path):
         conn = sqlite3.connect(str(tmp_path / "abs.db"),
                                check_same_thread=False)
         return AbstractSqlStore(conn)
+    if request.param in ("mysql", "postgres"):
+        # the real gated stores through their import-and-connect path,
+        # against a sqlite-backed DB-API shim injected as the driver
+        from .fake_dbapi import install
+
+        driver = "pymysql" if request.param == "mysql" else "psycopg2"
+        uninstall = install(driver, str(tmp_path / f"{driver}.db"))
+        request.addfinalizer(uninstall)
+        if request.param == "mysql":
+            from seaweedfs_tpu.filer.stores_gated import MysqlStore
+
+            return MysqlStore()
+        from seaweedfs_tpu.filer.stores_gated import PostgresStore
+
+        return PostgresStore()
+    if request.param == "etcd":
+        from seaweedfs_tpu.filer.etcd import EtcdStore
+
+        from .fake_etcd import FakeEtcd
+
+        fake = FakeEtcd()
+        request.addfinalizer(fake.stop)
+        return EtcdStore(fake.endpoint)
     return SqliteStore(str(tmp_path / "meta.db"))
 
 
@@ -87,6 +111,20 @@ class TestFilerCore:
         assert f.find_entry("/b/uno.txt") is not None
         assert f.find_entry("/b/two.txt") is not None
         assert f.find_entry("/a") is None
+
+    def test_root_listing_excludes_itself(self, store):
+        """The root entry "/" must never list as its own child — stores
+        whose layout scans (directory, name) rows or key prefixes used to
+        diverge here (etcd/sql/redis returned a phantom '/' first, which
+        hid real children under limit=1 and made recursive delete of '/'
+        recurse forever)."""
+        f = Filer(store)
+        f.create_entry(Entry(full_path="/afile.txt"))
+        names = [e.full_path for e in f.list_entries("/")]
+        assert "/" not in names
+        assert "/afile.txt" in names
+        first = f.list_entries("/", limit=1)
+        assert [e.full_path for e in first] == ["/afile.txt"]
 
     def test_metadata_events(self, store):
         f = Filer(store)
@@ -216,3 +254,37 @@ class TestGatedStores:
         for kind in ("redis", "mysql", "postgres"):
             with pytest.raises(RuntimeError, match="requires"):
                 make_store(kind)
+
+
+def test_full_cluster_on_etcd_store(tmp_path):
+    """The distributed-KV store class end-to-end: a filer backed by (fake)
+    etcd through the real v3 HTTP/JSON gateway wire protocol serves the
+    whole write/read path. Match weed/filer/etcd/etcd_store.go."""
+    from .fake_etcd import FakeEtcd
+
+    fake = FakeEtcd()
+    master = MasterServer(port=0, pulse_seconds=1)
+    master.start()
+    vol = VolumeServer([str(tmp_path / "v")], master.url, port=0,
+                       pulse_seconds=1)
+    vol.start()
+    filer = FilerServer(master.url, port=0, store_kind="etcd",
+                        store_path=fake.endpoint)
+    filer.start()
+    try:
+        payload = os.urandom(30000)
+        st, _, _ = http_request("POST", filer.url + "/e/a.bin", payload)
+        assert st == 201
+        st, _, body = http_request("GET", filer.url + "/e/a.bin")
+        assert st == 200 and body == payload
+        st, _, body = http_request("GET", filer.url + "/e/?limit=10")
+        assert st == 200
+        assert any(e["FullPath"] == "/e/a.bin"
+                   for e in json.loads(body)["Entries"])
+        # the entries really live in etcd
+        assert any(k.startswith(b"e/e\x00") for k in fake.kv)
+    finally:
+        filer.stop()
+        vol.stop()
+        master.stop()
+        fake.stop()
