@@ -78,6 +78,8 @@ def run_volume(args: list[str]) -> int:
     p.add_argument("-max", type=int, default=100)
     p.add_argument("-publicUrl", default="")
     p.add_argument("-pulseSeconds", type=int, default=5)
+    p.add_argument("-localSocket", default=None,
+                   help="also serve on this unix domain socket")
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.volume import VolumeServer
 
@@ -93,6 +95,7 @@ def run_volume(args: list[str]) -> int:
         rack=opts.rack,
         pulse_seconds=opts.pulseSeconds,
         max_volume_count=opts.max,
+        local_socket=opts.localSocket,
     )
     vs.start()
     print(f"volume server listening at {vs.url}")
@@ -105,7 +108,7 @@ def run_filer(args: list[str]) -> int:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-master", default="http://127.0.0.1:9333")
     p.add_argument(
-        "-store", default="memory", choices=["memory", "sqlite", "leveldb", "lsm"]
+        "-store", default="memory", choices=["memory", "sqlite", "leveldb", "lsm", "redis", "etcd", "mysql", "postgres"]
     )
     p.add_argument("-storePath", default=None)
     p.add_argument("-maxMB", type=int, default=4, help="chunk size")
@@ -125,6 +128,9 @@ def run_filer(args: list[str]) -> int:
     p.add_argument("-dedup", action="store_true",
                    help="content-defined-chunking dedup on uploads "
                         "(filer/dedup.py; incompatible with cipher)")
+    p.add_argument("-localSocket", default=None,
+                   help="also serve on this unix domain socket "
+                        "(same-host mounts skip TCP; -filer.localSocket)")
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.filer import FilerServer
 
@@ -141,6 +147,7 @@ def run_filer(args: list[str]) -> int:
         port=opts.port,
         store_kind=opts.store,
         store_path=opts.storePath,
+        local_socket=opts.localSocket,
         chunk_size_mb=opts.maxMB,
         default_replication=opts.defaultReplicaPlacement,
         collection=opts.collection,

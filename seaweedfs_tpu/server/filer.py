@@ -66,6 +66,7 @@ class FilerServer:
         dedup_avg_bits: int = 16,
         dedup_min: int = 16 * 1024,
         dedup_max: int = 512 * 1024,
+        local_socket: str | None = None,
     ) -> None:
         from seaweedfs_tpu.security import Guard, SecurityConfig
 
@@ -125,6 +126,9 @@ class FilerServer:
         self._remote_confs: dict = {}
         self._remote_mounts: dict = {}
         self._load_remote_state()
+        # `-filer.localSocket` (weed/command/filer.go): same-host clients
+        # (mounts) reach the filer over a unix domain socket
+        self.local_socket = local_socket
         self._register_stop = __import__("threading").Event()
         self._routes()
 
@@ -183,6 +187,8 @@ class FilerServer:
         import threading
 
         self._start_fastlane()
+        if self.local_socket:
+            self.service.enable_unix_socket(self.local_socket)
         if self.metrics_service is not None:
             self.metrics_service.start()
         self.dlm.host = self.url

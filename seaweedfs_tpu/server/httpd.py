@@ -32,7 +32,10 @@ class Request:
         }
         self.headers = handler.headers
         self.method = handler.command
-        self.remote_ip = handler.client_address[0]
+        ca = handler.client_address
+        # AF_UNIX peers have no address tuple (same-host by construction)
+        self.remote_ip = ca[0] if isinstance(ca, tuple) and ca else "unix"
+
         self._body: bytes | None = None
 
     @property
@@ -175,7 +178,10 @@ class HTTPService:
         start = _time.monotonic()
         path = urllib.parse.urlparse(handler.path).path
         peer_ok = True
-        if getattr(self, "_tls_on", False):
+        # unix-socket peers are same-host-trusted by construction: neither
+        # the mTLS CN gate (no TLS on AF_UNIX) nor the IP guard applies
+        if getattr(self, "_tls_on", False) and not getattr(
+                handler, "_unix_peer", False):
             try:
                 peer_ok = _tls.peer_allowed(
                     handler.connection.getpeercert(), self._allowed_cns
@@ -185,9 +191,11 @@ class HTTPService:
         if not peer_ok:
             req = None
             resp = Response({"error": "client certificate CN not allowed"}, 403)
-        elif self.guard is not None and not self.guard.is_allowed(
+        elif self.guard is not None and isinstance(
+            handler.client_address, tuple
+        ) and handler.client_address and not self.guard.is_allowed(
             handler.client_address[0]
-        ):
+        ):  # unix-socket peers are same-host: the IP whitelist is N/A
             req = None
             resp = Response({"error": "forbidden"}, 403)
         else:
@@ -305,14 +313,66 @@ class HTTPService:
 
             self._httpd = TLSHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
+        self._handler_cls = Handler
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+
+    def enable_unix_socket(self, path: str) -> None:
+        """Extra AF_UNIX listener sharing this service's routes — the
+        `-filer.localSocket` feature (`weed/command/filer.go`): same-host
+        clients (mounts especially) skip the TCP stack. The unix path is
+        same-host-trusted, like the reference's — no TLS/guard applies,
+        and requests bypass any engine front (they reach Python directly).
+        Call after start()."""
+        import socketserver
+
+        class handler(self._handler_cls):
+            # TCP_NODELAY does not exist on AF_UNIX sockets
+            disable_nagle_algorithm = False
+            _unix_peer = True  # exempt from the mTLS CN gate (same-host)
+
+        class UnixHTTPServer(ThreadingHTTPServer):
+            address_family = socket.AF_UNIX
+
+            def server_bind(inner):
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+                # skip HTTPServer.server_bind: it unpacks server_address
+                # as (host, port), which a unix path is not
+                socketserver.TCPServer.server_bind(inner)
+                inner.server_name = "localhost"
+                inner.server_port = 0
+
+        srv = UnixHTTPServer(path, handler)
+        self._unix_httpd = srv
+        self._unix_path = path
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    @property
+    def unix_url(self) -> str | None:
+        """http+unix:// URL for the local-socket listener, or None."""
+        path = getattr(self, "_unix_path", None)
+        if path is None:
+            return None
+        return "http+unix://" + urllib.parse.quote(path, safe="")
 
     def stop(self) -> None:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        unix = getattr(self, "_unix_httpd", None)
+        if unix is not None:
+            unix.shutdown()
+            unix.server_close()
+            self._unix_httpd = None
+            try:
+                os.unlink(self._unix_path)
+            except OSError:
+                pass
+            self._unix_path = None  # unix_url must stop advertising it
 
     @property
     def url(self) -> str:
@@ -356,6 +416,8 @@ def http_request(
     headers: dict | None = None,
     timeout: float = 30.0,
 ) -> tuple[int, dict, bytes]:
+    if url.startswith("http+unix://"):
+        return _unix_http_request(method, url, body, headers, timeout)
     req = urllib.request.Request(url, data=body, method=method)
     for k, v in (headers or {}).items():
         req.add_header(k, v)
@@ -365,6 +427,41 @@ def http_request(
             return resp.status, dict(resp.headers), resp.read()
     except urllib.error.HTTPError as e:
         return e.code, dict(e.headers), e.read()
+
+
+def _unix_http_request(
+    method: str, url: str, body: bytes | None, headers: dict | None,
+    timeout: float,
+) -> tuple[int, dict, bytes]:
+    """HTTP over a unix domain socket. URL form
+    `http+unix://<percent-encoded-socket-path><request-path>` — the same
+    convention requests-unix-socket/docker clients use. Server side:
+    HTTPService.enable_unix_socket (`-filer.localSocket`)."""
+    import http.client
+    import socket as _socket
+
+    rest = url[len("http+unix://"):]
+    sock_quoted, _, path_qs = rest.partition("/")
+    sock_path = urllib.parse.unquote(sock_quoted)
+
+    class _Conn(http.client.HTTPConnection):
+        def __init__(self) -> None:
+            super().__init__("localhost", timeout=timeout)
+
+        def connect(self) -> None:
+            s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            s.settimeout(timeout)
+            s.connect(sock_path)
+            self.sock = s
+
+    conn = _Conn()
+    try:
+        conn.request(method, "/" + path_qs, body=body,
+                     headers=dict(headers or {}))
+        resp = conn.getresponse()
+        return resp.status, dict(resp.headers), resp.read()
+    finally:
+        conn.close()
 
 
 def get_json(url: str, timeout: float = 30.0) -> dict:

@@ -42,6 +42,7 @@ class VolumeServer:
         pulse_seconds: int = 5,
         max_volume_count: int = 100,
         security: SecurityConfig | None = None,
+        local_socket: str | None = None,
     ) -> None:
         # -mserver may list several masters; heartbeats follow the raft
         # leader hint (`volume_grpc_client_to_master.go` re-dial on redirect)
@@ -67,6 +68,7 @@ class VolumeServer:
         self.volume_size_limit = 30 * 1024 * 1024 * 1024
         self._stop = threading.Event()
         self.fastlane = None  # native data-plane front door when available
+        self.local_socket = local_socket  # same-host unix listener
         self._routes()
 
     def _start_fastlane(self) -> None:
@@ -92,6 +94,8 @@ class VolumeServer:
 
     def start(self) -> None:
         self._start_fastlane()
+        if self.local_socket:
+            self.service.enable_unix_socket(self.local_socket)
         self.store = Store(
             self._dirs,
             ip=self._host,

@@ -299,3 +299,98 @@ class TestRealKernelMount:
             assert status == 200 and got == b"via the real kernel"
         finally:
             libc.umount2(mnt.encode(), 2)
+
+
+class TestUnixSocketMount:
+    """`-filer.localSocket` (weed/command/filer.go): same-host mounts reach
+    the filer over a unix domain socket instead of TCP — the WFS client
+    speaks http+unix:// end to end."""
+
+    def test_mount_e2e_over_unix_socket(self, tmp_path):
+        from seaweedfs_tpu.server.filer import FilerServer
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume import VolumeServer
+
+        sock = str(tmp_path / "filer.sock")
+        master = MasterServer(port=0)
+        master.start()
+        vol = VolumeServer([str(tmp_path / "v")], master_url=master.url,
+                           port=0)
+        vol.start()
+        vol.heartbeat_once()
+        filer = FilerServer(master_url=master.url, port=0,
+                            local_socket=sock)
+        filer.start()
+        try:
+            from seaweedfs_tpu.server.httpd import http_request
+
+            unix_url = filer.service.unix_url
+            assert unix_url is not None and unix_url.startswith("http+unix://")
+            # raw HTTP over the socket works
+            st, _, _ = http_request("POST", unix_url + "/probe.txt", b"hi")
+            assert st == 201
+            # a full mount session rides the unix socket
+            wfs = WFS(unix_url, chunk_size=64 * 1024)
+            k = VirtualFuseKernel(wfs)
+            err, ino, fh = k.create(1, "unix.txt")
+            assert err == 0
+            payload = os.urandom(200_000)  # multi-chunk
+            pos = 0
+            while pos < len(payload):
+                err, n = k.write(ino, fh, pos, payload[pos:pos + 64 * 1024])
+                assert err == 0
+                pos += n
+            assert k.flush(ino, fh) == 0
+            assert k.release(ino, fh) == 0
+            err, fh2 = k.open(ino)
+            assert err == 0
+            collected = b""
+            while len(collected) < len(payload):
+                err, piece = k.read(ino, fh2, len(collected), 64 * 1024)
+                assert err == 0 and piece
+                collected += piece
+            assert collected == payload
+            # the same file is visible over TCP too (one namespace)
+            st, _, got = http_request("GET", filer.url + "/unix.txt")
+            assert st == 200 and got == payload
+        finally:
+            filer.stop()
+            vol.stop()
+            master.stop()
+        assert not os.path.exists(sock)  # cleaned up on stop
+
+
+def test_unix_socket_exempt_from_mtls_gate(tmp_path):
+    """With process mTLS active and the Python listener serving TLS, the
+    unix socket (same-host-trusted, no TLS possible on AF_UNIX) must still
+    serve — and stop() must stop advertising the socket URL."""
+    pytest.importorskip("cryptography")
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from test_tls import _issue, _make_ca
+
+    from seaweedfs_tpu.security import tls as tls_mod
+    from seaweedfs_tpu.security.tls import TLSConfig
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.httpd import http_request
+    from seaweedfs_tpu.server.master import MasterServer
+
+    tmp = str(tmp_path)
+    ca_key, ca_cert, ca_pem = _make_ca(tmp)
+    cert, key = _issue(tmp, ca_key, ca_cert, "node1")
+    tls_mod.configure(TLSConfig(ca=ca_pem, cert=cert, key=key))
+    sock = str(tmp_path / "f.sock")
+    master = MasterServer(port=0)
+    master.start()
+    filer = FilerServer(master_url=master.url, port=0, local_socket=sock)
+    filer.start()
+    try:
+        unix_url = filer.service.unix_url
+        st, _, _ = http_request("POST", unix_url + "/t.txt", b"x")
+        assert st == 201, "unix peer must bypass the CN gate"
+    finally:
+        filer.stop()
+        master.stop()
+        tls_mod.reset()
+    assert filer.service.unix_url is None  # stopped: no longer advertised
