@@ -83,6 +83,8 @@ constexpr int kNID_commonName = 13;
 
 struct TlsApi {
     void* (*TLS_server_method)();
+    void* (*TLS_client_method)();
+    void (*SSL_set_connect_state)(void*);
     void* (*SSL_CTX_new)(void*);
     void (*SSL_CTX_free)(void*);
     int (*SSL_CTX_use_certificate_chain_file)(void*, const char*);
@@ -132,6 +134,8 @@ TlsApi* tls_api() {
                 return p;
             };
             *(void**)&api.TLS_server_method = S("TLS_server_method");
+            *(void**)&api.TLS_client_method = S("TLS_client_method");
+            *(void**)&api.SSL_set_connect_state = S("SSL_set_connect_state");
             *(void**)&api.SSL_CTX_new = S("SSL_CTX_new");
             *(void**)&api.SSL_CTX_free = S("SSL_CTX_free");
             *(void**)&api.SSL_CTX_use_certificate_chain_file =
@@ -349,6 +353,8 @@ struct BackendConn {
     uint32_t target_ip = 0;   // 0 = engine's default Python backend
     int target_port = 0;
     int mode = 0;             // 0 proxy, 1 filer chunk upload, 2 filer relay
+    void* ssl = nullptr;      // TLS client session (mTLS upstream hops)
+    uint32_t armed = 0;       // current epoll interest mask
     // filer-write context (mode 1) / relay fallback (mode 2)
     std::string f_path, f_fid, f_mime, f_md5hex;
     uint64_t f_size = 0;
@@ -358,9 +364,13 @@ struct BackendConn {
 
 struct Worker {
     int epfd = -1;
-    std::vector<int> idle_backends;   // keep-alive conns to Python, not in epoll
-    // keep-alive conns to other targets (volume servers), keyed ip<<16|port
-    std::unordered_map<uint64_t, std::vector<int>> idle_targets;
+    // keep-alive conns not currently in epoll: (fd, SSL* or null).
+    // idle_backends: the engine's Python backend (always plaintext);
+    // idle_targets: other targets (volume engines), keyed ip<<16|port —
+    // the TLS session must live as long as its socket
+    std::vector<std::pair<int, void*>> idle_backends;
+    std::unordered_map<uint64_t, std::vector<std::pair<int, void*>>>
+        idle_targets;
     std::vector<BackendConn*> pending;  // in-flight proxied requests
     size_t capped_inflight = 0;         // pending entries counted under the cap
     std::deque<BackendConn*> waiting;   // queued: backend concurrency capped
@@ -428,6 +438,7 @@ struct Engine {
     std::string jwt_write_key;      // non-empty: verify HS256 write JWTs natively
     std::string jwt_read_key;       // non-empty: verify read JWTs natively too
     void* tls_ctx = nullptr;        // OpenSSL SSL_CTX* (engine-terminated mTLS)
+    void* tls_client_ctx = nullptr;  // client ctx: upstream hops under mTLS
     std::vector<std::string> allowed_cns;  // '*'-glob CommonName allow-list
     std::atomic<bool> running{true};
     std::deque<Worker> workers;  // deque: Worker holds mutexes, never moves
@@ -539,6 +550,13 @@ int conn_write(Conn* c, const char* buf, int n) {
     if (e == kSSL_ERROR_WANT_READ || e == kSSL_ERROR_WANT_WRITE) return -1;
     return -2;
 }
+
+// upstream-socket IO (mTLS hops to volume engines ride a TLS CLIENT
+// session; SSL_read/SSL_write drive the handshake implicitly on the
+// nonblocking fd). Returns >0 bytes, 0 EOF, -1 wait-for-READ,
+// -3 wait-for-WRITE, -2 hard error.
+int back_recv(struct BackendConn* b, char* buf, int n);
+int back_send(struct BackendConn* b, const char* buf, int n);
 
 // case-insensitive header lookup inside [hdr_begin, hdr_end); returns value
 // with surrounding spaces trimmed, or empty string
@@ -1057,6 +1075,67 @@ bool handle_delete(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
 // proxy to the Python backend
 // ---------------------------------------------------------------------------
 
+int back_recv(BackendConn* b, char* buf, int n) {
+    if (b->ssl == nullptr) {
+        ssize_t r = recv(b->fd, buf, n, 0);
+        if (r > 0) return (int)r;
+        if (r == 0) return 0;
+        return (errno == EAGAIN || errno == EWOULDBLOCK) ? -1 : -2;
+    }
+    TlsApi* T = tls_api();
+    int r = T->SSL_read(b->ssl, buf, n);
+    if (r > 0) return r;
+    int e = T->SSL_get_error(b->ssl, r);
+    if (e == kSSL_ERROR_WANT_READ) return -1;
+    if (e == kSSL_ERROR_WANT_WRITE) return -3;
+    return r == 0 ? 0 : -2;
+}
+
+int back_send(BackendConn* b, const char* buf, int n) {
+    if (b->ssl == nullptr) {
+        ssize_t r = send(b->fd, buf, n, MSG_NOSIGNAL);
+        if (r >= 0) return (int)r;
+        // plain-socket EAGAIN on send = the send buffer is full: resume
+        // on WRITABILITY (-3), not readability
+        return (errno == EAGAIN || errno == EWOULDBLOCK) ? -3 : -2;
+    }
+    TlsApi* T = tls_api();
+    int r = T->SSL_write(b->ssl, buf, n);
+    if (r > 0) return r;
+    int e = T->SSL_get_error(b->ssl, r);
+    if (e == kSSL_ERROR_WANT_READ) return -1;
+    if (e == kSSL_ERROR_WANT_WRITE) return -3;
+    return -2;
+}
+
+// take a healthy pooled keep-alive conn (fd + optional TLS session) or
+// return -1; dead entries (peer closed while idle) are discarded
+int pool_take(std::vector<std::pair<int, void*>>& pool, void** ssl_out) {
+    while (!pool.empty()) {
+        int fd = pool.back().first;
+        void* ssl = pool.back().second;
+        pool.pop_back();
+        char probe;
+        ssize_t r = recv(fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
+        if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
+            if (ssl != nullptr) tls_api()->SSL_free(ssl);
+            close(fd);
+            continue;
+        }
+        *ssl_out = ssl;
+        return fd;
+    }
+    *ssl_out = nullptr;
+    return -1;
+}
+
+void back_free_ssl(BackendConn* b) {
+    if (b->ssl != nullptr) {
+        tls_api()->SSL_free(b->ssl);
+        b->ssl = nullptr;
+    }
+}
+
 int backend_connect(uint32_t ip, int port) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return -1;
@@ -1088,20 +1167,21 @@ void backend_finish(Worker* w, BackendConn* b, bool reusable) {
         }
     if (b->fd >= 0) {
         epoll_ctl(w->epfd, EPOLL_CTL_DEL, b->fd, nullptr);
-        if (b->target_ip != 0) {  // non-default target: pool per (ip,port)
-            auto& pool = w->idle_targets[((uint64_t)b->target_ip << 16) |
-                                         (uint16_t)b->target_port];
-            if (reusable && pool.size() < 8)
-                pool.push_back(b->fd);
-            else
-                close(b->fd);
-        } else if (reusable && w->idle_backends.size() < 8) {
-            w->idle_backends.push_back(b->fd);
+        auto& pool =
+            b->target_ip != 0
+                ? w->idle_targets[((uint64_t)b->target_ip << 16) |
+                                  (uint16_t)b->target_port]
+                : w->idle_backends;
+        if (reusable && pool.size() < 8) {
+            pool.emplace_back(b->fd, b->ssl);  // TLS session rides along
+            b->ssl = nullptr;
         } else {
+            back_free_ssl(b);
             close(b->fd);
         }
         b->fd = -1;
     }
+    back_free_ssl(b);  // non-pooled leftovers
     w->back_graveyard.push_back(b);
 }
 
@@ -1109,46 +1189,73 @@ void backend_finish(Worker* w, BackendConn* b, bool reusable) {
 bool backend_launch(Engine* E, Worker* w, BackendConn* b) {
     uint32_t ip = b->target_ip ? b->target_ip : E->backend_ip;
     int port = b->target_ip ? b->target_port : E->backend_port;
-    std::vector<int>* pool = &w->idle_backends;
-    if (b->target_ip != 0)
-        pool = &w->idle_targets[((uint64_t)b->target_ip << 16) |
-                                (uint16_t)b->target_port];
-    int fd = -1;
-    while (!pool->empty()) {  // pooled keep-alive conn if healthy
-        fd = pool->back();
-        pool->pop_back();
-        char probe;
-        ssize_t r = recv(fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
-        if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
-            close(fd);  // peer silently closed this pooled conn
-            fd = -1;
-            continue;
+    void* ssl = nullptr;
+    auto& pool = b->target_ip != 0
+                     ? w->idle_targets[((uint64_t)b->target_ip << 16) |
+                                       (uint16_t)b->target_port]
+                     : w->idle_backends;
+    int fd = pool_take(pool, &ssl);
+    bool pooled = fd >= 0;
+    for (;;) {
+        if (fd < 0) {
+            fd = backend_connect(ip, port);
+            if (fd < 0) return false;
+            // upstream hops to non-Python targets speak the cluster's
+            // mTLS (a volume engine terminates TLS): attach a CLIENT
+            // session presenting this node's cert; the handshake rides
+            // the first SSL_write/SSL_read on the nonblocking fd
+            if (b->target_ip != 0 && E->tls_client_ctx != nullptr) {
+                TlsApi* T = tls_api();
+                ssl = T->SSL_new(E->tls_client_ctx);
+                if (ssl == nullptr) {
+                    close(fd);
+                    return false;
+                }
+                T->SSL_set_fd(ssl, fd);
+                T->SSL_set_connect_state(ssl);
+            }
         }
-        break;
+        b->fd = fd;
+        b->ssl = ssl;
+        b->req_off = 0;
+        b->resp.clear();
+        b->hdr_end = 0;
+        b->body_mode = 0;
+        b->started = time(nullptr);
+        // optimistic send; leftover bytes flush on the next epoll event
+        bool want_write = false, failed = false;
+        while (b->req_off < b->req.size()) {
+            int n = back_send(b, b->req.data() + b->req_off,
+                              (int)std::min(b->req.size() - b->req_off,
+                                            (size_t)1 << 20));
+            if (n > 0) { b->req_off += n; continue; }
+            if (n == -1) break;                       // wait for read
+            if (n == -3) { want_write = true; break; }  // wait for write
+            failed = true;
+            break;
+        }
+        if (failed) {
+            back_free_ssl(b);
+            close(fd);
+            b->fd = -1;
+            fd = -1;
+            ssl = nullptr;
+            if (pooled) {  // a pooled conn died between probe and send
+                pooled = false;  // (TLS close_notify buffered behind the
+                continue;        // peek): retry once on a fresh socket
+            }
+            return false;
+        }
+        struct epoll_event ev;
+        // EPOLLOUT only when the last operation blocked on WRITE: a TLS
+        // handshake blocked on READ with unsent bytes must not arm it,
+        // or the empty send buffer makes epoll spin at 100% CPU
+        ev.events = EPOLLIN | (want_write ? EPOLLOUT : 0);
+        b->armed = ev.events;
+        ev.data.ptr = b;
+        epoll_ctl(w->epfd, EPOLL_CTL_ADD, fd, &ev);
+        return true;
     }
-    if (fd < 0) fd = backend_connect(ip, port);
-    if (fd < 0) return false;
-    b->fd = fd;
-    b->req_off = 0;
-    b->resp.clear();
-    b->hdr_end = 0;
-    b->body_mode = 0;
-    b->started = time(nullptr);
-    // optimistic send; leftover bytes flush on EPOLLOUT
-    while (b->req_off < b->req.size()) {
-        ssize_t n = send(fd, b->req.data() + b->req_off,
-                         b->req.size() - b->req_off, MSG_NOSIGNAL);
-        if (n > 0) { b->req_off += n; continue; }
-        if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
-        close(fd);
-        b->fd = -1;
-        return false;
-    }
-    struct epoll_event ev;
-    ev.events = EPOLLIN | (b->req_off < b->req.size() ? EPOLLOUT : 0);
-    ev.data.ptr = b;
-    epoll_ctl(w->epfd, EPOLL_CTL_ADD, fd, &ev);
-    return true;
 }
 
 // bypass_cap: long-poll endpoints (meta subscriptions) park cheaply in a
@@ -1281,32 +1388,43 @@ bool backend_parse(BackendConn* b) {
 }
 
 void on_backend_event(Engine* E, Worker* w, BackendConn* b, uint32_t events) {
-    if (events & EPOLLOUT) {
+    bool want_write = false;
+    if (b->req_off < b->req.size()) {
         while (b->req_off < b->req.size()) {
-            ssize_t n = send(b->fd, b->req.data() + b->req_off,
-                             b->req.size() - b->req_off, MSG_NOSIGNAL);
+            int n = back_send(b, b->req.data() + b->req_off,
+                              (int)std::min(b->req.size() - b->req_off,
+                                            (size_t)1 << 20));
             if (n > 0) { b->req_off += n; continue; }
-            if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+            if (n == -1) break;
+            if (n == -3) { want_write = true; break; }
             events |= EPOLLERR;
             break;
-        }
-        if (b->req_off >= b->req.size() && !(events & (EPOLLERR | EPOLLHUP))) {
-            struct epoll_event ev;
-            ev.events = EPOLLIN;
-            ev.data.ptr = b;
-            epoll_ctl(w->epfd, EPOLL_CTL_MOD, b->fd, &ev);
         }
     }
     bool eof = false, err = (events & EPOLLERR) != 0;
     if (!err) {
         char buf[65536];
         for (;;) {
-            ssize_t n = recv(b->fd, buf, sizeof buf, 0);
+            int n = back_recv(b, buf, sizeof buf);
             if (n > 0) { b->resp.append(buf, n); continue; }
             if (n == 0) { eof = true; break; }
-            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            if (n == -1) break;
+            if (n == -3) { want_write = true; break; }
             err = true;
             break;
+        }
+    }
+    if (!err && !eof) {
+        // keep the interest mask exact: EPOLLOUT only while an operation
+        // is blocked on WRITE — a stale EPOLLOUT on an idle-writable
+        // socket is a level-triggered busy-spin
+        uint32_t want = EPOLLIN | (want_write ? EPOLLOUT : 0);
+        if (want != b->armed) {
+            struct epoll_event ev;
+            ev.events = want;
+            ev.data.ptr = b;
+            epoll_ctl(w->epfd, EPOLL_CTL_MOD, b->fd, &ev);
+            b->armed = want;
         }
     }
     if (!err && backend_parse(b)) {
@@ -1324,6 +1442,7 @@ void on_backend_event(Engine* E, Worker* w, BackendConn* b, uint32_t events) {
         if (b->resp.empty() && !b->retried) {
             b->retried = true;
             epoll_ctl(w->epfd, EPOLL_CTL_DEL, b->fd, nullptr);
+            back_free_ssl(b);
             close(b->fd);
             b->fd = -1;
             if (backend_launch(E, w, b)) return;
@@ -2521,16 +2640,25 @@ void* worker_main(void* arg) {
         for (auto* c : w->graveyard) delete c;
         w->graveyard.clear();
     }
-    for (auto* b : w->pending) { if (b->fd >= 0) close(b->fd); delete b; }
+    for (auto* b : w->pending) {
+        back_free_ssl(b);
+        if (b->fd >= 0) close(b->fd);
+        delete b;
+    }
     w->pending.clear();
     for (auto* b : w->waiting) delete b;
     w->waiting.clear();
     for (auto* b : w->back_graveyard) delete b;
     w->back_graveyard.clear();
-    for (int fd : w->idle_backends) close(fd);
-    w->idle_backends.clear();
-    for (auto& kv : w->idle_targets)
-        for (int fd : kv.second) close(fd);
+    auto drain_pool = [](std::vector<std::pair<int, void*>>& pool) {
+        for (auto& pooled : pool) {
+            if (pooled.second != nullptr) tls_api()->SSL_free(pooled.second);
+            close(pooled.first);
+        }
+        pool.clear();
+    };
+    drain_pool(w->idle_backends);
+    for (auto& kv : w->idle_targets) drain_pool(kv.second);
     w->idle_targets.clear();
     return nullptr;
 }
@@ -2596,6 +2724,7 @@ int sw_fl_start(const char* host, int port, const char* backend_host,
                 const char* tls_cert, const char* tls_key,
                 const char* tls_ca, const char* tls_allowed_cns) {
     void* tls_ctx = nullptr;
+    void* tls_client_ctx = nullptr;
     if (tls_cert && *tls_cert) {
         TlsApi* T = tls_api();
         if (T == nullptr) return -4;  // no OpenSSL runtime on this host
@@ -2617,10 +2746,35 @@ int sw_fl_start(const char* host, int port, const char* backend_host,
                         kSSL_MODE_ENABLE_PARTIAL_WRITE |
                             kSSL_MODE_ACCEPT_MOVING_WRITE_BUFFER,
                         nullptr);
+        // client context for upstream hops (filer engine -> volume engine
+        // under mTLS): this node's cert doubles as the client cert, the
+        // server's cert must chain to the CA (identity = CA + CN, no
+        // hostname check — security/tls.py client semantics)
+        tls_client_ctx = T->SSL_CTX_new(T->TLS_client_method());
+        if (tls_client_ctx != nullptr) {
+            if (T->SSL_CTX_use_certificate_chain_file(tls_client_ctx,
+                                                      tls_cert) != 1 ||
+                T->SSL_CTX_use_PrivateKey_file(tls_client_ctx, tls_key,
+                                               kSSL_FILETYPE_PEM) != 1 ||
+                (tls_ca && *tls_ca &&
+                 T->SSL_CTX_load_verify_locations(tls_client_ctx, tls_ca,
+                                                  nullptr) != 1)) {
+                T->SSL_CTX_free(tls_client_ctx);
+                tls_client_ctx = nullptr;  // upstream hops stay on Python
+            } else {
+                T->SSL_CTX_set_verify(tls_client_ctx, kSSL_VERIFY_PEER,
+                                      nullptr);
+                T->SSL_CTX_ctrl(tls_client_ctx, kSSL_CTRL_MODE,
+                                kSSL_MODE_ENABLE_PARTIAL_WRITE |
+                                    kSSL_MODE_ACCEPT_MOVING_WRITE_BUFFER,
+                                nullptr);
+            }
+        }
     }
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) {
         if (tls_ctx) tls_api()->SSL_CTX_free(tls_ctx);
+        if (tls_client_ctx) tls_api()->SSL_CTX_free(tls_client_ctx);
         return -2;
     }
     int one = 1;
@@ -2634,6 +2788,7 @@ int sw_fl_start(const char* host, int port, const char* backend_host,
         listen(fd, 1024) != 0) {
         close(fd);
         if (tls_ctx) tls_api()->SSL_CTX_free(tls_ctx);
+        if (tls_client_ctx) tls_api()->SSL_CTX_free(tls_client_ctx);
         return -3;
     }
     socklen_t sl = sizeof sa;
@@ -2656,6 +2811,7 @@ int sw_fl_start(const char* host, int port, const char* backend_host,
     if (jwt_write_key && *jwt_write_key) E->jwt_write_key = jwt_write_key;
     if (jwt_read_key && *jwt_read_key) E->jwt_read_key = jwt_read_key;
     E->tls_ctx = tls_ctx;
+    E->tls_client_ctx = tls_client_ctx;
     if (tls_allowed_cns && *tls_allowed_cns) {
         const char* p = tls_allowed_cns;
         while (*p) {
@@ -2704,6 +2860,8 @@ void sw_fl_stop(int h) {
         close(w.epfd);
     }
     if (E->tls_ctx != nullptr) tls_api()->SSL_CTX_free(E->tls_ctx);
+    if (E->tls_client_ctx != nullptr)
+        tls_api()->SSL_CTX_free(E->tls_client_ctx);
     if (E->filer_journal_fd >= 0) close(E->filer_journal_fd);
     delete E;
 }
@@ -2878,6 +3036,14 @@ int sw_fl_filer_enable(int h, const char* journal_path,
     return 0;
 }
 
+// can this engine reach (possibly TLS) upstream targets natively? Under
+// mTLS that needs the client context; plaintext clusters always can.
+int sw_fl_tls_client_ok(int h) {
+    Engine* E = engine_at(h);
+    if (!E) return 0;
+    return (E->tls_ctx == nullptr || E->tls_client_ctx != nullptr) ? 1 : 0;
+}
+
 int sw_fl_filer_lease_set(int h, const char* vol_host, int vol_port,
                           uint32_t vid, uint32_t cookie,
                           unsigned long long key_start,
@@ -2885,6 +3051,9 @@ int sw_fl_filer_lease_set(int h, const char* vol_host, int vol_port,
                           const char* read_auth) {
     Engine* E = engine_at(h);
     if (!E) return -1;
+    if (E->tls_ctx != nullptr && E->tls_client_ctx == nullptr)
+        return -3;  // mTLS without a client ctx: uploads would hit a TLS
+                    // listener in plaintext and 500 — stay on Python
     auto L = std::make_shared<FilerLease>();
     L->vol_ip = htonl(INADDR_LOOPBACK);
     if (vol_host && *vol_host && strcmp(vol_host, "0.0.0.0") != 0) {

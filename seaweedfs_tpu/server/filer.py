@@ -319,11 +319,10 @@ class FilerServer:
         key copies, as the reference filer signs its own volume tokens."""
         from seaweedfs_tpu.storage.file_id import parse_needle_id_cookie
 
-        if self.fastlane.tls:
-            # under mTLS the volume engine only speaks TLS and the filer
-            # engine's upstream connections are plain TCP: chunk uploads
-            # go through Python (inline writes stay native — no volume
-            # hop). A native TLS *client* in the engine would lift this.
+        if not self.fastlane.tls_client_ok:
+            # mTLS without the engine's TLS client context (OpenSSL
+            # resolution failed): chunk uploads go through Python (inline
+            # writes stay native — no volume hop)
             return
         a = self.client.assign(
             count=count, replication=self.default_replication,
@@ -433,7 +432,7 @@ class FilerServer:
         ch = entry.chunks[0] if len(entry.chunks) == 1 else None
         if (ch is not None and not ch.cipher_key and not ch.is_compressed
                 and not ch.is_chunk_manifest and ch.offset == 0
-                and not self.fastlane.tls):  # relay is plain TCP
+                and self.fastlane.tls_client_ok):  # relay speaks mTLS too
             try:
                 vid = int(ch.file_id.split(",")[0])
                 locs = self.client.lookup_cached(vid)

@@ -116,6 +116,8 @@ def _bind(lib) -> bool:
         ]
         lib.sw_fl_filer_journal_reset.restype = ctypes.c_long
         lib.sw_fl_filer_journal_reset.argtypes = [ctypes.c_int]
+        lib.sw_fl_tls_client_ok.restype = ctypes.c_int
+        lib.sw_fl_tls_client_ok.argtypes = [ctypes.c_int]
         return True
     except AttributeError:
         return False
@@ -178,6 +180,9 @@ class Fastlane:
         self._lib = lib
         self.handle = handle
         self.tls = tls  # engine terminates mTLS itself: URLs are https
+        # can the engine natively reach upstream (volume) engines? Under
+        # mTLS this needs the C++ TLS *client* context too
+        self.tls_client_ok = bool(lib.sw_fl_tls_client_ok(handle))
         self.port = int(lib.sw_fl_port(handle))
         self._volumes: dict[int, object] = {}  # vid -> Volume (drain target)
         self._drain_mu = threading.Lock()
