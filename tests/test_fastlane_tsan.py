@@ -17,7 +17,10 @@ import pytest
 
 SRC = os.path.join(os.path.dirname(__file__), "..", "seaweedfs_tpu",
                    "native", "src")
-FILES = ["fastlane_sanity.cpp", "fastlane.cpp", "crc32c.cpp", "sha256.cpp"]
+# md5.cpp: the filer-mode inline writes hash in-engine; fast128 unused by
+# the engine but cheap to include if ever needed
+FILES = ["fastlane_sanity.cpp", "fastlane.cpp", "crc32c.cpp", "sha256.cpp",
+         "md5.cpp"]
 
 
 def _build_and_run(tmp_path, sanitizer: str) -> None:
