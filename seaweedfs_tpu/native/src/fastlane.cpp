@@ -1711,6 +1711,28 @@ void fcache_put(Engine* E, const std::string& path,
     }
 }
 
+// compare-and-promote: attach inline bytes to an existing chunk-backed
+// entry, atomically against the meta-log subscriber's puts/dels — the
+// check and the insert share one unique lock, so a racing overwrite's
+// fresh entry (different md5) can never be clobbered by stale bytes
+void fcache_promote(Engine* E, const std::string& path,
+                    const std::string& md5_hex, const char* body,
+                    size_t blen) {
+    std::unique_lock<std::shared_mutex> l(E->fcache_mu);
+    auto it = E->fcache.find(path);
+    if (it == E->fcache.end()) return;
+    auto& old = it->second;
+    if (old->md5_hex != md5_hex || !old->inline_data.empty()) return;
+    auto ent = std::make_shared<FilerCacheEnt>(*old);
+    ent->inline_data.assign(body, blen);
+    E->fcache_inline_bytes += blen;
+    ent->seq = ++E->fcache_seq;
+    E->fcache_fifo.emplace_back(path, ent->seq);
+    it->second = std::move(ent);
+    // budget enforcement happens on the next fcache_put pass; one
+    // 64KB-capped promotion cannot meaningfully overshoot 128MB
+}
+
 void fcache_del(Engine* E, const std::string& path) {
     std::unique_lock<std::shared_mutex> l(E->fcache_mu);
     if (path.empty()) {
@@ -1889,6 +1911,16 @@ void filer_relay_finish(Engine* E, Worker* w, BackendConn* b, bool ok) {
             c->out.append(b->resp, b->hdr_end,
                           b->resp.size() - b->hdr_end);
             E->stats.native_reads++;
+            // promote small hot objects: a FULL-entity, length-framed
+            // relay body moves into the inline cache (same 128MB budget +
+            // FIFO eviction, same meta-log invalidation), so repeat reads
+            // skip the volume hop entirely. body_mode==1 only — chunked/
+            // close-delimited responses carry framing or may be truncated.
+            size_t blen = b->resp.size() - b->hdr_end;
+            if (status == 200 && b->body_mode == 1 && blen > 0 &&
+                blen <= 65536)
+                fcache_promote(E, b->f_path, b->f_md5hex,
+                               b->resp.data() + b->hdr_end, blen);
         }
         backend_finish(w, b, !b->backend_close);
         drain_waiting(E, w);

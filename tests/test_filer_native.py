@@ -81,6 +81,42 @@ class TestNativeFilerPath:
         finally:
             f.stop()
 
+    def test_hot_chunk_promotion(self, cluster):
+        """A small chunk-backed object's first read relays to the volume;
+        the full-entity body is then promoted into the filer engine's
+        inline cache, so repeat reads never touch the volume again (and
+        an overwrite invalidates the promotion via the meta-log)."""
+        m, v, _ = cluster
+        f = _filer(cluster)
+        if not f._fl_filer_on or v.fastlane is None:
+            f.stop()
+            pytest.skip("engines unavailable")
+        try:
+            payload = os.urandom(8192)  # > inline limit, <= promotion cap
+            st, _, _ = http_request("POST", f.url + "/hot/a.bin", payload)
+            assert st == 201
+            st, _, body = http_request("GET", f.url + "/hot/a.bin")
+            assert st == 200 and body == payload  # relay (volume GET #1)
+            vreads = v.fastlane.stats()["native_reads"]
+            for _ in range(5):
+                st, _, body = http_request("GET", f.url + "/hot/a.bin")
+                assert st == 200 and body == payload
+            assert v.fastlane.stats()["native_reads"] == vreads, (
+                "promoted object must be served from filer memory")
+            # ranges work on the promoted copy too
+            st, _, body = http_request(
+                "GET", f.url + "/hot/a.bin",
+                headers={"Range": "bytes=100-199"})
+            assert st == 206 and body == payload[100:200]
+            # overwrite: the meta-log replaces the promotion
+            payload2 = os.urandom(9000)
+            st, _, _ = http_request("POST", f.url + "/hot/a.bin", payload2)
+            assert st == 201
+            st, _, body = http_request("GET", f.url + "/hot/a.bin")
+            assert st == 200 and body == payload2
+        finally:
+            f.stop()
+
     def test_meta_log_invalidates_cache(self, cluster):
         f = _filer(cluster)
         if not f._fl_filer_on:
