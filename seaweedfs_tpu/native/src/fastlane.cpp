@@ -1849,15 +1849,28 @@ void filer_upload_finish(Engine* E, Worker* w, BackendConn* b, bool ok) {
         ent->mtime = mtime;
         fcache_put(E, b->f_path, std::move(ent));
     }
+    if (c != nullptr && !good) {
+        // the upload failed (volume down / moved / DELETED under the
+        // lease — volume.delete.empty on a not-yet-written volume does
+        // exactly this): drop the lease so Python re-leases against live
+        // topology, and replay THIS request through the Python path so
+        // the client still gets its write
+        {
+            std::unique_lock<std::shared_mutex> l(E->flease_mu);
+            E->flease = nullptr;
+        }
+        Conn* cc = c;
+        std::string original = std::move(b->client_req);
+        backend_finish(w, b, false);
+        drain_waiting(E, w);
+        cc->upstream = nullptr;
+        proxy_request(E, w, cc, original.data(), original.size(), false);
+        flush_out(w, cc);
+        return;
+    }
     if (c != nullptr) {
         c->upstream = nullptr;
-        if (good) {
-            filer_write_ack(E, c, b->f_path, b->f_size, b->f_md5hex.c_str());
-        } else {
-            json_response(c, 500, "Internal Server Error",
-                          "{\"error\": \"chunk upload failed\"}");
-            c->want_close = true;
-        }
+        filer_write_ack(E, c, b->f_path, b->f_size, b->f_md5hex.c_str());
     }
     backend_finish(w, b, ok && !b->backend_close);
     if (c != nullptr) {
@@ -2027,6 +2040,9 @@ bool handle_filer_write(Engine* E, Worker* w, Conn* c,
     b->mode = 1;
     b->target_ip = L->vol_ip;
     b->target_port = L->vol_port;
+    // kept for the failure path: a dead/moved/deleted lease volume makes
+    // the finisher replay this request through the Python backend
+    b->client_req.assign(req, hdr_len + body_len);
     b->f_path = path;
     b->f_fid.assign(fid, fl);
     b->f_mime = mime;

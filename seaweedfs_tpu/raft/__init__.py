@@ -91,6 +91,7 @@ class RaftNode:
         self.commit_index = 0
         self.last_applied = 0
         self.leader_id: str | None = None
+        self.removed = False  # true after a replicated self-removal
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
         self._apply_results: dict[int, object] = {}
@@ -117,6 +118,9 @@ class RaftNode:
             self.snap_index = st.get("snap_index", 0)
             self.snap_term = st.get("snap_term", 0)
             self.snap_state = st.get("snap_state")
+            if "peers" in st:  # membership changes survive restarts
+                self.peers = [p for p in st["peers"] if p != self.id]
+            self.removed = bool(st.get("removed", False))
             if self.snap_state is not None and self.restore_fn is not None:
                 self.restore_fn(self.snap_state)
             self.last_applied = self.snap_index
@@ -136,6 +140,8 @@ class RaftNode:
                 "snap_index": self.snap_index,
                 "snap_term": self.snap_term,
                 "snap_state": self.snap_state,
+                "peers": self.peers,
+                "removed": self.removed,
             }, f)
         os.replace(tmp, p)
 
@@ -208,18 +214,57 @@ class RaftNode:
             except Exception:
                 pass  # demotion hooks must never break the raft transition
 
+    def _apply_conf(self, cmd: dict) -> dict:
+        """Replicated membership change (`cluster.raft.add/remove`,
+        `weed/shell/command_cluster_raft_add.go`): applied on every node
+        through the log, persisted so restarts (even after compaction)
+        keep the current member set. Removing THIS node demotes it to an
+        isolated follower."""
+        peer = (cmd.get("peer") or "").rstrip("/")
+        if cmd.get("op") == "add":
+            if peer and peer != self.id and peer not in self.peers:
+                self.peers.append(peer)
+                last_index = self.snap_index + len(self.log)
+                self.next_index[peer] = last_index + 1
+                self.match_index[peer] = 0
+        elif cmd.get("op") == "remove":
+            if peer == self.id:
+                # this node left the cluster: it must never elect itself
+                # leader of a singleton again (split brain with the
+                # remaining members) — `removed` pins it as a follower
+                self.peers = []
+                self.removed = True
+                self._become_follower(self.current_term)
+            elif peer in self.peers:
+                self.peers.remove(peer)
+                # keep replicating to the victim for a grace window (see
+                # _broadcast_heartbeats) so it applies its own removal
+                if not hasattr(self, "_parting"):
+                    self._parting: dict[str, float] = {}
+                self._parting[peer] = time.monotonic() + 3.0
+        self._persist()
+        return {"ok": True, "peers": list(self.peers)}
+
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
             e = self._entry(self.last_applied)
             if e is not None:
+                cmd = e["command"]
+                # capture leadership BEFORE applying: a self-removal conf
+                # entry demotes inside _apply_conf, and its proposer still
+                # deserves the result instead of a spurious NotLeader
+                was_leader = self.role == "leader"
                 try:
-                    result = self.apply_fn(e["command"])
+                    if isinstance(cmd, dict) and cmd.get("type") == "_raft_conf":
+                        result = self._apply_conf(cmd)
+                    else:
+                        result = self.apply_fn(cmd)
                 except Exception as exc:  # state machine must not kill raft
                     result = exc
                 # only a leader has propose() waiters that will claim the
                 # result; followers storing them forever is a leak
-                if self.role == "leader":
+                if was_leader:
                     self._apply_results[self.last_applied] = result
         self._maybe_compact()
         self._commit_cv.notify_all()
@@ -240,6 +285,8 @@ class RaftNode:
 
     def _run_election(self) -> None:
         with self.mu:
+            if self.removed:
+                return  # a removed node never elects itself (split brain)
             self.role = "candidate"
             self.current_term += 1
             term = self.current_term
@@ -296,7 +343,21 @@ class RaftNode:
 
     # --- replication ----------------------------------------------------------
     def _broadcast_heartbeats(self) -> None:
-        for peer in self.peers:
+        targets = list(self.peers)
+        # parting peers (just removed) still receive heartbeats briefly so
+        # their commit index reaches the removal entry and they learn they
+        # were removed (otherwise the victim never applies it)
+        parting = getattr(self, "_parting", None)
+        if parting:
+            now = time.monotonic()
+            for p in list(parting):
+                if parting[p] < now:
+                    parting.pop(p, None)
+                    self.next_index.pop(p, None)
+                    self.match_index.pop(p, None)
+                elif p not in targets:
+                    targets.append(p)
+        for peer in targets:
             threading.Thread(
                 target=self._replicate_to, args=(peer,), daemon=True
             ).start()
@@ -391,6 +452,12 @@ class RaftNode:
     # --- rpc handlers ---------------------------------------------------------
     def handle_request_vote(self, p: dict) -> dict:
         with self.mu:
+            # non-members cannot be elected: a removed node that missed
+            # its own removal keeps timing out, and without this gate its
+            # inflated terms would repeatedly depose the real leader
+            cand = p.get("candidate_id")
+            if cand is not None and cand != self.id and cand not in self.peers:
+                return {"term": self.current_term, "granted": False}
             # leader-lease check (hashicorp/raft CheckQuorum semantics): a
             # node that heard from a live leader recently refuses to join a
             # disruptive election — prevents term-inflation leadership flap
@@ -496,6 +563,16 @@ class RaftNode:
         with self.mu:
             return self.leader_id if self.role != "leader" else self.id
 
+    def add_peer(self, peer_url: str, timeout: float = 5.0):
+        """Leader-side membership add, replicated through the log
+        (`cluster.raft.add`)."""
+        return self.propose({"type": "_raft_conf", "op": "add",
+                             "peer": peer_url.rstrip("/")}, timeout)
+
+    def remove_peer(self, peer_url: str, timeout: float = 5.0):
+        return self.propose({"type": "_raft_conf", "op": "remove",
+                             "peer": peer_url.rstrip("/")}, timeout)
+
     def propose(self, command: dict, timeout: float = 5.0):
         """Append via the leader; blocks until committed+applied; returns the
         apply_fn result. Raises NotLeader elsewhere."""
@@ -519,7 +596,12 @@ class RaftNode:
                 remain = deadline - time.monotonic()
                 if remain <= 0:
                     raise TimeoutError(f"propose not committed in {timeout}s")
-                if self.role != "leader":
+                # a demotion only aborts the wait if the entry can no
+                # longer produce a result here — a self-removal conf entry
+                # demotes while STILL applying and storing its result
+                if self.role != "leader" and index not in self._apply_results:
+                    if self.last_applied >= index:
+                        break
                     raise NotLeader(self.leader_id)
                 self._commit_cv.wait(min(remain, 0.05))
             result = self._apply_results.pop(index, missing)

@@ -52,6 +52,18 @@ class RemoteStorageClient:
     def delete_file(self, path: str) -> None:
         raise NotImplementedError
 
+    def list_buckets(self) -> list[str]:
+        """Top-level containers (`remote_storage.go` ListBuckets): the
+        default derives them by traversing the remote, which costs a full
+        listing — vendors with a native bucket-list call (LocalRemoteStorage
+        does) should override. Root-level FILES are not buckets."""
+        seen: set[str] = set()
+        for rel, _, _ in self.traverse(""):
+            top, sep, _ = rel.partition("/")
+            if sep and top:  # only objects INSIDE a container count
+                seen.add(top)
+        return sorted(seen)
+
 
 class LocalRemoteStorage(RemoteStorageClient):
     """Directory tree as the 'cloud' — the dev/test vendor."""
@@ -75,6 +87,13 @@ class LocalRemoteStorage(RemoteStorageClient):
                 rel = os.path.relpath(p, base)
                 st = os.stat(p)
                 yield rel.replace(os.sep, "/"), st.st_size, st.st_mtime
+
+    def list_buckets(self) -> list[str]:
+        return sorted(
+            d for d in os.listdir(self.root)
+            if os.path.isdir(os.path.join(self.root, d))
+            and not d.startswith(".")
+        )
 
     def read_file(self, path: str) -> bytes:
         with open(self._abs(path), "rb") as f:

@@ -481,6 +481,16 @@ class S3Server:
             raise err("NoSuchBucket", bucket)
         return entry
 
+    def _require_writable_bucket(self, bucket: str) -> dict:
+        """Uploads into a read-only bucket are rejected — the state
+        `s3.bucket.quota.enforce -apply` flips when usage exceeds the
+        quota (`command_s3_bucket_quota_check.go` semantics)."""
+        entry = self._require_bucket(bucket)
+        if (entry.get("extended") or {}).get("s3-read-only"):
+            raise err("AccessDenied", f"bucket {bucket} is read-only"
+                                      " (quota enforcement)")
+        return entry
+
     # --- bucket handlers --------------------------------------------------------
     def _list_buckets(self, ident) -> Response:
         listing = self.fc.list(BUCKETS_DIR, limit=10_000)
@@ -921,7 +931,7 @@ class S3Server:
 
         from .auth import signing_key
 
-        self._require_bucket(bucket)
+        self._require_writable_bucket(bucket)
         fields, file_part = req.multipart_form()
         if file_part is None:
             raise err("MalformedPOSTRequest", "form has no file part")
@@ -1066,7 +1076,7 @@ class S3Server:
 
     # --- object handlers --------------------------------------------------------
     def _put_object(self, req: Request, bucket: str, key: str) -> Response:
-        self._require_bucket(bucket)
+        self._require_writable_bucket(bucket)
         body = req.body
         sha_hdr = req.headers.get("x-amz-content-sha256", "")
         if sha_hdr.startswith("STREAMING-"):
@@ -1110,7 +1120,7 @@ class S3Server:
         return Response(b"", 200, headers)
 
     def _copy_object(self, req: Request, bucket: str, key: str) -> Response:
-        self._require_bucket(bucket)
+        self._require_writable_bucket(bucket)
         src = urllib.parse.unquote(req.headers["x-amz-copy-source"]).lstrip("/")
         src_bucket, _, src_key = src.partition("/")
         src_entry = self.fc.get_entry(self._object_path(src_bucket, src_key))
@@ -1566,7 +1576,7 @@ class S3Server:
         return f"{d}/{upload_id}" if upload_id else d
 
     def _create_multipart(self, req: Request, bucket: str, key: str) -> Response:
-        self._require_bucket(bucket)
+        self._require_writable_bucket(bucket)
         upload_id = uuid.uuid4().hex
         staging = self._uploads_dir(bucket, upload_id)
         self.fc.mkdir(staging)
@@ -1594,6 +1604,9 @@ class S3Server:
         return json.loads(body)
 
     def _upload_part(self, req: Request, bucket: str, key: str, q: dict) -> Response:
+        # quota read-only covers in-flight uploads too, or a pre-flip
+        # uploadId could keep pouring parts into a frozen bucket
+        self._require_writable_bucket(bucket)
         upload_id = q["uploadId"]
         self._get_upload_manifest(bucket, upload_id)
         try:
@@ -1613,6 +1626,7 @@ class S3Server:
     def _complete_multipart(
         self, req: Request, bucket: str, key: str, q: dict
     ) -> Response:
+        self._require_writable_bucket(bucket)
         upload_id = q["uploadId"]
         manifest = self._get_upload_manifest(bucket, upload_id)
         staging = self._uploads_dir(bucket, upload_id)
@@ -1717,6 +1731,7 @@ class S3Server:
         return Response(b"", 204)
 
     def _list_parts(self, bucket: str, key: str, q: dict) -> Response:
+        self._require_writable_bucket(bucket)
         upload_id = q["uploadId"]
         manifest = self._get_upload_manifest(bucket, upload_id)
         staging = self._uploads_dir(bucket, upload_id)

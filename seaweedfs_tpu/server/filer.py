@@ -826,6 +826,40 @@ class FilerServer:
                 return Response({"error": str(e)}, 500)
             return Response({"ok": True, "dir": dir_, "synced": n})
 
+        @svc.route("POST", r"/__remote__/mount_buckets")
+        def remote_mount_buckets(req: Request) -> Response:
+            # `command_remote_mount_buckets.go`: mount every bucket of a
+            # configured remote under /buckets/<name> and pull metadata
+            from seaweedfs_tpu.remote_storage import make_remote_client
+
+            p = req.json()
+            conf_name = p.get("config")
+            conf = self._remote_confs.get(conf_name)
+            if conf is None:
+                return Response(
+                    {"error": f"unknown remote config {conf_name!r}"}, 400)
+            try:
+                client = make_remote_client(conf)
+                buckets = client.list_buckets()
+            except (OSError, ValueError, NotImplementedError) as e:
+                return Response({"error": f"list buckets: {e}"}, 500)
+            mounted = []
+            for b in buckets:
+                dir_ = f"/buckets/{b}"
+                # persist BEFORE syncing (like /__remote__/mount): a
+                # partial failure must leave the completed mounts durable,
+                # not in-memory-only until a restart drops them
+                self._remote_mounts[dir_] = {"config": conf_name, "path": b}
+                self._save_remote_state()
+                try:
+                    self._remote_meta_sync(dir_)
+                except (FilerError, OSError, ValueError) as e:
+                    return Response(
+                        {"error": f"sync {dir_}: {e}", "mounted": mounted},
+                        500)
+                mounted.append(b)
+            return Response({"ok": True, "mounted": mounted})
+
         @svc.route("POST", r"/__remote__/unmount")
         def remote_unmount(req: Request) -> Response:
             dir_ = normalize(req.json()["dir"])
@@ -991,6 +1025,72 @@ class FilerServer:
                 "ring": self.lock_ring.servers(),
                 "host": self.url,
             })
+
+        @svc.route("POST", r"/__meta__/notify")
+        def meta_notify(req: Request) -> Response:
+            # `command_fs_meta_notify.go`: recursively (re)send every
+            # entry under a directory to the notification queue so a
+            # downstream replicator can bootstrap from existing data
+            self._fl_filer_drain()
+            p = req.json()
+            root = normalize(p.get("directory", "/"))
+            if self.filer.notification_queue is None:
+                return Response({"error": "no notification queue"
+                                          " configured"}, 400)
+            sent = 0
+
+            def walk(d: str) -> None:
+                nonlocal sent
+                for e in self.filer.list_entries(d, limit=1 << 31):
+                    self.filer.notification_queue.send_message(
+                        e.full_path,
+                        {"directory": d, "old_entry": None,
+                         "new_entry": e.to_dict(),
+                         "ts_ns": time.time_ns(), "signatures": []},
+                    )
+                    sent += 1
+                    if e.is_directory:
+                        walk(e.full_path)
+
+            walk(root)
+            return Response({"sent": sent})
+
+        @svc.route("POST", r"/__meta__/change_volume_id")
+        def meta_change_volume_id(req: Request) -> Response:
+            # `command_fs_meta_change_volume_id.go`: after volumes are
+            # relocated/renumbered (e.g. cross-cluster copies), rewrite
+            # the volume id inside chunk fids under a directory. The
+            # blobs themselves moved — freed-chunk reclaim must not run.
+            self._fl_filer_drain()
+            p = req.json()
+            root = normalize(p.get("directory", "/"))
+            mapping = {str(k): str(v)
+                       for k, v in (p.get("mapping") or {}).items()}
+            if not mapping:
+                return Response({"error": "empty volume id mapping"}, 400)
+            changed = 0
+
+            def rewrite(chunks) -> bool:
+                hit = False
+                for c in chunks:
+                    vid, _, rest = c.file_id.partition(",")
+                    if vid in mapping:
+                        c.file_id = f"{mapping[vid]},{rest}"
+                        hit = True
+                return hit
+
+            def walk(d: str) -> None:
+                nonlocal changed
+                for e in self.filer.list_entries(d, limit=1 << 31):
+                    if e.is_directory:
+                        walk(e.full_path)
+                        continue
+                    if rewrite(e.chunks):
+                        self.filer.create_entry(e)  # freed fids ignored
+                        changed += 1
+
+            walk(root)
+            return Response({"changed": changed})
 
         @svc.route("GET", r"/__meta__/info")
         def meta_info(req: Request) -> Response:

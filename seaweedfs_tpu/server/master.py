@@ -365,6 +365,32 @@ class MasterServer:
             out["enabled"] = True
             return Response(out)
 
+        @svc.route("POST", r"/raft/add")
+        def raft_add(req: Request) -> Response:
+            # `cluster.raft.add` (command_cluster_raft_add.go): replicated
+            # membership change; leader-only like every admin mutation
+            if self.raft is None:
+                return Response({"error": "raft not enabled"}, 400)
+            if not self._is_leader():
+                return self._not_leader_response()
+            peer = (req.json().get("peer") or "").rstrip("/")
+            if not peer:
+                return Response({"error": "missing peer url"}, 400)
+            out = self.raft.add_peer(peer)
+            return Response(out)
+
+        @svc.route("POST", r"/raft/remove")
+        def raft_remove(req: Request) -> Response:
+            if self.raft is None:
+                return Response({"error": "raft not enabled"}, 400)
+            if not self._is_leader():
+                return self._not_leader_response()
+            peer = (req.json().get("peer") or "").rstrip("/")
+            if not peer:
+                return Response({"error": "missing peer url"}, 400)
+            out = self.raft.remove_peer(peer)
+            return Response(out)
+
         def do_assign(req: Request) -> Response:
             if not self._is_leader():
                 return self._not_leader_response()

@@ -178,3 +178,32 @@ def cmd_cluster_raft_ps(env: CommandEnv, args: list[str]) -> str:
         except Exception as e:
             lines.append(f"{p}  unreachable ({e})")
     return "\n".join(lines)
+
+
+@command("cluster.raft.add",
+         "-address <master_url> — add a master to the raft cluster"
+         " (replicated membership change)")
+def cmd_cluster_raft_add(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    addr = flags.get("address") or flags.get("id")
+    if not addr:
+        raise ShellError("usage: cluster.raft.add -address <master_url>")
+    try:
+        out = env.post(f"{env.master_url}/raft/add", {"peer": addr})
+    except IOError as e:
+        raise ShellError(str(e))
+    return f"added {addr}; members: {', '.join(out.get('peers', []))}"
+
+
+@command("cluster.raft.remove",
+         "-address <master_url> — remove a master from the raft cluster")
+def cmd_cluster_raft_remove(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    addr = flags.get("address") or flags.get("id")
+    if not addr:
+        raise ShellError("usage: cluster.raft.remove -address <master_url>")
+    try:
+        out = env.post(f"{env.master_url}/raft/remove", {"peer": addr})
+    except IOError as e:
+        raise ShellError(str(e))
+    return f"removed {addr}; members: {', '.join(out.get('peers', []))}"

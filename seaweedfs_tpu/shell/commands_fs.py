@@ -235,3 +235,34 @@ def cmd_fs_dedup_gc(env: CommandEnv, args: list[str]) -> str:
         f"scanned {out['scanned']} index entries, dropped {out['dropped']} "
         f"({out['bytes_freed']} bytes freed, {out['errors']} errors)"
     )
+
+
+@command("fs.meta.notify",
+         "[dir] — resend directory+file metadata to the notification queue"
+         " (bootstrap a downstream replicator)")
+def cmd_fs_meta_notify(env: CommandEnv, args: list[str]) -> str:
+    from seaweedfs_tpu.server.httpd import post_json
+
+    directory = args[0] if args else env.cwd
+    out = post_json(f"{env.require_filer()}/__meta__/notify",
+                    {"directory": directory})
+    return f"sent {out['sent']} entries under {directory}"
+
+
+@command("fs.meta.changeVolumeId",
+         "-dir <dir> -fromVolumeId <x> -toVolumeId <y> — rewrite volume ids"
+         " inside chunk fids (after volume relocation)")
+def cmd_fs_meta_change_volume_id(env: CommandEnv, args: list[str]) -> str:
+    from seaweedfs_tpu.server.httpd import post_json
+
+    flags = parse_flags(args)
+    directory = flags.get("dir", env.cwd)
+    try:
+        mapping = {flags["fromVolumeId"]: flags["toVolumeId"]}
+    except KeyError:
+        raise ShellError(
+            "usage: fs.meta.changeVolumeId -dir <dir>"
+            " -fromVolumeId <x> -toVolumeId <y>")
+    out = post_json(f"{env.require_filer()}/__meta__/change_volume_id",
+                    {"directory": directory, "mapping": mapping})
+    return f"rewrote {out['changed']} entries under {directory}"

@@ -72,3 +72,19 @@ def cmd_remote_uncache(env: CommandEnv, args: list[str]) -> str:
     flags = parse_flags(args)
     out = _filer_post(env, "/__remote__/uncache", {"dir": flags["dir"]})
     return f"uncached {out['uncached']} objects under {flags['dir']}"
+
+
+@command("remote.mount.buckets",
+         "-remote <config> — mount every bucket of a configured remote"
+         " under /buckets/<name> and pull its metadata")
+def cmd_remote_mount_buckets(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    conf = flags.get("remote") or flags.get("config")
+    if not conf:
+        raise ShellError("usage: remote.mount.buckets -remote <config>")
+    try:
+        out = _filer_post(env, "/__remote__/mount_buckets", {"config": conf})
+    except IOError as e:
+        raise ShellError(str(e))
+    names = out.get("mounted") or []
+    return f"mounted {len(names)} buckets: " + ", ".join(names)

@@ -174,3 +174,80 @@ def cmd_s3_circuitbreaker(env: CommandEnv, args: list[str]) -> str:
             headers={"Content-Type": "application/json"},
         )
     return json.dumps(config, indent=2)
+
+
+@command("s3.bucket.quota.enforce",
+         "[-apply] — check every bucket's usage vs quota; -apply flips"
+         " over-quota buckets read-only (and under-quota ones writable)")
+def cmd_s3_bucket_quota_enforce(env: CommandEnv, args: list[str]) -> str:
+    """`command_s3_bucket_quota_check.go`: walk the buckets, compare used
+    bytes against the quota.bytes extended attribute, and (with -apply)
+    set/clear the s3-read-only attribute the gateway's write paths honor."""
+    from seaweedfs_tpu.server.httpd import http_request
+
+    flags = parse_flags(args)
+    apply = "apply" in flags
+
+    def usage(path: str) -> int:
+        """Billable bytes under `path`: paginated (no silent truncation on
+        giant directories) and excluding dot-dirs like the .uploads
+        multipart staging area (its parts are not object data)."""
+        import urllib.parse as _u
+
+        total = 0
+        last = ""
+        while True:
+            qs = "limit=10000" + (
+                f"&lastFileName={_u.quote(last)}" if last else "")
+            status, _, body = env.filer_read(path, qs)
+            if status != 200:
+                return total
+            entries = json.loads(body).get("Entries") or []
+            for e in entries:
+                name = e["FullPath"].rsplit("/", 1)[-1]
+                if e["IsDirectory"]:
+                    if not name.startswith("."):
+                        total += usage(e["FullPath"])
+                else:
+                    total += int(e.get("FileSize") or 0)
+            if len(entries) < 10000:
+                return total
+            last = entries[-1]["FullPath"].rsplit("/", 1)[-1]
+
+    status, _, body = env.filer_read(BUCKETS_DIR, "limit=10000")
+    if status == 404:
+        return "(no buckets)"
+    lines = []
+    for e in json.loads(body).get("Entries") or []:
+        if not e["IsDirectory"] or e["FullPath"].rsplit(
+                "/", 1)[-1].startswith("."):
+            continue
+        path = e["FullPath"]
+        name = path.rsplit("/", 1)[-1]
+        st, _, meta = env.filer_read(path, "metadata=true")
+        entry = json.loads(meta)
+        ext = entry.get("extended") or {}
+        quota = int(ext.get("quota.bytes") or 0)
+        if quota <= 0:
+            continue
+        used = usage(path)
+        over = used > quota
+        readonly = bool(ext.get("s3-read-only"))
+        action = ""
+        if apply and over and not readonly:
+            entry.setdefault("extended", {})["s3-read-only"] = "quota"
+            action = " -> marked READ-ONLY"
+        elif apply and not over and readonly and ext.get(
+                "s3-read-only") == "quota":
+            entry["extended"].pop("s3-read-only", None)
+            action = " -> writable again"
+        if action:
+            http_request(
+                "PUT", f"{_filer(env)}{path}?meta.entry=true",
+                body=json.dumps(entry).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+        lines.append(
+            f"{name}: used {used} / quota {quota}"
+            f" ({'OVER' if over else 'ok'}){action}")
+    return "\n".join(lines) or "(no buckets with quotas)"

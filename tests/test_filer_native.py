@@ -219,3 +219,50 @@ class TestNativeFilerPath:
         finally:
             f.stop()
             v2.stop()
+
+
+def test_lease_survives_volume_deletion(cluster):
+    """volume.delete.empty (or a move/evacuation) can remove the volume a
+    filer's fid lease points at before anything was written to it. The
+    failed native upload must fall back to the Python path (the client
+    still gets a 201), drop the lease, and re-lease against live
+    topology so later writes return to the native path."""
+    m, v, _ = cluster
+    f = _filer(cluster)
+    if not f._fl_filer_on:
+        f.stop()
+        pytest.skip("engine unavailable")
+    try:
+        import time
+
+        from seaweedfs_tpu.server.httpd import post_json
+
+        lib, h = f.fastlane._lib, f.fastlane.handle
+        for _ in range(50):
+            if int(lib.sw_fl_filer_lease_remaining(h)) > 0:
+                break
+            time.sleep(0.1)
+        # delete EVERY volume on the server (they are all empty)
+        for vid in list(v.store.volume_ids()):
+            post_json(f"{v.url}/admin/delete_volume", {"volume": vid})
+        # the lease still points at a deleted volume: the write must
+        # succeed anyway (proxy fallback) and drop the lease
+        payload = os.urandom(30000)
+        st, _, _ = http_request("POST", f.url + "/dead/a.bin", payload)
+        assert st == 201
+        st, _, body = http_request("GET", f.url + "/dead/a.bin")
+        assert st == 200 and body == payload
+        # the loop re-leases against live topology; native writes resume
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if int(lib.sw_fl_filer_lease_remaining(h)) > 0:
+                break
+            time.sleep(0.1)
+        assert int(lib.sw_fl_filer_lease_remaining(h)) > 0
+        before = f.fastlane.stats()["native_writes"]
+        st, _, _ = http_request("POST", f.url + "/dead/b.bin",
+                                os.urandom(30000))
+        assert st == 201
+        assert f.fastlane.stats()["native_writes"] > before
+    finally:
+        f.stop()

@@ -369,3 +369,106 @@ def test_demotion_fires_on_demote_hook():
     with n.mu:
         n._become_follower(6)
     assert fired == [1]
+
+
+def test_dynamic_membership_add_remove(tmp_path):
+    """cluster.raft.add/remove: membership changes replicate through the
+    log, apply on every node, and survive restarts via persisted state
+    (command_cluster_raft_add.go semantics)."""
+    from seaweedfs_tpu.raft import RaftNode
+
+    transport = {}
+
+    def rpc(peer, method, payload, timeout=None):
+        node = transport.get(peer)
+        if node is None:
+            raise IOError(f"{peer} down")
+        return getattr(node, "handle_" + method)(payload)
+
+    a = RaftNode("A", ["B"], lambda c: {"applied": c},
+                 rpc=rpc, state_dir=str(tmp_path / "a"))
+    b = RaftNode("B", ["A"], lambda c: {"applied": c},
+                 rpc=rpc, state_dir=str(tmp_path / "b"))
+    transport["A"], transport["B"] = a, b
+    a.start(); b.start()
+    import time
+    for _ in range(100):
+        leader = a if a.is_leader() else b if b.is_leader() else None
+        if leader is not None:
+            break
+        time.sleep(0.05)
+    assert leader is not None
+    follower = b if leader is a else a
+    # add a third member C
+    c = RaftNode("C", [leader.id, follower.id], lambda c_: {"applied": c_},
+                 rpc=rpc, state_dir=str(tmp_path / "c"))
+    transport["C"] = c
+    c.start()
+    out = leader.add_peer("C")
+    assert "C" in out["peers"]
+    for _ in range(100):
+        if "C" in follower.peers:
+            break
+        time.sleep(0.05)
+    assert "C" in follower.peers  # replicated, not leader-local
+    # a command commits across the 3-node cluster
+    res = leader.propose({"type": "noop", "n": 1})
+    assert res == {"applied": {"type": "noop", "n": 1}}
+    # remove C again; both remaining members forget it
+    out = leader.remove_peer("C")
+    assert "C" not in out["peers"]
+    for _ in range(100):
+        if "C" not in follower.peers:
+            break
+        time.sleep(0.05)
+    assert "C" not in follower.peers
+    a.stop(); b.stop(); c.stop()
+
+
+def test_removed_node_never_becomes_singleton_leader(tmp_path):
+    """A node removed from the cluster keeps running but must never elect
+    itself leader of a one-node cluster — that would be a second active
+    master minting duplicate ids (split brain)."""
+    import time
+
+    from seaweedfs_tpu.raft import RaftNode
+
+    transport = {}
+
+    def rpc(peer, method, payload, timeout=None):
+        node = transport.get(peer)
+        if node is None:
+            raise IOError(f"{peer} down")
+        return getattr(node, "handle_" + method)(payload)
+
+    a = RaftNode("A", ["B"], lambda c: {"applied": c},
+                 rpc=rpc, state_dir=str(tmp_path / "a"))
+    b = RaftNode("B", ["A"], lambda c: {"applied": c},
+                 rpc=rpc, state_dir=str(tmp_path / "b"))
+    transport["A"], transport["B"] = a, b
+    a.start(); b.start()
+    leader = None
+    for _ in range(100):
+        leader = a if a.is_leader() else b if b.is_leader() else None
+        if leader is not None:
+            break
+        time.sleep(0.05)
+    assert leader is not None
+    follower = b if leader is a else a
+    out = leader.remove_peer(follower.id)
+    assert follower.id not in out["peers"]
+    for _ in range(100):
+        if follower.removed:
+            break
+        time.sleep(0.05)
+    assert follower.removed
+    # give the removed node many election timeouts: it must stay follower
+    time.sleep(1.5)
+    assert not follower.is_leader(), "removed node elected itself (split brain)"
+    assert leader.is_leader()
+    # and the flag survives a restart
+    follower.stop()
+    f2 = RaftNode(follower.id, [leader.id], lambda c: None, rpc=rpc,
+                  state_dir=str(tmp_path / ("a" if follower is a else "b")))
+    assert f2.removed
+    a.stop(); b.stop()

@@ -151,3 +151,97 @@ class TestVolumeExtra:
         # the live volume holds data -> not deleted
         assert f"{vid}@" not in out
         assert vol.store.get_volume(vid) is not None
+
+
+class TestRound5Verbs:
+    def test_quota_enforce(self, env, cluster):
+        """`s3.bucket.quota.enforce`: over-quota buckets flip read-only
+        (an attribute the S3 gateway's write paths reject on) and flip
+        back once under quota (command_s3_bucket_quota_check.go)."""
+        from seaweedfs_tpu.filer.filer_client import FilerClient
+        from seaweedfs_tpu.server.httpd import http_request
+
+        _, _, filer = cluster
+        run_command(env, "s3.bucket.create -name q1")
+        fc = FilerClient(filer.url)
+        fc.put("/buckets/q1/a.bin", os.urandom(300_000))
+        run_command(env, "s3.bucket.quota -name q1 -sizeMB 1")  # 1MB: under
+        out = run_command(env, "s3.bucket.quota.enforce -apply")
+        assert "q1" in out and "ok" in out
+        # shrink the quota below usage -> over -> read-only
+        st, _, body = http_request(
+            "GET", f"{filer.url}/buckets/q1?metadata=true")
+        entry = json.loads(body)
+        entry.setdefault("extended", {})["quota.bytes"] = "1000"
+        http_request("PUT", f"{filer.url}/buckets/q1?meta.entry=true",
+                     body=json.dumps(entry).encode(),
+                     headers={"Content-Type": "application/json"})
+        out = run_command(env, "s3.bucket.quota.enforce -apply")
+        assert "OVER" in out and "READ-ONLY" in out
+        st, _, body = http_request(
+            "GET", f"{filer.url}/buckets/q1?metadata=true")
+        assert json.loads(body)["extended"].get("s3-read-only") == "quota"
+        # raise the quota again -> enforcement clears the flag
+        entry = json.loads(body)
+        entry["extended"]["quota.bytes"] = str(100 << 20)
+        http_request("PUT", f"{filer.url}/buckets/q1?meta.entry=true",
+                     body=json.dumps(entry).encode(),
+                     headers={"Content-Type": "application/json"})
+        out = run_command(env, "s3.bucket.quota.enforce -apply")
+        assert "writable again" in out
+
+    def test_fs_meta_change_volume_id(self, env, cluster):
+        _, _, filer = cluster
+        from seaweedfs_tpu.filer.filer_client import FilerClient
+
+        fc = FilerClient(filer.url)
+        fc.put("/mv/a.bin", os.urandom(200_000))
+        filer._fl_filer_drain()
+        entry = filer.filer.find_entry("/mv/a.bin")
+        old_vid = entry.chunks[0].file_id.split(",")[0]
+        out = run_command(
+            env, f"fs.meta.changeVolumeId -dir /mv"
+                 f" -fromVolumeId {old_vid} -toVolumeId 99")
+        assert "rewrote 1" in out
+        entry = filer.filer.find_entry("/mv/a.bin")
+        assert all(c.file_id.startswith("99,") for c in entry.chunks)
+        # map it BACK so the blob still resolves
+        out = run_command(
+            env, f"fs.meta.changeVolumeId -dir /mv"
+                 f" -fromVolumeId 99 -toVolumeId {old_vid}")
+        assert "rewrote 1" in out
+        assert fc.read("/mv/a.bin") is not None
+
+    def test_fs_meta_notify(self, env, cluster, tmp_path):
+        _, _, filer = cluster
+        from seaweedfs_tpu.filer.filer_client import FilerClient
+        from seaweedfs_tpu.notification import FileQueue
+
+        spool = str(tmp_path / "spool")
+        filer.filer.notification_queue = FileQueue(spool)
+        try:
+            fc = FilerClient(filer.url)
+            fc.put("/nt/one.txt", b"x")
+            fc.put("/nt/sub/two.txt", b"y")
+            out = run_command(env, "fs.meta.notify /nt")
+            assert "sent 3" in out  # one.txt + sub + two.txt
+            files = os.listdir(spool)
+            assert files, "notification spool must hold replayed events"
+        finally:
+            filer.filer.notification_queue = None
+
+    def test_remote_mount_buckets(self, env, cluster, tmp_path):
+        _, _, filer = cluster
+        root = tmp_path / "cloud"
+        for b in ("alpha", "beta"):
+            os.makedirs(root / b)
+            (root / b / "obj.txt").write_bytes(b"remote " + b.encode())
+        run_command(env,
+                    f"remote.configure -name c1 -type local -root {root}")
+        out = run_command(env, "remote.mount.buckets -remote c1")
+        assert "mounted 2 buckets" in out and "alpha" in out
+        from seaweedfs_tpu.server.httpd import http_request
+
+        st, _, body = http_request(
+            "GET", f"{filer.url}/buckets/alpha/obj.txt")
+        assert st == 200 and body == b"remote alpha"
