@@ -469,6 +469,10 @@ struct Engine {
     std::shared_ptr<FilerLease> flease;
     std::string filer_read_auth;  // wildcard read JWT for relays (guarded
                                   // by flease_mu; refreshed with the lease)
+    std::shared_mutex frules_mu;
+    // fs.configure location prefixes: writes under them carry per-path
+    // storage rules only the Python pipeline resolves
+    std::vector<std::string> frule_prefixes;
 
     // any-state lookup (registration plumbing)
     std::shared_ptr<Vol> vol_raw(uint32_t vid) {
@@ -1979,6 +1983,18 @@ bool handle_filer_write(Engine* E, Worker* w, Conn* c,
     if (mime.size() >= 250 || mime.find_first_of("\r\n") != std::string::npos)
         return false;
     if (path.size() > 60000) return false;  // frame lengths are u16
+    // the /etc/ config area (filer.conf, IAM, dedup index) must be
+    // visible the moment the write acks — config consumers read through
+    // Python, so skip the drain-delayed native path entirely
+    if (path.compare(0, 5, "/etc/") == 0) return false;
+    {
+        // paths under an fs.configure rule prefix carry storage options
+        // (collection/replication/ttl/read-only) that only the Python
+        // write pipeline resolves
+        std::shared_lock<std::shared_mutex> rl(E->frules_mu);
+        for (const auto& pre : E->frule_prefixes)
+            if (path.compare(0, pre.size(), pre) == 0) return false;
+    }
     if (dlen <= E->filer_inline_limit) {
         // small-content inlining (filer.py SMALL_CONTENT_LIMIT): no volume
         // hop at all — journal, cache, ack
@@ -3160,6 +3176,22 @@ int sw_fl_filer_cache_put(int h, const char* path, const char* host,
     ent->size = size;
     ent->mtime = mtime;
     fcache_put(E, path, std::move(ent));
+    return 0;
+}
+
+// install the fs.configure rule prefixes (NUL-joined, n entries):
+// native writes under them defer to Python
+int sw_fl_filer_rules_set(int h, const char* prefixes, size_t n) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    std::vector<std::string> out;
+    const char* p = prefixes;
+    for (size_t i = 0; i < n; i++) {
+        out.emplace_back(p);
+        p += out.back().size() + 1;
+    }
+    std::unique_lock<std::shared_mutex> l(E->frules_mu);
+    E->frule_prefixes = std::move(out);
     return 0;
 }
 

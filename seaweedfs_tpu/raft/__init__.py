@@ -598,10 +598,9 @@ class RaftNode:
                     raise TimeoutError(f"propose not committed in {timeout}s")
                 # a demotion only aborts the wait if the entry can no
                 # longer produce a result here — a self-removal conf entry
-                # demotes while STILL applying and storing its result
+                # demotes while STILL applying (its result lands in
+                # _apply_results because leadership is captured pre-apply)
                 if self.role != "leader" and index not in self._apply_results:
-                    if self.last_applied >= index:
-                        break
                     raise NotLeader(self.leader_id)
                 self._commit_cv.wait(min(remain, 0.05))
             result = self._apply_results.pop(index, missing)

@@ -1731,7 +1731,7 @@ class S3Server:
         return Response(b"", 204)
 
     def _list_parts(self, bucket: str, key: str, q: dict) -> Response:
-        self._require_writable_bucket(bucket)
+        # ListParts is a READ: it must keep working on quota-frozen buckets
         upload_id = q["uploadId"]
         manifest = self._get_upload_manifest(bucket, upload_id)
         staging = self._uploads_dir(bucket, upload_id)

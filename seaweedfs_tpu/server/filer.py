@@ -129,8 +129,45 @@ class FilerServer:
         # `-filer.localSocket` (weed/command/filer.go): same-host clients
         # (mounts) reach the filer over a unix domain socket
         self.local_socket = local_socket
+        # per-path storage rules (`weed/filer/filer_conf.go`): loaded from
+        # /etc/seaweedfs/filer.conf, hot-reloaded via the meta-log
+        from seaweedfs_tpu.filer.filer_conf import FILER_CONF_PATH, FilerConf
+
+        conf_entry = self.filer.find_entry(FILER_CONF_PATH)
+        self.filer_conf = FilerConf.from_bytes(
+            bytes(conf_entry.content) if conf_entry else b"")
+        self.filer.subscribe(self._conf_on_meta)
         self._register_stop = __import__("threading").Event()
         self._routes()
+
+    def _conf_on_meta(self, ev) -> None:
+        """Hot-reload /etc/seaweedfs/filer.conf on any mutation of it."""
+        from seaweedfs_tpu.filer.filer_conf import FILER_CONF_PATH, FilerConf
+
+        target = ev.new_entry or ev.old_entry
+        if target is None or target.full_path != FILER_CONF_PATH:
+            return
+        if ev.new_entry is not None and not ev.new_entry.content and \
+                ev.new_entry.chunks:
+            # chunk-backed conf (written by an old build): refusing to
+            # parse b"" keeps the PREVIOUS rules instead of silently
+            # dropping enforcement
+            glog.warning("filer.conf is chunk-backed; keeping previous"
+                         " rules (rewrite it to inline)")
+            return
+        content = ev.new_entry.content if ev.new_entry else b""
+        self.filer_conf = FilerConf.from_bytes(bytes(content))
+        self._fl_push_rules()
+
+    def _fl_push_rules(self) -> None:
+        """Tell the engine which prefixes carry storage rules (their
+        writes must resolve collection/replication/ttl in Python)."""
+        if not getattr(self, "_fl_filer_on", False) or self.fastlane is None:
+            return
+        prefixes = self.filer_conf.prefixes()
+        blob = b"".join(p.encode() + b"\0" for p in prefixes)
+        self.fastlane._lib.sw_fl_filer_rules_set(
+            self.fastlane.handle, blob, len(prefixes))
 
     def _start_fastlane(self) -> None:
         """Front the filer with the engine. Proxied (Python) requests ride a
@@ -182,6 +219,7 @@ class FilerServer:
         self._fl_drain_mu = __import__("threading").Lock()
         self._fl_buf = __import__("ctypes").create_string_buffer(1 << 20)
         self.filer.subscribe(self._fl_on_meta)
+        self._fl_push_rules()  # fs.configure prefixes defer to Python
 
     def start(self) -> None:
         import threading
@@ -1187,9 +1225,33 @@ class FilerServer:
             data = req.body
             mime = req.headers.get("Content-Type", "")
             filename = path.rsplit("/", 1)[-1]
-        ttl = req.query.get("ttl", "")
-        collection = req.query.get("collection", self.collection)
-        replication = req.query.get("replication", self.default_replication)
+        # fs.configure per-path rules (filer_conf.go): longest prefix wins;
+        # explicit query params still override the rule's defaults. The
+        # /etc/ config area is EXEMPT — a broad read-only rule must never
+        # brick the very file that removes it.
+        rule = {} if path.startswith("/etc/") else (
+            self.filer_conf.match(path) or {})
+        if rule.get("read_only"):
+            return Response(
+                {"error": f"{rule.get('location_prefix')} is read-only"
+                          " (fs.configure)"}, 403)
+        rule_ttl = rule.get("ttl") or ""
+        if rule_ttl:
+            from seaweedfs_tpu.storage.types import TTL as _TTL
+
+            try:  # a malformed persisted rule must not 500 a whole subtree
+                _TTL.parse(rule_ttl)
+            except (ValueError, KeyError):
+                glog.warning("fs.configure rule %s has invalid ttl %r;"
+                             " ignoring it", rule.get("location_prefix"),
+                             rule_ttl)
+                rule_ttl = ""
+        ttl = req.query.get("ttl") or rule_ttl
+        collection = (req.query.get("collection") or rule.get("collection")
+                      or self.collection)
+        replication = (req.query.get("replication")
+                       or rule.get("replication")
+                       or self.default_replication)
 
         from seaweedfs_tpu.storage.types import TTL
 
@@ -1198,7 +1260,13 @@ class FilerServer:
         entry.attributes.file_size = len(data)
         entry.attributes.ttl_sec = TTL.parse(ttl).minutes() * 60
         entry.attributes.mtime = time.time()
-        if len(data) <= SMALL_CONTENT_LIMIT:
+        # /etc/seaweedfs/ config files are ALWAYS inlined: their
+        # loaders (filer.conf hot-reload) read entry.content, and a
+        # config silently chunked past 2KB would parse as empty —
+        # rules vanishing without a trace
+        if (len(data) <= SMALL_CONTENT_LIMIT
+                or (path.startswith("/etc/seaweedfs/")
+                    and len(data) <= 4 * 1024 * 1024)):
             entry.content = data
             entry.attributes.md5 = get_hash_service().submit(data).md5_hex()
         else:
@@ -1508,6 +1576,12 @@ class FilerServer:
     def _do_delete(self, req: Request) -> Response:
         self._fl_filer_drain()
         path = normalize(urllib.parse.unquote(req.path))
+        rule = {} if path.startswith("/etc/") else (
+            self.filer_conf.match(path) or {})
+        if rule.get("read_only"):
+            return Response(
+                {"error": f"{rule.get('location_prefix')} is read-only"
+                          " (fs.configure)"}, 403)
         recursive = req.query.get("recursive") == "true"
         try:
             chunks = self.filer.delete_entry(

@@ -266,3 +266,54 @@ def cmd_fs_meta_change_volume_id(env: CommandEnv, args: list[str]) -> str:
     out = post_json(f"{env.require_filer()}/__meta__/change_volume_id",
                     {"directory": directory, "mapping": mapping})
     return f"rewrote {out['changed']} entries under {directory}"
+
+
+@command("fs.configure",
+         "[-locationPrefix /p [-collection c] [-replication xyz] [-ttl 7d]"
+         " [-readOnly] [-delete] [-apply]] — per-path storage rules"
+         " (/etc/seaweedfs/filer.conf); no flags shows the current rules")
+def cmd_fs_configure(env: CommandEnv, args: list[str]) -> str:
+    """`command_fs_configure.go`: view/edit the filer's per-location
+    storage rules. Without -apply the resulting document is printed but
+    NOT saved (the reference's try-before-apply semantics); with -apply
+    it is written to /etc/seaweedfs/filer.conf, which every filer
+    hot-reloads via its metadata subscription."""
+    from seaweedfs_tpu.filer.filer_conf import FILER_CONF_PATH, FilerConf
+    from seaweedfs_tpu.server.httpd import http_request
+
+    flags = parse_flags(args)
+    filer = env.require_filer()
+    status, _, body = http_request("GET", filer + FILER_CONF_PATH)
+    conf = FilerConf.from_bytes(body if status == 200 else b"")
+    prefix = flags.get("locationPrefix")
+    if prefix is None:
+        return conf.to_bytes().decode()
+    if "delete" in flags:
+        conf.delete(prefix)
+    else:
+        rule = {"location_prefix": prefix}
+        if "collection" in flags:
+            rule["collection"] = flags["collection"]
+        if "replication" in flags:
+            rule["replication"] = flags["replication"]
+        if "ttl" in flags:
+            from seaweedfs_tpu.storage.types import TTL
+
+            try:  # validate at SAVE time: a bad persisted rule would
+                TTL.parse(flags["ttl"])  # break every write under the prefix
+            except (ValueError, KeyError):
+                raise ShellError(f"invalid -ttl {flags['ttl']!r}"
+                                 " (e.g. 5m, 3h, 7d)")
+            rule["ttl"] = flags["ttl"]
+        if "readOnly" in flags:
+            rule["read_only"] = True
+        conf.upsert(rule)
+    doc = conf.to_bytes()
+    if "apply" not in flags:
+        return doc.decode() + "\n(not saved; add -apply)"
+    st, _, resp = http_request(
+        "PUT", filer + FILER_CONF_PATH, doc,
+        {"Content-Type": "application/json"})
+    if st >= 300:
+        raise ShellError(f"save failed: {st} {resp[:120]!r}")
+    return doc.decode() + "\n(saved)"

@@ -200,8 +200,12 @@ def cmd_s3_bucket_quota_enforce(env: CommandEnv, args: list[str]) -> str:
             qs = "limit=10000" + (
                 f"&lastFileName={_u.quote(last)}" if last else "")
             status, _, body = env.filer_read(path, qs)
+            if status == 404:
+                return total  # directory vanished mid-walk
             if status != 200:
-                return total
+                # a truncated sum could flip an over-quota bucket back to
+                # writable — fail the bucket's check instead
+                raise ShellError(f"listing {path} -> {status}")
             entries = json.loads(body).get("Entries") or []
             for e in entries:
                 name = e["FullPath"].rsplit("/", 1)[-1]
@@ -230,7 +234,11 @@ def cmd_s3_bucket_quota_enforce(env: CommandEnv, args: list[str]) -> str:
         quota = int(ext.get("quota.bytes") or 0)
         if quota <= 0:
             continue
-        used = usage(path)
+        try:
+            used = usage(path)
+        except ShellError as e:
+            lines.append(f"{name}: usage check failed ({e}); skipped")
+            continue
         over = used > quota
         readonly = bool(ext.get("s3-read-only"))
         action = ""

@@ -118,6 +118,10 @@ def _bind(lib) -> bool:
         lib.sw_fl_filer_journal_reset.argtypes = [ctypes.c_int]
         lib.sw_fl_tls_client_ok.restype = ctypes.c_int
         lib.sw_fl_tls_client_ok.argtypes = [ctypes.c_int]
+        lib.sw_fl_filer_rules_set.restype = ctypes.c_int
+        lib.sw_fl_filer_rules_set.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t,
+        ]
         return True
     except AttributeError:
         return False
