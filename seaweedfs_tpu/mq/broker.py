@@ -560,6 +560,29 @@ class BrokerServer:
                         content_type="application/json")
             return Response({"ok": True, "partition_count": count}, 201)
 
+        @svc.route("POST", r"/topics/configure")
+        def topics_configure(req: Request) -> Response:
+            # `command_mq_topic_configure.go`: change a live topic's
+            # partition count. Only increases are allowed — shrinking
+            # would orphan data in the removed partitions. Key routing
+            # re-hashes over the new count; existing partitions keep
+            # their extents.
+            p = req.json()
+            ns, topic = p.get("namespace", "default"), p["topic"]
+            conf = self._topic_conf(ns, topic)
+            if conf is None:
+                return Response({"error": f"{ns}/{topic} not found"}, 404)
+            count = int(p.get("partition_count", conf["partition_count"]))
+            if count < conf["partition_count"]:
+                return Response(
+                    {"error": "partition count can only grow"
+                              f" (now {conf['partition_count']})"}, 400)
+            conf["partition_count"] = count
+            conf_path = f"{self._topic_dir(ns, topic)}/topic.conf"
+            self.fc.put(conf_path, json.dumps(conf).encode(),
+                        content_type="application/json")
+            return Response({"ok": True, "partition_count": count})
+
         @svc.route("GET", r"/topics/list")
         def topics_list(req: Request) -> Response:
             topics = [

@@ -1985,8 +1985,11 @@ bool handle_filer_write(Engine* E, Worker* w, Conn* c,
     if (path.size() > 60000) return false;  // frame lengths are u16
     // the /etc/ config area (filer.conf, IAM, dedup index) must be
     // visible the moment the write acks — config consumers read through
-    // Python, so skip the drain-delayed native path entirely
+    // Python, so skip the drain-delayed native path entirely. The system
+    // meta-log tree emits NO meta events (filer_notify skips it), so a
+    // natively-cached entry there could never be invalidated — skip too.
     if (path.compare(0, 5, "/etc/") == 0) return false;
+    if (path.compare(0, 16, "/topics/.system/") == 0) return false;
     {
         // paths under an fs.configure rule prefix carry storage options
         // (collection/replication/ttl/read-only) that only the Python

@@ -456,6 +456,12 @@ class FilerServer:
         lib, h = self.fastlane._lib, self.fastlane.handle
         path = entry.full_path
         a = entry.attributes
+        if path.startswith("/topics/.system/"):
+            # the system meta-log tree emits NO meta events (filer_notify
+            # skips it): a cached entry there could never be invalidated,
+            # so it must never be cached — from the read path either
+            lib.sw_fl_filer_cache_del(h, path.encode())
+            return
         if (entry.is_directory or a.ttl_sec > 0 or entry.hard_link_id
                 or not a.md5):
             lib.sw_fl_filer_cache_del(h, path.encode())

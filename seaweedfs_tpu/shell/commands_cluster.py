@@ -207,3 +207,23 @@ def cmd_cluster_raft_remove(env: CommandEnv, args: list[str]) -> str:
     except IOError as e:
         raise ShellError(str(e))
     return f"removed {addr}; members: {', '.join(out.get('peers', []))}"
+
+
+@command("mq.topic.configure",
+         "-topic <name> -partitionCount <n> [-namespace default] — grow a"
+         " live topic's partition count")
+def cmd_mq_topic_configure(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    ns = flags.get("namespace", "default")
+    try:
+        out = env.post(f"{_broker_url(env)}/topics/configure", {
+            "namespace": ns, "topic": flags["topic"],
+            "partition_count": int(flags["partitionCount"]),
+        })
+    except KeyError:
+        raise ShellError("usage: mq.topic.configure -topic <name>"
+                         " -partitionCount <n>")
+    except IOError as e:
+        raise ShellError(str(e))
+    return (f"topic {ns}/{flags['topic']} now has"
+            f" {out['partition_count']} partitions")

@@ -317,3 +317,37 @@ def cmd_fs_configure(env: CommandEnv, args: list[str]) -> str:
     if st >= 300:
         raise ShellError(f"save failed: {st} {resp[:120]!r}")
     return doc.decode() + "\n(saved)"
+
+
+@command("fs.log.purge",
+         "[-modifyDayAgo 365] — delete filer meta-log segments older than"
+         " N days")
+def cmd_fs_log_purge(env: CommandEnv, args: list[str]) -> str:
+    """`command_fs_log.go` fs.log.purge: the metadata event log persists
+    as dated segment files under /topics/.system/log/<yyyy-mm-dd>/...;
+    drop whole day-directories past the retention window. Day names come
+    from UTC (filer_notify segment_path uses gmtime), so the cutoff is
+    computed in UTC too."""
+    import datetime as _dt
+
+    flags = parse_flags(args)
+    days = int(flags.get("modifyDayAgo", 365))
+    cutoff = (_dt.datetime.now(_dt.timezone.utc).date()
+              - _dt.timedelta(days=days)).isoformat()
+    filer = env.require_filer()
+    status, _, body = env.filer_read("/topics/.system/log", "limit=100000")
+    if status != 200:
+        return "(no meta-log segments)"
+    purged, failed = [], []
+    for e in json.loads(body).get("Entries") or []:
+        day = e["FullPath"].rsplit("/", 1)[-1]
+        if e["IsDirectory"] and day < cutoff:
+            st, _, _ = http_request(
+                "DELETE", f"{filer}{e['FullPath']}?recursive=true")
+            (purged if st < 300 else failed).append(day)
+    out = f"purged {len(purged)} day(s)" + (
+        ": " + ", ".join(sorted(purged)) if purged else "")
+    if failed:
+        out += f"\nFAILED to purge {len(failed)}: " + ", ".join(
+            sorted(failed))
+    return out

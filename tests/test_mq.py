@@ -675,3 +675,34 @@ class TestConsumerRejoin:
         assert ns == [6, 7, 8], ns  # committed offsets survived the re-join
         con.commit()
         con.close()
+
+
+class TestTopicConfigure:
+    def test_partition_count_grows_not_shrinks(self, stack):
+        _, _, broker = stack
+        _post(broker.url + "/topics/create",
+              {"topic": "grow", "partition_count": 2})
+        for i in range(8):
+            s, _ = _post(broker.url + "/publish",
+                         {"topic": "grow", "key": f"k{i}", "value": i})
+            assert s == 200
+        s, out = _post(broker.url + "/topics/configure",
+                       {"topic": "grow", "partition_count": 4})
+        assert s == 200 and out["partition_count"] == 4
+        s, out = _get(broker.url + "/topics/describe?topic=grow")
+        assert out["partition_count"] == 4 and len(out["partitions"]) == 4
+        # publishes spread over the grown set; pre-grow data still reads
+        for i in range(8, 16):
+            s, _ = _post(broker.url + "/publish",
+                         {"topic": "grow", "key": f"k{i}", "value": i})
+            assert s == 200
+        total = 0
+        for k in range(4):
+            s, out = _get(broker.url +
+                          f"/subscribe?topic=grow&partition={k}&offset=0")
+            total += len(out["messages"])
+        assert total == 16
+        # shrinking is refused (it would orphan partition data)
+        s, out = _post(broker.url + "/topics/configure",
+                       {"topic": "grow", "partition_count": 1})
+        assert s == 400

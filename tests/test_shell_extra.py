@@ -245,3 +245,44 @@ class TestRound5Verbs:
         st, _, body = http_request(
             "GET", f"{filer.url}/buckets/alpha/obj.txt")
         assert st == 200 and body == b"remote alpha"
+
+
+def test_fs_log_purge(env, cluster):
+    """fs.log.purge (command_fs_log.go): dated meta-log day directories
+    older than the retention window are removed."""
+    _, _, filer = cluster
+    from seaweedfs_tpu.filer.filer_notify import SYSTEM_LOG_DIR
+    from seaweedfs_tpu.server.httpd import http_request
+
+    # plant an ancient day segment + a recent one
+    old_day = f"{SYSTEM_LOG_DIR}/2020-01-01"
+    new_day = f"{SYSTEM_LOG_DIR}/2999-01-01"
+    for d in (old_day, new_day):
+        st, _, _ = http_request("POST", f"{filer.url}{d}/seg.1.2", b"x")
+        assert st == 201
+    out = run_command(env, "fs.log.purge -modifyDayAgo 30")
+    assert "purged 1" in out and "2020-01-01" in out
+    st, _, _ = http_request("GET", f"{filer.url}{old_day}/seg.1.2")
+    assert st == 404
+    st, _, _ = http_request("GET", f"{filer.url}{new_day}/seg.1.2")
+    assert st == 200
+
+
+def test_system_log_never_cached_via_reads(env, cluster):
+    """Reading a system-log segment must not seed the engine cache: the
+    tree emits no meta events, so a cached entry there could never be
+    invalidated — a purge would leave ghosts served with 200."""
+    _, _, filer = cluster
+    from seaweedfs_tpu.filer.filer_notify import SYSTEM_LOG_DIR
+    from seaweedfs_tpu.server.httpd import http_request
+
+    day = f"{SYSTEM_LOG_DIR}/2021-05-05"
+    st, _, _ = http_request("POST", f"{filer.url}{day}/seg.9.9", b"logbytes")
+    assert st == 201
+    # read it (would seed the cache if not exempt), then purge
+    st, _, body = http_request("GET", f"{filer.url}{day}/seg.9.9")
+    assert st == 200 and body == b"logbytes"
+    out = run_command(env, "fs.log.purge -modifyDayAgo 30")
+    assert "2021-05-05" in out
+    st, _, _ = http_request("GET", f"{filer.url}{day}/seg.9.9")
+    assert st == 404, "purged segment must not be served from the cache"
