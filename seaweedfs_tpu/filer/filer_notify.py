@@ -13,7 +13,11 @@ from __future__ import annotations
 import json
 import time
 
-SYSTEM_LOG_DIR = "/topics/.system/log"
+# the whole .system tree is event-silent (see Filer._notify) and must
+# never enter the engine's path cache (nothing would invalidate it);
+# fastlane.cpp mirrors this prefix as a literal — a test pins them equal
+SYSTEM_TREE_PREFIX = "/topics/.system/"
+SYSTEM_LOG_DIR = SYSTEM_TREE_PREFIX + "log"
 
 
 def serialize_event(

@@ -456,10 +456,13 @@ class FilerServer:
         lib, h = self.fastlane._lib, self.fastlane.handle
         path = entry.full_path
         a = entry.attributes
-        if path.startswith("/topics/.system/"):
-            # the system meta-log tree emits NO meta events (filer_notify
-            # skips it): a cached entry there could never be invalidated,
-            # so it must never be cached — from the read path either
+        from seaweedfs_tpu.filer.filer_notify import SYSTEM_TREE_PREFIX
+
+        if path.startswith(SYSTEM_TREE_PREFIX):
+            # the .system log tree emits no meta events (Filer._notify
+            # skips SYSTEM_LOG_DIR): a cached entry under it could never
+            # be invalidated, so never cache it — from the read path
+            # either (the native write path is gated in fastlane.cpp)
             lib.sw_fl_filer_cache_del(h, path.encode())
             return
         if (entry.is_directory or a.ttl_sec > 0 or entry.hard_link_id
@@ -1135,6 +1138,97 @@ class FilerServer:
 
             walk(root)
             return Response({"changed": changed})
+
+        @svc.route("POST", r"/__meta__/merge_volumes")
+        def meta_merge_volumes(req: Request) -> Response:
+            # `command_fs_merge_volumes.go`: move chunks out of volume
+            # `from_vid` into `to_vid` (needle key/cookie preserved, so
+            # existing fids only change their volume part) and rewrite
+            # the metadata; dry-run unless apply. Old blobs are deleted
+            # after their entry is updated.
+            self._fl_filer_drain()
+            p = req.json()
+            root = normalize(p.get("directory", "/"))
+            from_vid = str(p.get("from_vid", ""))
+            to_vid = str(p.get("to_vid", ""))
+            apply = bool(p.get("apply"))
+            if not from_vid or not to_vid or from_vid == to_vid:
+                return Response(
+                    {"error": "need distinct from_vid and to_vid"}, 400)
+            try:
+                targets = self.client.lookup(int(to_vid))
+            except (IOError, ValueError) as e:
+                return Response({"error": f"target volume: {e}"}, 400)
+            target = targets[0]
+            moved = planned = 0
+            skipped: list[str] = []
+
+            import copy as _copy
+
+            from seaweedfs_tpu.server.httpd import http_request, peer_url
+
+            manifest_skipped = 0
+
+            def migrate(entry) -> bool:
+                nonlocal moved, planned
+                changed = False
+                old_chunks = []
+                for c in entry.chunks:
+                    vid, _, rest = c.file_id.partition(",")
+                    if vid != from_vid:
+                        continue
+                    planned += 1
+                    if not apply:
+                        continue
+                    new_fid = f"{to_vid},{rest}"
+                    try:
+                        # key collision in the target volume would clobber
+                        # a foreign needle (a same-key/other-cookie needle
+                        # HEADs 404 but still fails the overwrite check
+                        # below — caught the same way)
+                        st, _, _ = http_request(
+                            "HEAD", f"{peer_url(target)}/{new_fid}")
+                        if st == 200:
+                            skipped.append(c.file_id)
+                            continue
+                        data = self.client.fetch(c.file_id)
+                        self.client.upload_to(new_fid, target, data)
+                    except IOError:
+                        skipped.append(c.file_id)
+                        continue
+                    old_chunks.append(_copy.copy(c))
+                    c.file_id = new_fid
+                    changed = True
+                    moved += 1
+                if changed:
+                    self.filer.create_entry(entry)  # moved, not freed
+                    # reclaim via the shared path: dedup-managed blobs
+                    # (shared with other entries / the dedup index) are
+                    # kept, everything else is deleted
+                    self._reclaim_chunks(old_chunks)
+                return changed
+
+            def walk(d: str) -> None:
+                nonlocal manifest_skipped
+                for e in self.filer.list_entries(d, limit=1 << 31):
+                    if e.is_directory:
+                        walk(e.full_path)
+                        continue
+                    if any(c.is_chunk_manifest for c in e.chunks):
+                        # inner manifest fids may live in from_vid too;
+                        # migrating them means rewriting manifest blobs —
+                        # report instead of claiming a full drain
+                        manifest_skipped += 1
+                        continue
+                    if any(c.file_id.startswith(from_vid + ",")
+                           for c in e.chunks):
+                        migrate(e)
+
+            walk(root)
+            return Response({"planned": planned, "moved": moved,
+                             "skipped": skipped,
+                             "manifest_entries_skipped": manifest_skipped,
+                             "applied": apply})
 
         @svc.route("GET", r"/__meta__/info")
         def meta_info(req: Request) -> Response:

@@ -351,3 +351,38 @@ def cmd_fs_log_purge(env: CommandEnv, args: list[str]) -> str:
         out += f"\nFAILED to purge {len(failed)}: " + ", ".join(
             sorted(failed))
     return out
+
+
+@command("fs.merge.volumes",
+         "-fromVolumeId <x> -toVolumeId <y> [-dir /] [-apply] — move chunks"
+         " between volumes and rewrite metadata (consolidate small volumes)")
+def cmd_fs_merge_volumes(env: CommandEnv, args: list[str]) -> str:
+    """`command_fs_merge_volumes.go`: re-home every chunk of volume X into
+    volume Y (needle key/cookie preserved), dry-run unless -apply."""
+    from seaweedfs_tpu.server.httpd import post_json
+
+    flags = parse_flags(args)
+    try:
+        payload = {
+            "directory": flags.get("dir", "/"),
+            "from_vid": flags["fromVolumeId"],
+            "to_vid": flags["toVolumeId"],
+            "apply": "apply" in flags,
+        }
+    except KeyError:
+        raise ShellError("usage: fs.merge.volumes -fromVolumeId <x>"
+                         " -toVolumeId <y> [-dir /] [-apply]")
+    try:
+        out = post_json(f"{env.require_filer()}/__meta__/merge_volumes",
+                        payload)
+    except IOError as e:
+        raise ShellError(str(e))
+    msg = (f"{out['planned']} chunk(s) in volume {payload['from_vid']}"
+           f" under {payload['directory']}")
+    if out["applied"]:
+        msg += f"; moved {out['moved']} to volume {payload['to_vid']}"
+        if out["skipped"]:
+            msg += f"; SKIPPED (key collision): {', '.join(out['skipped'])}"
+    else:
+        msg += " (dry run; add -apply)"
+    return msg

@@ -319,3 +319,17 @@ def test_fs_configure_rules(cluster):
         assert st == 201
     finally:
         f.stop()
+
+
+def test_system_tree_prefix_pinned_in_engine():
+    """fastlane.cpp mirrors filer_notify.SYSTEM_TREE_PREFIX as a literal
+    (C can't import it): renaming the tree must update both or the
+    never-invalidated-cache guard silently stops matching."""
+    from seaweedfs_tpu.filer.filer_notify import SYSTEM_TREE_PREFIX
+
+    src = open(os.path.join(os.path.dirname(__file__), "..",
+                            "seaweedfs_tpu", "native", "src",
+                            "fastlane.cpp")).read()
+    needle = f'path.compare(0, {len(SYSTEM_TREE_PREFIX)},' \
+             f' "{SYSTEM_TREE_PREFIX}") == 0'
+    assert needle in src, needle

@@ -286,3 +286,40 @@ def test_system_log_never_cached_via_reads(env, cluster):
     assert "2021-05-05" in out
     st, _, _ = http_request("GET", f"{filer.url}{day}/seg.9.9")
     assert st == 404, "purged segment must not be served from the cache"
+
+
+def test_fs_merge_volumes(env, cluster):
+    """fs.merge.volumes: chunks move between volumes with their key and
+    cookie preserved, metadata follows, old blobs are reclaimed."""
+    _, vol, filer = cluster
+    from seaweedfs_tpu.filer.filer_client import FilerClient
+    from seaweedfs_tpu.server.httpd import http_request
+
+    fc = FilerClient(filer.url)
+    payload = os.urandom(120_000)
+    fc.put("/merge/a.bin", payload)
+    filer._fl_filer_drain()
+    entry = filer.filer.find_entry("/merge/a.bin")
+    from_vid = entry.chunks[0].file_id.split(",")[0]
+    # allocate a dedicated target volume (deterministic regardless of how
+    # many volumes earlier tests left around)
+    from seaweedfs_tpu.server.httpd import post_json
+
+    to_vid = "90"
+    post_json(f"{vol.url}/admin/allocate_volume",
+              {"volume": int(to_vid), "collection": "", "replication": "000"})
+    vol.heartbeat_once()
+    out = run_command(
+        env, f"fs.merge.volumes -fromVolumeId {from_vid}"
+             f" -toVolumeId {to_vid} -dir /merge")
+    assert "dry run" in out
+    entry = filer.filer.find_entry("/merge/a.bin")
+    assert entry.chunks[0].file_id.startswith(from_vid + ",")  # unchanged
+    out = run_command(
+        env, f"fs.merge.volumes -fromVolumeId {from_vid}"
+             f" -toVolumeId {to_vid} -dir /merge -apply")
+    assert "moved" in out
+    entry = filer.filer.find_entry("/merge/a.bin")
+    assert all(c.file_id.startswith(to_vid + ",") for c in entry.chunks)
+    # data still reads end-to-end through the filer
+    assert fc.read("/merge/a.bin") == payload
