@@ -1654,6 +1654,49 @@ class FilerServer:
         limit = int(req.query.get("limit", 1024))
         last = req.query.get("lastFileName", "")
         entries = self.filer.list_entries(entry.full_path, last, False, limit)
+        accept = (req.headers.get("Accept") or "")
+        if "text/html" in accept and "application/json" not in accept:
+            # browsers get the directory browser (`weed/server/filer_ui`);
+            # API clients keep the JSON listing. Attribute values go
+            # through quoteattr (escape() leaves double quotes — an XSS
+            # hole via filenames) and hrefs are percent-encoded (names
+            # with %/#/? would link to the wrong resource otherwise).
+            from xml.sax.saxutils import escape as _esc
+            from xml.sax.saxutils import quoteattr as _qa
+
+            def _href(p: str) -> str:
+                return _qa(urllib.parse.quote(p))
+
+            rows = []
+            if entry.full_path != "/":
+                rows.append(f"<tr><td><a href={_href(entry.parent)}>..</a>"
+                            "</td><td></td><td></td></tr>")
+            for e in entries:
+                name = _esc(e.name) + ("/" if e.is_directory else "")
+                size = "" if e.is_directory else str(e.size())
+                mtime = time.strftime(
+                    "%Y-%m-%d %H:%M", time.localtime(e.attributes.mtime))
+                rows.append(f"<tr><td><a href={_href(e.full_path)}>{name}"
+                            f'</a></td><td align="right">{size}</td>'
+                            f"<td>{mtime}</td></tr>")
+            more = ""
+            if len(entries) == limit:
+                next_url = (f"{urllib.parse.quote(entry.full_path)}"
+                            f"?lastFileName="
+                            f"{urllib.parse.quote_plus(entries[-1].name)}"
+                            f"&limit={limit}")
+                more = f"<p><a href={_qa(next_url)}>more…</a></p>"
+            html = (
+                "<html><head><title>seaweedfs-tpu filer"
+                f" {_esc(entry.full_path)}</title></head><body>"
+                f"<h3>{_esc(entry.full_path)}</h3>"
+                '<table cellpadding="4">'
+                "<tr><th align=\"left\">name</th>"
+                "<th align=\"right\">size</th>"
+                "<th align=\"left\">modified</th></tr>"
+                + "".join(rows) + f"</table>{more}</body></html>"
+            )
+            return Response(html.encode(), content_type="text/html")
         return Response(
             {
                 "Path": entry.full_path,

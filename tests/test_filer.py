@@ -288,3 +288,45 @@ def test_full_cluster_on_etcd_store(tmp_path):
         vol.stop()
         master.stop()
         fake.stop()
+
+
+def test_html_directory_browser(tmp_path):
+    """Browsers (Accept: text/html) get the filer_ui-style directory
+    listing; API clients keep the JSON listing."""
+    master = MasterServer(port=0, pulse_seconds=1)
+    master.start()
+    vol = VolumeServer([str(tmp_path / "v")], master.url, port=0,
+                       pulse_seconds=1)
+    vol.start()
+    filer = FilerServer(master.url, port=0)
+    filer.start()
+    try:
+        http_request("PUT", f"{filer.url}/web/a.txt", b"hello")
+        http_request("POST", f"{filer.url}/web/sub/?mkdir=true", b"")
+        st, hdrs, body = http_request(
+            "GET", f"{filer.url}/web",
+            headers={"Accept": "text/html,application/xhtml+xml"})
+        assert st == 200
+        assert hdrs["Content-Type"].startswith("text/html")
+        assert b"a.txt" in body and b"sub/" in body and b"<table" in body
+        # hostile filenames stay inert: quotes cannot break out of the
+        # href attribute, and odd characters are percent-encoded
+        evil = 'x" onmouseover="alert(1)'
+        http_request("PUT",
+                     f"{filer.url}/web/{__import__('urllib.parse', fromlist=['quote']).quote(evil)}",
+                     b"z")
+        http_request("PUT", f"{filer.url}/web/report%231.txt", b"z")
+        st, _, body = http_request(
+            "GET", f"{filer.url}/web", headers={"Accept": "text/html"})
+        # the quote is percent-encoded INSIDE the href attribute (it only
+        # appears as inert text in the link label), so no attribute
+        # breakout is possible
+        assert b'href="/web/x%22%20onmouseover' in body
+        assert b"report%231.txt" in body  # '#' percent-encoded in href
+        # JSON clients (no Accept or json) are unchanged
+        st, hdrs, body = http_request("GET", f"{filer.url}/web")
+        assert json.loads(body)["Entries"]
+    finally:
+        filer.stop()
+        vol.stop()
+        master.stop()
