@@ -342,16 +342,20 @@ def run_mount(args: list[str]) -> int:
     p.add_argument("-dir", required=True, help="mountpoint")
     p.add_argument("-readOnly", action="store_true")
     p.add_argument("-chunkCacheDir", default=None)
+    p.add_argument("-quotaMB", type=int, default=0,
+                   help="limit mounted usage; writes past it fail ENOSPC"
+                        " (adjustable at runtime via mount.configure)")
     opts = p.parse_args(args)
     _load_security()
-    from seaweedfs_tpu.mount import WFS, mount_fs
+    from seaweedfs_tpu.mount import WFS, mount_fs, start_admin_service
 
     filer = opts.filer
     if not filer.startswith("http"):
         filer = peer_url(filer)
     wfs = WFS(filer, read_only=opts.readOnly,
-              chunk_cache_dir=opts.chunkCacheDir)
+              chunk_cache_dir=opts.chunkCacheDir, quota_mb=opts.quotaMB)
     try:
+        start_admin_service(wfs, opts.dir)  # mount.configure control point
         print(f"mounting {filer} at {opts.dir}")
         mount_fs(wfs, opts.dir)
     except (PermissionError, FileNotFoundError) as e:

@@ -17,6 +17,52 @@ import os
 from .weedfs import WFS  # noqa: F401
 
 
+def admin_socket_path(mountpoint: str) -> str:
+    """Deterministic unix-socket path for a mount's admin listener — how
+    `mount.configure -dir <mp>` finds a RUNNING mount (the reference uses
+    the same convention with a hashed /tmp socket, `mount.go`)."""
+    import hashlib
+
+    digest = hashlib.md5(
+        os.path.abspath(mountpoint).encode()).hexdigest()[:10]
+    return f"/tmp/seaweedfs-tpu-mount-{digest}.sock"
+
+
+def start_admin_service(wfs: WFS, mountpoint: str):
+    """Tiny control listener on the mount's unix socket: GET /status and
+    POST /configure {"quotaMB": n} (`weed/mount/weedfs_grpc_server.go` /
+    command_mount_configure.go surface). Returns the HTTPService."""
+    from seaweedfs_tpu.server.httpd import HTTPService, Request, Response
+
+    svc = HTTPService("127.0.0.1", 0)
+
+    @svc.route("GET", r"/status")
+    def status(req: Request) -> Response:
+        return Response({
+            "mountpoint": os.path.abspath(mountpoint),
+            "quota_bytes": wfs.quota_bytes,
+            "used_bytes": wfs._usage(),
+            "read_only": wfs.read_only,
+        })
+
+    @svc.route("POST", r"/configure")
+    def configure(req: Request) -> Response:
+        p = req.json()
+        if "quotaMB" in p:
+            wfs.set_quota(int(p["quotaMB"]))
+        return Response({"ok": True, "quota_bytes": wfs.quota_bytes})
+
+    svc.plain_backend = True
+    svc.start()  # enable_unix_socket needs the handler class start() builds
+    svc.enable_unix_socket(admin_socket_path(mountpoint))
+    # the TCP side was only scaffolding: close it so the unix socket is
+    # the ONLY control surface (no stray unauthenticated loopback port)
+    svc._httpd.shutdown()
+    svc._httpd.server_close()
+    svc._httpd = None
+    return svc
+
+
 def mount_fs(wfs: WFS, mountpoint: str) -> None:  # pragma: no cover
     """Open /dev/fuse, mount(2), serve. Raises PermissionError without
     CAP_SYS_ADMIN (the normal case in unprivileged containers)."""

@@ -48,6 +48,7 @@ ERRNO_ISDIR = 21
 ERRNO_INVAL = 22
 ERRNO_NOTEMPTY = 39
 ERRNO_NOSYS = 38
+ERRNO_NOSPC = 28
 
 IN_HEADER = struct.Struct("<IIQQIIII")  # len opcode unique nodeid uid gid pid pad
 OUT_HEADER = struct.Struct("<IiQ")  # len error unique

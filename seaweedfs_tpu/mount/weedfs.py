@@ -50,7 +50,8 @@ class FileHandle:
 class WFS:
     def __init__(self, filer_url: str, chunk_size: int | None = None,
                  read_only: bool = False,
-                 chunk_cache_dir: str | None = None) -> None:
+                 chunk_cache_dir: str | None = None,
+                 quota_mb: int = 0) -> None:
         from seaweedfs_tpu.filer.filer_client import FilerClient
         from seaweedfs_tpu.filer.wdclient import WeedClient
         from seaweedfs_tpu.server.httpd import get_json
@@ -69,6 +70,68 @@ class WFS:
         self._handles: dict[int, FileHandle] = {}
         self._next_fh = 1
         self._lock = threading.Lock()
+        # mount quota (`weed/mount/weedfs_quota.go` semantics): writes
+        # fail ENOSPC once the mounted namespace's usage exceeds it, and
+        # statfs advertises it as the filesystem size. 0 = unlimited;
+        # adjustable at runtime via mount.configure (set_quota). Usage is
+        # refreshed by a BACKGROUND ticker, like the reference — a walk
+        # inside the single-threaded FUSE dispatch would freeze the whole
+        # mount for the duration of a large namespace listing.
+        self.quota_bytes = quota_mb * 1024 * 1024
+        self._usage_bytes = 0
+        self._usage_kick = threading.Event()
+        self._usage_thread: threading.Thread | None = None
+        if self.quota_bytes > 0:
+            self._start_usage_ticker()
+
+    # --- quota ---------------------------------------------------------------
+    def set_quota(self, quota_mb: int) -> None:
+        self.quota_bytes = quota_mb * 1024 * 1024
+        if self.quota_bytes > 0:
+            self._start_usage_ticker()
+        self._usage_kick.set()  # refresh promptly
+
+    def _start_usage_ticker(self) -> None:
+        if self._usage_thread is not None and self._usage_thread.is_alive():
+            return
+        self._refresh_usage()  # first number synchronously (mount start)
+        t = threading.Thread(target=self._usage_loop, daemon=True,
+                             name="mount-quota-usage")
+        self._usage_thread = t
+        t.start()
+
+    def _usage_loop(self) -> None:  # pragma: no cover - timing loop
+        while True:
+            self._usage_kick.wait(15.0)
+            self._usage_kick.clear()
+            self._refresh_usage()
+
+    def _refresh_usage(self) -> None:
+        def du(path: str) -> int:
+            total = 0
+            last = ""
+            while True:
+                out = self.fc.list(path, limit=10000, last_file_name=last)
+                entries = out.get("Entries") or []
+                for e in entries:
+                    if e["IsDirectory"]:
+                        total += du(e["FullPath"])
+                    else:
+                        total += int(e.get("FileSize") or 0)
+                if len(entries) < 10000:
+                    return total
+                last = entries[-1]["FullPath"].rsplit("/", 1)[-1]
+
+        try:
+            self._usage_bytes = du("/")
+        except Exception:
+            pass  # filer hiccup / non-JSON error body: keep the stale value
+
+    def _usage(self) -> int:
+        return self._usage_bytes
+
+    def _quota_exceeded(self) -> bool:
+        return self.quota_bytes > 0 and self._usage_bytes >= self.quota_bytes
 
     # --- inode table ----------------------------------------------------------
     def _ino_for(self, path: str, entry: dict | None = None) -> int:
@@ -434,6 +497,8 @@ class WFS:
     def _op_write(self, hdr, payload) -> bytes:
         if self.read_only:
             return fp.reply(hdr.unique, error=fp.ERRNO_INVAL)
+        if self._quota_exceeded():
+            return fp.reply(hdr.unique, error=fp.ERRNO_NOSPC)
         fields = fp.WRITE_IN.unpack_from(payload)
         fh, offset, size = fields[0], fields[1], fields[2]
         data = payload[fp.WRITE_IN.size:fp.WRITE_IN.size + size]
@@ -549,4 +614,9 @@ class WFS:
         return self._rename_common(hdr, newdir, payload[fp.RENAME2_IN.size:])
 
     def _op_statfs(self, hdr, payload) -> bytes:
+        if self.quota_bytes > 0:
+            blocks = max(1, self.quota_bytes // 4096)
+            free = max(0, (self.quota_bytes - self._usage()) // 4096)
+            return fp.reply(hdr.unique, fp.pack_statfs(
+                blocks=blocks, bfree=free, bavail=free))
         return fp.reply(hdr.unique, fp.pack_statfs())

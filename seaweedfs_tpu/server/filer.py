@@ -1696,7 +1696,8 @@ class FilerServer:
                 "<th align=\"left\">modified</th></tr>"
                 + "".join(rows) + f"</table>{more}</body></html>"
             )
-            return Response(html.encode(), content_type="text/html")
+            return Response(html.encode(),
+                            content_type="text/html; charset=utf-8")
         return Response(
             {
                 "Path": entry.full_path,

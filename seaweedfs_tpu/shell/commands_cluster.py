@@ -227,3 +227,39 @@ def cmd_mq_topic_configure(env: CommandEnv, args: list[str]) -> str:
         raise ShellError(str(e))
     return (f"topic {ns}/{flags['topic']} now has"
             f" {out['partition_count']} partitions")
+
+
+@command("mount.configure",
+         "-dir <mountpoint> [-quotaMB n] — inspect/adjust a RUNNING mount"
+         " via its local admin socket")
+def cmd_mount_configure(env: CommandEnv, args: list[str]) -> str:
+    """`command_mount_configure.go`: talks to the mount's admin listener
+    (deterministic unix socket derived from the mountpoint)."""
+    import urllib.parse as _u
+
+    from seaweedfs_tpu.mount import admin_socket_path
+    from seaweedfs_tpu.server.httpd import get_json, post_json
+
+    flags = parse_flags(args)
+    mp = flags.get("dir")
+    if not mp:
+        raise ShellError("usage: mount.configure -dir <mountpoint>"
+                         " [-quotaMB n]")
+    base = "http+unix://" + _u.quote(admin_socket_path(mp), safe="")
+    if "quotaMB" in flags:
+        try:
+            quota_mb = int(flags["quotaMB"])
+        except ValueError:
+            raise ShellError(f"invalid -quotaMB {flags['quotaMB']!r}")
+        try:
+            out = post_json(base + "/configure", {"quotaMB": quota_mb})
+        except (IOError, OSError) as e:
+            raise ShellError(f"no running mount at {mp!r}? ({e})")
+        return f"quota set to {out['quota_bytes']} bytes"
+    try:
+        out = get_json(base + "/status")
+    except (IOError, OSError) as e:
+        raise ShellError(f"no running mount at {mp!r}? ({e})")
+    return (f"mount {out['mountpoint']}: used {out['used_bytes']} /"
+            f" quota {out['quota_bytes'] or 'unlimited'}"
+            f"{' [read-only]' if out['read_only'] else ''}")

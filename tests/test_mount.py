@@ -394,3 +394,63 @@ def test_unix_socket_exempt_from_mtls_gate(tmp_path):
         master.stop()
         tls_mod.reset()
     assert filer.service.unix_url is None  # stopped: no longer advertised
+
+
+class TestMountQuota:
+    """Mount quota (`command_mount_configure.go` + weedfs quota): writes
+    ENOSPC past the limit, statfs advertises it, and a RUNNING mount is
+    adjustable through its deterministic admin unix socket."""
+
+    def test_quota_enforced_and_configurable(self, tmp_path):
+        from seaweedfs_tpu.mount import start_admin_service
+        from seaweedfs_tpu.server.filer import FilerServer
+        from seaweedfs_tpu.server.httpd import http_request
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume import VolumeServer
+        from seaweedfs_tpu.shell import CommandEnv, run_command
+
+        master = MasterServer(port=0)
+        master.start()
+        vol = VolumeServer([str(tmp_path / "v")], master_url=master.url,
+                           port=0)
+        vol.start()
+        vol.heartbeat_once()
+        filer = FilerServer(master_url=master.url, port=0)
+        filer.start()
+        admin = None
+        try:
+            wfs = WFS(filer.url, chunk_size=64 * 1024, quota_mb=1)
+            k = VirtualFuseKernel(wfs)
+            err, ino, fh = k.create(1, "fill.bin")
+            assert err == 0
+            # fill past 1MB, then flush so usage becomes visible
+            chunk = os.urandom(64 * 1024)
+            for i in range(20):  # 1.25MB
+                err, n = k.write(ino, fh, i * len(chunk), chunk)
+                assert err == 0
+            assert k.flush(ino, fh) == 0
+            assert k.release(ino, fh) == 0
+            wfs._refresh_usage()  # pick up the flushed bytes now
+            # over quota now: further writes ENOSPC
+            err, ino2, fh2 = k.create(1, "more.bin")
+            assert err == 0
+            err, _ = k.write(ino2, fh2, 0, b"x" * 1024)
+            assert err == fp.ERRNO_NOSPC
+            # mount.configure raises the quota through the admin socket
+            mp = str(tmp_path / "mnt")
+            admin = start_admin_service(wfs, mp)
+            env = CommandEnv(master.url, filer_url=filer.url)
+            out = run_command(env, f"mount.configure -dir {mp}")
+            assert "quota" in out
+            out = run_command(env, f"mount.configure -dir {mp} -quotaMB 100")
+            assert "quota set" in out
+            wfs._refresh_usage()
+            err, n = k.write(ino2, fh2, 0, b"x" * 1024)
+            assert (err, n) == (0, 1024)  # writable again
+            k.release(ino2, fh2)
+        finally:
+            if admin is not None:
+                admin.stop()
+            filer.stop()
+            vol.stop()
+            master.stop()
