@@ -40,6 +40,45 @@ BENCH_DIR = "/dev/shm/seaweedfs_tpu_bench"
 VID = 7
 
 
+def kernel_gbps_from_metrics(text: str) -> dict:
+    """Per-kernel throughput attribution from Prometheus exposition text:
+    pairs each SeaweedFS_*_seconds histogram's _sum with its companion
+    *_bytes_total counter (stats/trace.py kernel spans) and reports
+    bytes/second — so a BENCH run can say how fast each data-plane kernel
+    (ec encode/decode, hash paths) actually ran, from /metrics alone."""
+    import re
+
+    sum_re = re.compile(
+        r'^(SeaweedFS_\w+?)_seconds_sum\{kernel="([^"]*)"\} (\S+)$'
+    )
+    bytes_re = re.compile(
+        r'^(SeaweedFS_\w+?)_bytes_total\{kernel="([^"]*)"\} (\S+)$'
+    )
+    seconds: dict = {}
+    nbytes: dict = {}
+    for line in text.splitlines():
+        m = sum_re.match(line)
+        if m:
+            seconds[(m.group(1), m.group(2))] = float(m.group(3))
+            continue
+        m = bytes_re.match(line)
+        if m:
+            nbytes[(m.group(1), m.group(2))] = float(m.group(3))
+    out = {}
+    for key, secs in sorted(seconds.items()):
+        family, kernel = key
+        b = nbytes.get(key, 0.0)
+        if secs <= 0 or b <= 0:
+            continue
+        short = family.replace("SeaweedFS_", "")
+        out[f"{short}:{kernel}"] = {
+            "gbps": round(b / secs / 1e9, 3),
+            "seconds": round(secs, 3),
+            "gb": round(b / 1e9, 3),
+        }
+    return out
+
+
 def build_volume(staging: str, total_bytes: int = GiB) -> str:
     """A real volume (.dat/.idx via the storage engine) of ~total_bytes."""
     from seaweedfs_tpu.storage.needle import Needle
@@ -97,6 +136,7 @@ def bench_verb(staging_base: str, trials: int = 3) -> tuple[float, dict]:
     del pool
     best = 0.0
     times = []
+    kernels: dict = {}
     try:
         for _ in range(trials):
             try:  # the server auto-loads volumes found at startup
@@ -115,10 +155,21 @@ def bench_verb(staging_base: str, trials: int = 3) -> tuple[float, dict]:
             times.append(round(dt, 3))
             best = max(best, dat_bytes / dt / 1e9)
             post_json(f"{vs.url}/admin/ec/unmount", {"volume": VID})
+        # per-kernel GB/s attribution straight off the live /metrics surface
+        try:
+            from seaweedfs_tpu.server.httpd import http_request
+
+            _, _, metrics_text = http_request(
+                "GET", f"{vs.service.url}/metrics"
+            )
+            kernels = kernel_gbps_from_metrics(metrics_text.decode())
+        except Exception:
+            pass
     finally:
         vs.stop()
         master.stop()
-    return best, {"trial_seconds": times, "volume_bytes": dat_bytes}
+    return best, {"trial_seconds": times, "volume_bytes": dat_bytes,
+                  "kernel_gbps": kernels}
 
 
 def bench_sequential_reference_loop(staging_base: str, gfni: bool) -> float:
@@ -711,6 +762,16 @@ def main() -> None:
         detail["filer_small_files"] = bench_filer_small_files()
     except Exception as e:
         detail["filer_small_files"] = {"error": str(e)[:120]}
+    # end-of-run per-kernel attribution over EVERYTHING this process ran
+    # (verb trials + rebuild + hash benches), from the shared registry
+    try:
+        from seaweedfs_tpu.stats import default_registry
+
+        detail["kernel_gbps"] = kernel_gbps_from_metrics(
+            default_registry().render()
+        )
+    except Exception as e:
+        detail["kernel_gbps"] = {"error": str(e)[:120]}
     detail["note"] = (
         "value is the real shell ec.encode verb, disk-to-shards, 1GiB volume,"
         " best of 3. vs_baseline divides by baseline_seq_gfni_gbps: the"

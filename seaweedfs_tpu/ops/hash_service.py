@@ -34,6 +34,8 @@ import time
 
 import numpy as np
 
+from seaweedfs_tpu.stats import trace
+
 _MIN_BATCH = 4  # below this, batching buys nothing — hash synchronously
 _MAX_BATCH = 8192
 _LINGER_S = 0.0005
@@ -194,7 +196,12 @@ class HashService:
                 self._cv.notify_all()
         if idle:
             try:
+                t0 = time.perf_counter()
                 r._set(*_hash_one(data))
+                trace.observe_kernel(
+                    trace.FILER_HASH_SECONDS, "scalar",
+                    time.perf_counter() - t0, len(data),
+                )
             finally:
                 with self._cv:
                     self._active_sync -= 1
@@ -238,7 +245,11 @@ class HashService:
             return []
         lib = _native_lib()
         if lib is not None and hasattr(lib, "fast128_spans"):
-            digests = lib.fast128_spans(buf, cuts, seed)
+            with trace.kernel_span(
+                "hash.sw128_spans", trace.FILER_HASH_SECONDS, "sw128",
+                nbytes=int(cuts[-1]), role="filer", spans=len(cuts),
+            ):
+                digests = lib.fast128_spans(buf, cuts, seed)
             return [
                 "x" + binascii.hexlify(digests[i].tobytes()).decode()
                 for i in range(len(cuts))
@@ -250,18 +261,27 @@ class HashService:
         scalar fallback. The dedup path uses this for index MISSES only."""
         if not ranges:
             return []
+        nbytes = sum(n for _, n in ranges)
         lib = _native_lib()
         if lib is not None and hasattr(lib, "md5_spans"):
-            digests = lib.md5_spans(buf, [r[0] for r in ranges],
-                                    [r[1] for r in ranges])
+            with trace.kernel_span(
+                "hash.md5_spans", trace.FILER_HASH_SECONDS, "md5_spans",
+                nbytes=nbytes, role="filer", spans=len(ranges),
+            ):
+                digests = lib.md5_spans(buf, [r[0] for r in ranges],
+                                        [r[1] for r in ranges])
             return [
                 binascii.hexlify(digests[i].tobytes()).decode()
                 for i in range(len(ranges))
             ]
         mv = memoryview(buf)
-        return [
-            hashlib.md5(bytes(mv[o:o + n])).hexdigest() for o, n in ranges
-        ]
+        with trace.kernel_span(
+            "hash.md5_spans", trace.FILER_HASH_SECONDS, "md5_spans_scalar",
+            nbytes=nbytes, role="filer", spans=len(ranges),
+        ):
+            return [
+                hashlib.md5(bytes(mv[o:o + n])).hexdigest() for o, n in ranges
+            ]
 
     def hash_spans(self, buf, cuts) -> list[tuple[str, int]]:
         """Synchronous batch over CDC spans of one contiguous buffer:
@@ -277,7 +297,11 @@ class HashService:
             return []
         lib = _native_lib() if self.backend in ("native", "jax") else None
         if lib is not None and hasattr(lib, "md5_crc_batch_spans"):
-            digests, crcs = lib.md5_crc_batch_spans(buf, cuts)
+            with trace.kernel_span(
+                "hash.spans", trace.FILER_HASH_SECONDS, "md5_crc_spans",
+                nbytes=int(cuts[-1]), role="filer", spans=len(cuts),
+            ):
+                digests, crcs = lib.md5_crc_batch_spans(buf, cuts)
             return [
                 (binascii.hexlify(digests[i].tobytes()).decode(), int(crcs[i]))
                 for i in range(len(cuts))
@@ -285,10 +309,15 @@ class HashService:
         mv = memoryview(buf)
         out = []
         prev = 0
+        t0 = time.perf_counter()
         for c in cuts:
             md5, crc = _hash_one(bytes(mv[prev:c]))
             prev = c
             out.append((binascii.hexlify(md5).decode(), crc))
+        trace.observe_kernel(
+            trace.FILER_HASH_SECONDS, "md5_crc_spans_scalar",
+            time.perf_counter() - t0, int(cuts[-1]),
+        )
         return out
 
     # --- internals -----------------------------------------------------------
@@ -319,8 +348,14 @@ class HashService:
                 # hold one blob and the batch kernels would never engage.
                 items = [it for bucket in work.values() for it in bucket]
                 try:
+                    t0 = time.perf_counter()
                     digests, crcs = lib.md5_crc_batch_var(
                         [d for d, _ in items]
+                    )
+                    trace.observe_kernel(
+                        trace.FILER_HASH_SECONDS, "batch_var",
+                        time.perf_counter() - t0,
+                        sum(len(d) for d, _ in items),
                     )
                     for i, (_, r) in enumerate(items):
                         r._set(digests[i].tobytes(), int(crcs[i]))
@@ -343,7 +378,12 @@ class HashService:
         blobs = np.frombuffer(
             b"".join(d for d, _ in items), dtype=np.uint8
         ).reshape(len(items), length)
+        t0 = time.perf_counter()
         digests, crcs = _batch_hash(self.backend, blobs)
+        trace.observe_kernel(
+            trace.FILER_HASH_SECONDS, "batch-" + self.backend,
+            time.perf_counter() - t0, blobs.nbytes,
+        )
         for i, (_, r) in enumerate(items):
             r._set(digests[i].tobytes(), int(crcs[i]))
 

@@ -59,8 +59,6 @@ class SecurityConfig:
 
 
 def load_security_config(path: str | None = None) -> SecurityConfig:
-    import tomllib
-
     candidates = (
         [path]
         if path
@@ -70,6 +68,22 @@ def load_security_config(path: str | None = None) -> SecurityConfig:
             "/etc/seaweedfs/security.toml",
         ]
     )
+    try:
+        import tomllib
+    except ModuleNotFoundError:  # py<3.11: same-format tomli fallback
+        try:
+            import tomli as tomllib
+        except ModuleNotFoundError:
+            # a security.toml that EXISTS but cannot be parsed must fail
+            # loudly — silently booting with no auth/whitelist is worse
+            found = [c for c in candidates if c and os.path.exists(c)]
+            if found:
+                raise RuntimeError(
+                    f"cannot parse {found[0]}: needs tomllib (python >="
+                    " 3.11) or the tomli package; this interpreter has"
+                    " neither"
+                )
+            return SecurityConfig()
     for cand in candidates:
         if cand and os.path.exists(cand):
             with open(cand, "rb") as f:

@@ -91,6 +91,12 @@ class FilerServer:
             MetricsService(host, max(metrics_port, 0)) if metrics_port != 0 else None
         )
         # -encryptVolumeData / compression defaults (`weed/command/filer.go`)
+        if cipher and not cipher_util.available():
+            # fail at boot, not with a 500 on the first write
+            raise RuntimeError(
+                "-encryptVolumeData needs the 'cryptography' package,"
+                " which is not installed"
+            )
         self.cipher = cipher
         self.compress = compress
         # CDC dedup (filer/dedup.py): content-defined chunking + hash index.
@@ -545,11 +551,25 @@ class FilerServer:
         (`filer_server_handlers_write_upload.go:30`). Each chunk is
         independently maybe-compressed (mime heuristic) and AES-GCM
         encrypted when the filer runs ciphered (`upload_content.go`)."""
+        from seaweedfs_tpu.stats import trace
+
         if self.dedup:
-            return self._upload_chunks_cdc(
+            with trace.span("filer.upload_chunks_cdc", role="filer",
+                            bytes=len(data)):
+                return self._upload_chunks_cdc(
+                    data, ttl, collection, replication, mime=mime,
+                    filename=filename,
+                )
+        with trace.span("filer.upload_chunks", role="filer", bytes=len(data)):
+            return self._upload_chunks_plain(
                 data, ttl, collection, replication, mime=mime,
                 filename=filename,
             )
+
+    def _upload_chunks_plain(
+        self, data: bytes, ttl: str, collection: str, replication: str,
+        mime: str = "", filename: str = "",
+    ) -> tuple[list[FileChunk], str]:
         ext = os.path.splitext(filename)[1]
         md5 = hashlib.md5()
         chunks: list[FileChunk] = []
@@ -1003,6 +1023,9 @@ class FilerServer:
         # (`weed/server/filer_grpc_server_sub_meta.go`)
         @svc.route("GET", r"/__meta__/events")
         def meta_events(req: Request) -> Response:
+            from seaweedfs_tpu.stats import trace
+
+            trace.annotate(long_poll=True)  # slow by design: skip slow-log
             # native-write entries only become meta events when applied
             self._fl_filer_drain()
             since = int(req.query.get("since_ns", 0))

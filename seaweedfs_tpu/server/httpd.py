@@ -138,6 +138,7 @@ class HTTPService:
         self.routes: list[tuple[str, re.Pattern, Callable[[Request], Response]]] = []
         self.guard = None  # security.Guard — 403s non-whitelisted IPs when set
         self.metrics_role: str | None = None  # instrument requests when set
+        self.trace_role: str | None = None  # record request spans when set
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -162,6 +163,23 @@ class HTTPService:
                     reg.render().encode(),
                     content_type="text/plain; version=0.0.4; charset=utf-8",
                 )
+        self.enable_tracing(role)
+
+    def enable_tracing(self, role: str) -> None:
+        """Record a span for every request under this role (inheriting the
+        caller's trace via X-Sw-Trace-Id/X-Sw-Span) and serve the shared
+        ring buffer on /debug/traces + /debug/requests. Idempotent. Like
+        the request histograms, spans cover the Python path only — requests
+        the native engine serves never reach _dispatch."""
+        if self.trace_role is not None:
+            return
+        self.trace_role = role
+        _register_debug_routes(self)
+
+    def serve_debug_routes(self) -> None:
+        """Expose /debug/traces + /debug/requests without per-request
+        spans (standalone listeners like MetricsService)."""
+        _register_debug_routes(self)
 
     def route(self, method: str, pattern: str):
         compiled = re.compile(pattern)
@@ -177,6 +195,13 @@ class HTTPService:
 
         start = _time.monotonic()
         path = urllib.parse.urlparse(handler.path).path
+        span = None
+        if self.trace_role is not None:
+            from seaweedfs_tpu.stats import trace as _trace
+
+            span = _trace.begin_server_span(
+                self.trace_role, handler.command, path, handler.headers
+            )
         peer_ok = True
         # unix-socket peers are same-host-trusted by construction: neither
         # the mTLS CN gate (no TLS on AF_UNIX) nor the IP guard applies
@@ -224,6 +249,11 @@ class HTTPService:
             self._m_seconds.labels(self.metrics_role, handler.command).observe(
                 _time.monotonic() - start
             )
+        if span is not None:
+            from seaweedfs_tpu.stats import trace as _trace
+
+            resp.headers.setdefault(_trace.TRACE_HEADER, span.trace_id)
+            _trace.end_server_span(span, resp.status)
         # drain an unread request body before responding — on a keep-alive
         # connection leftover body bytes would desynchronize the next request
         length = int(handler.headers.get("Content-Length") or 0)
@@ -380,6 +410,32 @@ class HTTPService:
         return f"{scheme}://{self.host}:{self.port}"
 
 
+def _register_debug_routes(service: "HTTPService") -> None:
+    """`/debug/traces` (recent finished traces, JSON; ?limit= & ?min_ms=)
+    and `/debug/requests` (in-flight spans) over the process-wide trace
+    ring. Registered by enable_tracing, so on catch-all namespaces (the
+    filer) they precede — and shadow — same-named file paths."""
+    from seaweedfs_tpu.stats import trace as trace_mod
+
+    col = trace_mod.collector()
+
+    @service.route("GET", r"/debug/traces")
+    def debug_traces(req: Request) -> Response:
+        try:
+            limit = int(req.query.get("limit", 20))
+            min_ms = float(req.query.get("min_ms", 0))
+        except ValueError:
+            return Response({"error": "limit/min_ms must be numeric"}, 400)
+        return Response({
+            "traces": col.traces(limit=limit, min_ms=min_ms),
+            "capacity": col.max_spans,
+        })
+
+    @service.route("GET", r"/debug/requests")
+    def debug_requests(req: Request) -> Response:
+        return Response({"in_flight": col.inflight()})
+
+
 class MetricsService(HTTPService):
     """Standalone /metrics listener for servers whose main port has a
     catch-all namespace (the filer) — the reference's `-metricsPort`."""
@@ -396,6 +452,8 @@ class MetricsService(HTTPService):
                 reg.render().encode(),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
+
+        self.serve_debug_routes()
 
 
 def peer_url(hostport: str) -> str:
@@ -416,6 +474,9 @@ def http_request(
     headers: dict | None = None,
     timeout: float = 30.0,
 ) -> tuple[int, dict, bytes]:
+    from seaweedfs_tpu.stats import trace as _trace
+
+    headers = _trace.with_trace_headers(headers)
     if url.startswith("http+unix://"):
         return _unix_http_request(method, url, body, headers, timeout)
     req = urllib.request.Request(url, data=body, method=method)
@@ -513,6 +574,9 @@ class PooledHTTP:
         import http.client
         import ssl as _ssl
 
+        from seaweedfs_tpu.stats import trace as _trace
+
+        headers = _trace.with_trace_headers(headers)
         u = urllib.parse.urlsplit(url)
         key = f"{u.scheme}://{u.netloc}"
         pool = getattr(self._tl, "conns", None)

@@ -258,7 +258,11 @@ class MasterServer:
         self, collection: str, rp: ReplicaPlacement, ttl_u32: int, dc: str
     ) -> None:
         """Pick servers then instruct them to allocate (`volume_growth.go:243`)."""
-        with self._grow_lock:
+        from seaweedfs_tpu.stats import trace
+
+        with self._grow_lock, trace.span(
+            "master.grow", role="master", collection=collection,
+        ):
             lo = self.topo.layout(collection, rp, ttl_u32)
             if lo.active_volume_count(dc) > 0:
                 return  # another request already grew (in this DC if pinned)
@@ -300,8 +304,14 @@ class MasterServer:
     def _vacuum_check(self) -> None:
         """Ask volume servers to compact garbage-heavy volumes
         (`topology_vacuum.go:216`)."""
+        from seaweedfs_tpu.stats import trace
+
         if not getattr(self, "vacuum_enabled", True):
             return
+        with trace.span("master.vacuum_check", role="master"):
+            self._vacuum_round()
+
+    def _vacuum_round(self) -> None:
         for node in self.topo.all_nodes():
             for vid, info in list(node.volumes.items()):
                 if info.size == 0 or info.read_only:
@@ -322,6 +332,11 @@ class MasterServer:
 
         @svc.route("POST", r"/heartbeat")
         def heartbeat(req: Request) -> Response:
+            from seaweedfs_tpu.stats import trace
+
+            # periodic chatter: recorded only when the volume server's
+            # (sampled) heartbeat span linked us into its trace
+            trace.annotate(noise=True)
             if not self._is_leader():
                 # volume servers re-target to the leader (KeepConnected
                 # redirect semantics, `master_grpc_server.go`)
@@ -553,6 +568,9 @@ class MasterServer:
         def cluster_register(req: Request) -> Response:
             """Filers/brokers announce themselves (the reference rides this on
             the KeepConnected stream, `weed/cluster/cluster.go`)."""
+            from seaweedfs_tpu.stats import trace
+
+            trace.annotate(noise=True)  # periodic re-registration chatter
             p = req.json()
             prev = self._members.get(p["address"])
             self._members[p["address"]] = {

@@ -208,6 +208,22 @@ class VolumeServer:
 
     # --- heartbeat --------------------------------------------------------------
     def heartbeat_once(self) -> None:
+        """One heartbeat POST. Sampled tracing (first beat, then every
+        12th): a root span makes the master's handler span join the same
+        trace so ack propagation stays visible in /debug/traces, but an
+        every-beat span would flood the bounded ring with heartbeat noise
+        and evict real request traces."""
+        from seaweedfs_tpu.stats import trace
+
+        n = getattr(self, "_hb_count", 0)
+        self._hb_count = n + 1
+        if n % 12:
+            self._heartbeat_once()
+            return
+        with trace.span("volume.heartbeat", role="volume"):
+            self._heartbeat_once()
+
+    def _heartbeat_once(self) -> None:
         import json as _json
 
         if self.fastlane:  # report the engine's appends, not a stale view

@@ -109,6 +109,119 @@ def cmd_volume_status(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(out) if out else f"volume {vid} not found"
 
 
+@command("cluster.trace",
+         "[-limit n] [-minMs n] [-include url,url] — fetch /debug/traces"
+         " from master + volume servers + filers (+ -include'd endpoints,"
+         " e.g. s3 gateways) and render merged span trees")
+def cmd_cluster_trace(env: CommandEnv, args: list[str]) -> str:
+    """Cluster-wide trace view: every node keeps its own span ring; this
+    merges them by trace id into one tree per request (the multi-process
+    counterpart of the single-process ring in stats/trace.py). S3 gateways
+    don't register with the master, so pass them via -include to get the
+    [s3] root spans in a multi-process cluster."""
+    flags = parse_flags(args)
+    try:
+        limit = int(flags.get("limit", 10))
+        min_ms = float(flags.get("minMs", 0))
+    except ValueError:
+        raise ShellError(
+            "usage: cluster.trace [-limit n] [-minMs n] [-include url,url]"
+        )
+
+    endpoints = {env.master_url}
+    for extra in flags.get("include", "").split(","):
+        extra = extra.strip().rstrip("/")
+        if extra:
+            if not extra.startswith(("http://", "https://")):
+                extra = "http://" + extra
+            endpoints.add(extra)
+    try:
+        for sv in env.servers():
+            endpoints.add(sv.http)
+    except Exception:
+        pass
+    try:
+        ps = env.get(f"{env.master_url}/cluster/ps")
+        for f in ps.get("filers", []):
+            endpoints.add(f["address"])
+    except Exception:
+        pass
+    if env.filer_url:
+        endpoints.add(env.filer_url)
+
+    # trace_id -> span_id -> span; single-process clusters share one ring,
+    # so keying by span id dedups identical copies from every endpoint
+    merged: dict[str, dict[str, dict]] = {}
+    reached = []
+    # fetch deep with min_ms=0: node-side min_ms would drop a node's
+    # fast child spans out of a slow cross-node trace, and a shallow
+    # fetch would hide older slow traces behind recent fast ones — the
+    # -minMs filter applies AFTER the merge, on whole-trace duration
+    per_node = max(limit * 10, 100)
+    for ep in sorted(endpoints):
+        try:
+            out = env.get(
+                f"{ep}/debug/traces?limit={per_node}&min_ms=0",
+                timeout=10,
+            )
+        except Exception:
+            continue
+        reached.append(ep)
+        for tr in out.get("traces", []):
+            slot = merged.setdefault(tr["trace_id"], {})
+            for sp in tr["spans"]:
+                slot[sp["span_id"]] = sp
+    if not reached:
+        raise ShellError("no /debug/traces endpoint reachable")
+
+    def render_tree(spans: list[dict]) -> list[str]:
+        ids = {s["span_id"] for s in spans}
+        children: dict[str, list[dict]] = {}
+        roots = []
+        for s in sorted(spans, key=lambda s: s["start"]):
+            if s["parent_id"] in ids:
+                children.setdefault(s["parent_id"], []).append(s)
+            else:
+                roots.append(s)
+        lines: list[str] = []
+
+        def walk(s: dict, depth: int) -> None:
+            lines.append(
+                f"{'  ' * depth}[{s.get('role') or '-'}] {s['name']} "
+                f"{s['duration_ms']}ms {s['status']}"
+            )
+            for c in children.get(s["span_id"], []):
+                walk(c, depth + 1)
+
+        for r in roots:
+            walk(r, 1)
+        return lines
+
+    rows = []
+    for trace_id, by_id in merged.items():
+        spans = list(by_id.values())
+        start = min(s["start"] for s in spans)
+        end = max(s["start"] + s["duration_ms"] / 1000.0 for s in spans)
+        rows.append((start, (end - start) * 1000.0, trace_id, spans))
+    rows.sort(reverse=True)
+    out_lines = [f"merged traces from {len(reached)} endpoint(s)"]
+    shown = 0
+    for start, dur_ms, trace_id, spans in rows:
+        if dur_ms < min_ms:
+            continue
+        if shown >= limit:
+            break
+        shown += 1
+        roles = sorted({s["role"] for s in spans if s.get("role")})
+        out_lines.append(
+            f"trace {trace_id} {dur_ms:.1f}ms roles={','.join(roles)}"
+        )
+        out_lines.extend(render_tree(spans))
+    if shown == 0:
+        out_lines.append("no traces recorded (min_ms too high?)")
+    return "\n".join(out_lines)
+
+
 # --- mq.* (`weed/shell/command_mq_topic_list.go` etc.) -----------------------
 def _broker_url(env) -> str:
     ps = env.get(f"{env.master_url}/cluster/ps")

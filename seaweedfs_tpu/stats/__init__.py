@@ -1,4 +1,5 @@
-"""Prometheus-compatible metrics (`weed/stats/metrics.go:33-400`)."""
+"""Prometheus-compatible metrics (`weed/stats/metrics.go:33-400`) plus
+request tracing / kernel profiling (stats.trace)."""
 
 from .metrics import (
     Counter,

@@ -18,8 +18,22 @@ DEFAULT_BUCKETS = (
 )
 
 
+def _escape_label_value(value) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote and
+    newline must be escaped or the exposition line is unparseable."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt_labels(label_names: tuple, label_values: tuple, extra: str = "") -> str:
-    pairs = [f'{k}="{v}"' for k, v in zip(label_names, label_values)]
+    pairs = [
+        '{}="{}"'.format(k, _escape_label_value(v))
+        for k, v in zip(label_names, label_values)
+    ]
     if extra:
         pairs.append(extra)
     return "{" + ",".join(pairs) + "}" if pairs else ""
@@ -160,13 +174,15 @@ class Histogram(_Metric):
             totals = dict(self._totals)
         for key, counts in items:
             for ub, c in zip(self.buckets, counts):
+                le = 'le="{:g}"'.format(ub)
                 out.append(
                     f"{self.name}_bucket"
-                    f"{_fmt_labels(self.label_names, key, f'le=\"{ub:g}\"')} {c}"
+                    f"{_fmt_labels(self.label_names, key, le)} {c}"
                 )
+            inf = 'le="+Inf"'
             out.append(
                 f"{self.name}_bucket"
-                f"{_fmt_labels(self.label_names, key, 'le=\"+Inf\"')} {totals[key]}"
+                f"{_fmt_labels(self.label_names, key, inf)} {totals[key]}"
             )
             out.append(
                 f"{self.name}_sum{_fmt_labels(self.label_names, key)} {sums[key]:g}"
@@ -221,6 +237,13 @@ class Registry:
             if m is None:
                 m = Histogram(name, help_text, label_names, buckets)
                 self._metrics[name] = m
+            if not isinstance(m, Histogram):
+                raise TypeError(f"{name} already registered as {type(m).__name__}")
+            if m.buckets != tuple(sorted(buckets)):
+                raise TypeError(
+                    f"{name} already registered with buckets {m.buckets}, "
+                    f"not {tuple(sorted(buckets))}"
+                )
             return m
 
     def _get_or_create(self, cls, name, help_text, label_names):
@@ -259,6 +282,10 @@ def start_push_loop(push_url: str, role: str, instance: str,
     import urllib.request
 
     reg = default_registry()
+    push_errors = reg.counter(
+        "SeaweedFS_stats_push_errors_total",
+        "failed pushes to the metrics gateway", ("role",),
+    )
     url = (f"{push_url.rstrip('/')}/metrics/job/{role}"
            f"/instance/{urllib.parse.quote(instance, safe='')}")
 
@@ -272,11 +299,18 @@ def start_push_loop(push_url: str, role: str, instance: str,
         urllib.request.urlopen(req, timeout=10, context=ctx).read()
 
     def loop():
+        from seaweedfs_tpu.util import glog
+
+        failing_streak = 0
         while True:
             try:
                 push_once()
-            except Exception:
-                pass
+                failing_streak = 0
+            except Exception as e:
+                push_errors.labels(role).inc()
+                if failing_streak == 0:  # first failure per streak only
+                    glog.warning("metrics push to %s failed: %s", url, e)
+                failing_streak += 1
             if stop_event is not None:
                 if stop_event.wait(interval_sec):
                     return

@@ -12,7 +12,11 @@ previous CRC, `crc32c(data) == update(0, data)`.
 
 from __future__ import annotations
 
+import time as _time
+
 import numpy as np
+
+from seaweedfs_tpu.stats import trace as _trace
 
 _CASTAGNOLI_POLY_REFLECTED = 0x82F63B78
 
@@ -84,8 +88,26 @@ def update(crc: int, data: bytes | bytearray | memoryview | np.ndarray) -> int:
     return cc ^ 0xFFFFFFFF
 
 
+# Needle-checksum kernel profiling, volume-side family (distinct from
+# SeaweedFS_filer_hash_seconds so nested timing — hash_service's scalar
+# path calls crc32c inside its own observed section — never double-counts
+# within one family). Only blobs >= _OBSERVE_MIN are recorded: the
+# per-small-needle hot path must not pay metric locks per call, and large
+# blobs dominate the bytes anyway.
+_OBSERVE_MIN = 64 * 1024
+VOLUME_CRC32C_SECONDS = "SeaweedFS_volume_crc32c_seconds"
+
+
 def crc32c(data: bytes | bytearray | memoryview) -> int:
-    return update(0, data)
+    n = len(data)
+    if n < _OBSERVE_MIN:
+        return update(0, data)
+    t0 = _time.perf_counter()
+    out = update(0, data)
+    _trace.observe_kernel(
+        VOLUME_CRC32C_SECONDS, "crc32c", _time.perf_counter() - t0, n
+    )
+    return out
 
 
 def legacy_value(crc: int) -> int:

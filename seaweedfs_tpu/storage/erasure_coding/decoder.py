@@ -11,6 +11,7 @@ from __future__ import annotations
 import os
 from typing import Callable, Iterator
 
+from seaweedfs_tpu.stats import trace
 from seaweedfs_tpu.storage import idx as idx_mod
 from seaweedfs_tpu.storage.needle import get_actual_size
 from seaweedfs_tpu.storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
@@ -88,10 +89,14 @@ def write_dat_file(
     large_block_size: int = LARGE_BLOCK_SIZE,
     small_block_size: int = SMALL_BLOCK_SIZE,
 ) -> None:
-    """De-stripe the 10 data shards into .dat (`ec_decoder.go:154-201`)."""
+    """De-stripe the 10 data shards into .dat (`ec_decoder.go:154-201`).
+    Runs under a kernel span feeding SeaweedFS_volume_ec_decode_seconds."""
     readers = [open(shard_file_names[i], "rb") for i in range(DATA_SHARDS_COUNT)]
     try:
-        with open(base_file_name + ".dat", "wb") as out:
+        with trace.kernel_span(
+            "ec.decode", trace.EC_DECODE_SECONDS, "destripe",
+            nbytes=dat_file_size,
+        ), open(base_file_name + ".dat", "wb") as out:
             remaining = dat_file_size
             while remaining >= DATA_SHARDS_COUNT * large_block_size:
                 for r in readers:

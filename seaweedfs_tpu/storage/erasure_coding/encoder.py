@@ -33,6 +33,7 @@ import threading
 import numpy as np
 
 from seaweedfs_tpu.ops.rs_kernel import RSCodec, pick_pipeline_backend
+from seaweedfs_tpu.stats import trace
 from seaweedfs_tpu.storage import idx as idx_mod
 from seaweedfs_tpu.storage.types import size_is_valid
 
@@ -358,19 +359,51 @@ def write_ec_files(
 ) -> None:
     """Generate .ec00–.ec13 from .dat (`ec_encoder.go:57,198-235`),
     via the fused native single-pass kernel when the host supports it,
-    else the 3-stage pipeline (see module docstring)."""
+    else the 3-stage pipeline (see module docstring). Both paths run under
+    a kernel-timing span feeding SeaweedFS_volume_ec_encode_seconds (+ the
+    bytes counter), so /metrics alone yields encode GB/s."""
+    dat_path = base_file_name + ".dat"
+    total = os.path.getsize(dat_path)
     if codec is None or codec.backend == "native":
         backend = codec.backend if codec else pick_pipeline_backend()
-        if backend == "native" and _write_ec_files_fused(
-            base_file_name, large_block_size, small_block_size
-        ):
-            return
+        if backend == "native":
+            with trace.kernel_span(
+                "ec.encode", trace.EC_ENCODE_SECONDS, "fused", nbytes=total
+            ) as sp:
+                fused_ok = _write_ec_files_fused(
+                    base_file_name, large_block_size, small_block_size
+                )
+                if not fused_ok:
+                    # host can't run it: the pipeline span below carries
+                    # the bytes, and the probe must not count as a fused
+                    # execution in the histogram
+                    sp.attrs["bytes"] = 0
+                    sp.attrs["kernel"] = "fused-unavailable"
+            if fused_ok:
+                return
         if codec is None:
             codec = RSCodec(backend=backend)
     if batch is None:
         batch = _default_batch(codec.backend)
+    with trace.kernel_span(
+        "ec.encode", trace.EC_ENCODE_SECONDS, "pipeline-" + codec.backend,
+        nbytes=total,
+    ):
+        _write_ec_files_pipeline(
+            base_file_name, codec, large_block_size, small_block_size,
+            batch, total,
+        )
+
+
+def _write_ec_files_pipeline(
+    base_file_name: str,
+    codec: RSCodec,
+    large_block_size: int,
+    small_block_size: int,
+    batch: int,
+    total: int,
+) -> None:
     dat_path = base_file_name + ".dat"
-    total = os.path.getsize(dat_path)
     shard_size = shard_file_size(total, large_block_size, small_block_size)
     writers = _ShardWriters(base_file_name, shard_size)
     try:
@@ -459,6 +492,18 @@ def rebuild_ec_files(
     (`ec_encoder.go:61,237-291`), through the same three-stage pipeline —
     the GF transform is the inverted-submatrix product on the pipeline
     backend (BASELINE config 2). Returns the rebuilt shard ids."""
+    with trace.kernel_span(
+        "ec.rebuild", trace.EC_DECODE_SECONDS, "rebuild"
+    ) as sp:
+        return _rebuild_ec_files(base_file_name, codec, chunk, sp)
+
+
+def _rebuild_ec_files(
+    base_file_name: str,
+    codec: RSCodec | None,
+    chunk: int | None,
+    sp,
+) -> list[int]:
     from seaweedfs_tpu.ops import gf256
 
     codec = codec or RSCodec(backend=pick_pipeline_backend())
@@ -488,6 +533,8 @@ def rebuild_ec_files(
             tuple(missing),
         )
         shard_size = os.path.getsize(base_file_name + to_ext(use[0]))
+        # throughput convention: bytes read from the surviving data shards
+        sp.attrs["bytes"] = shard_size * DATA_SHARDS_COUNT
         writers = _ShardWriters(
             base_file_name, shard_size, shard_ids=missing
         )

@@ -12,10 +12,25 @@ from __future__ import annotations
 
 import os
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:  # gated: ciphered filers need it, plain filers must import fine
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # pragma: no cover - environment-dependent
+    AESGCM = None
 
 KEY_SIZE = 32
 NONCE_SIZE = 12
+
+
+def available() -> bool:
+    return AESGCM is not None
+
+
+def _require() -> None:
+    if AESGCM is None:
+        raise RuntimeError(
+            "chunk encryption needs the 'cryptography' package, which is"
+            " not installed; run the filer without -encryptVolumeData"
+        )
 
 
 def gen_cipher_key() -> bytes:
@@ -25,6 +40,7 @@ def gen_cipher_key() -> bytes:
 def encrypt(data: bytes, key: bytes | None = None) -> tuple[bytes, bytes]:
     """Returns (nonce||ciphertext||tag, key). Fresh key per chunk when none
     given (`Encrypt` cipher.go)."""
+    _require()
     if key is None:
         key = gen_cipher_key()
     nonce = os.urandom(NONCE_SIZE)
@@ -33,6 +49,7 @@ def encrypt(data: bytes, key: bytes | None = None) -> tuple[bytes, bytes]:
 
 
 def decrypt(payload: bytes, key: bytes) -> bytes:
+    _require()
     if len(payload) < NONCE_SIZE:
         raise ValueError("cipher payload too short")
     nonce, ct = payload[:NONCE_SIZE], payload[NONCE_SIZE:]

@@ -8,7 +8,10 @@ returns the parsed dict ({} when absent and not required).
 from __future__ import annotations
 
 import os
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # py<3.11: same-format tomli fallback
+    import tomli as tomllib
 
 SEARCH_DIRS = [
     ".",

@@ -224,6 +224,7 @@ class TestAzureSink:
 class TestGcsSink:
     @pytest.fixture()
     def fake_gcs(self):
+        pytest.importorskip("cryptography", reason="GCS JWT grant needs RSA")
         from cryptography.hazmat.primitives import hashes, serialization
         from cryptography.hazmat.primitives.asymmetric import padding, rsa
 

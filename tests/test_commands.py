@@ -103,7 +103,10 @@ class TestBackup:
 
 class TestScaffoldConfig:
     def test_scaffold_all_templates_parse(self, tmp_path, capsys):
-        import tomllib
+        try:
+            import tomllib
+        except ModuleNotFoundError:  # py<3.11
+            tomllib = pytest.importorskip("tomli")
 
         from seaweedfs_tpu.command.scaffold import TEMPLATES, run
 

@@ -225,6 +225,48 @@ class TestReplicaPlacement:
             assert ReplicaPlacement.from_byte(rp.to_byte()) == rp
 
 
+class TestPrometheusExposition:
+    """Text-format escaping + registry invariants (stats/metrics.py)."""
+
+    def test_label_values_escaped_per_spec(self):
+        from seaweedfs_tpu.stats.metrics import Registry
+
+        reg = Registry()
+        c = reg.counter("esc_total", "h", ("path",))
+        c.labels('a"b\\c\nd').inc()
+        lines = reg.render().splitlines()
+        sample = [l for l in lines if l.startswith("esc_total{")][0]
+        assert sample == 'esc_total{path="a\\"b\\\\c\\nd"} 1'
+
+    def test_histogram_le_labels_well_formed(self):
+        from seaweedfs_tpu.stats.metrics import Registry
+
+        reg = Registry()
+        h = reg.histogram("lat_seconds", buckets=(0.5, 1.0))
+        h.observe(0.7)
+        text = reg.render()
+        assert 'lat_seconds_bucket{le="0.5"} 0' in text
+        assert 'lat_seconds_bucket{le="1"} 1' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+
+    def test_histogram_bucket_mismatch_raises(self):
+        from seaweedfs_tpu.stats.metrics import Registry
+
+        reg = Registry()
+        reg.histogram("hb_seconds", buckets=(1, 2))
+        reg.histogram("hb_seconds", buckets=(2, 1))  # same set: fine
+        with pytest.raises(TypeError):
+            reg.histogram("hb_seconds", buckets=(1, 2, 3))
+
+    def test_histogram_kind_mismatch_raises(self):
+        from seaweedfs_tpu.stats.metrics import Registry
+
+        reg = Registry()
+        reg.counter("mixed_total")
+        with pytest.raises(TypeError):
+            reg.histogram("mixed_total")
+
+
 class TestTTL:
     def test_parse_format(self):
         for s in ["", "3m", "4h", "5d", "6w", "7M", "8y"]:

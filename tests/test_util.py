@@ -37,6 +37,10 @@ class TestCompression:
         assert decompress_data(packed) == data
 
     def test_zstd_roundtrip(self):
+        from seaweedfs_tpu.util import compression
+
+        if compression._zstd is None:
+            pytest.skip("zstandard package unavailable")
         data = b"abcdef" * 5000
         packed = zstd_data(data)
         assert is_zstd_data(packed)
@@ -65,6 +69,9 @@ class TestCompression:
         assert not ok3
 
 
+@pytest.mark.skipif(
+    not cipher.available(), reason="cryptography package unavailable"
+)
 class TestCipher:
     def test_roundtrip(self):
         data = os.urandom(10000)
@@ -174,6 +181,9 @@ class TestConcurrency:
                   attempts=2)
 
 
+@pytest.mark.skipif(
+    not cipher.available(), reason="cryptography package unavailable"
+)
 class TestCipheredFiler:
     """e2e: filer with -encryptVolumeData; volume servers hold ciphertext."""
 
