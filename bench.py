@@ -172,6 +172,71 @@ def bench_verb(staging_base: str, trials: int = 3) -> tuple[float, dict]:
                   "kernel_gbps": kernels}
 
 
+def fastlane_summary_from_metrics(text: str) -> dict:
+    """Fastlane engine health off one /metrics scrape (PR-2 series):
+    native-vs-proxied hit ratio plus per-op p50/p99 latency interpolated
+    from the `SeaweedFS_volume_fastlane_request_seconds` fixed buckets —
+    so BENCH records how much of the data plane actually ran natively and
+    at what latency, next to the kernel_gbps attribution."""
+    from seaweedfs_tpu.stats import parse_exposition
+
+    native = proxied = 0.0
+    # op -> {le_upper_bound_s: cumulative_count SUMMED across servers} —
+    # one process registry can carry several servers' series (the `server`
+    # label); summing per-bound keeps the merged histogram cumulative
+    # (sum of cumulatives is the cumulative of the sum)
+    buckets: dict = {}
+    counts: dict = {}
+    for name, labels, value in parse_exposition(text):
+        if name == "SeaweedFS_volume_fastlane_requests_total":
+            native += value
+        elif name == "SeaweedFS_volume_fastlane_proxied_total":
+            proxied += value
+        elif name == "SeaweedFS_volume_fastlane_request_seconds_bucket":
+            le = labels.get("le", "")
+            bound = float("inf") if le == "+Inf" else float(le)
+            per_op = buckets.setdefault(labels.get("op", ""), {})
+            per_op[bound] = per_op.get(bound, 0.0) + value
+        elif name == "SeaweedFS_volume_fastlane_request_seconds_count":
+            op = labels.get("op", "")
+            counts[op] = counts.get(op, 0.0) + value
+
+    def quantile(op: str, q: float):
+        bs = sorted(buckets.get(op, {}).items())
+        total = counts.get(op, 0.0)
+        if not bs or total <= 0:
+            return None
+        rank = q * total
+        prev_bound, prev_cum = 0.0, 0.0
+        for bound, cum in bs:
+            if cum >= rank:
+                if bound == float("inf"):
+                    return round(prev_bound, 6)  # overflow bucket: lower edge
+                # prev_cum < rank <= cum here, so the division is safe
+                frac = (rank - prev_cum) / (cum - prev_cum)
+                return round(prev_bound + frac * (bound - prev_bound), 6)
+            prev_bound, prev_cum = bound, cum
+        return round(prev_bound, 6)
+
+    total = native + proxied
+    out: dict = {
+        "native_requests": native,
+        "proxied_requests": proxied,
+        "fastlane_native_ratio": round(native / total, 4) if total else None,
+        "ops": {},
+    }
+    for op in sorted(counts):
+        if counts.get(op, 0) <= 0:
+            continue
+        p50, p99 = quantile(op, 0.5), quantile(op, 0.99)
+        out["ops"][op] = {
+            "count": counts[op],
+            "p50_ms": None if p50 is None else round(p50 * 1e3, 3),
+            "p99_ms": None if p99 is None else round(p99 * 1e3, 3),
+        }
+    return out
+
+
 def bench_sequential_reference_loop(staging_base: str, gfni: bool) -> float:
     """The reference's architecture (`ec_encoder.go:132-137`): one thread,
     256KB batches, read -> encode -> write, no overlap. gfni=False is the
@@ -515,6 +580,17 @@ def bench_small_files(n: int = 20000, size: int = 1024, c: int = 16) -> dict:
                 out["write_errors"] = w["errors"]
                 out["read_errors"] = r["errors"]
                 out["engine"] = vs.fastlane.stats()
+            try:
+                # PR-2 engine metrics: native hit ratio + per-op p50/p99
+                # straight off the live /metrics surface
+                from seaweedfs_tpu.server.httpd import http_request
+
+                _, _, mtext = http_request(
+                    "GET", f"{vs.service.url}/metrics")
+                out["fastlane"] = fastlane_summary_from_metrics(
+                    mtext.decode())
+            except Exception:
+                pass
             if master.fastlane is not None:
                 # the reference's exact write semantics: EVERY file pays a
                 # master /dir/assign round-trip before its volume POST
@@ -772,6 +848,11 @@ def main() -> None:
         )
     except Exception as e:
         detail["kernel_gbps"] = {"error": str(e)[:120]}
+    # PR-2: the fastlane engine's own series, captured while the small-file
+    # cluster was still alive (its collector unregisters on server stop)
+    fl = detail.get("small_files", {}).get("fastlane")
+    if fl is not None:
+        detail["fastlane"] = fl
     detail["note"] = (
         "value is the real shell ec.encode verb, disk-to-shards, 1GiB volume,"
         " best of 3. vs_baseline divides by baseline_seq_gfni_gbps: the"
@@ -834,6 +915,8 @@ def summary_line(
             "cdc_gbps_p75": cdc.get("gbps_p75_window"),
             "sf_write_req_s": sf.get("write_req_s"),
             "sf_read_req_s": sf.get("read_req_s"),
+            "fastlane_native_ratio": (sf.get("fastlane") or {}).get(
+                "fastlane_native_ratio"),
             "sf_assign_write_req_s": sf.get("write_assign_per_file_req_s"),
             "py_write_req_s": pyc.get("write_req_s"),
             "py_read_req_s": pyc.get("read_req_s"),

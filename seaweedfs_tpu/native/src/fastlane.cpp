@@ -272,6 +272,9 @@ struct Vol {
     std::atomic<uint64_t> last_ns{0};
     std::atomic<bool> readonly{false};
     std::atomic<bool> forward_writes{false};
+    // per-volume native-op counters (sw_fl_get_volume_metrics)
+    std::atomic<uint64_t> m_reads{0}, m_writes{0}, m_deletes{0},
+        m_read_bytes{0}, m_write_bytes{0};
     std::mutex append_mu;           // serializes .dat appends (C++ and Python)
     std::shared_mutex map_mu;       // guards nmap
     NMap nmap;
@@ -306,6 +309,40 @@ struct Stats {
         native_deletes{0}, native_assigns{0}, proxied{0};
 };
 
+// --- per-op engine metrics ---------------------------------------------------
+// Fixed-bucket latency histograms + byte counters, all relaxed atomics so
+// the hot path pays a handful of uncontended fetch_adds. Host profilers
+// cannot see into this engine's epoll loop, so it carries its own
+// instrumentation surface, exported raw through sw_fl_get_metrics and
+// rendered into Prometheus families by the Python side.
+
+constexpr int kOpRead = 0, kOpWrite = 1, kOpDelete = 2, kOpAssign = 3,
+              kOpProxy = 4;
+constexpr int kNumOps = 5;
+constexpr int kLatBuckets = 16;
+// finite bucket upper bounds in ns (50us..5s); each OpStat carries one
+// extra overflow slot that Python renders as +Inf
+constexpr uint64_t kLatBoundsNs[kLatBuckets] = {
+    50000ull,      100000ull,     250000ull,     500000ull,
+    1000000ull,    2500000ull,    5000000ull,    10000000ull,
+    25000000ull,   50000000ull,   100000000ull,  250000000ull,
+    500000000ull,  1000000000ull, 2500000000ull, 5000000000ull,
+};
+
+struct OpStat {
+    std::atomic<uint64_t> count{0}, bytes{0}, ns_sum{0};
+    std::atomic<uint64_t> buckets[kLatBuckets + 1] = {};
+
+    void observe(uint64_t ns, uint64_t nbytes) {
+        count.fetch_add(1, std::memory_order_relaxed);
+        if (nbytes) bytes.fetch_add(nbytes, std::memory_order_relaxed);
+        ns_sum.fetch_add(ns, std::memory_order_relaxed);
+        int i = 0;
+        while (i < kLatBuckets && ns > kLatBoundsNs[i]) i++;
+        buckets[i].fetch_add(1, std::memory_order_relaxed);
+    }
+};
+
 // ---------------------------------------------------------------------------
 // HTTP connection state
 // ---------------------------------------------------------------------------
@@ -323,6 +360,7 @@ struct Conn {
     size_t chunk_scan = 0;       // chunked decode: resume position in `in`
     std::string chunk_body;      // chunked decode: body decoded so far
     BackendConn* upstream = nullptr;  // pending proxied request, if any
+    uint64_t req_start_ns = 0;   // mono_ns at dispatch of the current request
     time_t last_active = 0;
     void* ssl = nullptr;  // OpenSSL SSL* when the engine terminates TLS
     int tls_hs = 0;       // 0 plaintext, 1 handshaking, 2 established
@@ -350,6 +388,7 @@ struct BackendConn {
     bool backend_close = false;
     bool retried = false;
     time_t started = 0;
+    uint64_t start_ns = 0;    // mono_ns at proxy launch (latency metrics)
     uint32_t target_ip = 0;   // 0 = engine's default Python backend
     int target_port = 0;
     int mode = 0;             // 0 proxy, 1 filer chunk upload, 2 filer relay
@@ -450,6 +489,7 @@ struct Engine {
     std::mutex ev_mu;
     std::deque<Event> events;
     Stats stats;
+    OpStat op_stats[kNumOps];
 
     // --- filer mode ---
     std::atomic<bool> filer_mode{false};
@@ -500,6 +540,19 @@ uint64_t now_ns() {
     struct timespec ts;
     clock_gettime(CLOCK_REALTIME, &ts);
     return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+uint64_t mono_ns() {  // latency measurement must not jump with wall time
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+// record one completed engine-served request into the per-op metrics;
+// c->req_start_ns was stamped when dispatch picked the request up, so
+// async completions (filer relays/uploads) include their upstream hop
+void observe_op(Engine* E, Conn* c, int op, uint64_t nbytes) {
+    E->op_stats[op].observe(mono_ns() - c->req_start_ns, nbytes);
 }
 
 void put_u32be(uint8_t* p, uint32_t v) {
@@ -850,6 +903,10 @@ bool handle_read(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
         append_response(c, status, status == 206 ? "Partial Content" : "OK",
                         ctype, extra, out_p, out_n, false);
     }
+    uint64_t served = head ? 0 : (uint64_t)out_n;
+    v->m_reads.fetch_add(1, std::memory_order_relaxed);
+    v->m_read_bytes.fetch_add(served, std::memory_order_relaxed);
+    observe_op(E, c, kOpRead, served);
     E->stats.native_reads++;
     return true;
 }
@@ -1001,6 +1058,9 @@ bool handle_write(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
              data_len, crc);
     body += tailbuf;
     json_response(c, 201, "Created", body);
+    v->m_writes.fetch_add(1, std::memory_order_relaxed);
+    v->m_write_bytes.fetch_add(data_len, std::memory_order_relaxed);
+    observe_op(E, c, kOpWrite, data_len);
     E->stats.native_writes++;
     return true;
 }
@@ -1071,6 +1131,8 @@ bool handle_delete(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
     char body[48];
     snprintf(body, sizeof body, "{\"size\": %d}", freed);
     json_response(c, 202, "Accepted", body);
+    v->m_deletes.fetch_add(1, std::memory_order_relaxed);
+    observe_op(E, c, kOpDelete, 0);
     E->stats.native_deletes++;
     return true;
 }
@@ -1271,6 +1333,7 @@ void proxy_request(Engine* E, Worker* w, Conn* c, const char* req, size_t len,
     b->client = c;
     b->req.assign(req, len);
     b->started = time(nullptr);
+    b->start_ns = mono_ns();
     b->counted = !bypass_cap;
     b->head_request = len >= 5 && memcmp(req, "HEAD ", 5) == 0;
     c->upstream = b;  // halts further request processing on this client
@@ -1329,6 +1392,8 @@ void backend_complete(Engine* E, Worker* w, BackendConn* b, bool ok,
         if (ok) {
             c->out += b->resp;
             if (!client_keep) c->want_close = true;
+            E->op_stats[kOpProxy].observe(mono_ns() - b->start_ns,
+                                          b->resp.size());
             E->stats.proxied++;
         } else {
             json_response(c, 502, "Bad Gateway",
@@ -1590,6 +1655,7 @@ bool handle_assign(Engine* E, Conn* c, const char* query, size_t qlen) {
     body += "\", ";
     body += ap->tails[vi];
     json_response(c, 200, "OK", body);
+    observe_op(E, c, kOpAssign, 0);
     E->stats.native_assigns++;
     return true;
 }
@@ -1775,6 +1841,7 @@ void filer_serve_inline(Engine* E, Conn* c,
         ent->mime.empty() ? "application/octet-stream" : ent->mime;
     if (!inm.empty() && inm == etag) {
         append_response(c, 304, "Not Modified", ctype, extra, "", 0, false);
+        observe_op(E, c, kOpRead, 0);
         E->stats.native_reads++;
         return;
     }
@@ -1791,6 +1858,7 @@ void filer_serve_inline(Engine* E, Conn* c,
                      data.size());
             append_response(c, 416, "Range Not Satisfiable", "", cr, "", 0,
                             false);
+            observe_op(E, c, kOpRead, 0);
             E->stats.native_reads++;
             return;
         }
@@ -1811,6 +1879,7 @@ void filer_serve_inline(Engine* E, Conn* c,
     }
     append_response(c, status, status == 206 ? "Partial Content" : "OK",
                     ctype, extra, data.data() + off, n, head);
+    observe_op(E, c, kOpRead, head ? 0 : n);
     E->stats.native_reads++;
 }
 
@@ -1825,6 +1894,7 @@ void filer_write_ack(Engine* E, Conn* c, const std::string& path,
              (unsigned long long)size, md5_hex);
     body += tail;
     json_response(c, 201, "Created", body);
+    observe_op(E, c, kOpWrite, size);
     E->stats.native_writes++;
 }
 
@@ -1927,6 +1997,7 @@ void filer_relay_finish(Engine* E, Worker* w, BackendConn* b, bool ok) {
             c->out += head;
             c->out.append(b->resp, b->hdr_end,
                           b->resp.size() - b->hdr_end);
+            observe_op(E, c, kOpRead, b->resp.size() - b->hdr_end);
             E->stats.native_reads++;
             // promote small hot objects: a FULL-entity, length-framed
             // relay body moves into the inline cache (same 128MB budget +
@@ -2156,6 +2227,7 @@ void filer_relay_launch(Engine* E, Worker* w, Conn* c,
 void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
               size_t hdr_len, const char* body, size_t body_len) {
     E->stats.requests++;
+    c->req_start_ns = mono_ns();
     if (!c->cn_ok) {
         // CA-valid client cert with a disallowed CommonName: same per-request
         // 403 surface the Python gate produces (httpd.py _dispatch)
@@ -2232,6 +2304,7 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
                 if (!inm.empty() && inm == "\"" + ent->md5_hex + "\"") {
                     append_response(c, 304, "Not Modified", "",
                                     "ETag: " + inm + "\r\n", "", 0, false);
+                    observe_op(E, c, kOpRead, 0);
                     E->stats.native_reads++;
                     return;
                 }
@@ -2247,6 +2320,7 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
                                  (unsigned long long)ent->size);
                         append_response(c, 416, "Range Not Satisfiable", "",
                                         cr, "", 0, false);
+                        observe_op(E, c, kOpRead, 0);
                         E->stats.native_reads++;
                         return;
                     }
@@ -3258,6 +3332,51 @@ void sw_fl_get_stats(int h, unsigned long long* out6) {
     out6[3] = E->stats.native_deletes.load();
     out6[4] = E->stats.proxied.load();
     out6[5] = E->stats.native_assigns.load();
+}
+
+// Self-describing per-op metrics snapshot (PR 2 observability ABI —
+// storage/fastlane.py binds it OPTIONALLY, so a prebuilt .so without this
+// symbol keeps working with plain sw_fl_get_stats). Layout:
+//   out[0] = n_ops   (read, write, delete, assign, proxied — in order)
+//   out[1] = n_buckets (finite bucket bounds; each op then carries
+//            n_buckets+1 counters, the last being the +Inf overflow)
+//   out[2 .. 2+n_buckets)  bucket upper bounds in NANOSECONDS
+//   then per op: count, bytes, ns_sum, bucket[n_buckets+1]
+// Returns u64 values written; -1 bad handle, -2 cap too small.
+long sw_fl_get_metrics(int h, unsigned long long* out, size_t cap) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    size_t need = 2 + kLatBuckets + (size_t)kNumOps * (3 + kLatBuckets + 1);
+    if (cap < need) return -2;
+    size_t o = 0;
+    out[o++] = (unsigned long long)kNumOps;
+    out[o++] = (unsigned long long)kLatBuckets;
+    for (int i = 0; i < kLatBuckets; i++) out[o++] = kLatBoundsNs[i];
+    for (int op = 0; op < kNumOps; op++) {
+        OpStat& s = E->op_stats[op];
+        out[o++] = s.count.load(std::memory_order_relaxed);
+        out[o++] = s.bytes.load(std::memory_order_relaxed);
+        out[o++] = s.ns_sum.load(std::memory_order_relaxed);
+        for (int i = 0; i <= kLatBuckets; i++)
+            out[o++] = s.buckets[i].load(std::memory_order_relaxed);
+    }
+    return (long)o;
+}
+
+// Per-volume native-op counters: out6 = reads, writes, deletes,
+// read_bytes, write_bytes, tail. Returns 0; -1 bad handle, -2 no volume.
+int sw_fl_get_volume_metrics(int h, uint32_t vid, unsigned long long* out6) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto v = E->vol_raw(vid);
+    if (!v) return -2;
+    out6[0] = v->m_reads.load(std::memory_order_relaxed);
+    out6[1] = v->m_writes.load(std::memory_order_relaxed);
+    out6[2] = v->m_deletes.load(std::memory_order_relaxed);
+    out6[3] = v->m_read_bytes.load(std::memory_order_relaxed);
+    out6[4] = v->m_write_bytes.load(std::memory_order_relaxed);
+    out6[5] = v->tail.load(std::memory_order_relaxed);
+    return 0;
 }
 
 }  // extern "C"

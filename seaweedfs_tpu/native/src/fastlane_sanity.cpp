@@ -54,6 +54,8 @@ int sw_fl_map_put(int h, uint32_t vid, uint64_t key,
                   unsigned long long offset, int32_t size);
 long sw_fl_drain_events(int h, uint8_t* out, size_t max_events);
 void sw_fl_get_stats(int h, unsigned long long* out6);
+long sw_fl_get_metrics(int h, unsigned long long* out, size_t cap);
+int sw_fl_get_volume_metrics(int h, uint32_t vid, unsigned long long* out6);
 int sw_fl_filer_enable(int h, const char* journal_path,
                        unsigned long long chunk_limit, int compress);
 int sw_fl_filer_lease_set(int h, const char* vol_host, int vol_port,
@@ -237,6 +239,12 @@ int main() {
             // put + delete churn: both sides of the map_mu surface
             sw_fl_map_put(h, 7, 1000000 + i, 4096 + 8 * i, 128);
             sw_fl_map_put(h, 7, 1000000 + i, 0, 0);
+            // concurrent metrics scrapes against the hammering workers
+            // (the PR-2 per-op histograms are relaxed atomics; any
+            // accidental non-atomic path shows up here under TSAN)
+            unsigned long long mbuf[256], vm[6];
+            sw_fl_get_metrics(h, mbuf, 256);
+            sw_fl_get_volume_metrics(h, 7, vm);
             usleep(1000);
         }
     });
@@ -249,6 +257,20 @@ int main() {
             "requests=%llu native_writes=%llu native_reads=%llu "
             "deletes=%llu proxied=%llu errors=%d\n",
             stats[0], stats[2], stats[1], stats[3], stats[4], errors.load());
+    {
+        // the metrics snapshot must agree with the plain counters
+        unsigned long long m[256];
+        long written = sw_fl_get_metrics(h, m, 256);
+        if (written < 2) { fprintf(stderr, "get_metrics failed\n"); return 1; }
+        size_t nb = (size_t)m[1];
+        unsigned long long reads = m[2 + nb];      // op 0 count
+        unsigned long long writes = m[2 + nb + (3 + nb + 1)];
+        if (reads != stats[1] || writes != stats[2]) {
+            fprintf(stderr, "metrics/stats mismatch r=%llu/%llu w=%llu/%llu\n",
+                    reads, stats[1], writes, stats[2]);
+            return 1;
+        }
+    }
 
     // ---- filer-mode phase: a SECOND engine acts as the filer, leasing
     // fids against the first (volume) engine — inline writes (journal +

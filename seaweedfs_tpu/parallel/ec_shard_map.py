@@ -21,6 +21,16 @@ from seaweedfs_tpu.ops.crc32c_kernel import _block_matrix, _zero_crc
 from seaweedfs_tpu.ops.rs_kernel import DATA_SHARDS, PARITY_SHARDS
 
 
+def _shard_map():
+    """Version-tolerant shard_map import: jax >= 0.4.44 exports it at the
+    top level, the pinned 0.4.37 only under jax.experimental."""
+    try:
+        from jax import shard_map  # jax >= 0.4.44
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
 def _bitplane_encode(jnp, jax, shards, a):
     """shards (10, n) uint8, a (80, 32) int8 -> parity (4, n) uint8.
 
@@ -50,7 +60,8 @@ def _encode_fn(mesh, n_volumes: int, n: int):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    shard_map = _shard_map()
 
     a = jnp.asarray(
         np.frombuffer(_parity_bit_matrix_bytes(), dtype=np.uint8).reshape(80, 32),
@@ -86,7 +97,8 @@ def _crc_fn(mesh, length: int):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    shard_map = _shard_map()
 
     from seaweedfs_tpu.ops.crc32c_kernel import _compiled_batch
 
@@ -113,7 +125,8 @@ def sharded_crc32c(mesh, blocks):
 def _md5_fn(mesh, length: int):
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    shard_map = _shard_map()
 
     from seaweedfs_tpu.ops.md5_kernel import _compiled_batch
 

@@ -151,10 +151,10 @@ class HTTPService:
         self.metrics_role = role
         reg = default_registry()
         self._m_total = reg.counter(
-            "seaweedfs_tpu_request_total", "requests", ("role", "method", "code")
+            "SeaweedFS_http_request_total", "requests", ("role", "method", "code")
         )
         self._m_seconds = reg.histogram(
-            "seaweedfs_tpu_request_seconds", "request latency", ("role", "method")
+            "SeaweedFS_http_request_seconds", "request latency", ("role", "method")
         )
         if serve_route:
             @self.route("GET", r"/metrics")

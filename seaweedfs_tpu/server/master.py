@@ -86,12 +86,101 @@ class MasterServer:
 
     def start(self) -> None:
         self._start_fastlane()
+        self._register_metrics_collector()
         if self._peer_config:
             self.enable_raft(
                 [p.rstrip("/") for p in self._peer_config
                  if p.rstrip("/") != self.url]
             )
         threading.Thread(target=self._maintenance_loop, daemon=True).start()
+
+    # --- topology gauges --------------------------------------------------------
+    MASTER_METRIC_FAMILIES = (
+        "SeaweedFS_master_volume_size_bytes",
+        "SeaweedFS_master_volume_file_count",
+        "SeaweedFS_master_volume_deleted_bytes",
+        "SeaweedFS_master_volume_readonly",
+        "SeaweedFS_master_volume_size_limit_bytes",
+        "SeaweedFS_master_free_slots",
+        "SeaweedFS_master_heartbeat_age_seconds",
+        "SeaweedFS_master_stale_heartbeats",
+        "SeaweedFS_master_ec_shard_count",
+        "SeaweedFS_master_volumes_underreplicated",
+        "SeaweedFS_master_ec_missing_shards",
+    )
+
+    def _register_metrics_collector(self) -> None:
+        """Export the heartbeat-fed topology view as Prometheus gauges at
+        scrape time (the reference's master exports the same families from
+        `weed/stats/metrics.go` MasterVolumeLayout gauges). Registered as a
+        scrape-time collector so /metrics always reflects the live tree —
+        no per-heartbeat gauge churn, nothing stale after a node expires."""
+        from seaweedfs_tpu.stats import default_registry
+
+        self._metrics_collector = default_registry().register_collector(
+            self._metrics_lines, names=self.MASTER_METRIC_FAMILIES,
+        )
+
+    def _metrics_lines(self) -> list[str]:
+        from seaweedfs_tpu.stats.metrics import _fmt_labels
+
+        lines: list[str] = []
+        # disambiguates multiple masters sharing one process registry
+        # (raft test clusters) — same role the volume collector's `server`
+        # label plays; without it their series would collide. Advertise the
+        # public port (the engine front when present, not the loopback
+        # backend the Python service binds behind it)
+        port = self.fastlane.port if getattr(self, "fastlane", None) \
+            else self.service.port
+        me = f"{self.service.host}:{port}"
+
+        def sample(family: str, labels: dict, value) -> None:
+            labels = {"master": me, **labels}
+            # integers render exactly: '{:g}' would clip volume sizes to 6
+            # significant digits, skewing cluster.check's capacity math
+            v = str(int(value)) if float(value).is_integer() else f"{value:g}"
+            lines.append(
+                f"{family}"
+                f"{_fmt_labels(tuple(labels), tuple(labels.values()))}"
+                f" {v}"
+            )
+
+        for fam in self.MASTER_METRIC_FAMILIES:
+            lines.append(f"# TYPE {fam} gauge")
+        sample("SeaweedFS_master_volume_size_limit_bytes", {},
+               self.topo.volume_size_limit)
+        now = time.time()
+        # 3x pulse: late enough that a GIL-starved heartbeat thread does
+        # not flap the gauge, early enough to flag well before the 5x-pulse
+        # node expiry removes the node (and its gauges) entirely
+        stale_after = 3 * max(self.topo.pulse_seconds, 1)
+        for node in self.topo.all_nodes():
+            where = {"dc": node.dc_name(), "rack": node.rack_name(),
+                     "node": node.id}
+            sample("SeaweedFS_master_free_slots", where, node.free_slots())
+            age = max(0.0, now - node.last_seen)
+            sample("SeaweedFS_master_heartbeat_age_seconds", where, age)
+            sample("SeaweedFS_master_stale_heartbeats", where,
+                   1 if age > stale_after else 0)
+            sample("SeaweedFS_master_ec_shard_count", where,
+                   sum(len(s.shard_ids()) for s in node.ec_shards.values()))
+            for vid, v in sorted(node.volumes.items()):
+                vl = {"volume": vid, "collection": v.collection,
+                      "node": node.id}
+                sample("SeaweedFS_master_volume_size_bytes", vl, v.size)
+                sample("SeaweedFS_master_volume_file_count", vl, v.file_count)
+                sample("SeaweedFS_master_volume_deleted_bytes", vl,
+                       v.deleted_byte_count)
+                sample("SeaweedFS_master_volume_readonly", vl,
+                       1 if v.read_only else 0)
+        for coll, vid, have, want in self.topo.under_replicated_volumes():
+            sample("SeaweedFS_master_volumes_underreplicated",
+                   {"volume": vid, "collection": coll, "have": have,
+                    "want": want}, want - have)
+        for vid, missing in sorted(self.topo.ec_missing_shards().items()):
+            sample("SeaweedFS_master_ec_missing_shards", {"volume": vid},
+                   missing)
+        return lines
 
     def _fl_assign_install(self, req, count: int, replication: str,
                            collection: str, ttl: str, dc: str) -> None:
@@ -220,6 +309,11 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if getattr(self, "_metrics_collector", None) is not None:
+            from seaweedfs_tpu.stats import default_registry
+
+            default_registry().unregister_collector(self._metrics_collector)
+            self._metrics_collector = None
         if self.raft is not None:
             self.raft.stop()
         if getattr(self, "fastlane", None) is not None:

@@ -69,6 +69,7 @@ class VolumeServer:
         self._stop = threading.Event()
         self.fastlane = None  # native data-plane front door when available
         self.local_socket = local_socket  # same-host unix listener
+        self._metrics_collector = None  # registry handle (start/stop)
         self._routes()
 
     def _start_fastlane(self) -> None:
@@ -106,6 +107,7 @@ class VolumeServer:
             for vid in self.store.volume_ids():
                 self._fl_register(vid)
             threading.Thread(target=self._fl_drain_loop, daemon=True).start()
+        self._register_metrics_collector()
         for loc in self.store.locations:
             loc.max_volume_count = self.max_volume_count
         for loc in self.store.locations:
@@ -129,6 +131,11 @@ class VolumeServer:
 
     def stop(self) -> None:  # idempotent: fixtures may stop twice
         self._stop.set()
+        if self._metrics_collector is not None:
+            from seaweedfs_tpu.stats import default_registry
+
+            default_registry().unregister_collector(self._metrics_collector)
+            self._metrics_collector = None
         if self.fastlane:
             self.fastlane.drain()
             self.fastlane.stop()
@@ -205,6 +212,135 @@ class VolumeServer:
                 svc._m_total.labels(svc.metrics_role, method, code).inc(delta)
             last[key] = stats[key]
         last["proxied"] = stats["proxied"]  # proxied ones count in Python
+
+    # --- metrics collector ------------------------------------------------------
+    FL_FAMILIES = (
+        "SeaweedFS_volume_fastlane_requests_total",
+        "SeaweedFS_volume_fastlane_request_seconds",
+        "SeaweedFS_volume_fastlane_bytes_total",
+        "SeaweedFS_volume_fastlane_proxied_total",
+        "SeaweedFS_volume_fastlane_volume_requests_total",
+        "SeaweedFS_volume_fastlane_volume_bytes_total",
+        "SeaweedFS_volume_disk_used_bytes",
+        "SeaweedFS_volume_disk_free_bytes",
+    )
+
+    def _register_metrics_collector(self) -> None:
+        """Scrape-time exporter for the series the Python registry cannot
+        count itself: the fastlane engine's per-op histograms/byte counters
+        (C-side atomics, read via sw_fl_get_metrics) and per-directory disk
+        gauges. The `server` label disambiguates multiple servers sharing
+        one process registry (test clusters)."""
+        from seaweedfs_tpu.stats import default_registry
+
+        self._metrics_collector = default_registry().register_collector(
+            self._metrics_lines, names=self.FL_FAMILIES,
+        )
+
+    def _metrics_lines(self) -> list[str]:
+        import os as _os
+
+        from seaweedfs_tpu.stats.metrics import _fmt_labels
+
+        server = f"{self._host}:{self.data_port}"
+        lines: list[str] = []
+
+        def sample(family: str, labels: dict, value, suffix: str = "") -> None:
+            # integers render exactly: '{:g}' would clip large byte counters
+            # to 6 significant digits and flatline rate() between scrapes
+            v = str(int(value)) if float(value).is_integer() else f"{value:g}"
+            lines.append(
+                f"{family}{suffix}"
+                f"{_fmt_labels(tuple(labels), tuple(labels.values()))}"
+                f" {v}"
+            )
+
+        fl = self.fastlane
+        if fl is not None:
+            m = fl.metrics()
+            lines.append("# HELP SeaweedFS_volume_fastlane_requests_total "
+                         "requests served natively by the fastlane engine")
+            lines.append("# TYPE SeaweedFS_volume_fastlane_requests_total counter")
+            if m is not None:
+                for op, st in m["ops"].items():
+                    if op == "proxied":
+                        continue
+                    sample("SeaweedFS_volume_fastlane_requests_total",
+                           {"server": server, "op": op}, st["count"])
+                lines.append("# TYPE SeaweedFS_volume_fastlane_proxied_total counter")
+                sample("SeaweedFS_volume_fastlane_proxied_total",
+                       {"server": server}, m["ops"]["proxied"]["count"])
+                lines.append("# TYPE SeaweedFS_volume_fastlane_bytes_total counter")
+                for op, st in m["ops"].items():
+                    sample("SeaweedFS_volume_fastlane_bytes_total",
+                           {"server": server, "op": op}, st["bytes"])
+                lines.append(
+                    "# TYPE SeaweedFS_volume_fastlane_request_seconds histogram")
+                for op, st in m["ops"].items():
+                    cum = 0
+                    for bound, c in zip(m["bounds_s"], st["buckets"]):
+                        cum += c
+                        sample("SeaweedFS_volume_fastlane_request_seconds",
+                               {"server": server, "op": op,
+                                "le": "{:g}".format(bound)}, cum, "_bucket")
+                    # +Inf and _count come from the buckets themselves (incl.
+                    # the engine's overflow slot), not the separately-read
+                    # count: relaxed-atomic snapshots taken mid-observe would
+                    # otherwise yield a non-monotonic histogram
+                    cum += st["buckets"][-1]
+                    sample("SeaweedFS_volume_fastlane_request_seconds",
+                           {"server": server, "op": op, "le": "+Inf"},
+                           cum, "_bucket")
+                    sample("SeaweedFS_volume_fastlane_request_seconds",
+                           {"server": server, "op": op}, st["seconds_sum"],
+                           "_sum")
+                    sample("SeaweedFS_volume_fastlane_request_seconds",
+                           {"server": server, "op": op}, cum, "_count")
+                lines.append(
+                    "# TYPE SeaweedFS_volume_fastlane_volume_requests_total"
+                    " counter")
+                for vid in sorted(fl._volumes):
+                    vm = fl.volume_metrics(vid)
+                    if vm is None:
+                        continue
+                    for op, cnt in (("read", vm["reads"]),
+                                    ("write", vm["writes"]),
+                                    ("delete", vm["deletes"])):
+                        sample(
+                            "SeaweedFS_volume_fastlane_volume_requests_total",
+                            {"server": server, "volume": vid, "op": op}, cnt)
+                    for op, nb in (("read", vm["read_bytes"]),
+                                   ("write", vm["write_bytes"])):
+                        sample(
+                            "SeaweedFS_volume_fastlane_volume_bytes_total",
+                            {"server": server, "volume": vid, "op": op}, nb)
+            else:
+                # stale .so without sw_fl_get_metrics: plain counters only
+                st = fl.stats()
+                for op, cnt in (("read", st["native_reads"]),
+                                ("write", st["native_writes"]),
+                                ("delete", st["native_deletes"])):
+                    sample("SeaweedFS_volume_fastlane_requests_total",
+                           {"server": server, "op": op}, cnt)
+                lines.append("# TYPE SeaweedFS_volume_fastlane_proxied_total counter")
+                sample("SeaweedFS_volume_fastlane_proxied_total",
+                       {"server": server}, st["proxied"])
+        store = self.store
+        if store is not None:
+            lines.append("# TYPE SeaweedFS_volume_disk_used_bytes gauge")
+            lines.append("# TYPE SeaweedFS_volume_disk_free_bytes gauge")
+            for loc in store.locations:
+                try:
+                    sv = _os.statvfs(loc.directory)
+                except OSError:
+                    continue
+                sample("SeaweedFS_volume_disk_used_bytes",
+                       {"server": server, "dir": loc.directory},
+                       (sv.f_blocks - sv.f_bfree) * sv.f_frsize)
+                sample("SeaweedFS_volume_disk_free_bytes",
+                       {"server": server, "dir": loc.directory},
+                       sv.f_bavail * sv.f_frsize)
+        return lines
 
     # --- heartbeat --------------------------------------------------------------
     def heartbeat_once(self) -> None:

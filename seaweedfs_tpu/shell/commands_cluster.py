@@ -36,27 +36,160 @@ def cmd_cluster_ps(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(lines)
 
 
-@command("cluster.check", "sanity-check cluster topology and replica health")
+def _scrape(url: str) -> list:
+    """GET <url>/metrics -> parsed (name, labels, value) samples."""
+    from seaweedfs_tpu.server.httpd import http_request
+    from seaweedfs_tpu.stats import parse_exposition
+
+    status, _, body = http_request("GET", f"{url}/metrics", timeout=10)
+    if status != 200:
+        raise IOError(f"GET {url}/metrics -> {status}")
+    return parse_exposition(body.decode("utf-8", "replace"))
+
+
+def _fmt_gb(n: float) -> str:
+    return f"{n / 1024**3:.1f}GB"
+
+
+@command("cluster.check",
+         "[-fail] [-capacityPct 90] — health dashboard: replica/EC health,"
+         " per-node disk + heartbeat freshness, volumes near the size cap,"
+         " read-only volumes, fastlane native-vs-proxied hit rate."
+         " -fail exits nonzero when any problem is found (scripting)")
 def cmd_cluster_check(env: CommandEnv, args: list[str]) -> str:
+    """Scrapes the PR-2 Prometheus series (`SeaweedFS_master_*` topology
+    gauges off the master, `SeaweedFS_volume_fastlane_*` + disk gauges off
+    every volume server) and renders one cluster-health dashboard — the
+    in-situ view arXiv:1709.05365 argues storage tuning needs."""
+    flags = parse_flags(args)
+    fail_mode = "fail" in flags
+    try:
+        cap_pct = float(flags.get("capacityPct", 90))
+    except ValueError:
+        raise ShellError("usage: cluster.check [-fail] [-capacityPct n]")
+
     servers = env.servers()
-    problems = []
+    replicas = env.volume_replicas()
+    problems: list[str] = []
     if not servers:
         problems.append("no volume servers registered")
-    replicas = env.volume_replicas()
+    # replica counts straight from the topology snapshot (works even when
+    # a node's /metrics is unreachable)
+    underrep_seen: set[str] = set()
     for vid, holders in sorted(replicas.items()):
         rp_byte = holders[0].volumes[vid].get("replica_placement", 0)
         want = (rp_byte // 100) + (rp_byte // 10) % 10 + rp_byte % 10 + 1
         if len(holders) < want:
+            underrep_seen.add(str(vid))
             problems.append(
                 f"volume {vid}: {len(holders)}/{want} replicas "
                 f"({', '.join(h.id for h in holders)})"
             )
-    header = (
-        f"topology: {len(servers)} volume servers, {len(replicas)} volumes"
+
+    # --- master gauges: size limit, staleness, readonly, EC shard health ---
+    size_limit = 30 * 1024**3
+    stale_nodes: dict[str, float] = {}
+    hb_age: dict[str, float] = {}
+    free_slots: dict[str, float] = {}
+    near_cap: list[str] = []
+    readonly_volumes: list[str] = []
+    try:
+        msamples = _scrape(env.master_url)
+    except Exception as e:
+        msamples = []
+        problems.append(f"master metrics unreachable: {e}")
+    for name, labels, value in msamples:
+        if name == "SeaweedFS_master_volume_size_limit_bytes":
+            size_limit = value or size_limit
+    for name, labels, value in msamples:
+        node = labels.get("node", "")
+        if name == "SeaweedFS_master_heartbeat_age_seconds":
+            hb_age[node] = value
+        elif name == "SeaweedFS_master_stale_heartbeats" and value > 0:
+            stale_nodes[node] = hb_age.get(node, value)
+        elif name == "SeaweedFS_master_free_slots":
+            free_slots[node] = value
+        elif name == "SeaweedFS_master_volume_size_bytes":
+            if value >= size_limit * cap_pct / 100.0:
+                near_cap.append(
+                    f"volume {labels.get('volume')} on {node}: "
+                    f"{_fmt_gb(value)} >= {cap_pct:g}% of "
+                    f"{_fmt_gb(size_limit)} cap"
+                )
+        elif name == "SeaweedFS_master_volume_readonly" and value > 0:
+            readonly_volumes.append(
+                f"volume {labels.get('volume')} read-only on {node}"
+            )
+        elif name == "SeaweedFS_master_volumes_underreplicated" and value > 0:
+            # skip vids the snapshot loop above already flagged — the gauge
+            # catches what the snapshot can't (e.g. a layout whose last
+            # holder vanished entirely), not the same fault twice
+            if labels.get("volume") not in underrep_seen:
+                problems.append(
+                    f"volume {labels.get('volume')} under-replicated: "
+                    f"{labels.get('have')}/{labels.get('want')} replicas"
+                )
+        elif name == "SeaweedFS_master_ec_missing_shards" and value > 0:
+            problems.append(
+                f"ec volume {labels.get('volume')}: {value:g} shard(s)"
+                " without a live holder"
+            )
+    for node, age in sorted(stale_nodes.items()):
+        problems.append(f"stale heartbeat from {node}: {age:.1f}s ago")
+    problems.extend(near_cap)
+    problems.extend(readonly_volumes)
+
+    # --- per-node scrape: disk + fastlane hit rate -------------------------
+    lines = [f"cluster.check @ {env.master_url}"]
+    ec_count = sum(len(sv.ec_shards) for sv in servers)
+    lines.append(
+        f"topology: {len(servers)} volume servers, {len(replicas)} volumes,"
+        f" {ec_count} ec volume holdings"
     )
-    if not problems:
-        return header + "\ncluster is healthy"
-    return header + "\n" + "\n".join(problems)
+    for sv in sorted(servers, key=lambda s: s.id):
+        disk_used = disk_free = 0.0
+        native = proxied = 0.0
+        try:
+            vsamples = _scrape(sv.http)
+        except Exception as e:
+            problems.append(f"{sv.id}: metrics unreachable ({e})")
+            lines.append(f"node {sv.id} dc={sv.dc} rack={sv.rack}:"
+                         " metrics unreachable")
+            continue
+        for name, labels, value in vsamples:
+            # the `server` label scopes series to this node when several
+            # servers share one process registry (test clusters)
+            if labels.get("server", sv.id) != sv.id:
+                continue
+            if name == "SeaweedFS_volume_disk_used_bytes":
+                disk_used += value
+            elif name == "SeaweedFS_volume_disk_free_bytes":
+                disk_free += value
+            elif name == "SeaweedFS_volume_fastlane_requests_total":
+                native += value
+            elif name == "SeaweedFS_volume_fastlane_proxied_total":
+                proxied += value
+        total = native + proxied
+        rate = f"{100.0 * native / total:.1f}%" if total else "n/a"
+        age = hb_age.get(sv.id)
+        lines.append(
+            f"node {sv.id} dc={sv.dc} rack={sv.rack}: "
+            f"disk {_fmt_gb(disk_used)} used / {_fmt_gb(disk_free)} free, "
+            f"free_slots={free_slots.get(sv.id, sv.free_slots()):g}, "
+            f"heartbeat {f'{age:.1f}s ago' if age is not None else 'n/a'}, "
+            f"fastlane native {rate}"
+            f" ({native:g} native / {proxied:g} proxied)"
+        )
+
+    if problems:
+        lines.append(f"{len(problems)} problem(s):")
+        lines.extend("  " + p for p in problems)
+        report = "\n".join(lines)
+        if fail_mode:
+            raise ShellError(report)
+        return report
+    lines.append("cluster is healthy")
+    return "\n".join(lines)
 
 
 @command("collection.list", "list collections")

@@ -7,6 +7,10 @@ from .metrics import (
     Histogram,
     Registry,
     default_registry,
+    parse_exposition,
 )
 
-__all__ = ["Counter", "Gauge", "Histogram", "Registry", "default_registry"]
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "default_registry",
+    "parse_exposition",
+]

@@ -218,9 +218,25 @@ class _Timer:
         return False
 
 
+class Collector:
+    """A scrape-time exposition source: fn() -> list of text-format lines.
+
+    Servers whose series live OUTSIDE the registry's counters (the fastlane
+    engine's C-side atomics, the master's topology tree) register one of
+    these; the registry calls it on every render. `names` declares the
+    metric families the fn produces so tooling (tools/check_metric_names.py)
+    can lint the namespace without scraping a live server."""
+
+    def __init__(self, fn, names: Iterable[str] = ()):
+        self.fn = fn
+        self.names = tuple(names)
+        self.failing = False  # first failure per streak is logged
+
+
 class Registry:
     def __init__(self) -> None:
         self._metrics: dict[str, _Metric] = {}
+        self._collectors: list[Collector] = []
         self._lock = threading.Lock()
 
     def counter(self, name, help_text="", label_names=()) -> Counter:
@@ -256,16 +272,99 @@ class Registry:
                 raise TypeError(f"{name} already registered as {type(m).__name__}")
             return m
 
+    def register_collector(self, fn, names: Iterable[str] = ()) -> Collector:
+        """Attach a scrape-time line source (see Collector). Returns the
+        handle to pass to unregister_collector — servers MUST unregister on
+        stop or a fixture-churned process accumulates stale closures."""
+        col = Collector(fn, names)
+        with self._lock:
+            self._collectors.append(col)
+        return col
+
+    def unregister_collector(self, col: Collector) -> None:
+        with self._lock:
+            if col in self._collectors:
+                self._collectors.remove(col)
+
+    def metric_names(self) -> list[str]:
+        """Every family name this registry can expose: registered metrics
+        plus collector-declared names (the lint surface)."""
+        with self._lock:
+            names = list(self._metrics)
+            for col in self._collectors:
+                names.extend(col.names)
+        return sorted(set(names))
+
     def render(self) -> str:
         with self._lock:
             metrics = list(self._metrics.values())
+            collectors = list(self._collectors)
         lines: list[str] = []
         for m in metrics:
             lines.extend(m.render())
+        for col in collectors:
+            # a dying server's collector must not break /metrics — but a
+            # silent swallow would erase whole families with no breadcrumb,
+            # so the first failure per streak is logged (start_push_loop's
+            # pattern)
+            try:
+                lines.extend(col.fn())
+                col.failing = False
+            except Exception as e:
+                if not col.failing:
+                    col.failing = True
+                    from seaweedfs_tpu.util import glog
+
+                    glog.warning("metrics collector %s failed: %s",
+                                 col.names[:1] or col.fn, e)
         return "\n".join(lines) + "\n"
 
 
 _default = Registry()
+
+
+_SAMPLE_RE = None  # compiled lazily: most processes never parse exposition
+
+
+def parse_exposition(text: str):
+    """Parse Prometheus text format -> list of (name, labels, value).
+
+    The inverse of Registry.render, shared by `cluster.check` (scraping
+    /metrics across the cluster), bench.py's fastlane summary, and tests.
+    Unparseable lines are skipped, like Prometheus itself treats them."""
+    import re
+
+    global _SAMPLE_RE
+    if _SAMPLE_RE is None:
+        _SAMPLE_RE = (
+            re.compile(r'^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$'),
+            re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"'),
+        )
+    line_re, label_re = _SAMPLE_RE
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = line_re.match(line)
+        if m is None:
+            continue
+        try:
+            value = float(m.group(3))
+        except ValueError:
+            continue
+        labels = {}
+        if m.group(2):
+            for lm in label_re.finditer(m.group(2)):
+                # single-pass unescape: ordered str.replace would corrupt a
+                # literal backslash followed by 'n' ("\\n" -> newline)
+                labels[lm.group(1)] = re.sub(
+                    r"\\(.)",
+                    lambda e: "\n" if e.group(1) == "n" else e.group(1),
+                    lm.group(2),
+                )
+        out.append((m.group(1), labels, value))
+    return out
 
 
 def default_registry() -> Registry:

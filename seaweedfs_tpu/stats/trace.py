@@ -218,6 +218,25 @@ def collector() -> TraceCollector:
     return _collector
 
 
+def record_span(name: str, role: str | None = None,
+                start: float | None = None, duration: float = 0.0,
+                trace_id: str | None = None,
+                attrs: dict | None = None) -> Span:
+    """Insert an already-finished span into the ring — for work measured
+    OUTSIDE Python. The fastlane engine's drained append/delete events
+    carry an engine-side ns timestamp; storage/fastlane.py synthesizes
+    them into spans here so `cluster.trace` finally shows natively-served
+    writes (they never touch a Python handler, so no server span exists)."""
+    sp = Span(trace_id or _new_id(), _new_id(), None, name, role, attrs)
+    if start is not None:
+        sp.start = start
+    sp.duration = max(0.0, duration)
+    sp.status = "ok"
+    with _collector._lock:
+        _collector._ring.append(sp)
+    return sp
+
+
 def annotate(**attrs) -> None:
     """Attach attrs to the thread's active span (e.g. a long-poll handler
     calls annotate(long_poll=True) so its deliberate multi-second waits

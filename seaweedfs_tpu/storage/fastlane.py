@@ -127,6 +127,29 @@ def _bind(lib) -> bool:
         return False
 
 
+def _bind_metrics(lib) -> bool:
+    """Declare the OPTIONAL per-op metrics ABI (PR 2). A prebuilt .so from
+    before sw_fl_get_metrics existed simply lacks the symbols — the engine
+    still runs, Fastlane.metrics() just returns None and the Prometheus
+    collector degrades to the plain sw_fl_get_stats counters."""
+    cached = getattr(lib, "_fastlane_metrics_bound", None)
+    if cached is not None:
+        return cached
+    try:
+        lib.sw_fl_get_metrics.restype = ctypes.c_long
+        lib.sw_fl_get_metrics.argtypes = [
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib.sw_fl_get_volume_metrics.restype = ctypes.c_int
+        lib.sw_fl_get_volume_metrics.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_void_p,
+        ]
+        lib._fastlane_metrics_bound = True
+    except AttributeError:
+        lib._fastlane_metrics_bound = False
+    return lib._fastlane_metrics_bound
+
+
 def _get_lib():
     if os.environ.get("SEAWEEDFS_TPU_DISABLE_FASTLANE") == "1":
         return None
@@ -179,11 +202,15 @@ class VolumeHook:
         self.engine._lib.sw_fl_map_put(self.engine.handle, self.vid, key, 0, -1)
 
 
+METRIC_OPS = ("read", "write", "delete", "assign", "proxied")
+
+
 class Fastlane:
     def __init__(self, lib, handle: int, tls: bool = False) -> None:
         self._lib = lib
         self.handle = handle
         self.tls = tls  # engine terminates mTLS itself: URLs are https
+        self._metrics_ok = _bind_metrics(lib)
         # can the engine natively reach upstream (volume) engines? Under
         # mTLS this needs the C++ TLS *client* context too
         self.tls_client_ok = bool(lib.sw_fl_tls_client_ok(handle))
@@ -191,6 +218,13 @@ class Fastlane:
         self._volumes: dict[int, object] = {}  # vid -> Volume (drain target)
         self._drain_mu = threading.Lock()
         self._buf = ctypes.create_string_buffer(_EVENT_SIZE * 4096)
+        # span-synthesis budget (tokens/second): the engine can push tens of
+        # thousands of events/s, and unthrottled synthesis would churn every
+        # real request trace out of the bounded ring (the same flooding the
+        # PR-1 noise guard exists to prevent). Metrics count EVERY event;
+        # spans are a bounded sample.
+        self._span_sec = -1
+        self._span_quota = 0
 
     @staticmethod
     def start(host: str, port: int, backend_port: int, workers: int = 0,
@@ -283,7 +317,16 @@ class Fastlane:
     # --- event drain --------------------------------------------------------
     def drain(self) -> int:
         """Apply engine-side appends/deletes to the Python needle maps
-        (memory-only — the engine already wrote .dat and .idx)."""
+        (memory-only — the engine already wrote .dat and .idx), and
+        synthesize events into finished spans in the shared trace ring:
+        natively-served writes never touch a Python handler, so without
+        this `cluster.trace` was blind to the whole data plane. Span
+        synthesis is budgeted per second so a native write storm cannot
+        evict every real request trace from the bounded ring."""
+        import time as _time
+
+        from seaweedfs_tpu.stats import trace as _trace
+
         total = 0
         with self._drain_mu:
             while True:
@@ -294,6 +337,19 @@ class Fastlane:
                 for i in range(n):
                     vid, op, key, offset, size, _, ns = _EVENT.unpack_from(
                         self._buf, i * _EVENT_SIZE)
+                    sec = int(_time.monotonic())
+                    if sec != self._span_sec:
+                        self._span_sec = sec
+                        self._span_quota = 128
+                    if self._span_quota > 0:
+                        self._span_quota -= 1
+                        _trace.record_span(
+                            "fastlane.append" if op == 0
+                            else "fastlane.delete",
+                            role="volume", start=ns / 1e9,
+                            attrs={"vid": vid, "key": f"{key:x}",
+                                   "size": size, "native": True},
+                        )
                     v = self._volumes.get(vid)
                     if v is None:
                         continue
@@ -342,6 +398,53 @@ class Fastlane:
             "native_deletes": int(out[3]),
             "proxied": int(out[4]),
             "native_assigns": int(out[5]),
+        }
+
+    # --- per-op metrics (optional ABI) --------------------------------------
+    def metrics(self) -> dict | None:
+        """Per-op latency histograms + byte counters from the engine, or
+        None when the loaded .so predates sw_fl_get_metrics. Shape:
+        {"bounds_s": [...], "ops": {op: {"count", "bytes", "seconds_sum",
+        "buckets": [... len(bounds_s)+1, last = +Inf overflow]}}}."""
+        if not self._metrics_ok:
+            return None
+        cap = 512
+        buf = (ctypes.c_ulonglong * cap)()
+        n = int(self._lib.sw_fl_get_metrics(self.handle, buf, cap))
+        if n < 2:
+            return None
+        n_ops, n_buckets = int(buf[0]), int(buf[1])
+        if n < 2 + n_buckets + n_ops * (3 + n_buckets + 1):
+            return None
+        bounds_s = [buf[2 + i] / 1e9 for i in range(n_buckets)]
+        ops: dict[str, dict] = {}
+        o = 2 + n_buckets
+        for i in range(n_ops):
+            name = METRIC_OPS[i] if i < len(METRIC_OPS) else f"op{i}"
+            ops[name] = {
+                "count": int(buf[o]),
+                "bytes": int(buf[o + 1]),
+                "seconds_sum": buf[o + 2] / 1e9,
+                "buckets": [int(buf[o + 3 + j]) for j in range(n_buckets + 1)],
+            }
+            o += 3 + n_buckets + 1
+        return {"bounds_s": bounds_s, "ops": ops}
+
+    def volume_metrics(self, vid: int) -> dict | None:
+        """Per-volume native-op counters, or None (old .so / unknown vid)."""
+        if not self._metrics_ok:
+            return None
+        out = (ctypes.c_ulonglong * 6)()
+        rc = int(self._lib.sw_fl_get_volume_metrics(self.handle, vid, out))
+        if rc != 0:
+            return None
+        return {
+            "reads": int(out[0]),
+            "writes": int(out[1]),
+            "deletes": int(out[2]),
+            "read_bytes": int(out[3]),
+            "write_bytes": int(out[4]),
+            "tail": int(out[5]),
         }
 
 

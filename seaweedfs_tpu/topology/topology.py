@@ -259,6 +259,34 @@ class Topology:
         return self.ec_shards.get(vid)
 
     # --- stats -----------------------------------------------------------------
+    def under_replicated_volumes(self) -> list[tuple[str, int, int, int]]:
+        """[(collection, vid, have, want)] across every layout — volumes
+        whose live replica count is below their placement's demand."""
+        with self._lock:
+            layouts = list(self._layouts.items())
+        out = []
+        for (coll, _, _), lo in layouts:
+            want = lo.replica_placement.copy_count()
+            for vid, have in lo.under_replicated():
+                out.append((coll, vid, have, want))
+        return sorted(out, key=lambda t: (t[0], t[1]))
+
+    def ec_missing_shards(self) -> dict[int, int]:
+        """vid -> number of EC shards with NO live holder."""
+        from seaweedfs_tpu.storage.erasure_coding import geometry
+
+        total = geometry.TOTAL_SHARDS_COUNT
+        with self._lock:
+            shard_maps = {
+                vid: sum(1 for nodes in sm.values() if nodes)
+                for vid, sm in self.ec_shards.items()
+            }
+        return {
+            vid: total - present
+            for vid, present in shard_maps.items()
+            if present < total
+        }
+
     def to_dict(self) -> dict:
         return {
             "max_volume_id": self._max_volume_id,

@@ -101,6 +101,19 @@ class VolumeLayout:
                 self.oversized.add(vid)
                 self._refresh_writable(vid)
 
+    def under_replicated(self) -> list[tuple[int, int]]:
+        """[(vid, live replica count)] for volumes with fewer live replicas
+        than the placement demands — the master-side health view that
+        `SeaweedFS_master_volumes_underreplicated` and `cluster.check`
+        render (`volume_layout.go` enoughCopies, inverted)."""
+        with self._lock:
+            want = self.replica_placement.copy_count()
+            return sorted(
+                (vid, len(locs))
+                for vid, locs in self.locations.items()
+                if len(locs) < want
+            )
+
     def active_volume_count(self, data_center: str = "") -> int:
         if not data_center:
             return len(self.writables)

@@ -78,6 +78,37 @@ def test_summary_line_survives_empty_detail():
     assert parsed["vs_baseline"] == 0.0
 
 
+def test_fastlane_summary_from_metrics():
+    """PR-2: native ratio + per-op p50/p99 computed from the scraped
+    SeaweedFS_volume_fastlane_* series (recorded into BENCH_full.json)."""
+    text = "\n".join([
+        '# TYPE SeaweedFS_volume_fastlane_requests_total counter',
+        'SeaweedFS_volume_fastlane_requests_total{server="h:1",op="read"} 60',
+        'SeaweedFS_volume_fastlane_requests_total{server="h:1",op="write"} 40',
+        'SeaweedFS_volume_fastlane_proxied_total{server="h:1"} 25',
+        'SeaweedFS_volume_fastlane_request_seconds_bucket'
+        '{server="h:1",op="write",le="0.001"} 20',
+        'SeaweedFS_volume_fastlane_request_seconds_bucket'
+        '{server="h:1",op="write",le="0.01"} 39',
+        'SeaweedFS_volume_fastlane_request_seconds_bucket'
+        '{server="h:1",op="write",le="+Inf"} 40',
+        'SeaweedFS_volume_fastlane_request_seconds_count'
+        '{server="h:1",op="write"} 40',
+    ])
+    out = bench.fastlane_summary_from_metrics(text)
+    assert out["native_requests"] == 100 and out["proxied_requests"] == 25
+    assert out["fastlane_native_ratio"] == 0.8
+    w = out["ops"]["write"]
+    assert w["count"] == 40
+    # p50: rank 20 lands exactly on the 1ms bucket boundary
+    assert w["p50_ms"] == 1.0
+    # p99: rank 39.6 falls in the overflow bucket -> lower edge (10ms)
+    assert w["p99_ms"] == 10.0
+    # empty scrape: no division by zero, ratio None
+    empty = bench.fastlane_summary_from_metrics("")
+    assert empty["fastlane_native_ratio"] is None and empty["ops"] == {}
+
+
 def test_probe_device_status_shape():
     # under the CPU-forced test env there is no accelerator: status must be
     # a reported fact with the attempt count, never an exception

@@ -385,6 +385,155 @@ class TestFastlaneActive:
         assert r["ok"] == n and r["errors"] == 0, r
 
 
+class TestFastlaneMetrics:
+    """PR-2 engine metrics: per-op latency histograms + byte counters off
+    sw_fl_get_metrics, the /metrics collector, span synthesis from the
+    event queue, and graceful degradation on a stale .so."""
+
+    def test_counters_and_histograms_move(self, cluster):
+        master, vs = cluster
+        if vs.fastlane is None:
+            pytest.skip("fastlane unavailable")
+        base = vs.fastlane.metrics()
+        if base is None:
+            pytest.skip("engine metrics ABI unavailable")
+        a = _assign(master)
+        u = f"http://{a['publicUrl']}/{a['fid']}"
+        assert http_request("POST", u, b"m" * 2048)[0] == 201
+        assert http_request("GET", u)[0] == 200
+        assert http_request("DELETE", u)[0] == 202
+        m = vs.fastlane.metrics()
+        for op, nbytes in (("read", 2048), ("write", 2048), ("delete", 0)):
+            st, st0 = m["ops"][op], base["ops"][op]
+            assert st["count"] == st0["count"] + 1, op
+            assert st["bytes"] == st0["bytes"] + nbytes, op
+            assert st["seconds_sum"] > st0["seconds_sum"], op
+            # every observation landed in exactly one bucket
+            assert sum(st["buckets"]) == st["count"], op
+        assert len(m["bounds_s"]) + 1 == len(m["ops"]["read"]["buckets"])
+        # per-volume counters followed
+        vid = int(a["fid"].split(",")[0])
+        vm = vs.fastlane.volume_metrics(vid)
+        assert vm["reads"] >= 1 and vm["writes"] >= 1 and vm["deletes"] >= 1
+        assert vm["write_bytes"] >= 2048 and vm["read_bytes"] >= 2048
+
+    def test_metrics_exported_on_metrics_endpoint(self, cluster):
+        from seaweedfs_tpu.stats import parse_exposition
+
+        master, vs = cluster
+        if vs.fastlane is None:
+            pytest.skip("fastlane unavailable")
+        if vs.fastlane.metrics() is None:
+            pytest.skip("engine metrics ABI unavailable")
+        a = _assign(master)
+        u = f"http://{a['publicUrl']}/{a['fid']}"
+        assert http_request("POST", u, b"x" * 100)[0] == 201
+        assert http_request("GET", u)[0] == 200
+        st, _, text = http_request("GET", f"{vs.service.url}/metrics")
+        assert st == 200
+        samples = parse_exposition(text.decode())
+        by_name: dict = {}
+        server = f"{vs._host}:{vs.data_port}"
+        for name, labels, value in samples:
+            if labels.get("server", server) == server:
+                by_name.setdefault(name, []).append((labels, value))
+        req = {l["op"]: v for l, v in
+               by_name["SeaweedFS_volume_fastlane_requests_total"]}
+        assert req["read"] >= 1 and req["write"] >= 1  # split by op
+        assert any(
+            v >= 1 for l, v in
+            by_name["SeaweedFS_volume_fastlane_request_seconds_bucket"]
+            if l["op"] == "write"
+        )
+        byt = {l["op"]: v for l, v in
+               by_name["SeaweedFS_volume_fastlane_bytes_total"]}
+        assert byt["write"] >= 100 and byt["read"] >= 100
+        assert "SeaweedFS_volume_fastlane_proxied_total" in by_name
+        assert "SeaweedFS_volume_disk_used_bytes" in by_name
+        # per-volume split present too
+        vols = by_name["SeaweedFS_volume_fastlane_volume_requests_total"]
+        assert any(l["op"] == "write" and v >= 1 for l, v in vols)
+
+    def test_drained_events_become_trace_spans(self, cluster):
+        from seaweedfs_tpu.stats import trace
+
+        master, vs = cluster
+        if vs.fastlane is None:
+            pytest.skip("fastlane unavailable")
+        a = _assign(master)
+        u = f"http://{a['publicUrl']}/{a['fid']}"
+        assert http_request("POST", u, b"traced-bytes")[0] == 201
+        assert http_request("DELETE", u)[0] == 202
+        vs.fastlane.drain()
+        spans = [
+            s for t in trace.collector().traces(limit=500)
+            for s in t["spans"] if s["name"].startswith("fastlane.")
+        ]
+        names = {s["name"] for s in spans}
+        assert "fastlane.append" in names and "fastlane.delete" in names
+        vid = int(a["fid"].split(",")[0])
+        mine = [s for s in spans if s["attrs"].get("vid") == vid]
+        assert mine, spans[:3]
+        assert all(s["role"] == "volume" and s["attrs"]["native"]
+                   for s in mine)
+        # the engine-side ns timestamp carried through as the span start
+        assert all(abs(s["start"] - __import__("time").time()) < 60
+                   for s in mine)
+
+    def test_degrades_cleanly_without_metrics_abi(self, cluster):
+        """A prebuilt .so lacking sw_fl_get_metrics: metrics() is None,
+        the collector falls back to plain counters, nothing raises."""
+        from seaweedfs_tpu.stats import parse_exposition
+
+        master, vs = cluster
+        if vs.fastlane is None:
+            pytest.skip("fastlane unavailable")
+        a = _assign(master)
+        u = f"http://{a['publicUrl']}/{a['fid']}"
+        assert http_request("POST", u, b"old-so")[0] == 201
+        vs.fastlane._metrics_ok = False  # what _bind_metrics reports then
+        try:
+            assert vs.fastlane.metrics() is None
+            assert vs.fastlane.volume_metrics(1) is None
+            st, _, text = http_request("GET", f"{vs.service.url}/metrics")
+            assert st == 200
+            samples = parse_exposition(text.decode())
+            server = f"{vs._host}:{vs.data_port}"
+            mine = [s for s in samples
+                    if s[1].get("server", server) == server]
+            req = {l.get("op"): v for n, l, v in mine
+                   if n == "SeaweedFS_volume_fastlane_requests_total"}
+            assert req.get("write", 0) >= 1  # counters still exported
+            assert not any(
+                n == "SeaweedFS_volume_fastlane_request_seconds_bucket"
+                for n, l, v in mine
+            )  # histograms need the ABI
+            # data plane unaffected
+            st, _, d = http_request("GET", u)
+            assert st == 200 and d == b"old-so"
+        finally:
+            vs.fastlane._metrics_ok = True
+
+    def test_bind_metrics_reports_missing_symbols(self):
+        """_bind_metrics against an object with no ABI -> False, cached."""
+        from seaweedfs_tpu.storage.fastlane import _bind_metrics
+
+        class FakeLib:
+            def __getattr__(self, name):  # mimics ctypes missing-symbol
+                raise AttributeError(name)
+
+            def __setattr__(self, name, value):
+                object.__setattr__(self, name, value)
+
+        class Settable(FakeLib):
+            pass
+
+        lib = Settable()
+        assert _bind_metrics(lib) is False
+        assert lib._fastlane_metrics_bound is False
+        assert _bind_metrics(lib) is False  # cached, no re-probe crash
+
+
 class TestFilerFront:
     """The filer's engine front is a concurrency governor: client bursts
     multiplex onto few Python threads, and long-poll meta subscriptions

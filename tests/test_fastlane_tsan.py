@@ -31,7 +31,9 @@ def _build_and_run(tmp_path, sanitizer: str) -> None:
         "g++", "-O1", "-g", "-std=c++17", f"-fsanitize={sanitizer}",
         "-DSW_FASTLANE_SANITY_MAIN",
         *[os.path.join(SRC, f) for f in FILES],
-        "-o", out, "-lpthread",
+        # -ldl: the engine dlopens OpenSSL at runtime; without it the
+        # sanitizer link fails and this whole arm silently skipped
+        "-o", out, "-lpthread", "-ldl",
     ]
     build = subprocess.run(cmd, capture_output=True, timeout=300)
     if build.returncode != 0:

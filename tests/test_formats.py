@@ -266,6 +266,78 @@ class TestPrometheusExposition:
         with pytest.raises(TypeError):
             reg.histogram("mixed_total")
 
+    def test_collector_lines_rendered_and_unregistered(self):
+        from seaweedfs_tpu.stats.metrics import Registry
+
+        reg = Registry()
+        col = reg.register_collector(
+            lambda: ['ext_gauge{a="1"} 42'], names=("ext_gauge",))
+        assert 'ext_gauge{a="1"} 42' in reg.render()
+        assert "ext_gauge" in reg.metric_names()
+        reg.unregister_collector(col)
+        assert "ext_gauge" not in reg.render()
+        assert "ext_gauge" not in reg.metric_names()
+
+    def test_collector_exception_does_not_break_render(self):
+        from seaweedfs_tpu.stats.metrics import Registry
+
+        reg = Registry()
+        reg.counter("ok_total").inc()
+
+        def boom():
+            raise RuntimeError("dying server")
+
+        reg.register_collector(boom, names=("dead_total",))
+        assert "ok_total" in reg.render()
+
+    def test_parse_exposition_roundtrip(self):
+        from seaweedfs_tpu.stats.metrics import Registry, parse_exposition
+
+        reg = Registry()
+        c = reg.counter("rt_total", "h", ("op", "path"))
+        c.labels("read", 'we"ird\\p\nath').inc(3)
+        h = reg.histogram("rt_seconds", buckets=(0.5, 1.0))
+        h.observe(0.7)
+        samples = parse_exposition(reg.render())
+        assert ("rt_total", {"op": "read", "path": 'we"ird\\p\nath'}, 3.0) \
+            in samples
+        bucket = [s for s in samples if s[0] == "rt_seconds_bucket"]
+        assert ("rt_seconds_bucket", {"le": "+Inf"}, 1.0) in bucket
+
+
+class TestMetricNameLint:
+    """tools/check_metric_names.py — the namespace cannot drift (tier-1)."""
+
+    def _tool(self):
+        import importlib
+        import pathlib
+        import sys
+
+        sys.path.insert(
+            0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        return importlib.import_module("check_metric_names")
+
+    def test_registry_and_collector_names_follow_convention(self):
+        tool = self._tool()
+        kinds, collector_names = tool.collect()
+        bad = tool.violations(kinds, collector_names)
+        assert not bad, "\n".join(bad)
+        # the walk actually saw the PR-2 families, not an empty registry
+        assert "SeaweedFS_volume_fastlane_requests_total" in collector_names
+        assert "SeaweedFS_master_volume_size_bytes" in collector_names
+        assert "SeaweedFS_http_request_total" in kinds
+
+    def test_lint_catches_violations(self):
+        tool = self._tool()
+        bad = tool.violations(
+            {"seaweedfs_tpu_request_total": "counter",     # bad prefix
+             "SeaweedFS_volume_reads": "counter",          # counter sans _total
+             "SeaweedFS_volume_lat": "histogram",          # histogram sans unit
+             "SeaweedFS_volume_free_total": "gauge",       # gauge with _total
+             "SeaweedFS_frobnicator_x_total": "counter"},  # unknown subsystem
+            [])
+        assert len(bad) == 5, bad
+
 
 class TestTTL:
     def test_parse_format(self):

@@ -206,5 +206,5 @@ class TestClusterJwtEnforcement:
         status, _, body = http_request("GET", f"{master.url}/metrics")
         assert status == 200
         text = body.decode()
-        assert "seaweedfs_tpu_request_total" in text
+        assert "SeaweedFS_http_request_total" in text
         assert 'role="master"' in text

@@ -63,8 +63,63 @@ class TestBasicCommands:
     def test_cluster_check_healthy(self, cluster):
         master, volumes, env = cluster
         write_blobs(master.url, 3)
+        for vs in volumes:
+            vs.heartbeat_once()
         out = run_command(env, "cluster.check")
         assert "healthy" in out
+        # the dashboard renders per-node health off the scraped series
+        assert "topology: 3 volume servers" in out
+        for vs in volumes:
+            assert f"node {vs._host}:{vs.data_port}" in out
+        assert "disk" in out and "heartbeat" in out
+        assert "fastlane native" in out
+
+    def test_cluster_check_fail_mode_on_readonly(self, cluster):
+        """Acceptance: a read-only volume makes `cluster.check -fail` exit
+        nonzero; without -fail the problems render but the verb returns."""
+        master, volumes, env = cluster
+        blobs = write_blobs(master.url, 3)
+        vid = int(next(iter(blobs)).rsplit("/", 1)[-1].split(",")[0])
+        holder = next(sv for sv in env.servers() if vid in sv.volumes)
+        env.post(f"{holder.http}/admin/volume/readonly", {"volume": vid})
+        target = next(v for v in volumes
+                      if f"{v._host}:{v.data_port}" == holder.id)
+        target.heartbeat_once()
+        out = run_command(env, "cluster.check")
+        assert f"volume {vid} read-only" in out
+        assert "problem(s)" in out and "healthy" not in out
+        with pytest.raises(ShellError, match="read-only"):
+            run_command(env, "cluster.check -fail")
+        # the shell CLI surfaces that as a nonzero exit for scripting
+        import io
+
+        from seaweedfs_tpu.shell.shell import run_shell
+
+        buf = io.StringIO()
+        rc = run_shell(master.url, script="cluster.check -fail", out=buf)
+        assert rc == 1 and "read-only" in buf.getvalue()
+        # healthy path exits 0
+        env.post(f"{holder.http}/admin/volume/readonly",
+                 {"volume": vid, "readonly": False})
+        target.heartbeat_once()
+        rc = run_shell(master.url, script="cluster.check -fail",
+                       out=io.StringIO())
+        assert rc == 0
+        # over-threshold path: with the bar at 0% every non-empty volume
+        # counts as near-cap and the same -fail exit fires
+        with pytest.raises(ShellError, match="cap"):
+            run_command(env, "cluster.check -fail -capacityPct 0")
+
+    def test_cluster_trace_shows_fastlane_spans(self, cluster):
+        master, volumes, env = cluster
+        if all(vs.fastlane is None for vs in volumes):
+            pytest.skip("fastlane unavailable")
+        write_blobs(master.url, 3)
+        for vs in volumes:
+            if vs.fastlane is not None:
+                vs.fastlane.drain()
+        out = run_command(env, "cluster.trace -limit 40")
+        assert "fastlane.append" in out
 
     def test_lock_required(self, cluster):
         _, _, env = cluster

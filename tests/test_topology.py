@@ -140,6 +140,93 @@ class TestGrowth:
         assert len(vids) == len(set(vids)) == 7  # strategy for 1 copy
 
 
+class TestHealthView:
+    """PR-2: the under-replication / EC-shard-health helpers that feed
+    `SeaweedFS_master_*` gauges and `cluster.check`."""
+
+    def test_under_replicated_volumes(self):
+        topo = Topology()
+        # rp=010 wants 2 copies; only one holder
+        topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[(1, 100, 10)]))
+        assert topo.under_replicated_volumes() == [("", 1, 1, 2)]
+        # second replica arrives -> healthy
+        topo.sync_heartbeat(hb("10.0.0.2", 8080, rack="r2",
+                               volumes=[(1, 100, 10)]))
+        assert topo.under_replicated_volumes() == []
+        # holder dies -> under-replicated again
+        topo.sync_heartbeat(hb("10.0.0.2", 8080, rack="r2", volumes=[]))
+        assert topo.under_replicated_volumes() == [("", 1, 1, 2)]
+
+    def test_layout_under_replicated_reports_live_count(self):
+        from seaweedfs_tpu.topology.volume_layout import VolumeLayout
+
+        lo = VolumeLayout(
+            replica_placement=ReplicaPlacement.parse("020"), ttl_u32=0)
+        topo = Topology()
+        n1 = topo.sync_heartbeat(hb("10.0.0.1", 8080))
+        from seaweedfs_tpu.topology.node import VolumeInfo
+
+        lo.register_volume(VolumeInfo(id=7, replica_placement=20), n1)
+        assert lo.under_replicated() == [(7, 1)]  # wants 3 copies
+
+    def test_ec_missing_shards(self):
+        topo = Topology()
+        beat = hb("10.0.0.1", 8080)
+        beat["ec_shards"] = [
+            {"id": 5, "collection": "", "ec_index_bits": (1 << 10) - 1}
+        ]  # shards 0..9 of 14 present
+        topo.sync_heartbeat(beat)
+        assert topo.ec_missing_shards() == {5: 4}
+        beat["ec_shards"][0]["ec_index_bits"] = (1 << 14) - 1
+        topo.sync_heartbeat(beat)
+        assert topo.ec_missing_shards() == {}
+
+    def test_master_gauge_exposition(self):
+        """The MasterServer collector renders the topology as gauges."""
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.stats import default_registry, parse_exposition
+
+        m = MasterServer(port=0, pulse_seconds=1)
+        m._register_metrics_collector()
+        try:
+            m.topo.sync_heartbeat(hb(
+                "10.9.9.9", 8080, dc="dcg", rack="rg",
+                volumes=[(3, 12345, 0)]))
+            node = m.topo.find_node("10.9.9.9:8080")
+            node.volumes[3].read_only = True
+            samples = parse_exposition(default_registry().render())
+            # every series carries the master instance label (shared-registry
+            # disambiguation); drop it for the positional asserts
+            me = f"{m.service.host}:{m.service.port}"
+            got = {}
+            for n, l, v in samples:
+                if not n.startswith("SeaweedFS_master"):
+                    continue
+                assert l.pop("master") == me, (n, l)
+                got[(n, tuple(sorted(l.items())))] = v
+            where = (("dc", "dcg"), ("node", "10.9.9.9:8080"), ("rack", "rg"))
+            assert got[("SeaweedFS_master_free_slots", where)] == 9
+            assert got[("SeaweedFS_master_stale_heartbeats", where)] == 0
+            vl = (("collection", ""), ("node", "10.9.9.9:8080"),
+                  ("volume", "3"))
+            assert got[("SeaweedFS_master_volume_size_bytes", vl)] == 12345
+            assert got[("SeaweedFS_master_volume_readonly", vl)] == 1
+            assert got[("SeaweedFS_master_volume_size_limit_bytes", ())] > 0
+            # stale once the clock passes 2x pulse
+            node.last_seen -= 60
+            samples = parse_exposition(default_registry().render())
+            stale = [v for n, l, v in samples
+                     if n == "SeaweedFS_master_stale_heartbeats"
+                     and l.get("node") == "10.9.9.9:8080"]
+            assert stale == [1]
+        finally:
+            m.stop()
+        assert not any(
+            s[0].startswith("SeaweedFS_master")
+            for s in parse_exposition(default_registry().render())
+        ), "collector must unregister on stop"
+
+
 class TestSequencers:
     def test_memory_persistence(self, tmp_path):
         p = str(tmp_path / "seq.json")

@@ -1,0 +1,95 @@
+#!/usr/bin/env python3
+"""Prometheus metric-namespace lint: SeaweedFS_<subsystem>_<name>[_unit][_total].
+
+Walks every family the process registry can expose — the counters and
+histograms registered at import/enable time, the lazily-created kernel
+families (stats/trace.py), and the collector-declared names the master and
+volume servers export (topology gauges, fastlane engine series) — and
+fails on any name violating the convention, so the metric namespace cannot
+drift PR over PR. Conventions enforced:
+
+  * name matches  SeaweedFS_<subsystem>_<snake_case>  with a known
+    subsystem (master, volume, filer, s3, http, stats, mount, mq, iam)
+  * counters end in _total
+  * histograms end in a base unit (_seconds or _bytes)
+  * gauges do not end in _total (that suffix promises counter semantics)
+
+Invoked from the tier-1 suite (tests/test_formats.py) and standalone:
+
+    python tools/check_metric_names.py
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+NAME_RE = re.compile(
+    r"^SeaweedFS_"
+    r"(master|volume|filer|s3|http|stats|mount|mq|iam)_"
+    r"[a-z][a-z0-9]*(_[a-z0-9]+)*$"
+)
+
+HISTOGRAM_UNITS = ("_seconds", "_bytes")
+
+
+def collect() -> tuple[dict[str, str], list[str]]:
+    """-> ({family: kind} for registry-backed metrics, [collector names])."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from seaweedfs_tpu.server.httpd import HTTPService
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.stats import default_registry, trace
+    from seaweedfs_tpu.storage import crc
+
+    # force the lazily-registered families into the registry
+    for fam in (trace.EC_ENCODE_SECONDS, trace.EC_DECODE_SECONDS,
+                trace.FILER_HASH_SECONDS, crc.VOLUME_CRC32C_SECONDS):
+        trace._kernel_metrics(fam)
+    svc = HTTPService(port=0)  # never started: registration side effect only
+    svc.enable_metrics("lint", serve_route=False)
+    reg = default_registry()
+    reg.counter("SeaweedFS_stats_push_errors_total",
+                "failed pushes to the metrics gateway", ("role",))
+    with reg._lock:
+        kinds = {name: m.kind for name, m in reg._metrics.items()}
+    collector_names = sorted(
+        set(MasterServer.MASTER_METRIC_FAMILIES)
+        | set(VolumeServer.FL_FAMILIES)
+    )
+    return kinds, collector_names
+
+
+def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
+    bad: list[str] = []
+    for name in sorted(set(kinds) | set(collector_names)):
+        if not NAME_RE.match(name):
+            bad.append(f"{name}: does not match "
+                       "SeaweedFS_<subsystem>_<snake_case>")
+    for name, kind in sorted(kinds.items()):
+        if kind == "counter" and not name.endswith("_total"):
+            bad.append(f"{name}: counter must end in _total")
+        elif kind == "histogram" and not name.endswith(HISTOGRAM_UNITS):
+            bad.append(f"{name}: histogram must end in a base unit "
+                       f"({'/'.join(HISTOGRAM_UNITS)})")
+        elif kind == "gauge" and name.endswith("_total"):
+            bad.append(f"{name}: gauge must not end in _total")
+    return bad
+
+
+def main() -> int:
+    kinds, collector_names = collect()
+    bad = violations(kinds, collector_names)
+    total = len(set(kinds) | set(collector_names))
+    if bad:
+        print(f"{len(bad)} metric-name violation(s) in {total} families:")
+        for b in bad:
+            print(f"  {b}")
+        return 1
+    print(f"{total} metric families OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
