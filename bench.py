@@ -79,6 +79,36 @@ def kernel_gbps_from_metrics(text: str) -> dict:
     return out
 
 
+def ec_pipeline_summary_from_metrics(text: str) -> dict:
+    """Per-stage EC pipeline attribution off one /metrics scrape (PR-3
+    series): busy vs queue-wait seconds per stage from the
+    `SeaweedFS_volume_ec_pipeline_seconds{stage,state}` histograms, plus
+    utilization = busy/(busy+wait) — so BENCH records WHERE the encode
+    pipeline's time went (reader starved? device slow? writer saturated?)
+    next to how fast it ran."""
+    from seaweedfs_tpu.stats import parse_exposition
+
+    sums: dict = {}
+    counts: dict = {}
+    for name, labels, value in parse_exposition(text):
+        key = (labels.get("stage", ""), labels.get("state", ""))
+        if name == "SeaweedFS_volume_ec_pipeline_seconds_sum":
+            sums[key] = sums.get(key, 0.0) + value
+        elif name == "SeaweedFS_volume_ec_pipeline_seconds_count":
+            counts[key] = counts.get(key, 0.0) + value
+    out: dict = {}
+    for (stage, state), secs in sorted(sums.items()):
+        st = out.setdefault(stage, {})
+        st[f"{state}_seconds"] = round(secs, 4)
+        st[f"{state}_batches"] = counts.get((stage, state), 0.0)
+    for st in out.values():
+        busy = st.get("busy_seconds", 0.0)
+        wait = st.get("wait_seconds", 0.0)
+        if busy + wait > 0:
+            st["utilization"] = round(busy / (busy + wait), 4)
+    return out
+
+
 def build_volume(staging: str, total_bytes: int = GiB) -> str:
     """A real volume (.dat/.idx via the storage engine) of ~total_bytes."""
     from seaweedfs_tpu.storage.needle import Needle
@@ -137,6 +167,14 @@ def bench_verb(staging_base: str, trials: int = 3) -> tuple[float, dict]:
     best = 0.0
     times = []
     kernels: dict = {}
+    # PR-3: sample this process's stacks across the trials (the overhead
+    # guard bounds the sampler's duty cycle, so the timed verb stays
+    # honest) — BENCH records the hottest frames next to the rates
+    from seaweedfs_tpu.stats import profiler as prof_mod
+
+    sampler = prof_mod.SamplingProfiler(hz=50)
+    sampler.start()
+    prof_out: dict = {}
     try:
         for _ in range(trials):
             try:  # the server auto-loads volumes found at startup
@@ -166,10 +204,16 @@ def bench_verb(staging_base: str, trials: int = 3) -> tuple[float, dict]:
         except Exception:
             pass
     finally:
+        prof_out = sampler.stop()
         vs.stop()
         master.stop()
-    return best, {"trial_seconds": times, "volume_bytes": dat_bytes,
-                  "kernel_gbps": kernels}
+    return best, {
+        "trial_seconds": times, "volume_bytes": dat_bytes,
+        "kernel_gbps": kernels,
+        "profile_top_frames": prof_mod.top_frames(
+            prof_out.get("stacks", {}), n=10),
+        "profile_overhead_ratio": prof_out.get("overhead_ratio"),
+    }
 
 
 def fastlane_summary_from_metrics(text: str) -> dict:
@@ -848,6 +892,16 @@ def main() -> None:
         )
     except Exception as e:
         detail["kernel_gbps"] = {"error": str(e)[:120]}
+    # PR-3: per-stage EC pipeline busy/wait attribution over everything
+    # this process encoded/rebuilt, from the same shared registry
+    try:
+        from seaweedfs_tpu.stats import default_registry
+
+        detail["ec_pipeline"] = ec_pipeline_summary_from_metrics(
+            default_registry().render()
+        )
+    except Exception as e:
+        detail["ec_pipeline"] = {"error": str(e)[:120]}
     # PR-2: the fastlane engine's own series, captured while the small-file
     # cluster was still alive (its collector unregisters on server stop)
     fl = detail.get("small_files", {}).get("fastlane")

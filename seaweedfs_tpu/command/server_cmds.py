@@ -45,6 +45,9 @@ def run_master(args: list[str]) -> int:
     p.add_argument("-pulseSeconds", type=int, default=5)
     p.add_argument("-peers", default="",
                    help="comma-separated master urls (raft HA; include self)")
+    p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
+                   help="log requests slower than this many ms for this "
+                        "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.master import MasterServer
 
@@ -61,6 +64,7 @@ def run_master(args: list[str]) -> int:
         peers=[peer_url(u)
                for u in opts.peers.split(",") if u],
         raft_dir=opts.mdir,
+        slow_ms=opts.slow_ms,
     )
     m.start()
     print(f"master listening at {m.url}")
@@ -80,6 +84,9 @@ def run_volume(args: list[str]) -> int:
     p.add_argument("-pulseSeconds", type=int, default=5)
     p.add_argument("-localSocket", default=None,
                    help="also serve on this unix domain socket")
+    p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
+                   help="log requests slower than this many ms for this "
+                        "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.volume import VolumeServer
 
@@ -96,6 +103,7 @@ def run_volume(args: list[str]) -> int:
         pulse_seconds=opts.pulseSeconds,
         max_volume_count=opts.max,
         local_socket=opts.localSocket,
+        slow_ms=opts.slow_ms,
     )
     vs.start()
     print(f"volume server listening at {vs.url}")
@@ -131,6 +139,9 @@ def run_filer(args: list[str]) -> int:
     p.add_argument("-localSocket", default=None,
                    help="also serve on this unix domain socket "
                         "(same-host mounts skip TCP; -filer.localSocket)")
+    p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
+                   help="log requests slower than this many ms for this "
+                        "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.filer import FilerServer
 
@@ -159,6 +170,7 @@ def run_filer(args: list[str]) -> int:
                for u in opts.peers.split(",") if u],
         dedup=opts.dedup,
         security=sec,
+        slow_ms=opts.slow_ms,
     )
     f.start()
     print(f"filer listening at {f.url}")
@@ -269,6 +281,9 @@ def run_s3(args: list[str]) -> int:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-filer", default="http://127.0.0.1:8888")
     p.add_argument("-config", default=None, help="identities json (s3.json)")
+    p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
+                   help="log requests slower than this many ms for this "
+                        "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
     opts = p.parse_args(args)
     _load_security()
     import json as _json
@@ -282,7 +297,8 @@ def run_s3(args: list[str]) -> int:
     filer = opts.filer
     if not filer.startswith("http"):
         filer = peer_url(filer)
-    s3 = S3Server(filer, host=opts.ip, port=opts.port, config=config)
+    s3 = S3Server(filer, host=opts.ip, port=opts.port, config=config,
+                  slow_ms=opts.slow_ms)
     s3.start()
     print(f"s3 gateway listening at {s3.url}")
     return _wait_forever()
@@ -295,6 +311,9 @@ def run_webdav(args: list[str]) -> int:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-filer", default="http://127.0.0.1:8888")
     p.add_argument("-readOnly", action="store_true")
+    p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
+                   help="log requests slower than this many ms for this "
+                        "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
     opts = p.parse_args(args)
     _load_security()
     from seaweedfs_tpu.server.webdav import WebDavServer
@@ -303,7 +322,7 @@ def run_webdav(args: list[str]) -> int:
     if not filer.startswith("http"):
         filer = peer_url(filer)
     srv = WebDavServer(filer, host=opts.ip, port=opts.port,
-                       read_only=opts.readOnly)
+                       read_only=opts.readOnly, slow_ms=opts.slow_ms)
     srv.start()
     print(f"webdav listening at {srv.url}")
     return _wait_forever()

@@ -78,6 +78,7 @@ class S3Server:
         port: int = 8333,
         config: dict | None = None,
         circuit_breaker: CircuitBreaker | None = None,
+        slow_ms: float | None = None,
     ) -> None:
         self.fc = FilerClient(filer_url)
         self.iam = IdentityAccessManagement()
@@ -88,6 +89,10 @@ class S3Server:
         self._sweep_stop = None
         self.service = HTTPService(host, port)
         self.service.enable_metrics("s3", serve_route=False)
+        if slow_ms is not None:  # -slowMs: per-role slow-span threshold
+            from seaweedfs_tpu.stats import trace as trace_mod
+
+            trace_mod.set_slow_threshold_ms(slow_ms, role="s3")
         self._iam_subscriber = None
         self._routes()
 

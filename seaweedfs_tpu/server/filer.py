@@ -67,6 +67,7 @@ class FilerServer:
         dedup_min: int = 16 * 1024,
         dedup_max: int = 512 * 1024,
         local_socket: str | None = None,
+        slow_ms: float | None = None,
     ) -> None:
         from seaweedfs_tpu.security import Guard, SecurityConfig
 
@@ -87,6 +88,10 @@ class FilerServer:
         # /metrics), so metrics get their own listener (`-metricsPort`;
         # -1 = ephemeral port, 0 = disabled, >0 = fixed)
         self.service.enable_metrics("filer", serve_route=False)
+        if slow_ms is not None:  # -slowMs: per-role slow-span threshold
+            from seaweedfs_tpu.stats import trace as trace_mod
+
+            trace_mod.set_slow_threshold_ms(slow_ms, role="filer")
         self.metrics_service = (
             MetricsService(host, max(metrics_port, 0)) if metrics_port != 0 else None
         )

@@ -411,21 +411,32 @@ class HTTPService:
 
 
 def _register_debug_routes(service: "HTTPService") -> None:
-    """`/debug/traces` (recent finished traces, JSON; ?limit= & ?min_ms=)
-    and `/debug/requests` (in-flight spans) over the process-wide trace
-    ring. Registered by enable_tracing, so on catch-all namespaces (the
-    filer) they precede — and shadow — same-named file paths."""
+    """`/debug/traces` (recent finished traces, JSON; ?limit= & ?min_ms=),
+    `/debug/requests` (in-flight spans; ?limit=), and the profiling
+    surface: `/debug/pprof/profile` (?seconds= & ?hz=; collapsed-stack
+    text, ?format=json for the structured form), `/debug/pprof/threads`
+    (instant all-thread dump), `/debug/pprof/device` (jax.profiler trace
+    tarball; 501 without jax). Registered by enable_tracing, so on
+    catch-all namespaces (the filer) they precede — and shadow —
+    same-named file paths. Malformed numeric query params are a 400 with
+    a JSON error, never an unhandled 500."""
     from seaweedfs_tpu.stats import trace as trace_mod
 
     col = trace_mod.collector()
 
     @service.route("GET", r"/debug/traces")
     def debug_traces(req: Request) -> Response:
+        import math
+
         try:
             limit = int(req.query.get("limit", 20))
             min_ms = float(req.query.get("min_ms", 0))
+            if not math.isfinite(min_ms):
+                raise ValueError(min_ms)
         except ValueError:
-            return Response({"error": "limit/min_ms must be numeric"}, 400)
+            return Response(
+                {"error": "limit/min_ms must be finite numbers"}, 400
+            )
         return Response({
             "traces": col.traces(limit=limit, min_ms=min_ms),
             "capacity": col.max_spans,
@@ -433,7 +444,64 @@ def _register_debug_routes(service: "HTTPService") -> None:
 
     @service.route("GET", r"/debug/requests")
     def debug_requests(req: Request) -> Response:
-        return Response({"in_flight": col.inflight()})
+        try:
+            limit = int(req.query.get("limit", 0))
+        except ValueError:
+            return Response({"error": "limit must be numeric"}, 400)
+        in_flight = col.inflight()
+        if limit > 0:
+            in_flight = in_flight[:limit]
+        return Response({"in_flight": in_flight})
+
+    @service.route("GET", r"/debug/pprof/profile")
+    def debug_pprof_profile(req: Request) -> Response:
+        from seaweedfs_tpu.stats import profiler as prof_mod
+
+        try:
+            seconds = prof_mod.clamp_seconds(req.query.get("seconds", 2))
+            hz = int(req.query.get("hz", 100))
+        except ValueError:
+            return Response({"error": "seconds/hz must be finite numbers"}, 400)
+        try:
+            out = prof_mod.profile(seconds=seconds, hz=hz)
+        except prof_mod.ProfilerBusy as e:
+            return Response({"error": str(e)}, 429)
+        out["role"] = service.trace_role or service.metrics_role
+        out["proc"] = prof_mod.PROCESS_TOKEN  # cluster.profile dedup key
+        if req.query.get("format") == "json":
+            return Response(out)
+        return Response(prof_mod.render_collapsed(out["stacks"]))
+
+    @service.route("GET", r"/debug/pprof/threads")
+    def debug_pprof_threads(req: Request) -> Response:
+        from seaweedfs_tpu.stats import profiler as prof_mod
+
+        return Response({
+            "role": service.trace_role or service.metrics_role,
+            "threads": prof_mod.threads_dump(),
+        })
+
+    @service.route("GET", r"/debug/pprof/device")
+    def debug_pprof_device(req: Request) -> Response:
+        from seaweedfs_tpu.stats import profiler as prof_mod
+
+        try:
+            seconds = prof_mod.clamp_seconds(req.query.get("seconds", 2))
+        except ValueError:
+            return Response({"error": "seconds must be a finite number"}, 400)
+        try:
+            data = prof_mod.device_trace(seconds)
+        except prof_mod.DeviceProfilerUnavailable as e:
+            return Response({"error": str(e)}, 501)
+        except prof_mod.ProfilerBusy as e:
+            return Response({"error": str(e)}, 429)
+        return Response(
+            data,
+            content_type="application/gzip",
+            headers={
+                "Content-Disposition": 'attachment; filename="jax-trace.tar.gz"'
+            },
+        )
 
 
 class MetricsService(HTTPService):

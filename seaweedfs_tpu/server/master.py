@@ -34,6 +34,7 @@ class MasterServer:
         security: SecurityConfig | None = None,
         peers: list[str] | None = None,
         raft_dir: str | None = None,
+        slow_ms: float | None = None,
     ) -> None:
         seq = MemorySequencer(f"{meta_dir}/sequence.json" if meta_dir else None)
         self.topo = Topology(
@@ -48,6 +49,10 @@ class MasterServer:
         if self.security.white_list:
             self.service.guard = Guard(self.security.white_list)
         self.service.enable_metrics("master")
+        if slow_ms is not None:  # -slowMs: per-role slow-span threshold
+            from seaweedfs_tpu.stats import trace as _trace
+
+            _trace.set_slow_threshold_ms(slow_ms, role="master")
         self._grow_lock = threading.Lock()
         self._stop = threading.Event()
         # cluster membership (filers/brokers announce themselves) + admin lock

@@ -43,6 +43,7 @@ class VolumeServer:
         max_volume_count: int = 100,
         security: SecurityConfig | None = None,
         local_socket: str | None = None,
+        slow_ms: float | None = None,
     ) -> None:
         # -mserver may list several masters; heartbeats follow the raft
         # leader hint (`volume_grpc_client_to_master.go` re-dial on redirect)
@@ -57,6 +58,10 @@ class VolumeServer:
         if self.security.white_list:
             self.service.guard = Guard(self.security.white_list)
         self.service.enable_metrics("volume")
+        if slow_ms is not None:  # -slowMs: per-role slow-span threshold
+            from seaweedfs_tpu.stats import trace as _trace
+
+            _trace.set_slow_threshold_ms(slow_ms, role="volume")
         self.store: Store | None = None
         self._dirs = directories
         self._host = host

@@ -36,10 +36,21 @@ def _iso8601(ts: float) -> str:
 
 class WebDavServer:
     def __init__(self, filer_url: str, host: str = "127.0.0.1",
-                 port: int = 7333, read_only: bool = False) -> None:
+                 port: int = 7333, read_only: bool = False,
+                 slow_ms: float | None = None) -> None:
         self.fc = FilerClient(filer_url)
         self.read_only = read_only
         self.service = HTTPService(host, port)
+        # request metrics + tracing + the /debug and /debug/pprof surface,
+        # like every other role. The WebDAV namespace is a catch-all (any
+        # path may be a file), so /metrics stays off the main port
+        # (serve_route=False) and the /debug routes, registered first,
+        # shadow same-named file paths — the filer's convention.
+        self.service.enable_metrics("webdav", serve_route=False)
+        if slow_ms is not None:  # -slowMs: per-role slow-span threshold
+            from seaweedfs_tpu.stats import trace as trace_mod
+
+            trace_mod.set_slow_threshold_ms(slow_ms, role="webdav")
         # path -> (token, expiry). Locks are actually enforced: mutations on
         # a locked path demand the token via the If header, LOCK on a live
         # lock is refused (423), and entries expire at the advertised

@@ -242,27 +242,12 @@ def cmd_volume_status(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(out) if out else f"volume {vid} not found"
 
 
-@command("cluster.trace",
-         "[-limit n] [-minMs n] [-include url,url] — fetch /debug/traces"
-         " from master + volume servers + filers (+ -include'd endpoints,"
-         " e.g. s3 gateways) and render merged span trees")
-def cmd_cluster_trace(env: CommandEnv, args: list[str]) -> str:
-    """Cluster-wide trace view: every node keeps its own span ring; this
-    merges them by trace id into one tree per request (the multi-process
-    counterpart of the single-process ring in stats/trace.py). S3 gateways
-    don't register with the master, so pass them via -include to get the
-    [s3] root spans in a multi-process cluster."""
-    flags = parse_flags(args)
-    try:
-        limit = int(flags.get("limit", 10))
-        min_ms = float(flags.get("minMs", 0))
-    except ValueError:
-        raise ShellError(
-            "usage: cluster.trace [-limit n] [-minMs n] [-include url,url]"
-        )
-
+def _discover_endpoints(env: CommandEnv, include: str = "") -> set[str]:
+    """Every /debug-capable node the shell can see: the master, each
+    volume server in the topology, registered filers, plus -include'd
+    urls (s3 gateways don't register with the master)."""
     endpoints = {env.master_url}
-    for extra in flags.get("include", "").split(","):
+    for extra in include.split(","):
         extra = extra.strip().rstrip("/")
         if extra:
             if not extra.startswith(("http://", "https://")):
@@ -281,6 +266,33 @@ def cmd_cluster_trace(env: CommandEnv, args: list[str]) -> str:
         pass
     if env.filer_url:
         endpoints.add(env.filer_url)
+    return endpoints
+
+
+@command("cluster.trace",
+         "[-limit n] [-minMs n] [-include url,url] — fetch /debug/traces"
+         " from master + volume servers + filers (+ -include'd endpoints,"
+         " e.g. s3 gateways) and render merged span trees")
+def cmd_cluster_trace(env: CommandEnv, args: list[str]) -> str:
+    """Cluster-wide trace view: every node keeps its own span ring; this
+    merges them by trace id into one tree per request (the multi-process
+    counterpart of the single-process ring in stats/trace.py). S3 gateways
+    don't register with the master, so pass them via -include to get the
+    [s3] root spans in a multi-process cluster."""
+    import math
+
+    flags = parse_flags(args)
+    try:
+        limit = int(flags.get("limit", 10))
+        min_ms = float(flags.get("minMs", 0))
+        if not math.isfinite(min_ms):
+            raise ValueError(min_ms)
+    except ValueError:
+        raise ShellError(
+            "usage: cluster.trace [-limit n] [-minMs n] [-include url,url]"
+        )
+
+    endpoints = _discover_endpoints(env, flags.get("include", ""))
 
     # trace_id -> span_id -> span; single-process clusters share one ring,
     # so keying by span id dedups identical copies from every endpoint
@@ -353,6 +365,94 @@ def cmd_cluster_trace(env: CommandEnv, args: list[str]) -> str:
     if shown == 0:
         out_lines.append("no traces recorded (min_ms too high?)")
     return "\n".join(out_lines)
+
+
+@command("cluster.profile",
+         "[-seconds n] [-hz n] [-include url,url] [-out path] — sample every"
+         " node's Python stacks concurrently (/debug/pprof/profile) and"
+         " merge them, role-prefixed, into one flamegraph-ready"
+         " collapsed-stack output")
+def cmd_cluster_profile(env: CommandEnv, args: list[str]) -> str:
+    """Cluster-wide CPU attribution: every reachable node samples itself
+    for the same window (the fetches run concurrently — the window is
+    wall-clock, so serial fetches would profile different moments), and
+    the collapsed stacks merge under a per-role root (`master;...`,
+    `volume;...`) so one flamegraph splits by role first. Several roles
+    sharing one interpreter dedup by process identity — their stacks merge
+    once, under a combined `role+role;` root, instead of counting the same
+    process once per role. Feed the -out file to flamegraph.pl or
+    speedscope as-is."""
+    import math
+    import threading as _threading
+
+    flags = parse_flags(args)
+    try:
+        seconds = float(flags.get("seconds", 2))
+        hz = int(flags.get("hz", 100))
+        if not math.isfinite(seconds) or seconds <= 0:
+            raise ValueError(seconds)
+    except ValueError:
+        raise ShellError(
+            "usage: cluster.profile [-seconds n] [-hz n] [-include url,url]"
+            " [-out path]"
+        )
+
+    endpoints = _discover_endpoints(env, flags.get("include", ""))
+    results: dict[str, dict] = {}
+
+    def fetch(ep: str) -> None:
+        try:
+            results[ep] = env.get(
+                f"{ep}/debug/pprof/profile?seconds={seconds:g}&hz={hz}"
+                "&format=json",
+                timeout=seconds + 30,
+            )
+        except Exception:
+            pass  # an unreachable node must not sink the cluster view
+
+    threads = [
+        _threading.Thread(target=fetch, args=(ep,), daemon=True)
+        for ep in sorted(endpoints)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if not results:
+        raise ShellError("no /debug/pprof/profile endpoint reachable")
+
+    from seaweedfs_tpu.stats import profiler as prof_mod
+
+    # group endpoints by process identity: in a single-process cluster
+    # every role's endpoint sampled the SAME interpreter, and merging each
+    # copy would multiply sample counts and attribute every role's threads
+    # to every role (cluster.trace's span-id dedup, process-level)
+    by_proc: dict[str, list[str]] = {}
+    for ep in sorted(results):
+        by_proc.setdefault(results[ep].get("proc") or ep, []).append(ep)
+    merged: dict[str, int] = {}
+    total_samples = 0
+    for token in sorted(by_proc):
+        eps = by_proc[token]
+        roles = sorted({results[ep].get("role") or "node" for ep in eps})
+        best = max(eps, key=lambda ep: int(results[ep].get("samples", 0)))
+        out = results[best]
+        prof_mod.merge_collapsed(
+            merged, out.get("stacks", {}), prefix="+".join(roles)
+        )
+        total_samples += int(out.get("samples", 0))
+    body = prof_mod.render_collapsed(merged)
+    header = (
+        f"profiled {len(results)}/{len(endpoints)} endpoint(s)"
+        f" ({len(by_proc)} process(es)) for"
+        f" {seconds:g}s @ {hz}Hz: {total_samples} samples,"
+        f" {len(merged)} distinct stacks"
+    )
+    if "out" in flags:
+        with open(flags["out"], "w") as f:
+            f.write(body + "\n")
+        return header + f"\ncollapsed stacks written to {flags['out']}"
+    return header + "\n" + body
 
 
 # --- mq.* (`weed/shell/command_mq_topic_list.go` etc.) -----------------------

@@ -45,12 +45,26 @@ FILER_HASH_SECONDS = "SeaweedFS_filer_hash_seconds"
 _local = threading.local()
 
 _slow_threshold_s = float(os.environ.get("SEAWEEDFS_TPU_SLOW_MS", "1000")) / 1000.0
+# per-role overrides (a filer serving long directory scans can run a laxer
+# threshold than the volume data plane in the same process) — set by each
+# server's -slowMs flag via set_slow_threshold_ms(ms, role=...)
+_slow_threshold_roles: dict[str, float] = {}
 
 
-def set_slow_threshold_ms(ms: float) -> None:
-    """Server spans slower than this are logged via glog (0 disables)."""
+def set_slow_threshold_ms(ms: float, role: str | None = None) -> None:
+    """Server spans slower than this are logged via glog (0 disables).
+    With role=None sets the process default (the SEAWEEDFS_TPU_SLOW_MS
+    env var's knob); with a role, overrides it for that role's spans only
+    (each server entrypoint's -slowMs flag)."""
     global _slow_threshold_s
-    _slow_threshold_s = ms / 1000.0
+    if role is None:
+        _slow_threshold_s = ms / 1000.0
+    else:
+        _slow_threshold_roles[role] = ms / 1000.0
+
+
+def slow_threshold_s(role: str | None = None) -> float:
+    return _slow_threshold_roles.get(role, _slow_threshold_s)
 
 
 def _new_id() -> str:
@@ -125,6 +139,17 @@ class TraceCollector:
         self._ring: collections.deque[Span] = collections.deque(maxlen=max_spans)
         self._inflight: dict[str, Span] = {}
         self._lock = threading.Lock()
+        # self-observability (SeaweedFS_stats_trace_*): how many spans this
+        # ring recorded and how many it LOST (eviction under churn, unkept
+        # noise) — the losses cluster.trace can't see from the ring alone
+        self.spans_total = 0
+        self.dropped_total = 0
+
+    def _append_locked(self, span: Span) -> None:
+        if len(self._ring) == self.max_spans:
+            self.dropped_total += 1  # deque eviction is silent; count it
+        self._ring.append(span)
+        self.spans_total += 1
 
     # --- span lifecycle -------------------------------------------------------
     def start_span(
@@ -165,7 +190,9 @@ class TraceCollector:
         with self._lock:
             self._inflight.pop(span.span_id, None)
             if keep:
-                self._ring.append(span)
+                self._append_locked(span)
+            else:
+                self.dropped_total += 1
         if getattr(_local, "ctx", None) == (span.trace_id, span.span_id):
             _local.ctx = span._prev_ctx
 
@@ -233,7 +260,7 @@ def record_span(name: str, role: str | None = None,
     sp.duration = max(0.0, duration)
     sp.status = "ok"
     with _collector._lock:
-        _collector._ring.append(sp)
+        _collector._append_locked(sp)
     return sp
 
 
@@ -285,9 +312,10 @@ def end_server_span(span: Span, status_code: int) -> None:
     # slow-request logging is a SERVER-span concern only: kernel spans
     # (a 30s EC destripe) and internal-op spans are slow by design and
     # already visible under the enclosing request span
+    threshold = slow_threshold_s(span.role)
     if (
-        _slow_threshold_s > 0
-        and span.duration >= _slow_threshold_s
+        threshold > 0
+        and span.duration >= threshold
         and not span.attrs.get("long_poll")  # slow by design
     ):
         glog.warning(
@@ -356,3 +384,40 @@ def kernel_span(name: str, family: str, kernel: str, nbytes: int = 0,
         family, str(sp.attrs.get("kernel") or kernel), dt,
         int(sp.attrs.get("bytes") or 0),
     )
+
+
+# --- trace-ring self-metrics --------------------------------------------------
+TRACE_SELF_FAMILIES = (
+    "SeaweedFS_stats_trace_spans_total",
+    "SeaweedFS_stats_trace_dropped_total",
+    "SeaweedFS_stats_trace_inflight",
+)
+
+
+def _self_metrics_lines() -> list[str]:
+    """The ring's own health on /metrics: recorded spans, LOST spans
+    (eviction under churn + unkept noise), and the in-flight count — so
+    the observability layer can see its own losses instead of silently
+    presenting a churned-out ring as "no traces"."""
+    with _collector._lock:
+        spans = _collector.spans_total
+        dropped = _collector.dropped_total
+        inflight = len(_collector._inflight)
+    return [
+        "# HELP SeaweedFS_stats_trace_spans_total spans recorded into the"
+        " trace ring",
+        "# TYPE SeaweedFS_stats_trace_spans_total counter",
+        f"SeaweedFS_stats_trace_spans_total {spans:g}",
+        "# HELP SeaweedFS_stats_trace_dropped_total spans lost to ring"
+        " eviction or dropped as unsampled noise",
+        "# TYPE SeaweedFS_stats_trace_dropped_total counter",
+        f"SeaweedFS_stats_trace_dropped_total {dropped:g}",
+        "# HELP SeaweedFS_stats_trace_inflight spans currently open",
+        "# TYPE SeaweedFS_stats_trace_inflight gauge",
+        f"SeaweedFS_stats_trace_inflight {inflight:g}",
+    ]
+
+
+default_registry().register_collector(
+    _self_metrics_lines, names=TRACE_SELF_FAMILIES
+)

@@ -29,6 +29,7 @@ import json
 import os
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -62,6 +63,33 @@ def _default_batch(backend: str) -> int:
     return DEFAULT_BATCH_DEVICE if backend == "jax" else DEFAULT_BATCH_HOST
 
 _QUEUE_DEPTH = 2
+
+# Per-stage pipeline attribution (RapidRAID's lesson — arXiv:1207.6744 —
+# is that a pipelined coder lives or dies by per-stage balance): each
+# batch contributes a busy observation (doing its stage's work) and a
+# wait observation (blocked on the bounded queues / buffer freelist), so
+# /metrics alone answers which stage is the bottleneck and at what
+# utilization (busy_sum / (busy_sum + wait_sum)). The write stage's busy
+# time includes blocking on the encode handle's parity (device drain).
+# The fused single-pass engine has no stages; it reports stage="fused".
+EC_PIPELINE_SECONDS = "SeaweedFS_volume_ec_pipeline_seconds"
+
+_pipeline_hist_cache = None
+
+
+def _pipeline_hist():
+    global _pipeline_hist_cache
+    hist = _pipeline_hist_cache  # GIL-atomic read; registry locks creation
+    if hist is None:
+        from seaweedfs_tpu.stats.metrics import default_registry
+
+        hist = default_registry().histogram(
+            EC_PIPELINE_SECONDS,
+            "per-batch busy vs queue-wait seconds per EC pipeline stage",
+            ("stage", "state"),
+        )
+        _pipeline_hist_cache = hist
+    return hist
 
 
 def _ensure_buf(buf, need: int, cap: int) -> np.ndarray:
@@ -212,7 +240,8 @@ def _run_pipeline(jobs, read_job, encode_job, write_job) -> None:
     """reader thread -> encode (caller thread) -> writer thread, with
     bounded queues, a shared buffer freelist for backpressure, and a stop
     flag so a failure in any stage unwinds the other two instead of
-    deadlocking on a full/empty queue."""
+    deadlocking on a full/empty queue. Every batch feeds the per-stage
+    busy/wait histograms (EC_PIPELINE_SECONDS above)."""
     read_q: queue.Queue = queue.Queue(maxsize=_QUEUE_DEPTH)
     write_q: queue.Queue = queue.Queue(maxsize=_QUEUE_DEPTH)
     free: queue.Queue = queue.Queue()
@@ -220,6 +249,8 @@ def _run_pipeline(jobs, read_job, encode_job, write_job) -> None:
         free.put(None)  # buffer slots; reader sizes/reuses lazily
     stop = threading.Event()
     errors: list[BaseException] = []
+    hist = _pipeline_hist()
+    perf = time.perf_counter
 
     def _put(q: queue.Queue, item) -> bool:
         while not stop.is_set():
@@ -231,13 +262,21 @@ def _run_pipeline(jobs, read_job, encode_job, write_job) -> None:
         return False
 
     def reader():
+        o_wait = hist.labels("read", "wait")
+        o_busy = hist.labels("read", "busy")
         try:
             for job in jobs:
                 if stop.is_set():
                     return
+                t0 = perf()
                 slot = free.get()
+                t1 = perf()
                 buf = read_job(job, slot)
-                if not _put(read_q, (job, buf)):
+                t2 = perf()
+                ok = _put(read_q, (job, buf))
+                o_wait.observe((t1 - t0) + (perf() - t2))
+                o_busy.observe(t2 - t1)
+                if not ok:
                     return
         except BaseException as e:  # noqa: BLE001 - propagated below
             errors.append(e)
@@ -246,13 +285,19 @@ def _run_pipeline(jobs, read_job, encode_job, write_job) -> None:
             _put(read_q, None) or read_q.put(None)
 
     def writer():
+        o_wait = hist.labels("write", "wait")
+        o_busy = hist.labels("write", "busy")
         try:
             while True:
+                t0 = perf()
                 item = write_q.get()
+                t1 = perf()
                 if item is None:
                     return
                 job, buf, handle = item
                 write_job(job, buf, handle)
+                o_wait.observe(t1 - t0)
+                o_busy.observe(perf() - t1)
                 free.put(buf)
         except BaseException as e:  # noqa: BLE001
             errors.append(e)
@@ -267,13 +312,21 @@ def _run_pipeline(jobs, read_job, encode_job, write_job) -> None:
     wt = threading.Thread(target=writer, name="ec-writer", daemon=True)
     rt.start()
     wt.start()
+    o_wait = hist.labels("encode", "wait")
+    o_busy = hist.labels("encode", "busy")
     try:
         while True:
+            t0 = perf()
             item = read_q.get()
+            t1 = perf()
             if item is None:
                 break
             job, buf = item
-            write_q.put((job, buf, encode_job(job, buf)))
+            handle = encode_job(job, buf)
+            t2 = perf()
+            write_q.put((job, buf, handle))
+            o_wait.observe((t1 - t0) + (perf() - t2))
+            o_busy.observe(t2 - t1)
     except BaseException as e:  # noqa: BLE001 - e.g. device error mid-encode
         errors.append(e)
         stop.set()
@@ -370,9 +423,16 @@ def write_ec_files(
             with trace.kernel_span(
                 "ec.encode", trace.EC_ENCODE_SECONDS, "fused", nbytes=total
             ) as sp:
+                t0 = time.perf_counter()
                 fused_ok = _write_ec_files_fused(
                     base_file_name, large_block_size, small_block_size
                 )
+                if fused_ok:
+                    # single-pass engine: no read/encode/write stages exist,
+                    # but the family must still account for the bytes' time
+                    _pipeline_hist().labels("fused", "busy").observe(
+                        time.perf_counter() - t0
+                    )
                 if not fused_ok:
                     # host can't run it: the pipeline span below carries
                     # the bytes, and the probe must not count as a fused
@@ -553,6 +613,7 @@ def _rebuild_ec_files(
             except Exception:  # pragma: no cover - import-gated
                 lib = None
             if lib is not None and hasattr(lib, "gf256_matmul_fds"):
+                t0 = time.perf_counter()
                 try:
                     rc = lib.gf256_matmul_fds(
                         matrix.tobytes(),
@@ -567,6 +628,9 @@ def _rebuild_ec_files(
                     writers.abort()
                     raise
                 if rc == 0:
+                    _pipeline_hist().labels("fused", "busy").observe(
+                        time.perf_counter() - t0
+                    )
                     writers.dirty = True
                     writers.close()
                     return missing

@@ -326,6 +326,12 @@ class TestMetricNameLint:
         assert "SeaweedFS_volume_fastlane_requests_total" in collector_names
         assert "SeaweedFS_master_volume_size_bytes" in collector_names
         assert "SeaweedFS_http_request_total" in kinds
+        # PR-3: pipeline attribution + the self-observability collectors
+        assert "SeaweedFS_volume_ec_pipeline_seconds" in kinds
+        assert kinds["SeaweedFS_volume_ec_pipeline_seconds"] == "histogram"
+        assert "SeaweedFS_stats_trace_spans_total" in collector_names
+        assert "SeaweedFS_stats_trace_dropped_total" in collector_names
+        assert "SeaweedFS_stats_profile_samples_total" in collector_names
 
     def test_lint_catches_violations(self):
         tool = self._tool()

@@ -40,13 +40,15 @@ def collect() -> tuple[dict[str, str], list[str]]:
     from seaweedfs_tpu.server.httpd import HTTPService
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume import VolumeServer
-    from seaweedfs_tpu.stats import default_registry, trace
+    from seaweedfs_tpu.stats import default_registry, profiler, trace
     from seaweedfs_tpu.storage import crc
+    from seaweedfs_tpu.storage.erasure_coding import encoder as ec_encoder
 
     # force the lazily-registered families into the registry
     for fam in (trace.EC_ENCODE_SECONDS, trace.EC_DECODE_SECONDS,
                 trace.FILER_HASH_SECONDS, crc.VOLUME_CRC32C_SECONDS):
         trace._kernel_metrics(fam)
+    ec_encoder._pipeline_hist()  # SeaweedFS_volume_ec_pipeline_seconds
     svc = HTTPService(port=0)  # never started: registration side effect only
     svc.enable_metrics("lint", serve_route=False)
     reg = default_registry()
@@ -54,9 +56,13 @@ def collect() -> tuple[dict[str, str], list[str]]:
                 "failed pushes to the metrics gateway", ("role",))
     with reg._lock:
         kinds = {name: m.kind for name, m in reg._metrics.items()}
+    # collector-declared families: the master/volume scrape-time sources
+    # plus the PR-3 self-observability collectors (trace ring, profiler)
     collector_names = sorted(
         set(MasterServer.MASTER_METRIC_FAMILIES)
         | set(VolumeServer.FL_FAMILIES)
+        | set(trace.TRACE_SELF_FAMILIES)
+        | set(profiler.PROFILER_FAMILIES)
     )
     return kinds, collector_names
 
