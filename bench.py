@@ -109,6 +109,50 @@ def ec_pipeline_summary_from_metrics(text: str) -> dict:
     return out
 
 
+def request_rates_summary_from_history(hist, window_sec: float,
+                                       now: float | None = None,
+                                       eng=None) -> dict:
+    """Cluster-level request view off the PR-4 history ring: per-role/
+    method HTTP req/s and per-op fastlane req/s + bytes/s over the window
+    covering the bench run, plus the alerts that fired during it — so
+    BENCH records what the serving surface sustained (and whether anything
+    alarmed) next to the kernel attribution."""
+    import time as _time
+
+    now = _time.time() if now is None else now
+    out: dict = {
+        "window_seconds": round(window_sec, 1),
+        "http_req_s": {},
+        "fastlane_ops": {},
+    }
+    for labels, rate in hist.rates(
+        "SeaweedFS_http_request_total", window_sec, now
+    ):
+        if not rate:
+            continue
+        key = f"{labels.get('role', '?')}:{labels.get('method', '?')}"
+        out["http_req_s"][key] = round(
+            out["http_req_s"].get(key, 0.0) + rate, 2
+        )
+    for fam, field in (
+        ("SeaweedFS_volume_fastlane_requests_total", "req_s"),
+        ("SeaweedFS_volume_fastlane_bytes_total", "bytes_s"),
+    ):
+        for labels, rate in hist.rates(fam, window_sec, now):
+            if not rate:
+                continue
+            op = out["fastlane_ops"].setdefault(labels.get("op", "?"), {})
+            op[field] = round(op.get(field, 0.0) + rate, 2)
+    if eng is None:
+        from seaweedfs_tpu.stats import alerts as alerts_mod
+
+        eng = alerts_mod.engine()
+    snap = eng.snapshot()
+    out["alerts_fired"] = snap["fired_events"]
+    out["alerts_firing"] = snap["firing"]
+    return out
+
+
 def build_volume(staging: str, total_bytes: int = GiB) -> str:
     """A real volume (.dat/.idx via the storage engine) of ~total_bytes."""
     from seaweedfs_tpu.storage.needle import Needle
@@ -793,6 +837,7 @@ def bench_hash_1m_4k(
 
 
 def main() -> None:
+    run_t0 = time.time()
     os.makedirs(BENCH_DIR, exist_ok=True)
     staging_base = build_volume(os.path.join(BENCH_DIR, "staging"))
 
@@ -902,6 +947,19 @@ def main() -> None:
         )
     except Exception as e:
         detail["ec_pipeline"] = {"error": str(e)[:120]}
+    # PR-4: per-op request/byte rates from the history window covering this
+    # run, plus the alerts that fired while it ran (the servers the benches
+    # started fed the process-wide ring the whole time)
+    try:
+        from seaweedfs_tpu.stats import history as history_mod
+
+        hist = history_mod.default_history()
+        hist.scrape_once()  # close the window at the run's tail
+        detail["request_rates"] = request_rates_summary_from_history(
+            hist, time.time() - run_t0 + hist.interval
+        )
+    except Exception as e:
+        detail["request_rates"] = {"error": str(e)[:120]}
     # PR-2: the fastlane engine's own series, captured while the small-file
     # cluster was still alive (its collector unregisters on server stop)
     fl = detail.get("small_files", {}).get("fastlane")

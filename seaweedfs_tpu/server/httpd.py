@@ -163,6 +163,28 @@ class HTTPService:
                     reg.render().encode(),
                     content_type="text/plain; version=0.0.4; charset=utf-8",
                 )
+        # process identity: start time (the history ring's restart signal)
+        # and a build_info series per role — cluster.top's uptime/version
+        import seaweedfs_tpu
+        from seaweedfs_tpu.stats import alerts as alerts_mod
+        from seaweedfs_tpu.stats import history as history_mod
+        from seaweedfs_tpu.stats.metrics import PROCESS_START_TIME
+
+        # whole seconds: an integer renders exactly in the exposition
+        # (uptime math off a digit-clipped float put starts in the future)
+        reg.gauge(
+            "SeaweedFS_process_start_time_seconds",
+            "unix time this process started (counter-reset detection)",
+        ).set(int(PROCESS_START_TIME))
+        reg.gauge(
+            "SeaweedFS_build_info",
+            "constant 1, labeled with the build version and server role",
+            ("version", "role"),
+        ).labels(seaweedfs_tpu.__version__, role).set(1)
+        # the self-scraping history ring + alert engine start with the
+        # first metered server in the process (library imports pay nothing)
+        history_mod.default_history().start()
+        alerts_mod.engine()
         self.enable_tracing(role)
 
     def enable_tracing(self, role: str) -> None:
@@ -416,7 +438,10 @@ def _register_debug_routes(service: "HTTPService") -> None:
     surface: `/debug/pprof/profile` (?seconds= & ?hz=; collapsed-stack
     text, ?format=json for the structured form), `/debug/pprof/threads`
     (instant all-thread dump), `/debug/pprof/device` (jax.profiler trace
-    tarball; 501 without jax). Registered by enable_tracing, so on
+    tarball; 501 without jax), plus the PR-4 history/alert surface:
+    `/debug/metrics/history` (?family= & ?window= & ?samples=; the
+    self-scraped ring with windowed counter rates) and `/debug/alerts`
+    (?window=; every rule's firing state). Registered by enable_tracing, so on
     catch-all namespaces (the filer) they precede — and shadow —
     same-named file paths. Malformed numeric query params are a 400 with
     a JSON error, never an unhandled 500."""
@@ -480,6 +505,59 @@ def _register_debug_routes(service: "HTTPService") -> None:
             "role": service.trace_role or service.metrics_role,
             "threads": prof_mod.threads_dump(),
         })
+
+    @service.route("GET", r"/debug/metrics/history")
+    def debug_metrics_history(req: Request) -> Response:
+        import math
+
+        from seaweedfs_tpu.stats import history as history_mod
+        from seaweedfs_tpu.stats import profiler as prof_mod
+
+        hist = history_mod.default_history()
+        try:
+            window = float(req.query.get("window", hist.retention_seconds))
+            max_samples = int(req.query.get("samples", 16))
+            if not math.isfinite(window) or window <= 0:
+                raise ValueError(window)
+        except ValueError:
+            return Response(
+                {"error": "window/samples must be positive finite numbers"},
+                400,
+            )
+        hist.ensure_fresh()
+        return Response({
+            "interval": hist.interval,
+            "slots": hist.slots,
+            "window": window,
+            "scrapes": hist.scrapes_total,
+            "proc": prof_mod.PROCESS_TOKEN,  # cluster.top dedup key
+            "series": hist.snapshot(
+                family=req.query.get("family") or None,
+                window=window,
+                max_samples=max(0, max_samples),
+            ),
+        })
+
+    @service.route("GET", r"/debug/alerts")
+    def debug_alerts(req: Request) -> Response:
+        import math
+
+        from seaweedfs_tpu.stats import alerts as alerts_mod
+        from seaweedfs_tpu.stats import profiler as prof_mod
+
+        window = req.query.get("window")
+        try:
+            if window is not None:
+                window = float(window)
+                if not math.isfinite(window) or window <= 0:
+                    raise ValueError(window)
+        except ValueError:
+            return Response(
+                {"error": "window must be a positive finite number"}, 400
+            )
+        out = alerts_mod.engine().status(window=window)
+        out["proc"] = prof_mod.PROCESS_TOKEN
+        return Response(out)
 
     @service.route("GET", r"/debug/pprof/device")
     def debug_pprof_device(req: Request) -> Response:

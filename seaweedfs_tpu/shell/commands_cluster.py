@@ -52,10 +52,12 @@ def _fmt_gb(n: float) -> str:
 
 
 @command("cluster.check",
-         "[-fail] [-capacityPct 90] — health dashboard: replica/EC health,"
-         " per-node disk + heartbeat freshness, volumes near the size cap,"
-         " read-only volumes, fastlane native-vs-proxied hit rate."
-         " -fail exits nonzero when any problem is found (scripting)")
+         "[-fail] [-capacityPct 90] [-include url,url] — health dashboard:"
+         " replica/EC health, per-node disk + heartbeat freshness, volumes"
+         " near the size cap, read-only volumes, fastlane"
+         " native-vs-proxied hit rate, firing alerts (every discovered"
+         " endpoint + -include'd gateways). -fail exits nonzero when any"
+         " problem is found or any critical alert fires (scripting)")
 def cmd_cluster_check(env: CommandEnv, args: list[str]) -> str:
     """Scrapes the PR-2 Prometheus series (`SeaweedFS_master_*` topology
     gauges off the master, `SeaweedFS_volume_fastlane_*` + disk gauges off
@@ -86,6 +88,20 @@ def cmd_cluster_check(env: CommandEnv, args: list[str]) -> str:
                 f"({', '.join(h.id for h in holders)})"
             )
 
+    # firing alerts (PR-4): every node's /metrics carries the alert
+    # engine's SeaweedFS_alerts_firing gauge; criticals are problems
+    # (so -fail trips on an error storm or a stale heartbeat between
+    # manual checks), warnings render informationally. Dedup by
+    # (alert, severity): single-process clusters share one engine.
+    firing_alerts: dict[str, str] = {}
+
+    def note_alerts(samples: list) -> None:
+        for name, labels, value in samples:
+            if name == "SeaweedFS_alerts_firing" and value > 0:
+                alert = labels.get("alert", "?")
+                if firing_alerts.get(alert) != "critical":
+                    firing_alerts[alert] = labels.get("severity", "warning")
+
     # --- master gauges: size limit, staleness, readonly, EC shard health ---
     size_limit = 30 * 1024**3
     stale_nodes: dict[str, float] = {}
@@ -98,6 +114,7 @@ def cmd_cluster_check(env: CommandEnv, args: list[str]) -> str:
     except Exception as e:
         msamples = []
         problems.append(f"master metrics unreachable: {e}")
+    note_alerts(msamples)
     for name, labels, value in msamples:
         if name == "SeaweedFS_master_volume_size_limit_bytes":
             size_limit = value or size_limit
@@ -156,6 +173,7 @@ def cmd_cluster_check(env: CommandEnv, args: list[str]) -> str:
             lines.append(f"node {sv.id} dc={sv.dc} rack={sv.rack}:"
                          " metrics unreachable")
             continue
+        note_alerts(vsamples)
         for name, labels, value in vsamples:
             # the `server` label scopes series to this node when several
             # servers share one process registry (test clusters)
@@ -180,6 +198,31 @@ def cmd_cluster_check(env: CommandEnv, args: list[str]) -> str:
             f"fastlane native {rate}"
             f" ({native:g} native / {proxied:g} proxied)"
         )
+
+    # alerts fire per PROCESS: in a multi-process cluster the filer/s3
+    # engines are separate — poll every OTHER discovered endpoint's
+    # /debug/alerts too (the filer's catch-all main port has no /metrics,
+    # but its debug routes shadow file paths)
+    seen = {env.master_url} | {sv.http for sv in servers}
+    for ep in sorted(_discover_endpoints(env, flags.get("include", ""),
+                                         servers=servers) - seen):
+        try:
+            out = env.get(f"{ep}/debug/alerts", timeout=10)
+        except Exception:
+            continue  # an unreachable gateway must not sink the check
+        for a in out.get("alerts", []):
+            if a.get("firing"):
+                name = a.get("name", "?")
+                if firing_alerts.get(name) != "critical":
+                    firing_alerts[name] = a.get("severity", "warning")
+
+    for alert, sev in sorted(firing_alerts.items()):
+        if sev == "critical":
+            problems.append(
+                f"alert {alert} firing [critical] (see /debug/alerts)"
+            )
+        else:
+            lines.append(f"warning: alert {alert} firing (see /debug/alerts)")
 
     if problems:
         lines.append(f"{len(problems)} problem(s):")
@@ -242,10 +285,12 @@ def cmd_volume_status(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(out) if out else f"volume {vid} not found"
 
 
-def _discover_endpoints(env: CommandEnv, include: str = "") -> set[str]:
+def _discover_endpoints(env: CommandEnv, include: str = "",
+                        servers: list | None = None) -> set[str]:
     """Every /debug-capable node the shell can see: the master, each
     volume server in the topology, registered filers, plus -include'd
-    urls (s3 gateways don't register with the master)."""
+    urls (s3 gateways don't register with the master). Pass `servers` to
+    reuse an already-fetched topology snapshot instead of re-fetching."""
     endpoints = {env.master_url}
     for extra in include.split(","):
         extra = extra.strip().rstrip("/")
@@ -254,7 +299,7 @@ def _discover_endpoints(env: CommandEnv, include: str = "") -> set[str]:
                 extra = "http://" + extra
             endpoints.add(extra)
     try:
-        for sv in env.servers():
+        for sv in (env.servers() if servers is None else servers):
             endpoints.add(sv.http)
     except Exception:
         pass
@@ -267,6 +312,23 @@ def _discover_endpoints(env: CommandEnv, include: str = "") -> set[str]:
     if env.filer_url:
         endpoints.add(env.filer_url)
     return endpoints
+
+
+def _fetch_concurrently(endpoints, fetch) -> None:
+    """Run fetch(ep) for every endpoint on daemon threads and join. The
+    shared fan-out under cluster.profile / cluster.top: each fetch
+    swallows its own failures (an unreachable node must not sink the
+    cluster view) and the wall-clock window stays simultaneous."""
+    import threading as _threading
+
+    threads = [
+        _threading.Thread(target=fetch, args=(ep,), daemon=True)
+        for ep in sorted(endpoints)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
 
 
 @command("cluster.trace",
@@ -383,7 +445,6 @@ def cmd_cluster_profile(env: CommandEnv, args: list[str]) -> str:
     process once per role. Feed the -out file to flamegraph.pl or
     speedscope as-is."""
     import math
-    import threading as _threading
 
     flags = parse_flags(args)
     try:
@@ -408,16 +469,9 @@ def cmd_cluster_profile(env: CommandEnv, args: list[str]) -> str:
                 timeout=seconds + 30,
             )
         except Exception:
-            pass  # an unreachable node must not sink the cluster view
+            pass
 
-    threads = [
-        _threading.Thread(target=fetch, args=(ep,), daemon=True)
-        for ep in sorted(endpoints)
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    _fetch_concurrently(endpoints, fetch)
     if not results:
         raise ShellError("no /debug/pprof/profile endpoint reachable")
 
@@ -453,6 +507,203 @@ def cmd_cluster_profile(env: CommandEnv, args: list[str]) -> str:
             f.write(body + "\n")
         return header + f"\ncollapsed stacks written to {flags['out']}"
     return header + "\n" + body
+
+
+def _fmt_bytes_rate(n: float | None) -> str:
+    if not n:
+        return "-"
+    for unit, div in (("GB/s", 1e9), ("MB/s", 1e6), ("KB/s", 1e3)):
+        if n >= div:
+            return f"{n / div:.1f}{unit}"
+    return f"{n:.0f}B/s"
+
+
+def _fmt_uptime(sec: float | None) -> str:
+    if sec is None or sec < 0:
+        return "-"
+    sec = int(sec)
+    if sec >= 86400:
+        return f"{sec // 86400}d{(sec % 86400) // 3600}h"
+    if sec >= 3600:
+        return f"{sec // 3600}h{(sec % 3600) // 60}m"
+    if sec >= 60:
+        return f"{sec // 60}m{sec % 60}s"
+    return f"{sec}s"
+
+
+@command("cluster.top",
+         "[-once] [-interval 2] [-window 60] [-count n] [-include url,url]"
+         " — live dashboard: per-role request rates, 5xx%, p99, bytes/s,"
+         " uptime and firing alerts from every node's history ring."
+         " -once renders a single frame and returns")
+def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
+    """The rates-over-time view cluster.check can't give: every reachable
+    node serves its self-scraped history ring (/debug/metrics/history)
+    and alert state (/debug/alerts); this fetches all of them
+    CONCURRENTLY, dedups endpoints sharing one process (single-process
+    clusters expose every role's series at every port), aggregates
+    per-role request/error/byte rates, interpolates p99 from windowed
+    bucket rates, and renders one table plus the firing alerts. Without
+    -once it redraws every -interval seconds until -count frames (or
+    Ctrl-C)."""
+    import math
+    import time as _time
+
+    from seaweedfs_tpu.stats.history import quantile_from_bucket_rates
+
+    flags = parse_flags(args)
+    try:
+        interval = float(flags.get("interval", 2.0))
+        window = float(flags.get("window", 60.0))
+        count = int(flags.get("count", 0))
+        if not math.isfinite(interval) or interval <= 0:
+            raise ValueError(interval)
+        if not math.isfinite(window) or window <= 0:
+            raise ValueError(window)
+    except ValueError:
+        raise ShellError(
+            "usage: cluster.top [-once] [-interval n] [-window n]"
+            " [-count n] [-include url,url]"
+        )
+    once = "once" in flags
+
+    def frame() -> str:
+        endpoints = _discover_endpoints(env, flags.get("include", ""))
+        hist_res: dict[str, dict] = {}
+        alert_res: dict[str, dict] = {}
+
+        def fetch(ep: str) -> None:
+            try:  # samples=0: rates + last values only, no raw points
+                hist_res[ep] = env.get(
+                    f"{ep}/debug/metrics/history?window={window:g}&samples=0",
+                    timeout=10,
+                )
+            except Exception:
+                return  # an unreachable node must not sink the view
+            try:
+                alert_res[ep] = env.get(
+                    f"{ep}/debug/alerts?window={window:g}", timeout=10
+                )
+            except Exception:
+                pass
+
+        _fetch_concurrently(endpoints, fetch)
+        if not hist_res:
+            raise ShellError("no /debug/metrics/history endpoint reachable")
+
+        # one representative endpoint per process (cluster.profile's dedup)
+        by_proc: dict[str, str] = {}
+        for ep in sorted(hist_res):
+            by_proc.setdefault(hist_res[ep].get("proc") or ep, ep)
+
+        now = _time.time()
+        roles: dict[str, dict] = {}
+
+        def row(role: str) -> dict:
+            return roles.setdefault(role, {
+                "req_s": 0.0, "err_s": 0.0, "bytes_s": 0.0,
+                "buckets": {}, "uptime": None, "version": None,
+            })
+
+        for token in sorted(by_proc):
+            series = hist_res[by_proc[token]].get("series", [])
+            start_ts = None
+            proc_roles: set[str] = set()
+            version = None
+            for s in series:
+                fam = s.get("family", "")
+                labels = s.get("labels", {})
+                rate = s.get("rate")
+                if fam == "SeaweedFS_http_request_total" and rate:
+                    r = row(labels.get("role", "?"))
+                    r["req_s"] += rate
+                    if labels.get("code", "").startswith("5"):
+                        r["err_s"] += rate
+                elif fam == "SeaweedFS_http_request_seconds_bucket" and rate:
+                    le = labels.get("le", "")
+                    bound = float("inf") if le == "+Inf" else float(le)
+                    b = row(labels.get("role", "?"))["buckets"]
+                    b[bound] = b.get(bound, 0.0) + rate
+                elif fam == "SeaweedFS_volume_fastlane_bytes_total" and rate:
+                    row("volume")["bytes_s"] += rate
+                elif fam == "SeaweedFS_process_start_time_seconds":
+                    start_ts = s.get("last")
+                elif fam == "SeaweedFS_build_info":
+                    proc_roles.add(labels.get("role", "?"))
+                    version = labels.get("version")
+            for role in proc_roles:
+                r = row(role)
+                if start_ts:
+                    up = now - start_ts
+                    r["uptime"] = max(r["uptime"] or 0.0, up)
+                if version and not r["version"]:
+                    r["version"] = version
+
+        firing: dict[str, dict] = {}
+        seen_procs: set[str] = set()
+        for ep in sorted(alert_res):
+            token = alert_res[ep].get("proc") or ep
+            if token in seen_procs:
+                continue
+            seen_procs.add(token)
+            for a in alert_res[ep].get("alerts", []):
+                if a.get("firing"):
+                    firing.setdefault(a["name"], a)
+
+        lines = [
+            f"cluster.top @ {env.master_url}  window={window:g}s  "
+            f"{len(by_proc)} process(es), {len(hist_res)} endpoint(s)",
+            f"{'role':<10} {'req/s':>9} {'5xx%':>7} {'p99 ms':>9}"
+            f" {'bytes/s':>10} {'uptime':>8}  version",
+        ]
+        for role in sorted(roles):
+            r = roles[role]
+            p99 = quantile_from_bucket_rates(r["buckets"], 0.99)
+            err_pct = (
+                f"{100.0 * r['err_s'] / r['req_s']:.1f}" if r["req_s"] else "-"
+            )
+            lines.append(
+                f"{role:<10} {r['req_s']:>9.1f} {err_pct:>7}"
+                f" {('n/a' if p99 is None else f'{p99 * 1e3:.2f}'):>9}"
+                f" {_fmt_bytes_rate(r['bytes_s']):>10}"
+                f" {_fmt_uptime(r['uptime']):>8}  {r['version'] or '-'}"
+            )
+        if not roles:
+            lines.append("(no rates yet — the history ring needs two"
+                         " scrapes inside the window)")
+        if firing:
+            lines.append(f"{len(firing)} alert(s) firing:")
+            for name in sorted(firing):
+                a = firing[name]
+                lines.append(
+                    f"  [{a.get('severity', '?')}] {name}:"
+                    f" {a.get('detail', '')}"
+                )
+        else:
+            lines.append("no alerts firing")
+        return "\n".join(lines)
+
+    if once:
+        return frame()
+    shown = 0
+    try:
+        while True:
+            # clear + home, like top(1); each frame re-discovers endpoints.
+            # A transient fetch failure (master restarting, network blip)
+            # renders as a frame and the watch keeps going — only Ctrl-C
+            # (or -count) ends it, like top(1).
+            try:
+                body = frame()
+            except ShellError as e:
+                body = f"cluster.top @ {env.master_url}: {e} (retrying)"
+            print("\x1b[2J\x1b[H" + body, flush=True)
+            shown += 1
+            if count > 0 and shown >= count:
+                break
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+    return f"cluster.top stopped after {shown} frame(s)"
 
 
 # --- mq.* (`weed/shell/command_mq_topic_list.go` etc.) -----------------------

@@ -1,0 +1,381 @@
+"""Declarative rate-based alerting over the metrics history ring.
+
+`cluster.check` can see a read-only volume; it cannot see an error-ratio
+climbing, a heartbeat going stale between manual checks, or a disk
+filling overnight. The `AlertEngine` evaluates a fixed set of declarative
+rules (stats/history.py windowed rates + freshest gauge values) after
+every history scrape, keeps per-rule firing state with rising-edge
+counters, and exports it three ways:
+
+  * `SeaweedFS_alerts_firing{alert,severity}` 0/1 on `/metrics` through a
+    Registry collector (so an external Prometheus — and `cluster.check`,
+    which scrapes every node — sees the state with zero extra plumbing),
+    plus `SeaweedFS_alerts_fired_total{alert,severity}` rising edges;
+  * `GET /debug/alerts` (server/httpd) — full JSON with value + detail;
+  * `cluster.check -fail` exits nonzero on any firing *critical* alert,
+    and `cluster.top` renders the firing set live.
+
+Rules are plain (name, severity, description, check) records — the check
+gets (history, now, params) and returns None or (value, detail). Names
+ride into the `alert` label, so `tools/check_metric_names.py` lints them
+like metric names. Thresholds live in one `params` dict
+(`engine().configure(...)` to tune).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from seaweedfs_tpu.stats import history as history_mod
+from seaweedfs_tpu.stats.metrics import _fmt_labels, default_registry
+
+ALERT_FAMILIES = ("SeaweedFS_alerts_firing",)
+
+DEFAULT_PARAMS = {
+    # evaluation window (seconds) for every rate-based rule
+    "window": 60.0,
+    # http_error_ratio: 5xx share of all requests, with a minimum absolute
+    # 5xx rate so three stray 500s in a quiet minute don't page anyone
+    "error_ratio": 0.05,
+    "error_min_rate": 0.5,
+    # disk_near_cap: percent of a data directory's filesystem in use
+    "disk_capacity_pct": 95.0,
+    # metrics_push_errors: any sustained push failure is worth a warning
+    "push_error_rate": 0.0,
+    # trace_ring_drops: eviction churn this fast means the ring is blind
+    "trace_drop_rate": 100.0,
+    # ec_pipeline_starved: a stage waiting this many times longer than it
+    # works (and at all meaningfully) is starved by its neighbor
+    "starvation_wait_ratio": 3.0,
+    "starvation_min_wait": 0.05,
+}
+
+
+class Rule:
+    """One declarative alert rule. `check(history, now, params)` returns
+    None (not firing) or (value, detail)."""
+
+    __slots__ = ("name", "severity", "description", "check")
+
+    def __init__(self, name: str, severity: str, description: str, check):
+        self.name = name
+        self.severity = severity
+        self.description = description
+        self.check = check
+
+
+def _sum_rates(hist, family: str, window: float, now: float, match=None):
+    """Sum of windowed rates across a family's series (None when no
+    series has enough samples — distinct from a true 0.0 rate)."""
+    total = None
+    for labels, rate in hist.rates(family, window, now):
+        if rate is None:
+            continue
+        if match is not None and not match(labels):
+            continue
+        total = (total or 0.0) + rate
+    return total
+
+
+def _check_http_error_ratio(hist, now, p):
+    w = p["window"]
+    total = _sum_rates(hist, "SeaweedFS_http_request_total", w, now)
+    if not total:
+        return None
+    errs = _sum_rates(
+        hist, "SeaweedFS_http_request_total", w, now,
+        match=lambda l: l.get("code", "").startswith("5"),
+    ) or 0.0
+    ratio = errs / total
+    if errs > p["error_min_rate"] and ratio > p["error_ratio"]:
+        return ratio, (
+            f"{errs:.2f}/s of {total:.2f}/s requests are 5xx"
+            f" ({ratio:.1%} > {p['error_ratio']:.0%})"
+        )
+    return None
+
+
+def _check_heartbeat_stale(hist, now, p):
+    # the master's stale gauge already encodes its 3x-pulse threshold;
+    # latests(require_current) ignores a stopped master's leftovers
+    ages = {
+        l.get("node", ""): v
+        for l, v, _ in hist.latests("SeaweedFS_master_heartbeat_age_seconds")
+    }
+    stale = []
+    for labels, value, _ in hist.latests("SeaweedFS_master_stale_heartbeats"):
+        if value > 0:
+            node = labels.get("node", "?")
+            stale.append((node, ages.get(node, value)))
+    if not stale:
+        return None
+    worst = max(age for _, age in stale)
+    return worst, "stale heartbeat from " + ", ".join(
+        f"{node} ({age:.1f}s)" for node, age in sorted(stale)
+    )
+
+
+def _check_disk_near_cap(hist, now, p):
+    used = {
+        tuple(sorted(l.items())): v
+        for l, v, _ in hist.latests("SeaweedFS_volume_disk_used_bytes")
+    }
+    details, worst = [], None
+    for labels, free, _ in hist.latests("SeaweedFS_volume_disk_free_bytes"):
+        u = used.get(tuple(sorted(labels.items())))
+        if u is None or u + free <= 0:
+            continue
+        pct = 100.0 * u / (u + free)
+        if pct >= p["disk_capacity_pct"]:
+            details.append(
+                f"{labels.get('server', '?')} {labels.get('dir', '?')}"
+                f" {pct:.1f}% used"
+            )
+            worst = max(worst or 0.0, pct)
+    if not details:
+        return None
+    return worst, "disk near capacity: " + "; ".join(sorted(details))
+
+
+def _check_push_errors(hist, now, p):
+    rate = _sum_rates(
+        hist, "SeaweedFS_stats_push_errors_total", p["window"], now
+    )
+    if rate is not None and rate > p["push_error_rate"]:
+        return rate, f"metrics pushes failing at {rate:.2f}/s"
+    return None
+
+
+def _check_trace_drops(hist, now, p):
+    rate = _sum_rates(
+        hist, "SeaweedFS_stats_trace_dropped_total", p["window"], now
+    )
+    if rate is not None and rate > p["trace_drop_rate"]:
+        return rate, (
+            f"trace ring dropping {rate:.0f} spans/s"
+            " (capacity churn — raise SEAWEEDFS_TPU_TRACE_CAPACITY?)"
+        )
+    return None
+
+
+def _check_ec_starved(hist, now, p):
+    per_stage: dict[str, dict] = {}
+    for labels, rate in hist.rates(
+        "SeaweedFS_volume_ec_pipeline_seconds_sum", p["window"], now
+    ):
+        if rate is None:
+            continue
+        st = per_stage.setdefault(labels.get("stage", "?"), {})
+        state = labels.get("state", "")
+        st[state] = st.get(state, 0.0) + rate
+    starved, worst = [], None
+    for stage, st in sorted(per_stage.items()):
+        busy = st.get("busy", 0.0)
+        wait = st.get("wait", 0.0)
+        if wait > p["starvation_min_wait"] and \
+                wait > p["starvation_wait_ratio"] * busy:
+            starved.append(f"{stage} (busy {busy:.2f}s/s, wait {wait:.2f}s/s)")
+            worst = max(worst or 0.0, wait)
+    if not starved:
+        return None
+    return worst, "EC pipeline stage starving: " + ", ".join(starved)
+
+
+def default_rules() -> list[Rule]:
+    return [
+        Rule("http_error_ratio", "critical",
+             "5xx share of HTTP requests over the window exceeds the"
+             " threshold", _check_http_error_ratio),
+        Rule("heartbeat_stale", "critical",
+             "a volume server's master heartbeat is stale (3x pulse)",
+             _check_heartbeat_stale),
+        Rule("disk_near_cap", "critical",
+             "a volume data directory's filesystem is nearly full",
+             _check_disk_near_cap),
+        Rule("metrics_push_errors", "warning",
+             "pushes to the metrics gateway are failing",
+             _check_push_errors),
+        Rule("trace_ring_drops", "warning",
+             "the trace ring is evicting spans faster than the threshold",
+             _check_trace_drops),
+        Rule("ec_pipeline_starved", "warning",
+             "an EC pipeline stage spends far longer waiting than working",
+             _check_ec_starved),
+    ]
+
+
+class AlertEngine:
+    """Evaluates rules against a MetricsHistory; keeps firing state;
+    exports it as `SeaweedFS_alerts_firing` through a Registry collector.
+    Attached as a history listener, so state refreshes on every scrape."""
+
+    def __init__(self, history=None, rules=None, registry=None, params=None):
+        self.history = (
+            history if history is not None else history_mod.default_history()
+        )
+        self.registry = (
+            registry if registry is not None else default_registry()
+        )
+        self.rules = list(default_rules() if rules is None else rules)
+        names = [r.name for r in self.rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate alert rule names: {sorted(names)}")
+        self.params = dict(DEFAULT_PARAMS)
+        if params:
+            self.params.update(params)
+        self._lock = threading.Lock()
+        self.firing: dict[str, dict] = {}  # name -> {severity,since,value,detail}
+        self.fired_events = 0  # rising edges since process start
+        self._last_eval = 0.0
+        self._fired_total = self.registry.counter(
+            "SeaweedFS_alerts_fired_total",
+            "alert rising edges (rule transitioned to firing)",
+            ("alert", "severity"),
+        )
+        self._collector = self.registry.register_collector(
+            self._lines, names=ALERT_FAMILIES
+        )
+        self.history.add_listener(self._on_scrape)
+
+    def close(self) -> None:
+        self.history.remove_listener(self._on_scrape)
+        self.registry.unregister_collector(self._collector)
+
+    def configure(self, **params) -> None:
+        """Tune thresholds (keys of DEFAULT_PARAMS)."""
+        unknown = set(params) - set(DEFAULT_PARAMS)
+        if unknown:
+            raise ValueError(f"unknown alert params: {sorted(unknown)}")
+        self.params.update(params)
+
+    def _on_scrape(self, hist, now) -> None:
+        self.evaluate(now=now)
+
+    def _run_checks(self, now: float, params: dict) -> dict:
+        results = {}
+        for rule in self.rules:
+            try:
+                res = rule.check(self.history, now, params)
+            except Exception:
+                res = None  # a broken rule must not take down the scrape
+            if res is not None:
+                results[rule.name] = res
+        return results
+
+    def evaluate(self, now: float | None = None) -> dict:
+        """Run every rule, update firing state (rising edges counted),
+        return a snapshot {name: {severity, since, value, detail}}."""
+        now = time.time() if now is None else now
+        results = self._run_checks(now, self.params)
+        self._last_eval = time.time()
+        with self._lock:
+            for rule in self.rules:
+                res = results.get(rule.name)
+                cur = self.firing.get(rule.name)
+                if res is None:
+                    if cur is not None:
+                        del self.firing[rule.name]
+                    continue
+                value, detail = res
+                if cur is None:
+                    self.firing[rule.name] = {
+                        "severity": rule.severity, "since": now,
+                        "value": value, "detail": detail,
+                    }
+                    self.fired_events += 1
+                    self._fired_total.labels(rule.name, rule.severity).inc()
+                else:
+                    cur["value"] = value
+                    cur["detail"] = detail
+            return {k: dict(v) for k, v in self.firing.items()}
+
+    def status(self, window: float | None = None,
+               now: float | None = None) -> dict:
+        """The /debug/alerts body: every rule with its firing state. A
+        window override evaluates transiently (canonical firing state —
+        the one /metrics exports — always uses the configured window)."""
+        now = time.time() if now is None else now
+        # ensure_fresh's scrape already re-evaluates via the listener; only
+        # evaluate here when no fresh evaluation exists (double rule runs
+        # per dashboard poll would double the history scans)
+        self.history.ensure_fresh()
+        if window is None or float(window) == self.params["window"]:
+            if time.time() - self._last_eval > self.history.interval:
+                self.evaluate(now=now)
+            with self._lock:
+                firing = {k: dict(v) for k, v in self.firing.items()}
+        else:
+            p = dict(self.params)
+            p["window"] = float(window)
+            firing = {}
+            for name, (value, detail) in self._run_checks(now, p).items():
+                rule = next(r for r in self.rules if r.name == name)
+                prev = self.firing.get(name)
+                firing[name] = {
+                    "severity": rule.severity,
+                    "since": prev["since"] if prev else now,
+                    "value": value, "detail": detail,
+                }
+        alerts = []
+        for rule in self.rules:
+            st = firing.get(rule.name)
+            entry = {
+                "name": rule.name,
+                "severity": rule.severity,
+                "description": rule.description,
+                "firing": st is not None,
+            }
+            if st is not None:
+                entry["since"] = round(st["since"], 3)
+                entry["value"] = round(float(st["value"]), 6)
+                entry["detail"] = st["detail"]
+            alerts.append(entry)
+        alerts.sort(key=lambda a: (
+            not a["firing"], a["severity"] != "critical", a["name"]
+        ))
+        return {
+            "window": float(window if window is not None
+                            else self.params["window"]),
+            "firing": sum(1 for a in alerts if a["firing"]),
+            "alerts": alerts,
+        }
+
+    def snapshot(self) -> dict:
+        """Public view of the firing state + edge counter (bench.py's
+        request_rates summary reads this; no private-state reach-ins)."""
+        with self._lock:
+            return {
+                "fired_events": self.fired_events,
+                "firing": sorted(self.firing),
+            }
+
+    def _lines(self) -> list[str]:
+        with self._lock:
+            firing = set(self.firing)
+        lines = [
+            "# HELP SeaweedFS_alerts_firing 1 while the alert rule fires"
+            " (see /debug/alerts for detail)",
+            "# TYPE SeaweedFS_alerts_firing gauge",
+        ]
+        for rule in self.rules:  # every rule exports, firing or not
+            lines.append(
+                "SeaweedFS_alerts_firing"
+                + _fmt_labels(("alert", "severity"), (rule.name, rule.severity))
+                + (" 1" if rule.name in firing else " 0")
+            )
+        return lines
+
+
+_engine: AlertEngine | None = None
+_engine_lock = threading.Lock()
+
+
+def engine() -> AlertEngine:
+    """Process-wide engine over the default history/registry. Created
+    lazily (first metered server or first /debug/alerts hit)."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            _engine = AlertEngine()
+        return _engine

@@ -17,6 +17,12 @@ DEFAULT_BUCKETS = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# Captured when the stats layer first loads (servers import it at boot):
+# exported as SeaweedFS_process_start_time_seconds so the history ring and
+# cluster.top can tell a restarted process (counters back at zero) from a
+# stalled one, and render uptime.
+PROCESS_START_TIME = time.time()
+
 
 def _escape_label_value(value) -> str:
     """Prometheus text-format label escaping: backslash, double-quote and
@@ -27,6 +33,17 @@ def _escape_label_value(value) -> str:
         .replace('"', '\\"')
         .replace("\n", "\\n")
     )
+
+
+def _fmt_value(v: float) -> str:
+    """Exposition value at full precision: '{:g}' clips to 6 significant
+    digits, which truncates big byte counters / unix-time gauges (a 1.7e9
+    start-time gauge rounded ~700s into the future, and a clipped counter
+    reads flat between scrapes, so rate() = 0). Integers render exactly;
+    other floats via repr (shortest round-trip form, what Prometheus's own
+    Go client emits)."""
+    v = float(v)
+    return str(int(v)) if v.is_integer() else repr(v)
 
 
 def _fmt_labels(label_names: tuple, label_values: tuple, extra: str = "") -> str:
@@ -71,7 +88,10 @@ class Counter(_Metric):
         with self._lock:
             items = sorted(self._values.items())
         for key, val in items:
-            out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {val:g}")
+            out.append(
+                f"{self.name}{_fmt_labels(self.label_names, key)}"
+                f" {_fmt_value(val)}"
+            )
         return out
 
 
@@ -122,7 +142,10 @@ class Gauge(_Metric):
                     pass
             items = sorted(merged.items())
         for key, val in items:
-            out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {val:g}")
+            out.append(
+                f"{self.name}{_fmt_labels(self.label_names, key)}"
+                f" {_fmt_value(val)}"
+            )
         return out
 
 
@@ -185,7 +208,8 @@ class Histogram(_Metric):
                 f"{_fmt_labels(self.label_names, key, inf)} {totals[key]}"
             )
             out.append(
-                f"{self.name}_sum{_fmt_labels(self.label_names, key)} {sums[key]:g}"
+                f"{self.name}_sum{_fmt_labels(self.label_names, key)}"
+                f" {_fmt_value(sums[key])}"
             )
             out.append(
                 f"{self.name}_count{_fmt_labels(self.label_names, key)} {totals[key]}"

@@ -77,6 +77,9 @@ class Volume:
         self._fl_hook = None  # set while the fastlane engine fronts this volume
         self.readonly = False
         self.last_append_at_ns = 0
+        # bumped by commit_compact's swap: readers that straddle it retry
+        # against the post-swap (nm, dat) pair instead of failing spuriously
+        self._compact_gen = 0
 
         dat_path = self.base_name + ".dat"
         tier = self._load_tier_info()
@@ -289,10 +292,36 @@ class Volume:
         return Needle.from_bytes(blob, size=size, version=self.version())
 
     def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
+        # Reads run lock-free against (nm, dat); commit_compact swaps both
+        # under the write lock. A read straddling the swap can pair the old
+        # map's offset with the new file (garbage bytes -> size/CRC errors,
+        # or a spurious NotFound) — when the compaction generation moved
+        # mid-read, retry against the now-consistent pair instead of
+        # surfacing a 404/500 for a perfectly live needle.
+        while True:
+            gen = self._compact_gen
+            if gen & 1:  # seqlock: odd = swap in flight, wait it out
+                time.sleep(0.001)
+                continue
+            try:
+                n = self._read_needle_once(needle_id, cookie)
+            except Exception:
+                if self._compact_gen == gen:
+                    raise  # a real miss/corruption, not a swap race
+                continue
+            # a successful read must ALSO re-validate: a swap completing
+            # mid-read can pair the old map's offset with the new file and
+            # still parse cleanly if another needle sits there
+            if self._compact_gen == gen:
+                return n
+
+    def _read_needle_once(self, needle_id: int, cookie: int | None) -> Needle:
         nv = self.nm.get(needle_id)
         if nv is None or not size_is_valid(nv[1]):
             raise NotFound(f"needle {needle_id:x} not found")
         n = self._read_at(nv[0], nv[1])
+        if n.id != needle_id:  # wrong record at this offset (torn read)
+            raise NotFound(f"needle {needle_id:x} not found at offset")
         if cookie is not None and n.cookie != cookie:
             raise NotFound("cookie mismatch")
         if n.has_ttl() and n.ttl.minutes() > 0 and n.has_last_modified():
@@ -344,15 +373,33 @@ class Volume:
             raise VolumeError("no compacted files to commit")
         with self._write_lock:
             self._makeup_diff(dst_dat, dst_idx)
-            self.nm.close()
-            self._dat.close()
+            # Swap-in order matters for concurrent READERS (the data plane
+            # does not take the write lock): rename, build the NEW handles,
+            # flip the references, and only then close the old ones — a
+            # reader mid-lookup keeps a consistent (nm, dat) pair (its open
+            # fd survives the rename) instead of hitting a closed file or a
+            # half-rebuilt needle map and 404ing a live needle.
             os.replace(dst_dat, self.base_name + ".dat")
             os.replace(dst_idx, self.base_name + ".idx")
-            self._dat = DiskFile(self.base_name + ".dat")
-            header = self._dat.read_at(SUPER_BLOCK_SIZE, 0)
-            self.super_block = SuperBlock.from_bytes(header)
-            self.nm = CompactNeedleMap(self.base_name + ".idx")
-            self._size = os.path.getsize(self.base_name + ".dat")
+            new_dat = DiskFile(self.base_name + ".dat")
+            header = new_dat.read_at(SUPER_BLOCK_SIZE, 0)
+            new_nm = CompactNeedleMap(self.base_name + ".idx")
+            old_nm, old_dat = self.nm, self._dat
+            # seqlock around the reference flips: readers seeing an odd
+            # generation wait; readers that tore across the flips see the
+            # generation move and retry (read_needle). The finally block
+            # guarantees the generation returns to even even if a flip
+            # raises — a forever-odd gen would hang every reader.
+            self._compact_gen += 1
+            try:
+                self.super_block = SuperBlock.from_bytes(header)
+                self.nm = new_nm
+                self._dat = new_dat
+                self._size = os.path.getsize(self.base_name + ".dat")
+            finally:
+                self._compact_gen += 1
+            old_nm.close()
+            old_dat.close()
 
     def _makeup_diff(self, dst_dat: str, dst_idx: str) -> None:
         """Replay idx entries appended after the compact snapshot onto the

@@ -332,6 +332,16 @@ class TestMetricNameLint:
         assert "SeaweedFS_stats_trace_spans_total" in collector_names
         assert "SeaweedFS_stats_trace_dropped_total" in collector_names
         assert "SeaweedFS_stats_profile_samples_total" in collector_names
+        # PR-4: history/alert collector families + process identity gauges
+        assert "SeaweedFS_alerts_firing" in collector_names
+        assert "SeaweedFS_stats_history_scrapes_total" in collector_names
+        assert "SeaweedFS_stats_history_dropped_series_total" \
+            in collector_names
+        assert kinds["SeaweedFS_alerts_fired_total"] == "counter"
+        assert kinds["SeaweedFS_build_info"] == "gauge"
+        assert kinds["SeaweedFS_process_start_time_seconds"] == "gauge"
+        # every registered alert-rule name passes the rule lint
+        assert tool.alert_rule_violations() == []
 
     def test_lint_catches_violations(self):
         tool = self._tool()
@@ -343,6 +353,12 @@ class TestMetricNameLint:
              "SeaweedFS_frobnicator_x_total": "counter"},  # unknown subsystem
             [])
         assert len(bad) == 5, bad
+
+    def test_alert_rule_name_convention(self):
+        tool = self._tool()
+        assert tool.ALERT_RULE_RE.match("http_error_ratio")
+        for bad in ("HttpErrors", "5xx_burst", "errors-", "_x", "a__b"):
+            assert not tool.ALERT_RULE_RE.match(bad), bad
 
 
 class TestTTL:

@@ -9,10 +9,16 @@ fails on any name violating the convention, so the metric namespace cannot
 drift PR over PR. Conventions enforced:
 
   * name matches  SeaweedFS_<subsystem>_<snake_case>  with a known
-    subsystem (master, volume, filer, s3, http, stats, mount, mq, iam)
+    subsystem (master, volume, filer, s3, http, stats, mount, mq, iam,
+    alerts, process)
   * counters end in _total
   * histograms end in a base unit (_seconds or _bytes)
   * gauges do not end in _total (that suffix promises counter semantics)
+  * alert-rule names (they ride into SeaweedFS_alerts_firing{alert=...})
+    are unique snake_case with a known severity
+
+`SeaweedFS_build_info` is the one subsystem-less exception — the
+Prometheus build-info convention (`<binary>_build_info`).
 
 Invoked from the tier-1 suite (tests/test_formats.py) and standalone:
 
@@ -27,9 +33,15 @@ import sys
 
 NAME_RE = re.compile(
     r"^SeaweedFS_"
-    r"(master|volume|filer|s3|http|stats|mount|mq|iam)_"
+    r"(master|volume|filer|s3|http|stats|mount|mq|iam|alerts|process)_"
     r"[a-z][a-z0-9]*(_[a-z0-9]+)*$"
 )
+
+# Prometheus build-info convention: no subsystem segment
+SPECIAL_NAMES = {"SeaweedFS_build_info"}
+
+ALERT_RULE_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)*$")
+ALERT_SEVERITIES = {"critical", "warning"}
 
 HISTOGRAM_UNITS = ("_seconds", "_bytes")
 
@@ -40,7 +52,8 @@ def collect() -> tuple[dict[str, str], list[str]]:
     from seaweedfs_tpu.server.httpd import HTTPService
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume import VolumeServer
-    from seaweedfs_tpu.stats import default_registry, profiler, trace
+    from seaweedfs_tpu.stats import alerts, default_registry, history, \
+        profiler, trace
     from seaweedfs_tpu.storage import crc
     from seaweedfs_tpu.storage.erasure_coding import encoder as ec_encoder
 
@@ -63,13 +76,38 @@ def collect() -> tuple[dict[str, str], list[str]]:
         | set(VolumeServer.FL_FAMILIES)
         | set(trace.TRACE_SELF_FAMILIES)
         | set(profiler.PROFILER_FAMILIES)
+        | set(history.HISTORY_FAMILIES)
+        | set(alerts.ALERT_FAMILIES)
     )
     return kinds, collector_names
+
+
+def alert_rule_violations() -> list[str]:
+    """Rule names become the `alert` label of SeaweedFS_alerts_firing and
+    SeaweedFS_alerts_fired_total — lint them like metric names: unique
+    snake_case, known severity."""
+    from seaweedfs_tpu.stats import alerts
+
+    rules = alerts.default_rules()
+    bad: list[str] = []
+    seen: set[str] = set()
+    for r in rules:
+        if not ALERT_RULE_RE.match(r.name):
+            bad.append(f"alert rule {r.name!r}: not snake_case")
+        if r.name in seen:
+            bad.append(f"alert rule {r.name!r}: duplicate name")
+        seen.add(r.name)
+        if r.severity not in ALERT_SEVERITIES:
+            bad.append(f"alert rule {r.name!r}: severity {r.severity!r}"
+                       f" not in {sorted(ALERT_SEVERITIES)}")
+    return bad
 
 
 def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
     bad: list[str] = []
     for name in sorted(set(kinds) | set(collector_names)):
+        if name in SPECIAL_NAMES:
+            continue
         if not NAME_RE.match(name):
             bad.append(f"{name}: does not match "
                        "SeaweedFS_<subsystem>_<snake_case>")
@@ -86,7 +124,7 @@ def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
 
 def main() -> int:
     kinds, collector_names = collect()
-    bad = violations(kinds, collector_names)
+    bad = violations(kinds, collector_names) + alert_rule_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
         print(f"{len(bad)} metric-name violation(s) in {total} families:")
