@@ -753,6 +753,120 @@ def bench_filer_small_files(n: int = 20000, size: int = 1024, c: int = 16) -> di
     return out
 
 
+def maintenance_summary(trials: int = 2, blobs: int = 8) -> dict:
+    """PR-5: the autonomous maintenance subsystem's heal latency. A 3-node
+    cluster EC-encodes a volume, then each trial deletes one holder's
+    shards and measures wall time until the daemon (scan interval 0.25s)
+    has every shard back — plus one injected replica loss. Reports tasks
+    executed and mean time-to-heal; arXiv:1207.6744's point is exactly
+    that this number, not codec GB/s, is what degraded reads feel."""
+    import tempfile
+
+    from seaweedfs_tpu.server.httpd import get_json, http_request, post_json
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+
+    d = os.path.join(BENCH_DIR, "maintenance")
+    os.makedirs(d, exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=d)
+    master = MasterServer(port=0, pulse_seconds=1, volume_size_limit_mb=64,
+                          maintenance_interval=0.25)
+    master.start()
+    vols = []
+    out: dict = {"trials": trials}
+    try:
+        for i in range(3):
+            vs = VolumeServer(
+                [os.path.join(tmp, f"v{i}")], master.url, port=0,
+                rack=f"r{i}", pulse_seconds=1, max_volume_count=30,
+            )
+            vs.start()
+            vols.append(vs)
+        env = CommandEnv(master.url)
+        fids = []
+        for i in range(blobs):
+            a = get_json(f"{master.url}/dir/assign")
+            url = f"http://{a['publicUrl']}/{a['fid']}"
+            http_request("POST", url, b"m" * 4000)
+            fids.append(a["fid"])
+        run_command(env, "lock")
+        vid = int(fids[0].split(",")[0])
+        run_command(env, f"ec.encode -volumeId {vid}")
+        run_command(env, "unlock")  # daemon repairs take the admin lease
+        post_json(f"{master.url}/maintenance/enable")
+
+        def shard_count() -> int:
+            return len({
+                s for sv in env.servers() for s in sv.ec_shards.get(vid, [])
+            })
+
+        heal_times = []
+        for _ in range(trials):
+            holders = [
+                sv for sv in env.servers()
+                if sv.ec_shards.get(vid)  # holders with >0 shards
+            ]
+            victim = min(holders, key=lambda sv: len(sv.ec_shards[vid]))
+            # at most 4 of 14: RS(10,4) heals up to 4 lost shards, and the
+            # rebuild concentrates shards so a whole-holder wipe on a later
+            # trial could push the volume below the 10-shard floor
+            lost = list(victim.ec_shards[vid])[:4]
+            t0 = time.time()
+            env.post(
+                f"{victim.http}/admin/ec/delete_shards",
+                {"volume": vid, "shards": lost, "delete_index": False},
+            )
+            # the loss must be topology-visible before the heal is timed —
+            # a stale pre-injection snapshot reads as instant healing, and
+            # a trial whose loss NEVER surfaces must be skipped, not
+            # recorded as a ~10s phantom heal
+            seen_loss = False
+            deadline = t0 + 10
+            while time.time() < deadline:
+                if shard_count() < 14:
+                    seen_loss = True
+                    break
+                time.sleep(0.05)
+            if not seen_loss:
+                continue
+            deadline = t0 + 60
+            while time.time() < deadline and shard_count() < 14:
+                time.sleep(0.1)
+            if shard_count() == 14:
+                heal_times.append(time.time() - t0)
+        if heal_times:
+            out["shard_loss_time_to_heal_s"] = round(
+                sum(heal_times) / len(heal_times), 3)
+            out["shard_loss_healed"] = len(heal_times)
+        # one replica loss on a replicated volume
+        rep = get_json(f"{master.url}/dir/assign?replication=010")
+        http_request("POST",
+                     f"http://{rep['publicUrl']}/{rep['fid']}", b"r" * 4000)
+        rvid = int(rep["fid"].split(",")[0])
+        holders = [sv for sv in env.servers() if rvid in sv.volumes]
+        if len(holders) == 2:
+            t0 = time.time()
+            env.post(f"{holders[0].http}/admin/delete_volume",
+                     {"volume": rvid})
+            deadline = t0 + 60
+            while time.time() < deadline:
+                if len([sv for sv in env.servers()
+                        if rvid in sv.volumes]) == 2:
+                    out["replica_loss_time_to_heal_s"] = round(
+                        time.time() - t0, 3)
+                    break
+                time.sleep(0.1)
+        st = get_json(f"{master.url}/debug/maintenance")
+        out["tasks_executed"] = st.get("counts", {})
+        out["scheduler_stats"] = st.get("scheduler", {}).get("stats", {})
+    finally:
+        for vs in vols:
+            vs.stop()
+        master.stop()
+    return out
+
+
 def bench_hash_1m_4k(
     total_blobs: int = 1_000_000, slab: int = 65536, device: bool = True
 ) -> dict:
@@ -927,6 +1041,11 @@ def main() -> None:
         detail["filer_small_files"] = bench_filer_small_files()
     except Exception as e:
         detail["filer_small_files"] = {"error": str(e)[:120]}
+    # PR-5: autonomous-maintenance heal latency (injected shard/replica loss)
+    try:
+        detail["maintenance_summary"] = maintenance_summary()
+    except Exception as e:
+        detail["maintenance_summary"] = {"error": str(e)[:120]}
     # end-of-run per-kernel attribution over EVERYTHING this process ran
     # (verb trials + rebuild + hash benches), from the shared registry
     try:

@@ -48,6 +48,16 @@ def run_master(args: list[str]) -> int:
     p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
                    help="log requests slower than this many ms for this "
                         "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
+    p.add_argument("-maintenance", action="store_true",
+                   help="run the autonomous maintenance daemon "
+                        "(detect -> plan -> heal; off by default)")
+    p.add_argument("-maintenance.dryRun", dest="maintenance_dry_run",
+                   action="store_true",
+                   help="maintenance plans repairs without executing them")
+    p.add_argument("-maintenance.interval", dest="maintenance_interval",
+                   type=float, default=None,
+                   help="maintenance scan interval seconds "
+                        "(default: pulseSeconds)")
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.master import MasterServer
 
@@ -65,6 +75,9 @@ def run_master(args: list[str]) -> int:
                for u in opts.peers.split(",") if u],
         raft_dir=opts.mdir,
         slow_ms=opts.slow_ms,
+        maintenance=opts.maintenance or opts.maintenance_dry_run,
+        maintenance_dry_run=opts.maintenance_dry_run,
+        maintenance_interval=opts.maintenance_interval,
     )
     m.start()
     print(f"master listening at {m.url}")
@@ -201,6 +214,16 @@ def run_server(args: list[str]) -> int:
                    help="content-defined-chunking dedup on filer uploads")
     p.add_argument("-s3.config", dest="s3_config", default=None,
                    help="identities json (s3.json)")
+    p.add_argument("-maintenance", action="store_true",
+                   help="run the autonomous maintenance daemon "
+                        "(detect -> plan -> heal; off by default)")
+    p.add_argument("-maintenance.dryRun", dest="maintenance_dry_run",
+                   action="store_true",
+                   help="maintenance plans repairs without executing them")
+    p.add_argument("-maintenance.interval", dest="maintenance_interval",
+                   type=float, default=None,
+                   help="maintenance scan interval seconds "
+                        "(default: pulseSeconds)")
     opts = p.parse_args(args)
 
     from seaweedfs_tpu.server.master import MasterServer
@@ -213,6 +236,9 @@ def run_server(args: list[str]) -> int:
         volume_size_limit_mb=opts.volumeSizeLimitMB,
         default_replication=opts.defaultReplication,
         security=sec,
+        maintenance=opts.maintenance or opts.maintenance_dry_run,
+        maintenance_dry_run=opts.maintenance_dry_run,
+        maintenance_interval=opts.maintenance_interval,
     )
     m.start()
     print(f"master listening at {m.url}")

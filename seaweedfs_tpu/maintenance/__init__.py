@@ -1,0 +1,24 @@
+"""Autonomous maintenance: detect → plan → heal.
+
+The subsystem that turns four PRs of observability (topology gauges,
+under-replication / missing-shard counts, history rings, alerts) into
+automated operation: detectors (detectors.py) scan the master's live
+topology and emit typed RepairTasks, a bounded scheduler (scheduler.py)
+dedups/prioritizes/throttles them, and executors (executors.py) heal
+through the same plan/apply helpers the admin-shell repair verbs use.
+MaintenanceDaemon (daemon.py) runs the loop inside the master behind
+`-maintenance` (off by default; `-maintenance.dryRun` plans without
+executing) and serves /debug/maintenance.
+"""
+
+from .daemon import ALERT_SCANS, MAINTENANCE_FAMILIES, MaintenanceDaemon, \
+    ensure_metrics
+from .detectors import DETECTORS, TASK_TYPES, RepairTask, TaskSpec, scan
+from .executors import EXECUTORS, execute
+from .scheduler import RepairScheduler
+
+__all__ = [
+    "ALERT_SCANS", "DETECTORS", "EXECUTORS", "MAINTENANCE_FAMILIES",
+    "MaintenanceDaemon", "RepairScheduler", "RepairTask", "TASK_TYPES",
+    "TaskSpec", "ensure_metrics", "execute", "scan",
+]

@@ -1,0 +1,368 @@
+"""MaintenanceDaemon: the loop that closes detect → plan → heal.
+
+Runs inside the master (leader-only) behind the `-maintenance` flag, off
+by default. Every scan interval it runs the detectors over the live
+topology, offers the resulting RepairTasks to the RepairScheduler, and
+drains whatever the scheduler's caps/throttle admit onto a small worker
+pool that executes repairs through the shared shell plan/apply helpers.
+`-maintenance.dryRun` runs the identical pipeline but executors only
+plan — zero mutations — so an operator can watch /debug/maintenance and
+see exactly what the daemon *would* heal.
+
+Besides polling, the daemon subscribes to the PR-4 AlertEngine's
+`on_fire` hook: a rising disk_near_cap alert triggers an immediate
+vacuum+balance scan, a rising heartbeat_stale alert an evacuate scan —
+reaction, not just periodic discovery.
+
+Every repair is traced (`maintenance.<type>` spans) and timed into
+`SeaweedFS_maintenance_{tasks_total,task_seconds,queue_depth,
+failures_total}` so cluster.check/cluster.top-style tooling sees healing
+load next to the foreground traffic it is throttled to never starve.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+from seaweedfs_tpu.stats import default_registry
+
+from . import detectors as detectors_mod
+from . import executors as executors_mod
+from .detectors import TASK_TYPES, RepairTask
+from .scheduler import RepairScheduler
+
+# collector-backed family (scrape-time view of the scheduler's queues)
+MAINTENANCE_FAMILIES = ("SeaweedFS_maintenance_queue_depth",)
+
+# alert name -> detector subset to scan immediately on a rising edge
+ALERT_SCANS = {
+    "disk_near_cap": ("vacuum", "balance"),
+    "heartbeat_stale": ("evacuate",),
+}
+
+
+def ensure_metrics(registry=None):
+    """Register (idempotently) the maintenance metric families on the
+    process registry; returns (tasks_total, task_seconds, failures_total)."""
+    reg = registry if registry is not None else default_registry()
+    return (
+        reg.counter(
+            "SeaweedFS_maintenance_tasks_total",
+            "maintenance tasks by terminal state"
+            " (completed|failed|planned)",
+            ("task", "state"),
+        ),
+        reg.histogram(
+            "SeaweedFS_maintenance_task_seconds",
+            "wall time per executed maintenance task",
+            ("task",),
+        ),
+        reg.counter(
+            "SeaweedFS_maintenance_failures_total",
+            "failed maintenance task executions (each arms backoff)",
+            ("task",),
+        ),
+    )
+
+
+class MaintenanceDaemon:
+    def __init__(
+        self,
+        master,
+        interval: float | None = None,
+        dry_run: bool = False,
+        scheduler: RepairScheduler | None = None,
+        history_size: int = 128,
+        registry=None,
+    ) -> None:
+        self.master = master
+        self.interval = (
+            interval if interval is not None
+            else float(max(master.topo.pulse_seconds, 1))
+        )
+        self.dry_run = bool(dry_run)
+        self.enabled = True
+        self.scheduler = scheduler or RepairScheduler()
+        self.registry = registry if registry is not None else default_registry()
+        self._m_tasks, self._m_seconds, self._m_failures = ensure_metrics(
+            self.registry
+        )
+        self._collector = None
+        self._lock = threading.Lock()
+        self._history: deque[dict] = deque(maxlen=history_size)
+        self._counts: dict[tuple[str, str], int] = {}
+        self._pending_types: set[str] = set()  # requested subset scans
+        self._pending_full = False  # an explicit full-scan request
+        self._wake = threading.Event()
+        self._stopping = False
+        self._thread: threading.Thread | None = None
+        self._pool: ThreadPoolExecutor | None = None
+        self._env = None
+        self._lease_mutex = threading.Lock()
+        self._lease_count = 0
+        self._renew_thread: threading.Thread | None = None
+        self._alert_engine = None
+        self.scans = 0
+        self.started_at: float | None = None
+
+    # --- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.started_at = time.time()
+        self._collector = self.registry.register_collector(
+            self._queue_depth_lines, names=MAINTENANCE_FAMILIES
+        )
+        try:  # react to firing alerts, not just the polling scan
+            from seaweedfs_tpu.stats import alerts as alerts_mod
+
+            self._alert_engine = alerts_mod.engine()
+            self._alert_engine.add_on_fire(self._on_alert)
+        except Exception:
+            self._alert_engine = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.scheduler.global_limit,
+            thread_name_prefix="sw-maint",
+        )
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="sw-maint-scan"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopping = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        if self._alert_engine is not None:
+            self._alert_engine.remove_on_fire(self._on_alert)
+            self._alert_engine = None
+        if self._collector is not None:
+            self.registry.unregister_collector(self._collector)
+            self._collector = None
+
+    def _command_env(self):
+        if self._env is None:
+            from seaweedfs_tpu.shell.env import CommandEnv
+
+            self._env = CommandEnv(self.master.url, holder="maintenance")
+        return self._env
+
+    def _acquire_lease(self, env) -> None:
+        """Refcounted admin lease shared by the worker pool: every worker
+        uses the one 'maintenance' holder (the master's lock is
+        re-entrant per holder), so the lease is taken when the first
+        concurrent repair starts and dropped when the last one ends —
+        an operator's `lock` cannot slip in between two daemon tasks.
+        A renewal thread re-acquires every 10s while any repair runs: a
+        single long rebuild must not outlive the lease's 30s ttl and
+        silently lose the mutual exclusion mid-copy. Lease POSTs carry a
+        short timeout: they run under _lease_mutex, and a hung 300s call
+        here would freeze every worker's task start AND finish."""
+        with self._lease_mutex:
+            # re-acquire also refreshes the 30s ttl
+            env.acquire_lock(timeout=10)
+            self._lease_count += 1
+            if self._renew_thread is None or not self._renew_thread.is_alive():
+                self._renew_thread = threading.Thread(
+                    target=self._renew_lease_loop, args=(env,),
+                    daemon=True, name="sw-maint-lease",
+                )
+                self._renew_thread.start()
+
+    def _release_lease(self, env) -> None:
+        with self._lease_mutex:
+            self._lease_count -= 1
+            if self._lease_count <= 0:
+                try:
+                    env.release_lock(timeout=10)
+                except Exception:
+                    pass  # expired lease: nothing to release
+
+    def _renew_lease_loop(self, env) -> None:
+        while not self._stopping:
+            time.sleep(10.0)  # well inside the 30s lease ttl
+            with self._lease_mutex:
+                if self._lease_count <= 0:
+                    return
+                try:
+                    env.acquire_lock(timeout=10)
+                except Exception:
+                    pass  # lost race after expiry: next task 409s+backs off
+
+    # --- scanning -------------------------------------------------------------
+    def _on_alert(self, name: str, info: dict) -> None:
+        types = ALERT_SCANS.get(name)
+        if types is None:
+            return
+        self.request_scan(types)
+
+    def request_scan(self, types=None) -> None:
+        """Ask the loop for an immediate scan (subset or full)."""
+        with self._lock:
+            if types is None:
+                self._pending_full = True
+            else:
+                self._pending_types.update(types)
+        self._wake.set()
+
+    def scan_now(self, types=None) -> list[dict]:
+        """Synchronous scan + enqueue (the `cluster.maintenance -now` verb);
+        returns what was offered. Dispatch still rides the loop/caps."""
+        offered = self._scan_and_enqueue(types)
+        self._wake.set()
+        return [t.to_dict() for t in offered]
+
+    def _scan_and_enqueue(self, types=None) -> list[RepairTask]:
+        self.scans += 1
+        now = time.time()
+        offered = []
+        for task in detectors_mod.scan(self.master, types):
+            if self.scheduler.offer(task, now):
+                offered.append(task)
+        return offered
+
+    # --- the loop -------------------------------------------------------------
+    def _loop(self) -> None:
+        next_scan = 0.0  # monotonic deadline for the periodic full scan
+        while True:
+            woke = self._wake.wait(timeout=self.interval)
+            if self._stopping:
+                return
+            with self._lock:
+                # a timeout tick — or an overdue scan deadline — is a full
+                # scan; an explicit wake scans the requested subset, or
+                # skips straight to dispatch when a completed task only
+                # woke us to drain the queue. The deadline matters: while
+                # a long backlog drains, completion wakes arrive faster
+                # than the interval and would otherwise postpone detection
+                # of NEW faults indefinitely.
+                full = (
+                    (not woke) or self._pending_full
+                    or time.monotonic() >= next_scan
+                )
+                types = None if full else (set(self._pending_types) or None)
+                dispatch_only = woke and not full and types is None
+                self._pending_full = False
+                self._pending_types.clear()
+                self._wake.clear()
+            if not self.enabled or not self.master._is_leader():
+                next_scan = 0.0  # scan immediately on re-enable/election
+                continue
+            if not dispatch_only:
+                try:
+                    self._scan_and_enqueue(types)
+                except Exception:
+                    pass
+                if full:
+                    next_scan = time.monotonic() + self.interval
+            self._dispatch()
+
+    def _dispatch(self) -> None:
+        while not self._stopping:
+            task = self.scheduler.next_task()
+            if task is None:
+                return
+            pool = self._pool
+            if pool is None:
+                self.scheduler.complete(task, ok=True)
+                return
+            pool.submit(self._run_task, task)
+
+    def _run_task(self, task: RepairTask) -> None:
+        started = time.time()
+        state, detail, error = "completed", {}, None
+        env = self._command_env()
+        try:
+            if not self.dry_run:
+                # the same exclusive admin lease the shell's repair verbs
+                # demand: while an operator holds `lock`, acquisition 409s,
+                # the task fails into backoff and retries after the human
+                # is done — never interleaving with a manual repair.
+                # Dry-run only plans (read-only): no lease needed.
+                self._acquire_lease(env)
+            try:
+                detail = executors_mod.execute(
+                    task, env, dry_run=self.dry_run
+                )
+            finally:
+                if not self.dry_run:
+                    self._release_lease(env)
+            if self.dry_run:
+                state = "planned"
+        except Exception as e:
+            state, error = "failed", str(e)[:300]
+        duration = time.time() - started
+        ok = state != "failed"
+        retry_in = self.scheduler.complete(task, ok=ok)
+        # a finished task frees a cap/throttle slot: wake the loop so the
+        # next queued task dispatches now, not a full scan interval later
+        if not self._stopping:
+            self._wake.set()
+        self._m_tasks.labels(task.type, state).inc()
+        if state != "planned":  # planning costs nothing worth histogramming
+            self._m_seconds.labels(task.type).observe(duration)
+        if not ok:
+            self._m_failures.labels(task.type).inc()
+        entry = {
+            "task": task.to_dict(), "state": state,
+            "started": round(started, 3),
+            "duration_ms": round(duration * 1000.0, 2),
+        }
+        if detail.get("planned") is not None:
+            entry["planned"] = detail["planned"]
+        if detail.get("applied") is not None:
+            entry["applied"] = detail["applied"]
+        if error is not None:
+            entry["error"] = error
+            entry["retry_in"] = round(retry_in, 2)
+        with self._lock:
+            self._history.append(entry)
+            k = (task.type, state)
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    # --- views ----------------------------------------------------------------
+    def _queue_depth_lines(self) -> list[str]:
+        from seaweedfs_tpu.stats.metrics import _fmt_labels
+
+        lines = ["# TYPE SeaweedFS_maintenance_queue_depth gauge"]
+        depths = self.scheduler.queue_depths()
+        for task_type in sorted(TASK_TYPES):
+            d = depths.get(task_type, {"queued": 0, "in_flight": 0})
+            for st in ("queued", "in_flight"):
+                lines.append(
+                    "SeaweedFS_maintenance_queue_depth"
+                    + _fmt_labels(("task", "state"), (task_type, st))
+                    + f" {d[st]}"
+                )
+        return lines
+
+    def status(self, history_limit: int = 50) -> dict:
+        with self._lock:
+            history = list(self._history)[-history_limit:]
+            counts: dict[str, dict[str, int]] = {}
+            for (task_type, state), n in sorted(self._counts.items()):
+                counts.setdefault(task_type, {})[state] = n
+        return {
+            "enabled": self.enabled,
+            "dry_run": self.dry_run,
+            "interval": self.interval,
+            "scans": self.scans,
+            "started_at": self.started_at,
+            "task_types": {
+                name: {"priority": spec.priority,
+                       "concurrency": spec.concurrency,
+                       "description": spec.description}
+                for name, spec in TASK_TYPES.items()
+            },
+            "scheduler": self.scheduler.snapshot(),
+            "counts": counts,
+            "history": history,
+        }

@@ -1,0 +1,228 @@
+"""Maintenance detectors: periodic scans of the master's live topology
+that emit typed RepairTasks — the "detect" leg of detect → plan → heal.
+
+Each detector reads the same state PRs 2–4 taught the master to export
+(`volume_layout.under_replicated()`, `topology.ec_missing_shards()`,
+heartbeat ages, per-volume deleted-byte counters) and turns a fault into
+a `RepairTask` the scheduler can dedup, prioritize and throttle. The
+reference runs the same scans inside the master
+(`topology_vacuum.go:216`, `command_volume_fix_replication.go`,
+`command_ec_rebuild.go`) but as operator verbs; RapidRAID
+(arXiv:1207.6744) and the online-EC study (arXiv:1709.05365) both show
+scheduling — not codec speed — dominates degraded-mode tails, so
+detection here only *emits* tasks; pacing lives in scheduler.py.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from seaweedfs_tpu.storage.erasure_coding import geometry
+
+
+@dataclass(frozen=True)
+class TaskSpec:
+    """One registered maintenance task type. Names ride into the
+    `task` label of every SeaweedFS_maintenance_* metric, so
+    tools/check_metric_names.py lints them (unique snake_case)."""
+
+    name: str
+    priority: int  # default priority, lower = more urgent
+    concurrency: int  # per-type in-flight cap
+    description: str
+
+
+# the registry: detectors/executors key on these names
+TASK_TYPES: dict[str, TaskSpec] = {
+    spec.name: spec
+    for spec in (
+        TaskSpec("fix_replication", 0, 2,
+                 "copy a replica of an under-replicated volume"),
+        TaskSpec("ec_rebuild", 1, 1,
+                 "rebuild missing RS(10,4) shards on the Pallas path"),
+        TaskSpec("evacuate", 2, 1,
+                 "pre-copy replicas off a stale-heartbeat node"),
+        TaskSpec("vacuum", 3, 1,
+                 "compact a volume whose deleted-bytes crossed the"
+                 " threshold"),
+        TaskSpec("balance", 4, 1,
+                 "even out volume counts across nodes"),
+    )
+}
+
+
+@dataclass(frozen=True)
+class RepairTask:
+    """One unit of planned repair work. `key` is the dedup identity: the
+    scheduler refuses a task whose key is already queued or in flight."""
+
+    type: str
+    volume_id: int | None = None
+    collection: str = ""
+    node: str = ""  # node id the repair primarily loads (per-node limits)
+    priority: int = 10
+    reason: str = ""
+    params: dict = field(default_factory=dict, compare=False, hash=False)
+
+    def __post_init__(self):
+        if self.type not in TASK_TYPES:
+            raise ValueError(f"unknown maintenance task type {self.type!r}")
+
+    @property
+    def key(self) -> tuple:
+        # volume-scoped repairs dedup on the volume alone: the holder
+        # node recorded for per-node limits follows topology iteration
+        # order, and keying on it would let the SAME fault enqueue twice
+        # when holders reorder between scans (double-replicating it).
+        # Node-scoped tasks (evacuate, balance) dedup on the node.
+        if self.volume_id is not None:
+            return (self.type, self.volume_id)
+        return (self.type, self.node)
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type, "volume_id": self.volume_id,
+            "collection": self.collection, "node": self.node,
+            "priority": self.priority, "reason": self.reason,
+            "params": dict(self.params),
+        }
+
+
+def _task(type_: str, **kw) -> RepairTask:
+    kw.setdefault("priority", TASK_TYPES[type_].priority)
+    return RepairTask(type=type_, **kw)
+
+
+def detect_under_replicated(master) -> list[RepairTask]:
+    """volume_layout.under_replicated(), the source feeding the
+    `SeaweedFS_master_volumes_underreplicated` gauge."""
+    tasks = []
+    for coll, vid, have, want in master.topo.under_replicated_volumes():
+        holders = master.topo.lookup(vid, coll)
+        if not holders:
+            continue  # nothing left to copy from
+        tasks.append(_task(
+            "fix_replication", volume_id=vid, collection=coll,
+            node=holders[0].id,
+            reason=f"{have}/{want} replicas",
+            params={"have": have, "want": want},
+        ))
+    return tasks
+
+
+def detect_ec_missing_shards(master) -> list[RepairTask]:
+    """topology.ec_missing_shards(), the `SeaweedFS_master_ec_missing_shards`
+    source. Only recoverable volumes (>= 10 shards survive) become tasks."""
+    total = geometry.TOTAL_SHARDS_COUNT
+    data = geometry.DATA_SHARDS_COUNT
+    tasks = []
+    for vid, missing in sorted(master.topo.ec_missing_shards().items()):
+        present = total - missing
+        if present < data:
+            continue  # unrecoverable: rebuilding needs 10 of 14
+        shard_map = master.topo.lookup_ec_shards(vid) or {}
+        holders = sorted({n.id for nodes in shard_map.values() for n in nodes})
+        if not holders:
+            continue
+        tasks.append(_task(
+            "ec_rebuild", volume_id=vid,
+            collection=master.topo.ec_collections.get(vid, ""),
+            node=holders[0],
+            reason=f"{missing} shard(s) without a live holder",
+            params={"missing": missing, "present": present},
+        ))
+    return tasks
+
+
+def detect_vacuum_candidates(master) -> list[RepairTask]:
+    """Deleted-bytes share over the master's garbage threshold — the same
+    scan the legacy auto-vacuum ran, now emitting schedulable tasks."""
+    tasks = []
+    seen: set[int] = set()
+    threshold = master.garbage_threshold
+    for node, vid, ratio in master.topo.vacuum_candidates(threshold):
+        if vid in seen:  # one task per volume; the executor hits every holder
+            continue
+        seen.add(vid)
+        tasks.append(_task(
+            "vacuum", volume_id=vid, node=node.id,
+            reason=f"garbage {ratio:.1%} > {threshold:.0%}",
+            params={"garbage_ratio": round(ratio, 4)},
+        ))
+    return tasks
+
+
+def detect_imbalance(master, slack: int = 2) -> list[RepairTask]:
+    """Volume-count spread across nodes beyond `slack` emits one
+    cluster-wide balance task (the executor plans the full move list)."""
+    nodes = master.topo.all_nodes()
+    if len(nodes) < 2:
+        return []
+    counts = {n.id: len(n.volumes) for n in nodes}
+    lo, hi = min(counts.values()), max(counts.values())
+    if hi - lo <= slack:
+        return []
+    busiest = max(counts, key=lambda k: counts[k])
+    return [_task(
+        "balance", node=busiest,
+        reason=f"volume counts spread {lo}..{hi} (> {slack})",
+        params={"min": lo, "max": hi},
+    )]
+
+
+def detect_stale_nodes(master) -> list[RepairTask]:
+    """Nodes whose heartbeat is stale (3x pulse — the PR-4 heartbeat_stale
+    alert threshold) but not yet expired (5x pulse) are evacuation
+    candidates: pre-copy their replicas from surviving holders before the
+    master forgets the node entirely."""
+    now = time.time()
+    stale_after = 3 * max(master.topo.pulse_seconds, 1)
+    tasks = []
+    for node in master.topo.all_nodes():
+        age = now - node.last_seen
+        if age <= stale_after:
+            continue
+        tasks.append(_task(
+            "evacuate", node=node.id,
+            reason=f"heartbeat {age:.1f}s stale",
+            params={"age": round(age, 1)},
+        ))
+    return tasks
+
+
+# task type -> detector; the daemon iterates this to scan
+DETECTORS = {
+    "fix_replication": detect_under_replicated,
+    "ec_rebuild": detect_ec_missing_shards,
+    "vacuum": detect_vacuum_candidates,
+    "balance": detect_imbalance,
+    "evacuate": detect_stale_nodes,
+}
+
+
+_warned_detectors: set[str] = set()
+
+
+def scan(master, types=None) -> list[RepairTask]:
+    """Run the selected detectors (all by default) against the master's
+    live topology. A broken detector must not sink the scan, but a
+    silently dead repair class is worse — the first failure per detector
+    is logged (the alerts push-loop convention)."""
+    from seaweedfs_tpu.util import glog
+
+    tasks: list[RepairTask] = []
+    for name, fn in DETECTORS.items():
+        if types is not None and name not in types:
+            continue
+        try:
+            tasks.extend(fn(master))
+            _warned_detectors.discard(name)
+        except Exception as e:
+            if name not in _warned_detectors:
+                _warned_detectors.add(name)
+                glog.warning(
+                    "maintenance detector %s failing (repair class idle"
+                    " until it recovers): %s", name, e,
+                )
+    return tasks

@@ -1,0 +1,158 @@
+"""Maintenance executors: the "heal" leg of detect → plan → heal.
+
+Each executor drives the SAME plan/apply helpers the admin-shell repair
+verbs use (`volume.fix.replication`, `ec.rebuild`, `volume.vacuum`,
+`volume.balance` — shell/commands_volume.py + commands_ec.py), so humans
+and the daemon repair through one code path and one -dryRun/-apply
+convention. An executor returns {"planned": [...]} in dry-run mode and
+{"planned": [...], "applied": [...]} after a real repair; raising marks
+the task failed (the scheduler arms backoff).
+"""
+
+from __future__ import annotations
+
+from seaweedfs_tpu.shell.commands_ec import (
+    apply_rebuild,
+    describe_rebuild,
+    plan_rebuild,
+)
+from seaweedfs_tpu.shell.commands_volume import (
+    apply_balance,
+    apply_fix_replication,
+    apply_vacuum,
+    describe_balance,
+    describe_fix_replication,
+    describe_vacuum,
+    plan_balance,
+    plan_fix_replication,
+    plan_vacuum,
+)
+
+from .detectors import RepairTask
+
+
+def _exec_fix_replication(task: RepairTask, env, dry_run: bool) -> dict:
+    actions = plan_fix_replication(env, task.volume_id)
+    planned = describe_fix_replication(actions)
+    if dry_run:
+        return {"planned": planned}
+    if actions and all(a.get("target") is None for a in actions):
+        raise RuntimeError(
+            f"volume {task.volume_id}: no candidate server for a new replica"
+        )
+    return {"planned": planned,
+            "applied": apply_fix_replication(env, actions)}
+
+
+def _exec_ec_rebuild(task: RepairTask, env, dry_run: bool) -> dict:
+    plan = plan_rebuild(env, task.volume_id, task.collection)
+    if plan is None:  # healed between detection and dispatch
+        return {"planned": [], "applied": []}
+    planned = describe_rebuild(plan)
+    if dry_run:
+        return {"planned": planned}
+    rebuilt = apply_rebuild(env, plan)
+    return {"planned": planned,
+            "applied": [f"rebuilt shards {rebuilt} on {plan['rebuilder']}"]}
+
+
+def _exec_vacuum(task: RepairTask, env, dry_run: bool) -> dict:
+    actions = plan_vacuum(env, volume_id=task.volume_id)
+    planned = describe_vacuum(actions)
+    if dry_run:
+        return {"planned": planned}
+    return {"planned": planned, "applied": apply_vacuum(env, actions)}
+
+
+def _exec_balance(task: RepairTask, env, dry_run: bool) -> dict:
+    actions = plan_balance(env)
+    planned = describe_balance(actions)
+    if dry_run:
+        return {"planned": planned}
+    return {"planned": planned, "applied": apply_balance(env, actions)}
+
+
+def _plan_evacuate(env, node_id: str) -> list[dict]:
+    """Copy actions moving the stale node's replicas onto healthy nodes,
+    sourcing from SURVIVING holders (the stale node is presumed
+    unreachable — `command_volume_server_evacuate.go`, degraded variant).
+    Volumes with no other holder are reported, not silently skipped."""
+    servers = env.servers()
+    stale = next((sv for sv in servers if sv.id == node_id), None)
+    if stale is None:
+        return []  # already expired: fix_replication owns it now
+    healthy = [sv for sv in servers if sv.id != node_id]
+    actions = []
+    for vid in sorted(stale.volumes):
+        others = [sv for sv in healthy if vid in sv.volumes]
+        if not others:
+            actions.append({"volume": vid, "source": None, "target": None})
+            continue
+        ranked = sorted(
+            (sv for sv in healthy
+             if vid not in sv.volumes and sv.free_slots() > 0),
+            key=lambda sv: -sv.free_slots(),
+        )
+        if not ranked:
+            actions.append({"volume": vid, "source": others[0].id,
+                            "target": None})
+            continue
+        dst = ranked[0]
+        actions.append({"volume": vid, "source": others[0].id,
+                        "source_url": others[0].http,
+                        "target": dst.id, "target_url": dst.http})
+        dst.volumes[vid] = stale.volumes[vid]  # keep the local view fresh
+    return actions
+
+
+def _exec_evacuate(task: RepairTask, env, dry_run: bool) -> dict:
+    actions = _plan_evacuate(env, task.node)
+    planned = []
+    for a in actions:
+        if a.get("target") is None:
+            planned.append(
+                f"volume {a['volume']}: "
+                + ("no surviving replica to copy from"
+                   if a.get("source") is None else "no candidate target")
+            )
+        else:
+            planned.append(
+                f"volume {a['volume']}: copy {a['source']} -> {a['target']}"
+            )
+    if dry_run:
+        return {"planned": planned}
+    applied = []
+    for a in actions:
+        if a.get("target") is None or a.get("source") is None:
+            continue
+        env.post(
+            f"{a['target_url']}/admin/volume/copy",
+            {"volume": a["volume"], "source": a["source_url"]},
+        )
+        applied.append(
+            f"volume {a['volume']}: copied {a['source']} -> {a['target']}"
+        )
+    return {"planned": planned, "applied": applied}
+
+
+EXECUTORS = {
+    "fix_replication": _exec_fix_replication,
+    "ec_rebuild": _exec_ec_rebuild,
+    "vacuum": _exec_vacuum,
+    "balance": _exec_balance,
+    "evacuate": _exec_evacuate,
+}
+
+
+def execute(task: RepairTask, env, dry_run: bool = False) -> dict:
+    """Run one task's executor; every repair is traced as a
+    `maintenance.<type>` span so /debug/traces and cluster.trace show
+    healing next to the foreground traffic it must not starve."""
+    from seaweedfs_tpu.stats import trace
+
+    fn = EXECUTORS[task.type]
+    with trace.span(
+        f"maintenance.{task.type}", role="master",
+        volume=task.volume_id, node=task.node, dry_run=dry_run,
+    ):
+        return fn(task, env, dry_run)

@@ -1,0 +1,226 @@
+"""Repair scheduler: the "plan" leg of detect → plan → heal.
+
+A bounded priority queue with the pacing machinery arXiv:1207.6744
+(RapidRAID) and arXiv:1709.05365 argue matters more than codec speed:
+
+  * per-task-type concurrency caps (TASK_TYPES[..].concurrency) — one
+    runaway class of repair cannot monopolize the workers;
+  * per-node in-flight limits — a node already copying a replica is not
+    also handed an EC rebuild (degraded reads on that node would pay).
+    The limit binds the task's PRIMARY node (the source holder /
+    rebuilder / vacuum holder recorded in its key); copy TARGETS are
+    picked at plan time inside the executor and are not reserved here,
+    so two concurrent repairs may still land copies on one target;
+  * dedup by task key — a fault detected on every scan enqueues once;
+  * exponential backoff with jitter on failed repairs — a node that
+    refuses a copy is retried at 2s, 4s, 8s... (+-50% jitter so a
+    thundering herd of failed tasks does not re-arrive in lockstep);
+  * a global token-bucket repair throttle (repair_rate/s, burst) — the
+    aggregate healing rate is bounded so foreground traffic never
+    starves behind a repair storm.
+
+Everything takes an optional `now` so tests drive time deterministically.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+import threading
+import time
+
+from .detectors import TASK_TYPES, RepairTask
+
+
+class RepairScheduler:
+    def __init__(
+        self,
+        max_queue: int = 256,
+        per_node_limit: int = 1,
+        global_limit: int = 4,
+        type_caps: dict[str, int] | None = None,
+        repair_rate: float = 2.0,
+        repair_burst: float = 4.0,
+        backoff_base: float = 2.0,
+        backoff_max: float = 120.0,
+        rng: random.Random | None = None,
+    ) -> None:
+        self.max_queue = max_queue
+        self.per_node_limit = per_node_limit
+        self.global_limit = global_limit
+        self.type_caps = {
+            name: spec.concurrency for name, spec in TASK_TYPES.items()
+        }
+        if type_caps:
+            self.type_caps.update(type_caps)
+        self.repair_rate = repair_rate
+        self.repair_burst = repair_burst
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self._heap: list[tuple[int, int, RepairTask]] = []
+        self._seq = 0
+        self._queued: dict[tuple, RepairTask] = {}
+        self._in_flight: dict[tuple, RepairTask] = {}
+        self._node_inflight: dict[str, int] = {}
+        self._type_inflight: dict[str, int] = {}
+        # key -> {"failures": n, "not_before": ts}
+        self._backoff: dict[tuple, dict] = {}
+        self._tokens = repair_burst
+        self._tokens_ts: float | None = None
+        self.stats = {
+            "offered": 0, "deduped": 0, "backed_off": 0, "queue_full": 0,
+            "dispatched": 0, "completed": 0, "failed": 0,
+            "max_node_inflight": 0, "max_inflight": 0,
+        }
+
+    # --- intake ---------------------------------------------------------------
+    def offer(self, task: RepairTask, now: float | None = None) -> bool:
+        """Admit a detected task. False when it is already queued/in
+        flight, still backing off from a failure, or the queue is full."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self.stats["offered"] += 1
+            key = task.key
+            if key in self._queued or key in self._in_flight:
+                self.stats["deduped"] += 1
+                return False
+            bo = self._backoff.get(key)
+            if bo is not None and bo["not_before"] > now:
+                self.stats["backed_off"] += 1
+                return False
+            if len(self._queued) >= self.max_queue:
+                self.stats["queue_full"] += 1
+                return False
+            self._seq += 1
+            heapq.heappush(self._heap, (task.priority, self._seq, task))
+            self._queued[key] = task
+            return True
+
+    # --- dispatch -------------------------------------------------------------
+    def _refill(self, now: float) -> None:
+        if self._tokens_ts is None:
+            self._tokens_ts = now
+        self._tokens = min(
+            self.repair_burst,
+            self._tokens + (now - self._tokens_ts) * self.repair_rate,
+        )
+        self._tokens_ts = now
+
+    def next_task(self, now: float | None = None) -> RepairTask | None:
+        """Pop the most urgent runnable task, honoring every cap. Tasks
+        blocked by a cap stay queued for the next call."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._refill(now)
+            if self._tokens < 1.0:
+                return None
+            if len(self._in_flight) >= self.global_limit:
+                return None
+            deferred = []
+            picked = None
+            while self._heap:
+                prio, seq, task = heapq.heappop(self._heap)
+                if task.key not in self._queued:  # stale heap entry
+                    continue
+                if (
+                    self._type_inflight.get(task.type, 0)
+                    >= self.type_caps.get(task.type, 1)
+                    or (task.node and self._node_inflight.get(task.node, 0)
+                        >= self.per_node_limit)
+                ):
+                    deferred.append((prio, seq, task))
+                    continue
+                picked = task
+                break
+            for entry in deferred:
+                heapq.heappush(self._heap, entry)
+            if picked is None:
+                return None
+            self._tokens -= 1.0
+            del self._queued[picked.key]
+            self._in_flight[picked.key] = picked
+            self._type_inflight[picked.type] = (
+                self._type_inflight.get(picked.type, 0) + 1
+            )
+            if picked.node:
+                n = self._node_inflight.get(picked.node, 0) + 1
+                self._node_inflight[picked.node] = n
+                self.stats["max_node_inflight"] = max(
+                    self.stats["max_node_inflight"], n
+                )
+            self.stats["dispatched"] += 1
+            self.stats["max_inflight"] = max(
+                self.stats["max_inflight"], len(self._in_flight)
+            )
+            return picked
+
+    def complete(
+        self, task: RepairTask, ok: bool, now: float | None = None
+    ) -> float:
+        """Mark a dispatched task finished. On failure, arm exponential
+        backoff with jitter and return the retry delay (0.0 on success)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            key = task.key
+            self._in_flight.pop(key, None)
+            t = self._type_inflight.get(task.type, 0)
+            self._type_inflight[task.type] = max(0, t - 1)
+            if task.node:
+                n = self._node_inflight.get(task.node, 0)
+                self._node_inflight[task.node] = max(0, n - 1)
+            if ok:
+                self.stats["completed"] += 1
+                self._backoff.pop(key, None)
+                return 0.0
+            self.stats["failed"] += 1
+            bo = self._backoff.setdefault(
+                key, {"failures": 0, "not_before": 0.0}
+            )
+            bo["failures"] += 1
+            delay = min(
+                self.backoff_max,
+                self.backoff_base * 2 ** (bo["failures"] - 1),
+            ) * (0.5 + self._rng.random())  # +-50% jitter
+            bo["not_before"] = now + delay
+            return delay
+
+    # --- views ----------------------------------------------------------------
+    def queue_depths(self) -> dict[str, dict[str, int]]:
+        """{task_type: {queued, in_flight}} for the metrics collector."""
+        with self._lock:
+            out: dict[str, dict[str, int]] = {}
+            for t in self._queued.values():
+                out.setdefault(t.type, {"queued": 0, "in_flight": 0})
+                out[t.type]["queued"] += 1
+            for t in self._in_flight.values():
+                out.setdefault(t.type, {"queued": 0, "in_flight": 0})
+                out[t.type]["in_flight"] += 1
+            return out
+
+    def snapshot(self, now: float | None = None) -> dict:
+        now = time.time() if now is None else now
+        with self._lock:
+            return {
+                "queued": [
+                    t.to_dict() for _, _, t in sorted(self._heap)
+                    if t.key in self._queued
+                ],
+                "in_flight": [t.to_dict() for t in self._in_flight.values()],
+                "backoff": [
+                    {"type": k[0], "target": k[1],
+                     "failures": v["failures"],
+                     "retry_in": max(0.0, round(v["not_before"] - now, 2))}
+                    for k, v in self._backoff.items()
+                ],
+                "stats": dict(self.stats),
+                "limits": {
+                    "max_queue": self.max_queue,
+                    "per_node_limit": self.per_node_limit,
+                    "global_limit": self.global_limit,
+                    "type_caps": dict(self.type_caps),
+                    "repair_rate": self.repair_rate,
+                    "repair_burst": self.repair_burst,
+                },
+            }

@@ -35,6 +35,9 @@ class MasterServer:
         peers: list[str] | None = None,
         raft_dir: str | None = None,
         slow_ms: float | None = None,
+        maintenance: bool = False,
+        maintenance_dry_run: bool = False,
+        maintenance_interval: float | None = None,
     ) -> None:
         seq = MemorySequencer(f"{meta_dir}/sequence.json" if meta_dir else None)
         self.topo = Topology(
@@ -70,6 +73,14 @@ class MasterServer:
         # observed via a request) forces a re-sync against the replicated
         # ceiling before ids are handed out (advisor r1 finding #1)
         self._seq_synced_term = -1
+        # autonomous maintenance (seaweedfs_tpu/maintenance): off by
+        # default; -maintenance starts the detect->plan->heal daemon,
+        # -maintenance.dryRun plans without executing
+        self.maintenance = None
+        self._maintenance_flag = maintenance
+        self._maintenance_dry_run = maintenance_dry_run
+        self._maintenance_interval = maintenance_interval
+        self._maintenance_lock = threading.Lock()
         self._routes()
 
     # --- lifecycle -------------------------------------------------------------
@@ -98,6 +109,34 @@ class MasterServer:
                  if p.rstrip("/") != self.url]
             )
         threading.Thread(target=self._maintenance_loop, daemon=True).start()
+        if self._maintenance_flag:
+            self._ensure_maintenance(dry_run=self._maintenance_dry_run)
+
+    def _ensure_maintenance(self, dry_run: bool | None = False):
+        """Create (or reconfigure) and start the maintenance daemon — the
+        `-maintenance` flag at boot, or `cluster.maintenance -enable` at
+        runtime. dry_run=None preserves the daemon's current mode: a bare
+        re-enable must not silently flip a dry-run daemon into mutating
+        mode. Locked: two racing /maintenance/enable requests must not
+        each start (and one leak) a daemon, and an enable racing stop()
+        must not start a daemon that outlives the master."""
+        with self._maintenance_lock:
+            if self._stop.is_set():
+                raise RuntimeError("master is stopping")
+            if self.maintenance is None:
+                from seaweedfs_tpu.maintenance import MaintenanceDaemon
+
+                daemon = MaintenanceDaemon(
+                    self, interval=self._maintenance_interval,
+                    dry_run=bool(dry_run),
+                )
+                daemon.start()
+                self.maintenance = daemon
+            else:
+                if dry_run is not None:
+                    self.maintenance.dry_run = bool(dry_run)
+                self.maintenance.enabled = True
+            return self.maintenance
 
     # --- topology gauges --------------------------------------------------------
     MASTER_METRIC_FAMILIES = (
@@ -314,6 +353,12 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        # under the same lock as _ensure_maintenance: an in-flight enable
+        # must either finish first (and be stopped here) or observe _stop
+        with self._maintenance_lock:
+            if self.maintenance is not None:
+                self.maintenance.stop()
+                self.maintenance = None
         if getattr(self, "_metrics_collector", None) is not None:
             from seaweedfs_tpu.stats import default_registry
 
@@ -407,23 +452,24 @@ class MasterServer:
 
         if not getattr(self, "vacuum_enabled", True):
             return
+        # the maintenance subsystem owns vacuum while its daemon is on
+        # (including dry-run: the legacy loop mutating would break the
+        # "plans with zero mutations" contract)
+        if self.maintenance is not None and self.maintenance.enabled:
+            return
         with trace.span("master.vacuum_check", role="master"):
             self._vacuum_round()
 
     def _vacuum_round(self) -> None:
-        for node in self.topo.all_nodes():
-            for vid, info in list(node.volumes.items()):
-                if info.size == 0 or info.read_only:
-                    continue
-                if info.deleted_byte_count / max(info.size, 1) > self.garbage_threshold:
-                    try:
-                        post_json(
-                            peer_url(node.url) + "/admin/vacuum",
-                            {"volume": vid},
-                            timeout=120,
-                        )
-                    except Exception:
-                        pass
+        for node, vid, _ in self.topo.vacuum_candidates(self.garbage_threshold):
+            try:
+                post_json(
+                    peer_url(node.url) + "/admin/vacuum",
+                    {"volume": vid},
+                    timeout=120,
+                )
+            except Exception:
+                pass
 
     # --- routes ----------------------------------------------------------------
     def _routes(self) -> None:
@@ -782,6 +828,61 @@ class MasterServer:
                         except Exception:
                             pass
             return Response({"ok": True, "deleted": deleted})
+
+        # --- autonomous maintenance plane (seaweedfs_tpu/maintenance) ---
+        @svc.route("GET", r"/debug/maintenance")
+        def debug_maintenance(req: Request) -> Response:
+            if self.maintenance is None:
+                return Response({"configured": False, "enabled": False})
+            out = self.maintenance.status()
+            out["configured"] = True
+            return Response(out)
+
+        @svc.route("POST", r"/maintenance/enable")
+        def maintenance_enable(req: Request) -> Response:
+            try:
+                p = req.json()
+            except ValueError:
+                p = {}
+            # an absent dryRun key preserves the running daemon's mode —
+            # only an explicit true/false flips it (a bare re-enable must
+            # not silently turn a plan-only daemon into a mutating one)
+            dry = p.get("dryRun")
+            d = self._ensure_maintenance(
+                dry_run=None if dry is None else bool(dry)
+            )
+            return Response({
+                "ok": True, "enabled": True, "dry_run": d.dry_run,
+                "interval": d.interval,
+            })
+
+        @svc.route("POST", r"/maintenance/disable")
+        def maintenance_disable(req: Request) -> Response:
+            if self.maintenance is not None:
+                self.maintenance.enabled = False
+            return Response({"ok": True, "enabled": False})
+
+        @svc.route("POST", r"/maintenance/scan")
+        def maintenance_scan(req: Request) -> Response:
+            """Force a scan now (`cluster.maintenance -now [task]`)."""
+            if self.maintenance is None:
+                return Response({"error": "maintenance not configured"}, 400)
+            try:
+                p = req.json()
+            except ValueError:
+                p = {}
+            task = p.get("task")
+            if task is not None:
+                from seaweedfs_tpu.maintenance import TASK_TYPES
+
+                if task not in TASK_TYPES:
+                    return Response(
+                        {"error": f"unknown task type {task!r}"
+                         f" (known: {sorted(TASK_TYPES)})"}, 400)
+            offered = self.maintenance.scan_now(
+                None if task is None else (task,)
+            )
+            return Response({"ok": True, "offered": offered})
 
         @svc.route("POST", r"/vol/vacuum/disable")
         def vacuum_disable(req: Request) -> Response:

@@ -15,6 +15,7 @@ from . import commands_cluster  # noqa: E402,F401
 from . import commands_volume  # noqa: E402,F401
 from . import commands_ec  # noqa: E402,F401
 from . import commands_fs  # noqa: E402,F401
+from . import commands_maintenance  # noqa: E402,F401
 from . import commands_remote  # noqa: E402,F401
 from . import commands_s3  # noqa: E402,F401
 

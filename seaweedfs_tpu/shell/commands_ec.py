@@ -5,7 +5,7 @@
 from __future__ import annotations
 
 from .env import CommandEnv, ServerView, ShellError
-from .registry import command, parse_flags
+from .registry import command, dry_run_flag, parse_flags, render_plan
 
 TOTAL_SHARDS = 14
 DATA_SHARDS = 10
@@ -151,18 +151,17 @@ def cmd_ec_decode(env: CommandEnv, args: list[str]) -> str:
     return f"ec.decode volume {vid}: reconstructed on {target.id}"
 
 
-@command("ec.rebuild", "-volumeId <n> [-collection name] — rebuild missing "
-         "shards (ref command_ec_rebuild.go:99)", needs_lock=True)
-def cmd_ec_rebuild(env: CommandEnv, args: list[str]) -> str:
-    flags = parse_flags(args)
-    vid = int(flags["volumeId"])
-    collection = flags.get("collection", "")
+def plan_rebuild(env: CommandEnv, vid: int, collection: str = "") -> dict | None:
+    """The rebuild plan for one EC volume: which holder rebuilds, which
+    shards it pulls from whom, which shards are missing. None when all 14
+    shards are present; raises when fewer than 10 survive. Shared between
+    the `ec.rebuild` verb and the maintenance daemon's ec_rebuild executor."""
     servers = env.servers()
     holders = [sv for sv in servers if vid in sv.ec_shards]
     present = sorted({s for sv in holders for s in sv.ec_shards[vid]})
     missing = [s for s in range(TOTAL_SHARDS) if s not in present]
     if not missing:
-        return f"volume {vid}: all {TOTAL_SHARDS} shards present"
+        return None
     if len(present) < DATA_SHARDS:
         raise ShellError(
             f"volume {vid}: only {len(present)} shards left, cannot rebuild"
@@ -170,38 +169,79 @@ def cmd_ec_rebuild(env: CommandEnv, args: list[str]) -> str:
     # rebuilder = holder with the most local shards and enough free slots
     rebuilder = max(holders, key=lambda sv: (len(sv.ec_shards[vid]), sv.free_slots()))
     local = set(rebuilder.ec_shards[vid])
+    pulls = []
     for sv in holders:
         if sv.id == rebuilder.id:
             continue
         pull = [s for s in sv.ec_shards[vid] if s not in local]
         if pull:
-            env.post(
-                f"{rebuilder.http}/admin/ec/copy",
-                {"volume": vid, "collection": collection, "shards": pull,
-                 "source": sv.http},
-                timeout=3600,
-            )
+            pulls.append({"source": sv.id, "source_url": sv.http,
+                          "shards": pull})
             local.update(pull)
+    return {
+        "volume": vid, "collection": collection,
+        "rebuilder": rebuilder.id, "rebuilder_url": rebuilder.http,
+        "missing": missing, "present": present, "pulls": pulls,
+        "own": sorted(rebuilder.ec_shards[vid]),
+    }
+
+
+def describe_rebuild(plan: dict) -> list[str]:
+    """Display lines for a plan_rebuild plan — shared by the verb's
+    dry-run output and /debug/maintenance history."""
+    steps = [
+        f"pull shards {p['shards']} from {p['source']} to"
+        f" {plan['rebuilder']}" for p in plan["pulls"]
+    ]
+    steps.append(f"rebuild shards {plan['missing']} on {plan['rebuilder']}")
+    return steps
+
+
+def apply_rebuild(env: CommandEnv, plan: dict) -> list[int]:
+    """Execute a plan_rebuild plan: pull inputs, rebuild on the Pallas
+    RS(10,4) path, drop pulled-only inputs, re-mount."""
+    vid, collection = plan["volume"], plan["collection"]
+    rb = plan["rebuilder_url"]
+    for p in plan["pulls"]:
+        env.post(
+            f"{rb}/admin/ec/copy",
+            {"volume": vid, "collection": collection,
+             "shards": p["shards"], "source": p["source_url"]},
+            timeout=3600,
+        )
     out = env.post(
-        f"{rebuilder.http}/admin/ec/rebuild",
+        f"{rb}/admin/ec/rebuild",
         {"volume": vid, "collection": collection}, timeout=3600,
     )
     # drop shards the rebuilder only pulled as rebuild inputs, keep its own +
     # the rebuilt ones, then re-mount to refresh its shard list
-    pulled = [s for s in local if s not in rebuilder.ec_shards[vid]]
-    keep = set(rebuilder.ec_shards[vid]) | set(out.get("rebuilt", []))
+    pulled = [s for p in plan["pulls"] for s in p["shards"]]
+    keep = set(plan["own"]) | set(out.get("rebuilt", []))
     drop = [s for s in pulled if s not in keep]
     if drop:
         env.post(
-            f"{rebuilder.http}/admin/ec/delete_shards",
+            f"{rb}/admin/ec/delete_shards",
             {"volume": vid, "collection": collection, "shards": drop},
         )
-    env.post(f"{rebuilder.http}/admin/ec/mount",
+    env.post(f"{rb}/admin/ec/mount",
              {"volume": vid, "collection": collection})
-    return (
-        f"volume {vid}: rebuilt shards {out.get('rebuilt', missing)} on "
-        f"{rebuilder.id}"
-    )
+    return out.get("rebuilt", plan["missing"])
+
+
+@command("ec.rebuild", "-volumeId <n> [-collection name] [-dryRun|-apply] —"
+         " rebuild missing shards (ref command_ec_rebuild.go:99)",
+         needs_lock=True)
+def cmd_ec_rebuild(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    collection = flags.get("collection", "")
+    plan = plan_rebuild(env, vid, collection)
+    if plan is None:
+        return f"volume {vid}: all {TOTAL_SHARDS} shards present"
+    if dry_run_flag(flags):
+        return render_plan("ec.rebuild", describe_rebuild(plan))
+    rebuilt = apply_rebuild(env, plan)
+    return f"volume {vid}: rebuilt shards {rebuilt} on {plan['rebuilder']}"
 
 
 @command("ec.balance", "spread EC shards evenly across servers "

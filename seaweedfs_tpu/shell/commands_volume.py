@@ -8,7 +8,7 @@ from __future__ import annotations
 from seaweedfs_tpu.server.httpd import http_request
 
 from .env import CommandEnv, ServerView, ShellError
-from .registry import command, parse_flags
+from .registry import command, dry_run_flag, parse_flags, render_plan
 
 
 def _find_server(servers: list[ServerView], node_id: str) -> ServerView:
@@ -83,22 +83,55 @@ def cmd_volume_mark(env: CommandEnv, args: list[str]) -> str:
     return f"volume {vid} on {sv.id} marked {'readonly' if readonly else 'writable'}"
 
 
-@command("volume.vacuum", "[-garbageThreshold 0.3] [-volumeId n] — compact garbage")
-def cmd_volume_vacuum(env: CommandEnv, args: list[str]) -> str:
-    flags = parse_flags(args)
-    vid = flags.get("volumeId")
-    done = []
+def plan_vacuum(
+    env: CommandEnv, threshold: float = 0.3, volume_id: int | None = None
+) -> list[dict]:
+    """Replica holders whose garbage ratio crosses the threshold (or every
+    holder of an explicitly named volume). Shared between the
+    `volume.vacuum` verb and the maintenance daemon's vacuum executor."""
+    actions = []
     for sv in env.servers():
         for v in sv.volumes.values():
-            if vid is not None and v["id"] != int(vid):
+            if volume_id is not None and v["id"] != volume_id:
                 continue
-            threshold = float(flags.get("garbageThreshold", 0.3))
-            if vid is None and (
-                v["size"] == 0 or v["garbage"] / max(v["size"], 1) < threshold
-            ):
+            size = v.get("size", 0)
+            ratio = v.get("garbage", 0) / max(size, 1)
+            if volume_id is None and (size == 0 or ratio < threshold):
                 continue
-            env.post(f"{sv.http}/admin/vacuum", {"volume": v["id"]})
-            done.append(f"{v['id']}@{sv.id}")
+            actions.append({
+                "volume": v["id"], "node": sv.id, "node_url": sv.http,
+                "garbage_ratio": round(ratio, 4),
+            })
+    return actions
+
+
+def describe_vacuum(actions: list[dict]) -> list[str]:
+    """Display lines for a plan_vacuum plan — the ONE rendering both the
+    verb's dry-run output and /debug/maintenance history use."""
+    return [
+        f"vacuum volume {a['volume']} on {a['node']}"
+        f" (garbage {a['garbage_ratio']:.1%})" for a in actions
+    ]
+
+
+def apply_vacuum(env: CommandEnv, actions: list[dict]) -> list[str]:
+    done = []
+    for a in actions:
+        env.post(f"{a['node_url']}/admin/vacuum", {"volume": a["volume"]})
+        done.append(f"{a['volume']}@{a['node']}")
+    return done
+
+
+@command("volume.vacuum", "[-garbageThreshold 0.3] [-volumeId n]"
+         " [-dryRun|-apply] — compact garbage")
+def cmd_volume_vacuum(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"]) if "volumeId" in flags else None
+    threshold = float(flags.get("garbageThreshold", 0.3))
+    actions = plan_vacuum(env, threshold, vid)
+    if dry_run_flag(flags):
+        return render_plan("volume.vacuum", describe_vacuum(actions))
+    done = apply_vacuum(env, actions)
     return "vacuumed: " + (", ".join(done) if done else "nothing to do")
 
 
@@ -161,12 +194,26 @@ def cmd_volume_check_disk(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(lines) if lines else "all replicas are in sync"
 
 
-@command("volume.fix.replication", "re-replicate under-replicated volumes "
-         "(ref command_volume_fix_replication.go:58)", needs_lock=True)
-def cmd_volume_fix_replication(env: CommandEnv, args: list[str]) -> str:
+def plan_fix_replication(
+    env: CommandEnv, volume_id: int | None = None
+) -> list[dict]:
+    """Planned replica copies for every under-replicated volume (or one
+    named volume): rack-spreading target choice, one action per missing
+    replica. Shared between the `volume.fix.replication` verb and the
+    maintenance daemon's fix_replication executor — humans and the daemon
+    repair through the same plan."""
     servers = env.servers()
-    lines = []
-    for vid, holders in sorted(env.volume_replicas().items()):
+    # replica map off the snapshot just fetched — env.volume_replicas()
+    # would pay a second full /dir/status round-trip per plan (and the
+    # daemon plans once per task)
+    replicas: dict[int, list[ServerView]] = {}
+    for sv in servers:
+        for vid in sv.volumes:
+            replicas.setdefault(vid, []).append(sv)
+    actions = []
+    for vid, holders in sorted(replicas.items()):
+        if volume_id is not None and vid != volume_id:
+            continue
         info = holders[0].volumes[vid]
         rp = info.get("replica_placement", 0)
         want = (rp // 100) + (rp // 10) % 10 + rp % 10 + 1
@@ -180,50 +227,138 @@ def cmd_volume_fix_replication(env: CommandEnv, args: list[str]) -> str:
             key=lambda sv: ((sv.dc, sv.rack) in holder_racks, -sv.free_slots()),
         )
         for _ in range(want - len(holders)):
+            action = {"volume": vid, "have": len(holders), "want": want,
+                      "source": holders[0].id, "source_url": holders[0].http}
             if not candidates:
-                lines.append(f"volume {vid}: no candidate server")
+                action.update(target=None, target_url=None)
+                actions.append(action)
                 break
             dst = candidates.pop(0)
-            env.post(
-                f"{dst.http}/admin/volume/copy",
-                {"volume": vid, "source": holders[0].http},
-            )
-            lines.append(f"volume {vid}: replicated to {dst.id}")
+            action.update(target=dst.id, target_url=dst.http)
+            actions.append(action)
+    return actions
+
+
+def describe_fix_replication(actions: list[dict]) -> list[str]:
+    """Display lines for a plan_fix_replication plan — shared by the
+    verb's dry-run output and /debug/maintenance history."""
+    return [
+        f"volume {a['volume']} ({a['have']}/{a['want']} replicas): copy"
+        f" {a['source']} -> {a['target'] or 'NO CANDIDATE'}"
+        for a in actions
+    ]
+
+
+def apply_fix_replication(env: CommandEnv, actions: list[dict]) -> list[str]:
+    lines = []
+    for a in actions:
+        if a.get("target") is None:
+            lines.append(f"volume {a['volume']}: no candidate server")
+            continue
+        env.post(
+            f"{a['target_url']}/admin/volume/copy",
+            {"volume": a["volume"], "source": a["source_url"]},
+        )
+        lines.append(f"volume {a['volume']}: replicated to {a['target']}")
+    return lines
+
+
+@command("volume.fix.replication", "[-volumeId n] [-dryRun|-apply] —"
+         " re-replicate under-replicated volumes"
+         " (ref command_volume_fix_replication.go:58)", needs_lock=True)
+def cmd_volume_fix_replication(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"]) if "volumeId" in flags else None
+    actions = plan_fix_replication(env, vid)
+    if dry_run_flag(flags):
+        return render_plan("volume.fix.replication",
+                           describe_fix_replication(actions))
+    lines = apply_fix_replication(env, actions)
     return "\n".join(lines) if lines else "all volumes sufficiently replicated"
 
 
-@command("volume.balance", "even out volume counts across servers "
-         "(ref command_volume_balance.go)", needs_lock=True)
-def cmd_volume_balance(env: CommandEnv, args: list[str]) -> str:
-    flags = parse_flags(args)
-    collection = flags.get("collection")
-    servers = env.servers()
+def plan_balance(
+    env: CommandEnv, collection: str | None = None,
+    servers: list[ServerView] | None = None,
+) -> list[dict]:
+    """The move list `volume.balance` would perform, computed by running
+    the convergence loop against a local copy of the topology snapshot —
+    no mutations. Shared with the maintenance balance executor. Pass
+    `servers` to reuse an already-fetched snapshot."""
+    servers = env.servers() if servers is None else servers
     if len(servers) < 2:
-        return "nothing to balance (fewer than 2 servers)"
-    moved = []
+        return []
+    # simulated state: per-node eligible volumes + full membership (a move
+    # must not land a volume on a node already holding a replica of it)
+    vols = {
+        sv.id: {
+            vid: v for vid, v in sv.volumes.items()
+            if collection is None or v.get("collection", "") == collection
+        }
+        for sv in servers
+    }
+    membership = {sv.id: set(sv.volumes) for sv in servers}
+    urls = {sv.id: sv.http for sv in servers}
+    actions = []
     for _ in range(100):  # converge
-        def count(sv: ServerView) -> int:
-            return sum(
-                1 for v in sv.volumes.values()
-                if collection is None or v.get("collection", "") == collection
-            )
-
-        servers.sort(key=count)
-        low, high = servers[0], servers[-1]
-        if count(high) - count(low) <= 1:
+        order = sorted(servers, key=lambda sv: len(vols[sv.id]))
+        low, high = order[0], order[-1]
+        if len(vols[high.id]) - len(vols[low.id]) <= 1:
             break
-        # move the smallest eligible volume whose replicas aren't already on low
         movable = [
-            v for v in high.volumes.values()
-            if (collection is None or v.get("collection", "") == collection)
-            and v["id"] not in low.volumes
+            v for vid, v in vols[high.id].items()
+            if vid not in membership[low.id]
         ]
         if not movable:
             break
         pick = min(movable, key=lambda v: v["size"])
-        _move_volume(env, pick["id"], high, low)
-        moved.append(f"{pick['id']}: {high.id} -> {low.id}")
-        servers = env.servers()  # refresh
+        vid = pick["id"]
+        actions.append({
+            "volume": vid, "source": high.id, "source_url": urls[high.id],
+            "target": low.id, "target_url": urls[low.id],
+        })
+        del vols[high.id][vid]
+        membership[high.id].discard(vid)
+        vols[low.id][vid] = pick
+        membership[low.id].add(vid)
+    return actions
+
+
+def describe_balance(actions: list[dict]) -> list[str]:
+    """Display lines for a plan_balance plan — shared by the verb's
+    dry-run output and /debug/maintenance history."""
+    return [
+        f"move volume {a['volume']}: {a['source']} -> {a['target']}"
+        for a in actions
+    ]
+
+
+def apply_balance(env: CommandEnv, actions: list[dict]) -> list[str]:
+    from types import SimpleNamespace
+
+    moved = []
+    for a in actions:
+        _move_volume(
+            env, a["volume"],
+            SimpleNamespace(http=a["source_url"]),
+            SimpleNamespace(http=a["target_url"]),
+        )
+        moved.append(f"{a['volume']}: {a['source']} -> {a['target']}")
+    return moved
+
+
+@command("volume.balance", "[-collection c] [-dryRun|-apply] — even out"
+         " volume counts across servers (ref command_volume_balance.go)",
+         needs_lock=True)
+def cmd_volume_balance(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    servers = env.servers()  # one snapshot: shared with the plan
+    if len(servers) < 2:
+        return "nothing to balance (fewer than 2 servers)"
+    actions = plan_balance(env, flags.get("collection"), servers=servers)
+    if dry_run_flag(flags):
+        return render_plan("volume.balance", describe_balance(actions))
+    moved = apply_balance(env, actions)
     return "\n".join(moved) if moved else "already balanced"
 
 

@@ -72,12 +72,14 @@ class CommandEnv:
         return get_json(url, timeout=timeout)
 
     # --- admin lock (weed/shell lock/unlock) ----------------------------------
-    def acquire_lock(self) -> None:
-        self.post(f"{self.master_url}/cluster/lock", {"holder": self.holder})
+    def acquire_lock(self, timeout: float = 30) -> None:
+        self.post(f"{self.master_url}/cluster/lock", {"holder": self.holder},
+                  timeout=timeout)
         self.locked = True
 
-    def release_lock(self) -> None:
-        self.post(f"{self.master_url}/cluster/unlock", {"holder": self.holder})
+    def release_lock(self, timeout: float = 30) -> None:
+        self.post(f"{self.master_url}/cluster/unlock",
+                  {"holder": self.holder}, timeout=timeout)
         self.locked = False
 
     def require_filer(self) -> str:

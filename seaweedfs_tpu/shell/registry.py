@@ -47,6 +47,25 @@ def parse_flags(argv: list[str]) -> dict[str, str]:
     return out
 
 
+def dry_run_flag(flags: dict) -> bool:
+    """The uniform -dryRun/-apply convention every repair verb shares
+    (volume.fix.replication / ec.rebuild / volume.balance / volume.vacuum,
+    and through them the maintenance executors): -dryRun renders the plan
+    without mutating anything, -apply (the default) executes it."""
+    dry = "dryRun" in flags
+    if dry and "apply" in flags:
+        raise ShellError("pass only one of -dryRun / -apply")
+    return dry
+
+
+def render_plan(verb: str, actions: list[str]) -> str:
+    """Uniform dry-run output: what -apply would do, one action per line."""
+    if not actions:
+        return f"{verb} (dry run): nothing to do"
+    head = f"{verb} (dry run): {len(actions)} action(s) planned:"
+    return "\n".join([head] + ["  " + a for a in actions])
+
+
 def run_command(env: CommandEnv, line: str) -> str:
     argv = shlex.split(line)
     if not argv:

@@ -227,6 +227,10 @@ class AlertEngine:
         self._lock = threading.Lock()
         self.firing: dict[str, dict] = {}  # name -> {severity,since,value,detail}
         self.fired_events = 0  # rising edges since process start
+        # rising-edge listeners: fn(rule_name, info) called once per edge
+        # (not while a rule keeps firing) — the maintenance daemon reacts
+        # to disk_near_cap/heartbeat_stale through this hook
+        self._on_fire: list = []
         self._last_eval = 0.0
         self._fired_total = self.registry.counter(
             "SeaweedFS_alerts_fired_total",
@@ -249,6 +253,21 @@ class AlertEngine:
             raise ValueError(f"unknown alert params: {sorted(unknown)}")
         self.params.update(params)
 
+    def add_on_fire(self, fn) -> None:
+        """Subscribe to rising edges: fn(rule_name, info) fires once when a
+        rule transitions to firing (info = {severity, since, value,
+        detail}). Listeners run outside the engine lock, after the firing
+        state is committed; a raising listener is swallowed (it must not
+        take down the scrape that evaluated the rules)."""
+        with self._lock:
+            if fn not in self._on_fire:
+                self._on_fire.append(fn)
+
+    def remove_on_fire(self, fn) -> None:
+        with self._lock:
+            if fn in self._on_fire:
+                self._on_fire.remove(fn)
+
     def _on_scrape(self, hist, now) -> None:
         self.evaluate(now=now)
 
@@ -269,6 +288,7 @@ class AlertEngine:
         now = time.time() if now is None else now
         results = self._run_checks(now, self.params)
         self._last_eval = time.time()
+        rising: list[tuple[str, dict]] = []
         with self._lock:
             for rule in self.rules:
                 res = results.get(rule.name)
@@ -279,16 +299,27 @@ class AlertEngine:
                     continue
                 value, detail = res
                 if cur is None:
-                    self.firing[rule.name] = {
+                    info = {
                         "severity": rule.severity, "since": now,
                         "value": value, "detail": detail,
                     }
+                    self.firing[rule.name] = info
                     self.fired_events += 1
                     self._fired_total.labels(rule.name, rule.severity).inc()
+                    rising.append((rule.name, dict(info)))
                 else:
                     cur["value"] = value
                     cur["detail"] = detail
-            return {k: dict(v) for k, v in self.firing.items()}
+            snapshot = {k: dict(v) for k, v in self.firing.items()}
+            listeners = list(self._on_fire)
+        # outside the lock: a listener may call back into the engine
+        for name, info in rising:
+            for fn in listeners:
+                try:
+                    fn(name, info)
+                except Exception:
+                    pass  # a broken listener must not sink the scrape
+        return snapshot
 
     def status(self, window: float | None = None,
                now: float | None = None) -> dict:

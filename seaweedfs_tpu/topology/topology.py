@@ -118,6 +118,12 @@ class Topology:
             if vid not in new_ec:
                 self._unregister_ec(vid, node)
         for vid, info in new_ec.items():
+            # unregister-then-register: a node reporting the SAME ec volume
+            # with FEWER shards (partial shard loss/move) must drop out of
+            # the shard ids it no longer holds, or ec_missing_shards() keeps
+            # counting the stale holder and the loss stays invisible
+            if vid in node.ec_shards:
+                self._unregister_ec(vid, node)
             self._register_ec(info, node)
         node.ec_shards = new_ec
         return node
@@ -270,6 +276,23 @@ class Topology:
             for vid, have in lo.under_replicated():
                 out.append((coll, vid, have, want))
         return sorted(out, key=lambda t: (t[0], t[1]))
+
+    def vacuum_candidates(
+        self, garbage_threshold: float
+    ) -> list[tuple[DataNode, int, float]]:
+        """[(node, vid, garbage_ratio)] for writable, non-empty volumes whose
+        deleted-bytes share crosses the threshold — the master's vacuum scan
+        and the maintenance vacuum detector share this one view
+        (`topology_vacuum.go:216` scanning semantics)."""
+        out = []
+        for node in self.all_nodes():
+            for vid, info in list(node.volumes.items()):
+                if info.size == 0 or info.read_only:
+                    continue
+                ratio = info.deleted_byte_count / max(info.size, 1)
+                if ratio > garbage_threshold:
+                    out.append((node, vid, ratio))
+        return out
 
     def ec_missing_shards(self) -> dict[int, int]:
         """vid -> number of EC shards with NO live holder."""

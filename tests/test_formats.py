@@ -342,6 +342,27 @@ class TestMetricNameLint:
         assert kinds["SeaweedFS_process_start_time_seconds"] == "gauge"
         # every registered alert-rule name passes the rule lint
         assert tool.alert_rule_violations() == []
+        # PR-5: the maintenance subsystem's families + task-type registry
+        assert "SeaweedFS_maintenance_queue_depth" in collector_names
+        assert kinds["SeaweedFS_maintenance_tasks_total"] == "counter"
+        assert kinds["SeaweedFS_maintenance_task_seconds"] == "histogram"
+        assert kinds["SeaweedFS_maintenance_failures_total"] == "counter"
+        assert tool.task_type_violations() == []
+
+    def test_task_type_lint_catches_violations(self, monkeypatch):
+        from seaweedfs_tpu import maintenance
+
+        tool = self._tool()
+        spec = maintenance.TaskSpec("BadName", 1, 0, "x")
+        monkeypatch.setattr(
+            maintenance, "TASK_TYPES",
+            {**maintenance.TASK_TYPES, "BadName": spec},
+        )
+        bad = tool.task_type_violations()
+        assert any("not snake_case" in b for b in bad)
+        assert any("concurrency" in b for b in bad)
+        assert any("no matching detector" in b for b in bad)
+        assert any("no matching executor" in b for b in bad)
 
     def test_lint_catches_violations(self):
         tool = self._tool()

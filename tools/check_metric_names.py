@@ -10,12 +10,14 @@ drift PR over PR. Conventions enforced:
 
   * name matches  SeaweedFS_<subsystem>_<snake_case>  with a known
     subsystem (master, volume, filer, s3, http, stats, mount, mq, iam,
-    alerts, process)
+    alerts, process, maintenance)
   * counters end in _total
   * histograms end in a base unit (_seconds or _bytes)
   * gauges do not end in _total (that suffix promises counter semantics)
   * alert-rule names (they ride into SeaweedFS_alerts_firing{alert=...})
     are unique snake_case with a known severity
+  * maintenance task-type names (they ride into the `task` label of every
+    SeaweedFS_maintenance_* family) are unique snake_case
 
 `SeaweedFS_build_info` is the one subsystem-less exception — the
 Prometheus build-info convention (`<binary>_build_info`).
@@ -33,7 +35,8 @@ import sys
 
 NAME_RE = re.compile(
     r"^SeaweedFS_"
-    r"(master|volume|filer|s3|http|stats|mount|mq|iam|alerts|process)_"
+    r"(master|volume|filer|s3|http|stats|mount|mq|iam|alerts|process"
+    r"|maintenance)_"
     r"[a-z][a-z0-9]*(_[a-z0-9]+)*$"
 )
 
@@ -49,6 +52,7 @@ HISTOGRAM_UNITS = ("_seconds", "_bytes")
 def collect() -> tuple[dict[str, str], list[str]]:
     """-> ({family: kind} for registry-backed metrics, [collector names])."""
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from seaweedfs_tpu import maintenance
     from seaweedfs_tpu.server.httpd import HTTPService
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume import VolumeServer
@@ -62,6 +66,7 @@ def collect() -> tuple[dict[str, str], list[str]]:
                 trace.FILER_HASH_SECONDS, crc.VOLUME_CRC32C_SECONDS):
         trace._kernel_metrics(fam)
     ec_encoder._pipeline_hist()  # SeaweedFS_volume_ec_pipeline_seconds
+    maintenance.ensure_metrics()  # SeaweedFS_maintenance_* families
     svc = HTTPService(port=0)  # never started: registration side effect only
     svc.enable_metrics("lint", serve_route=False)
     reg = default_registry()
@@ -78,6 +83,7 @@ def collect() -> tuple[dict[str, str], list[str]]:
         | set(profiler.PROFILER_FAMILIES)
         | set(history.HISTORY_FAMILIES)
         | set(alerts.ALERT_FAMILIES)
+        | set(maintenance.MAINTENANCE_FAMILIES)
     )
     return kinds, collector_names
 
@@ -103,6 +109,34 @@ def alert_rule_violations() -> list[str]:
     return bad
 
 
+def task_type_violations() -> list[str]:
+    """Maintenance task-type names become the `task` label of every
+    SeaweedFS_maintenance_* family AND the detector/executor registry
+    keys — lint them like alert-rule names: unique snake_case, with a
+    detector and an executor actually registered for each."""
+    from seaweedfs_tpu import maintenance
+
+    bad: list[str] = []
+    for name, spec in maintenance.TASK_TYPES.items():
+        if not ALERT_RULE_RE.match(name):
+            bad.append(f"maintenance task type {name!r}: not snake_case")
+        if spec.name != name:
+            bad.append(f"maintenance task type {name!r}: spec name"
+                       f" mismatch ({spec.name!r})")
+        if spec.concurrency < 1:
+            bad.append(f"maintenance task type {name!r}: concurrency"
+                       f" {spec.concurrency} < 1")
+    for registry_name, registry in (
+        ("detector", maintenance.DETECTORS),
+        ("executor", maintenance.EXECUTORS),
+    ):
+        missing = set(maintenance.TASK_TYPES) ^ set(registry)
+        for name in sorted(missing):
+            bad.append(f"maintenance task type {name!r}: no matching"
+                       f" {registry_name} registration")
+    return bad
+
+
 def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
     bad: list[str] = []
     for name in sorted(set(kinds) | set(collector_names)):
@@ -124,7 +158,8 @@ def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
 
 def main() -> int:
     kinds, collector_names = collect()
-    bad = violations(kinds, collector_names) + alert_rule_violations()
+    bad = violations(kinds, collector_names) + alert_rule_violations() \
+        + task_type_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
         print(f"{len(bad)} metric-name violation(s) in {total} families:")
