@@ -746,8 +746,93 @@ def bench_filer_small_files(n: int = 20000, size: int = 1024, c: int = 16) -> di
             out["error"] = f"loadgen failed: ok w={w['ok']} r={r['ok']}"
         if filer.fastlane is not None:
             out["engine"] = filer.fastlane.stats()
+            fm = filer.fastlane.front_metrics()
+            if fm is not None:
+                out["front_metrics"] = fm
+                native = sum(st["native"] for st in fm.values())
+                fb = sum(sum(st["fallback"].values()) for st in fm.values())
+                out["filer_native_ratio"] = (
+                    round(native / (native + fb), 4) if native + fb else None
+                )
+                # the acceptance bar: the native lease verifiably HELD — no
+                # pathological fallbacks (lease/backpressure/upstream)
+                from seaweedfs_tpu.storage.fastlane import (
+                    PATHOLOGICAL_REASONS,
+                )
+
+                out["pathological_fallbacks"] = sum(
+                    st["fallback"][r] for st in fm.values()
+                    for r in PATHOLOGICAL_REASONS
+                )
+            out["lease_live"] = filer.fastlane.lease_count()
     finally:
         for s in (filer, vs, master):
+            if s is not None:
+                s.stop()
+    return out
+
+
+def bench_s3_small_files(n: int = 10000, size: int = 1024, c: int = 16) -> dict:
+    """S3-path small objects: write/read req/s THROUGH the gateway
+    (sigv4-less open IAM, so the engine's S3 front relays object bytes
+    straight to the filer engine — the full millions-of-users path:
+    client -> s3 engine -> filer engine -> volume engine, zero GIL hops).
+    Reference equivalent: `weed/s3api/s3api_object_handlers*.go`."""
+    import random
+
+    from seaweedfs_tpu.native import lib as native_lib
+    from seaweedfs_tpu.s3api.s3_server import S3Server
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.httpd import http_request
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    d = os.path.join(BENCH_DIR, "s3files")
+    os.makedirs(d, exist_ok=True)
+    out: dict = {"objects": n, "size": size, "concurrency": c}
+    master = vs = filer = s3 = None
+    try:
+        master = MasterServer(port=0, pulse_seconds=1)
+        master.start()
+        vs = VolumeServer([d], master.url, port=0, pulse_seconds=1,
+                          max_volume_count=20)
+        vs.start()
+        filer = FilerServer(master_url=master.url, port=0)
+        filer.start()
+        s3 = S3Server(filer.url, port=0)
+        s3.start()
+        if native_lib is None:
+            out["error"] = "skipped: native lib unavailable"
+            return out
+        st, _, _ = http_request("PUT", s3.url + "/bench")  # create bucket
+        if st != 200:
+            out["error"] = f"bucket create -> {st}"
+            return out
+        port = int(s3.url.rsplit(":", 1)[1])
+        paths = [f"/bench/o{i}" for i in range(n)]
+        w = native_lib.loadgen("127.0.0.1", port, c, "PUT", paths,
+                               bytes(size))
+        random.Random(7).shuffle(paths)
+        r = native_lib.loadgen("127.0.0.1", port, c, "GET", paths)
+        if w["ok"] > 0 and r["ok"] > 0:
+            out["write_req_s"] = w["req_per_sec"]
+            out["read_req_s"] = r["req_per_sec"]
+            out["write_errors"] = w["errors"]
+            out["read_errors"] = r["errors"]
+        else:
+            out["error"] = f"loadgen failed: ok w={w['ok']} r={r['ok']}"
+        if s3.fastlane is not None:
+            out["engine"] = s3.fastlane.stats()
+            fm = s3.fastlane.front_metrics()
+            if fm is not None:
+                out["front_metrics"] = fm
+                native = sum(st["native"] for st in fm.values())
+                fb = sum(sum(st["fallback"].values()) for st in fm.values())
+                out["s3_native_ratio"] = (
+                    round(native / (native + fb), 4) if native + fb else None
+                )
+    finally:
+        for s in (s3, filer, vs, master):
             if s is not None:
                 s.stop()
     return out
@@ -1041,6 +1126,11 @@ def main() -> None:
         detail["filer_small_files"] = bench_filer_small_files()
     except Exception as e:
         detail["filer_small_files"] = {"error": str(e)[:120]}
+    # PR-6: the S3 front door (engine -> filer engine relay) end to end
+    try:
+        detail["s3_small_files"] = bench_s3_small_files()
+    except Exception as e:
+        detail["s3_small_files"] = {"error": str(e)[:120]}
     # PR-5: autonomous-maintenance heal latency (injected shard/replica loss)
     try:
         detail["maintenance_summary"] = maintenance_summary()
@@ -1121,6 +1211,7 @@ def summary_line(
     cdc = detail.get("cdc_dedup", {})
     sf = detail.get("small_files", {})
     fsf = detail.get("filer_small_files", {})
+    s3f = detail.get("s3_small_files", {})
     pyc = sf.get("python_client", {})
     summary = {
         "metric": "ec.encode",
@@ -1153,9 +1244,12 @@ def summary_line(
             "py_read_req_s": pyc.get("read_req_s"),
             "filer_write_req_s": fsf.get("write_req_s"),
             "filer_read_req_s": fsf.get("read_req_s"),
+            "filer_native_ratio": fsf.get("filer_native_ratio"),
+            "s3_write_req_s": s3f.get("write_req_s"),
+            "s3_read_req_s": s3f.get("read_req_s"),
             "note": "host GFNI engine carries the verb (DRAM-bound ~4GB/s;"
-            " chip link has never exceeded ~30MB/s — see device_status);"
-            " full per-config detail in BENCH_full.json",
+            " chip link dead — see device_status); detail in"
+            " BENCH_full.json",
         },
     }
     summary = _drop_nonfinite(summary)

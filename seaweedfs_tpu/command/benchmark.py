@@ -34,10 +34,16 @@ def run(args: list[str]) -> int:
     p.add_argument("-c", type=int, default=16, help="concurrency")
     p.add_argument("-collection", default="benchmark")
     p.add_argument("-seed", type=int, default=0)
+    p.add_argument(
+        "-assignBatch", type=int, default=64,
+        help="fids minted per master assign (count=N + fid_delta sub-fids); "
+        "1 = one assign RPC per file",
+    )
     opts = p.parse_args(args)
     report = run_benchmark(
         opts.master, n=opts.n, size=opts.size, c=opts.c,
         collection=opts.collection, seed=opts.seed,
+        assign_batch=opts.assignBatch,
     )
     print(json.dumps(report, indent=2))
     return 0
@@ -50,10 +56,18 @@ def run_benchmark(
     c: int = 16,
     collection: str = "benchmark",
     seed: int = 0,
+    assign_batch: int = 64,
 ) -> dict:
     """Write n files of `size` bytes at concurrency c, then read them back
     shuffled; returns the req/s + latency-percentile report (the reference's
-    `weed benchmark` loop, `benchmark.go:113-260`)."""
+    `weed benchmark` loop, `benchmark.go:113-260`).
+
+    Assigns are batched: one `/dir/assign?count=N` mints N sequential fids
+    (`fid`, `fid_1`, ... — the volume server resolves the `_delta` suffix,
+    `needle.go:ParsePath`), so the allocation RPC amortizes across
+    `assign_batch` uploads instead of doubling every write's round trips.
+    Falls back to per-file assigns when the master mints per-fid write JWTs
+    (a batch token would only cover the base fid)."""
     import types
 
     from seaweedfs_tpu.server.httpd import PooledHTTP, peer_url
@@ -61,17 +75,18 @@ def run_benchmark(
     opts = types.SimpleNamespace(
         master=master, n=n, size=size, c=c, collection=collection, seed=seed
     )
+    assign_batch = max(1, assign_batch)
     masters = [peer_url(u).rstrip("/") for u in opts.master.split(",") if u]
     state = {"master": masters[0]}
     pool = PooledHTTP()  # keep-alive per worker thread, like the Go client
     rng = random.Random(opts.seed)
     payload = bytes(rng.randrange(256) for _ in range(opts.size))
 
-    def assign() -> dict:
+    def assign(count: int = 1) -> dict:
         for _ in range(len(masters) + 2):  # follow raft leader hints
             status, _, body = pool.request(
                 "GET",
-                f"{state['master']}/dir/assign?count=1"
+                f"{state['master']}/dir/assign?count={count}"
                 f"&collection={opts.collection}",
             )
             if status >= 400:
@@ -93,19 +108,55 @@ def run_benchmark(
     write_lat: list[float] = []
     fids: list[str] = []
 
+    import collections
+    import threading
+
+    fid_pool: collections.deque = collections.deque()
+    fid_lock = threading.Lock()
+    batching = {"on": assign_batch > 1}
+
+    def next_fid() -> tuple[str, str, str | None]:
+        """One pre-minted (fid, location, auth) — refills with a single
+        count=assign_batch RPC when the pool runs dry. Once batching is
+        OFF, assigns run per-call OUTSIDE the lock: holding it across the
+        RPC would serialize all c workers behind one master round-trip
+        (worse than the unbatched client this replaces)."""
+        if not batching["on"]:
+            a = assign(count=1)
+            return a["fid"], a["publicUrl"], a.get("auth")
+        with fid_lock:
+            if batching["on"] and not fid_pool:
+                a = assign(count=assign_batch)
+                base, loc = a["fid"], a["publicUrl"]
+                got = int(a.get("count", 1))
+                if a.get("auth") or got < 2:
+                    # per-fid JWT (or a master that ignored count): the
+                    # delta sub-fids would be unauthorized/unminted
+                    batching["on"] = False
+                    fid_pool.append((base, loc, a.get("auth")))
+                else:
+                    fid_pool.extend(
+                        (base if i == 0 else f"{base}_{i}", loc, None)
+                        for i in range(got)
+                    )
+            if fid_pool:
+                return fid_pool.popleft()
+        a = assign(count=1)  # batching just disabled and the pool drained
+        return a["fid"], a["publicUrl"], a.get("auth")
+
     def do_write(i: int):
         t0 = time.perf_counter()
-        a = assign()
-        url = f"{peer_url(a['publicUrl'])}/{a['fid']}"
+        fid, loc, auth = next_fid()
+        url = f"{peer_url(loc)}/{fid}"
         headers = {}
-        if a.get("auth"):
-            headers["Authorization"] = f"BEARER {a['auth']}"
+        if auth:
+            headers["Authorization"] = f"BEARER {auth}"
         status, _, body = pool.request("POST", url, payload, headers)
         if status >= 300:
             raise IOError(f"upload -> {status}: {body[:120]!r}")
         # remember the volume location: the reader reuses it instead of
         # paying a lookup per read (the Go benchmark caches locations too)
-        return a["fid"], a["publicUrl"], time.perf_counter() - t0
+        return fid, loc, time.perf_counter() - t0
 
     t_start = time.perf_counter()
     with concurrent.futures.ThreadPoolExecutor(opts.c) as ex:

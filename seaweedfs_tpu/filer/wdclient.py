@@ -120,6 +120,30 @@ class WeedClient:
         with self._lock:
             self._vid_cache.pop(vid, None)
 
+    def assign_batch(
+        self,
+        n: int,
+        replication: str = "",
+        collection: str = "",
+        ttl: str = "",
+    ) -> tuple[list[str], str, str]:
+        """ONE master assign (count=n) covering a whole chunked upload:
+        returns (fids, location, auth) where fids are the base fid plus its
+        `_1.._n-1` deltas, all on one volume (`weed/operation/assign_file_id`
+        count semantics). Amortizes the per-chunk allocation RPC that
+        dominated multi-chunk upload latency."""
+        a = self.assign(
+            count=n, replication=replication, collection=collection, ttl=ttl
+        )
+        if a.get("error"):
+            raise IOError(a["error"])
+        granted = int(a.get("count", n) or n)
+        if granted < n:
+            raise IOError(f"assign granted {granted} < {n} fids")
+        fid = a["fid"]
+        fids = [fid] + [f"{fid}_{i}" for i in range(1, n)]
+        return fids, a["publicUrl"], a.get("auth", "")
+
     # --- blob ops ---------------------------------------------------------------
     def upload(
         self,

@@ -48,6 +48,7 @@
 #include <shared_mutex>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 extern "C" uint32_t sw_crc32c_update(uint32_t crc, const char* data, size_t len);
@@ -284,7 +285,7 @@ struct Vol {
     }
 };
 
-struct Event {  // mirrored by storage/fastlane.py (40 bytes, little-endian)
+struct Event {  // mirrored by storage/fastlane.py (48 bytes, little-endian)
     uint32_t vid;
     uint32_t op;        // 0 put, 1 delete-tombstone
     uint64_t key;
@@ -292,6 +293,8 @@ struct Event {  // mirrored by storage/fastlane.py (40 bytes, little-endian)
     int32_t size;       // needle body size (put) or freed size (delete)
     uint32_t pad;
     uint64_t append_ns;
+    uint64_t trace_id;  // X-Sw-Trace-Id of the originating request (0=none):
+                        // drain-synthesized spans join the caller's trace
 };
 
 struct Engine;
@@ -355,6 +358,14 @@ struct Conn {
     std::string in;      // accumulated request bytes
     std::string out;     // pending response bytes
     size_t out_off = 0;
+    // zero-copy body channel: large response bodies ride here instead of
+    // being memcpy'd into `out` — flush_out sends headers + body with one
+    // writev. Either an owned buffer (out2, moved in) or a pinned shared
+    // one (out2_pin keeps it alive); out2_data/len point at the bytes.
+    std::string out2;
+    std::shared_ptr<const void> out2_pin;
+    const char* out2_data = nullptr;
+    size_t out2_len = 0, out2_off = 0;
     bool want_close = false;
     bool sent_continue = false;  // answered Expect: 100-continue this request
     size_t chunk_scan = 0;       // chunked decode: resume position in `in`
@@ -391,14 +402,18 @@ struct BackendConn {
     uint64_t start_ns = 0;    // mono_ns at proxy launch (latency metrics)
     uint32_t target_ip = 0;   // 0 = engine's default Python backend
     int target_port = 0;
-    int mode = 0;             // 0 proxy, 1 filer chunk upload, 2 filer relay
+    int mode = 0;             // 0 proxy, 1 filer chunk upload, 2 filer relay,
+                              // 3 s3 get relay, 4 s3 put relay, 5 s3 delete
     void* ssl = nullptr;      // TLS client session (mTLS upstream hops)
     uint32_t armed = 0;       // current epoll interest mask
     // filer-write context (mode 1) / relay fallback (mode 2)
     std::string f_path, f_fid, f_mime, f_md5hex;
     uint64_t f_size = 0;
     uint64_t f_mtime = 0;
-    std::string client_req;   // original client request (mode-2 fallback)
+    uint64_t f_trace = 0;     // trace id riding the upstream hop
+    std::shared_ptr<struct FilerLease> f_lease;  // lease that minted f_fid:
+                              // an upload failure drops THIS lease only
+    std::string client_req;   // original client request (fallback replay)
 };
 
 struct Worker {
@@ -450,10 +465,15 @@ struct FilerCacheEnt {
     uint64_t size = 0;
     uint64_t mtime = 0;  // seconds
     uint64_t seq = 0;    // FIFO generation: stale queue entries are no-ops
+    bool tombstone = false;  // natively-acked DELETE not yet drained:
+                             // read-your-deletes across engine cores
 };
 
 // leased fid range from the master (one /dir/assign?count=N): the engine
-// mints fids locally so a native write costs zero master round-trips
+// mints fids locally so a native write costs zero master round-trips.
+// The engine holds a POOL of these (one per volume) refreshed by Python —
+// chunk writes round-robin across live leases instead of stalling on one
+// spent range, and a failed volume drops only its own lease.
 struct FilerLease {
     uint32_t vol_ip = 0;
     int vol_port = 0;
@@ -463,6 +483,21 @@ struct FilerLease {
     uint64_t end_key = 0;
     std::string auth;  // Authorization value for uploads ("" = none)
 };
+
+// front-door accounting: every data-plane-shaped request on a filer/S3
+// front either serves natively or falls back to the Python proxy for a
+// REASON — exported via sw_fl_front_metrics so a silent fallback regime
+// (like r05's rejected lease) is a metric + alert, not a log line.
+constexpr int kFrRead = 0, kFrWrite = 1, kFrDelete = 2;
+constexpr int kNumFrontOps = 3;
+constexpr int kFbCacheMiss = 0, kFbNoLease = 1, kFbLeaseSpent = 2,
+              kFbTooLarge = 3, kFbBodyShape = 4, kFbSystemPath = 5,
+              kFbQuery = 6, kFbBackpressure = 7, kFbUpstream = 8,
+              kFbAuth = 9, kFbBucketState = 10, kFbOther = 11;
+constexpr int kNumFbReasons = 12;
+
+// per-bucket native permission bits (sw_fl_s3_bucket_set)
+constexpr int kS3Read = 1, kS3Write = 2, kS3Delete = 4;
 
 struct Engine {
     int listen_fd = -1;
@@ -506,13 +541,33 @@ struct Engine {
     uint64_t fcache_seq = 0;
     std::deque<std::pair<std::string, uint64_t>> fcache_fifo;  // (path, seq)
     std::shared_mutex flease_mu;
-    std::shared_ptr<FilerLease> flease;
+    // lease POOL, one entry per volume (sw_fl_filer_lease_set upserts by
+    // vid): chunk writes round-robin across unspent leases, and an upload
+    // failure drops only the failed volume's lease
+    std::vector<std::shared_ptr<FilerLease>> fleases;
+    std::atomic<uint64_t> flease_rr{0};
     std::string filer_read_auth;  // wildcard read JWT for relays (guarded
                                   // by flease_mu; refreshed with the lease)
     std::shared_mutex frules_mu;
     // fs.configure location prefixes: writes under them carry per-path
     // storage rules only the Python pipeline resolves
     std::vector<std::string> frule_prefixes;
+
+    // --- s3 front mode ---
+    // The gateway's engine relays gated object GET/PUT/DELETE straight to
+    // the FILER's engine front door (protocol translation only — auth'd /
+    // versioned / policied / meta-carrying requests all fall back to the
+    // Python handlers, which keep the full S3 surface).
+    std::atomic<bool> s3_mode{false};
+    uint32_t s3_filer_ip = 0;
+    int s3_filer_port = 0;
+    std::shared_mutex s3_mu;
+    std::unordered_map<std::string, int> s3_buckets;  // bucket -> flag bits
+    std::unordered_set<std::string> s3_uploads;  // "<bucket>/<uploadId>"
+
+    // front-door accounting (filer + s3 modes)
+    std::atomic<uint64_t> fr_native[kNumFrontOps] = {};
+    std::atomic<uint64_t> fr_fallback[kNumFrontOps][kNumFbReasons] = {};
 
     // any-state lookup (registration plumbing)
     std::shared_ptr<Vol> vol_raw(uint32_t vid) {
@@ -540,6 +595,63 @@ uint64_t now_ns() {
     struct timespec ts;
     clock_gettime(CLOCK_REALTIME, &ts);
     return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+void front_native_inc(Engine* E, int op) {
+    E->fr_native[op].fetch_add(1, std::memory_order_relaxed);
+}
+void front_fb_inc(Engine* E, int op, int reason) {
+    E->fr_fallback[op][reason].fetch_add(1, std::memory_order_relaxed);
+}
+
+// round-robin over the lease pool, atomically minting one key from the
+// first unspent range; null when the pool is empty (*reason=kFbNoLease)
+// or fully spent (*reason=kFbLeaseSpent) — the caller proxies and the
+// Python side re-leases against live topology
+std::shared_ptr<FilerLease> take_filer_lease(Engine* E, uint64_t* key,
+                                             int* reason) {
+    std::shared_lock<std::shared_mutex> l(E->flease_mu);
+    size_t n = E->fleases.size();
+    if (n == 0) {
+        *reason = kFbNoLease;
+        return nullptr;
+    }
+    size_t start = E->flease_rr.fetch_add(1, std::memory_order_relaxed);
+    for (size_t i = 0; i < n; i++) {
+        auto& L = E->fleases[(start + i) % n];
+        uint64_t k = L->next_key.fetch_add(1, std::memory_order_relaxed);
+        if (k < L->end_key) {
+            *key = k;
+            return L;
+        }
+    }
+    *reason = kFbLeaseSpent;
+    return nullptr;
+}
+
+// a failed upload condemns ONLY the lease that minted its fid (the volume
+// died / moved / was deleted); the other volumes' leases keep serving
+void drop_filer_lease(Engine* E, const std::shared_ptr<FilerLease>& L) {
+    if (!L) return;
+    std::unique_lock<std::shared_mutex> l(E->flease_mu);
+    for (size_t i = 0; i < E->fleases.size(); i++)
+        if (E->fleases[i] == L) {
+            E->fleases.erase(E->fleases.begin() + i);
+            return;
+        }
+}
+
+// parse a 16-hex-char X-Sw-Trace-Id into the u64 that rides Event frames
+// (stats/trace.py ids are os.urandom(8).hex()); 0 = absent/foreign format
+uint64_t parse_trace_id(const std::string& s) {
+    if (s.empty() || s.size() > 16) return 0;
+    uint64_t v = 0;
+    for (char c : s) {
+        if (!isxdigit((unsigned char)c)) return 0;
+        v = (v << 4) | (uint64_t)(c >= '0' && c <= '9' ? c - '0'
+                                  : (c | 0x20) - 'a' + 10);
+    }
+    return v;
 }
 
 uint64_t mono_ns() {  // latency measurement must not jump with wall time
@@ -758,6 +870,19 @@ void json_response(Conn* c, int status, const char* reason,
                     body.size(), false);
 }
 
+// defined next to flush_out (they share the out/out2 lane layout)
+void respond_zc_owned(Conn* c, int status, const char* reason,
+                      const std::string& ctype, const std::string& extra,
+                      std::string&& body, size_t off, size_t n);
+void respond_zc_pinned(Conn* c, int status, const char* reason,
+                       const std::string& ctype, const std::string& extra,
+                       std::shared_ptr<const void> pin, const char* data,
+                       size_t n);
+
+// bodies at least this large ride the zero-copy out2 channel; smaller
+// ones are cheaper to memcpy into the header buffer than to writev
+constexpr size_t kZeroCopyMin = 4096;
+
 // ---------------------------------------------------------------------------
 // native read
 // ---------------------------------------------------------------------------
@@ -899,6 +1024,12 @@ bool handle_read(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
         extra += hint;
         append_response(c, status, status == 206 ? "Partial Content" : "OK",
                         ctype, extra, "", 0, false);
+    } else if (out_n >= kZeroCopyMin) {
+        // zero-copy: the pread blob moves onto the out2 lane; headers +
+        // body leave in one writev instead of a second body memcpy
+        respond_zc_owned(c, status, status == 206 ? "Partial Content" : "OK",
+                         ctype, extra, std::move(blob),
+                         (size_t)(out_p - blob.data()), out_n);
     } else {
         append_response(c, status, status == 206 ? "Partial Content" : "OK",
                         ctype, extra, out_p, out_n, false);
@@ -986,7 +1117,8 @@ bool multipart_first_file(const std::string& ctype, const char* body,
 
 bool handle_write(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
                   uint32_t cookie, const char* data, size_t data_len,
-                  const std::string& name, const std::string& mime) {
+                  const std::string& name, const std::string& mime,
+                  uint64_t trace_id = 0) {
     if (data_len > 0xFFFFFFFFull) return false;
     // build the v2/v3 record (needle.py to_bytes with data non-empty)
     uint8_t flags = 0x08;  // HAS_LAST_MODIFIED (server always sets it)
@@ -1050,7 +1182,7 @@ bool handle_write(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
         v->tail.store(offset + total, std::memory_order_relaxed);
         v->last_ns.store(ns, std::memory_order_relaxed);
     }
-    E->push_event({v->vid, 0, key, offset, size, 0, ns});
+    E->push_event({v->vid, 0, key, offset, size, 0, ns, trace_id});
     std::string body = "{\"name\": \"";
     json_escape(nm, body);
     char tailbuf[64];
@@ -1066,7 +1198,7 @@ bool handle_write(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
 }
 
 bool handle_delete(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
-                   uint32_t cookie) {
+                   uint32_t cookie, uint64_t trace_id = 0) {
     // no cookie check on delete — matches storage/volume.py delete_needle
     uint64_t off; int32_t size;
     {
@@ -1127,7 +1259,7 @@ bool handle_delete(Engine* E, Conn* c, std::shared_ptr<Vol>& v, uint64_t key,
         v->tail.store(offset + total, std::memory_order_relaxed);
         v->last_ns.store(ns, std::memory_order_relaxed);
     }
-    E->push_event({v->vid, 1, key, offset, freed, 0, ns});
+    E->push_event({v->vid, 1, key, offset, freed, 0, ns, trace_id});
     char body[48];
     snprintf(body, sizeof body, "{\"size\": %d}", freed);
     json_response(c, 202, "Accepted", body);
@@ -1222,6 +1354,7 @@ int backend_connect(uint32_t ip, int port) {
 
 void flush_out(Worker* w, Conn* c);
 void process_buffered(Engine* E, Worker* w, Conn* c);
+void drain_buffered(Engine* E, Worker* w, Conn* c);
 
 void backend_finish(Worker* w, BackendConn* b, bool reusable) {
     for (size_t i = 0; i < w->pending.size(); i++)
@@ -1379,6 +1512,9 @@ void drain_waiting(Engine* E, Worker* w) {
 
 void filer_upload_finish(Engine* E, Worker* w, BackendConn* b, bool ok);
 void filer_relay_finish(Engine* E, Worker* w, BackendConn* b, bool ok);
+void s3_get_finish(Engine* E, Worker* w, BackendConn* b, bool ok);
+void s3_put_finish(Engine* E, Worker* w, BackendConn* b, bool ok);
+void s3_delete_finish(Engine* E, Worker* w, BackendConn* b, bool ok);
 
 // deliver the completed (or failed) upstream response and resume the
 // client's request pipeline; filer-mode conns have their own finishers
@@ -1386,6 +1522,9 @@ void backend_complete(Engine* E, Worker* w, BackendConn* b, bool ok,
                       bool client_keep, bool reusable) {
     if (b->mode == 1) { filer_upload_finish(E, w, b, ok); return; }
     if (b->mode == 2) { filer_relay_finish(E, w, b, ok); return; }
+    if (b->mode == 3) { s3_get_finish(E, w, b, ok); return; }
+    if (b->mode == 4) { s3_put_finish(E, w, b, ok); return; }
+    if (b->mode == 5) { s3_delete_finish(E, w, b, ok); return; }
     Conn* c = b->client;
     if (c != nullptr) {
         c->upstream = nullptr;
@@ -1404,8 +1543,7 @@ void backend_complete(Engine* E, Worker* w, BackendConn* b, bool ok,
     backend_finish(w, b, reusable);
     drain_waiting(E, w);
     if (c != nullptr) {
-        if (!c->want_close) process_buffered(E, w, c);
-        flush_out(w, c);
+        drain_buffered(E, w, c);
     }
 }
 
@@ -1843,6 +1981,7 @@ void filer_serve_inline(Engine* E, Conn* c,
         append_response(c, 304, "Not Modified", ctype, extra, "", 0, false);
         observe_op(E, c, kOpRead, 0);
         E->stats.native_reads++;
+        front_native_inc(E, kFrRead);
         return;
     }
     const std::string& data = ent->inline_data;
@@ -1860,6 +1999,7 @@ void filer_serve_inline(Engine* E, Conn* c,
                             false);
             observe_op(E, c, kOpRead, 0);
             E->stats.native_reads++;
+            front_native_inc(E, kFrRead);
             return;
         }
         if (rr == 0) {
@@ -1877,10 +2017,21 @@ void filer_serve_inline(Engine* E, Conn* c,
         snprintf(cl, sizeof cl, "X-File-Size: %zu\r\n", data.size());
         extra += cl;
     }
-    append_response(c, status, status == 206 ? "Partial Content" : "OK",
-                    ctype, extra, data.data() + off, n, head);
+    if (!head && n >= kZeroCopyMin) {
+        // serve straight out of the cache entry: the shared_ptr pins the
+        // bytes for the write's lifetime, no copy into the conn buffer
+        respond_zc_pinned(
+            c, status, status == 206 ? "Partial Content" : "OK", ctype,
+            extra,
+            std::shared_ptr<const void>(ent, (const void*)ent.get()),
+            data.data() + off, n);
+    } else {
+        append_response(c, status, status == 206 ? "Partial Content" : "OK",
+                        ctype, extra, data.data() + off, n, head);
+    }
     observe_op(E, c, kOpRead, head ? 0 : n);
     E->stats.native_reads++;
+    front_native_inc(E, kFrRead);
 }
 
 // finish a native filer write once the entry is journaled: cache + respond
@@ -1896,6 +2047,7 @@ void filer_write_ack(Engine* E, Conn* c, const std::string& path,
     json_response(c, 201, "Created", body);
     observe_op(E, c, kOpWrite, size);
     E->stats.native_writes++;
+    front_native_inc(E, kFrWrite);
 }
 
 // mode-1 completion: the volume server answered the chunk upload
@@ -1926,13 +2078,12 @@ void filer_upload_finish(Engine* E, Worker* w, BackendConn* b, bool ok) {
     if (c != nullptr && !good) {
         // the upload failed (volume down / moved / DELETED under the
         // lease — volume.delete.empty on a not-yet-written volume does
-        // exactly this): drop the lease so Python re-leases against live
-        // topology, and replay THIS request through the Python path so
+        // exactly this): drop the lease THAT MINTED THIS FID so Python
+        // re-leases against live topology (the rest of the pool keeps
+        // serving), and replay THIS request through the Python path so
         // the client still gets its write
-        {
-            std::unique_lock<std::shared_mutex> l(E->flease_mu);
-            E->flease = nullptr;
-        }
+        drop_filer_lease(E, b->f_lease);
+        front_fb_inc(E, kFrWrite, kFbUpstream);
         Conn* cc = c;
         std::string original = std::move(b->client_req);
         backend_finish(w, b, false);
@@ -1948,8 +2099,7 @@ void filer_upload_finish(Engine* E, Worker* w, BackendConn* b, bool ok) {
     }
     backend_finish(w, b, ok && !b->backend_close);
     if (c != nullptr) {
-        if (!c->want_close) process_buffered(E, w, c);
-        flush_out(w, c);
+        drain_buffered(E, w, c);
     }
 }
 
@@ -1994,32 +2144,41 @@ void filer_relay_finish(Engine* E, Worker* w, BackendConn* b, bool ok) {
                          "Last-Modified: %a, %d %b %Y %H:%M:%S GMT\r\n", &g);
                 head.insert(head.size() - 2, lm);
             }
-            c->out += head;
-            c->out.append(b->resp, b->hdr_end,
-                          b->resp.size() - b->hdr_end);
-            observe_op(E, c, kOpRead, b->resp.size() - b->hdr_end);
+            size_t blen = b->resp.size() - b->hdr_end;
+            observe_op(E, c, kOpRead, blen);
             E->stats.native_reads++;
+            front_native_inc(E, kFrRead);
             // promote small hot objects: a FULL-entity, length-framed
             // relay body moves into the inline cache (same 128MB budget +
             // FIFO eviction, same meta-log invalidation), so repeat reads
             // skip the volume hop entirely. body_mode==1 only — chunked/
             // close-delimited responses carry framing or may be truncated.
-            size_t blen = b->resp.size() - b->hdr_end;
             if (status == 200 && b->body_mode == 1 && blen > 0 &&
                 blen <= 65536)
                 fcache_promote(E, b->f_path, b->f_md5hex,
                                b->resp.data() + b->hdr_end, blen);
+            c->out += head;
+            if (blen >= kZeroCopyMin && c->out2_len == 0) {
+                // relay body rides the zero-copy lane: the upstream
+                // response buffer moves as-is, out2_data skips its head
+                c->out2 = std::move(b->resp);
+                c->out2_data = c->out2.data() + b->hdr_end;
+                c->out2_len = blen;
+                c->out2_off = 0;
+            } else {
+                c->out.append(b->resp, b->hdr_end, blen);
+            }
         }
         backend_finish(w, b, !b->backend_close);
         drain_waiting(E, w);
         if (c != nullptr) {
-            if (!c->want_close) process_buffered(E, w, c);
-            flush_out(w, c);
+            drain_buffered(E, w, c);
         }
         return;
     }
     // miss/moved/error: forget the location and let Python serve it
     fcache_del(E, b->f_path);
+    front_fb_inc(E, kFrRead, kFbUpstream);
     std::string original = std::move(b->client_req);
     backend_finish(w, b, false);
     drain_waiting(E, w);
@@ -2041,33 +2200,38 @@ bool handle_filer_write(Engine* E, Worker* w, Conn* c,
     const char* data = body;
     size_t dlen = body_len;
     std::string mime = ctype;
+    auto fb = [&](int reason) {  // typed fallback: metric, then proxy
+        front_fb_inc(E, kFrWrite, reason);
+        return false;
+    };
     if (ctype.rfind("multipart/form-data", 0) == 0) {
         std::string pn, pt;
         if (!multipart_first_file(ctype, body, body_len, &pn, &pt, &data,
                                   &dlen))
-            return false;
+            return fb(kFbBodyShape);
         mime = pt;
     } else if (ctype.rfind("multipart/", 0) == 0) {
-        return false;
+        return fb(kFbBodyShape);
     }
     if (mime == "application/x-www-form-urlencoded") mime.clear();
     if (mime.size() >= 250 || mime.find_first_of("\r\n") != std::string::npos)
-        return false;
-    if (path.size() > 60000) return false;  // frame lengths are u16
+        return fb(kFbBodyShape);
+    if (path.size() > 60000) return fb(kFbOther);  // frame lengths are u16
     // the /etc/ config area (filer.conf, IAM, dedup index) must be
     // visible the moment the write acks — config consumers read through
     // Python, so skip the drain-delayed native path entirely. The system
     // meta-log tree emits NO meta events (filer_notify skips it), so a
     // natively-cached entry there could never be invalidated — skip too.
-    if (path.compare(0, 5, "/etc/") == 0) return false;
-    if (path.compare(0, 16, "/topics/.system/") == 0) return false;
+    if (path.compare(0, 5, "/etc/") == 0) return fb(kFbSystemPath);
+    if (path.compare(0, 16, "/topics/.system/") == 0) return fb(kFbSystemPath);
     {
         // paths under an fs.configure rule prefix carry storage options
         // (collection/replication/ttl/read-only) that only the Python
         // write pipeline resolves
         std::shared_lock<std::shared_mutex> rl(E->frules_mu);
         for (const auto& pre : E->frule_prefixes)
-            if (path.compare(0, pre.size(), pre) == 0) return false;
+            if (path.compare(0, pre.size(), pre) == 0)
+                return fb(kFbSystemPath);
     }
     if (dlen <= E->filer_inline_limit) {
         // small-content inlining (filer.py SMALL_CONTENT_LIMIT): no volume
@@ -2077,7 +2241,7 @@ bool handle_filer_write(Engine* E, Worker* w, Conn* c,
         uint64_t mtime = (uint64_t)time(nullptr);
         std::string frame =
             filer_frame(1, dlen, mtime, md5hex, path, "", mime, data, dlen);
-        if (!filer_commit(E, frame)) return false;
+        if (!filer_commit(E, frame)) return fb(kFbBackpressure);
         auto ent = std::make_shared<FilerCacheEnt>();
         ent->inline_data.assign(data, dlen);
         ent->mime = mime;
@@ -2088,13 +2252,14 @@ bool handle_filer_write(Engine* E, Worker* w, Conn* c,
         filer_write_ack(E, c, path, dlen, md5hex);
         return true;
     }
-    if (dlen > E->filer_chunk_limit) return false;  // multi-chunk: Python
+    if (dlen > E->filer_chunk_limit)
+        return fb(kFbTooLarge);  // multi-chunk: Python
     if (E->filer_compress) {
         // the Python pipeline compresses by mime AND by extension
         // (util/compression.py is_compressable_file_type); anything its
         // heuristic might gzip must take the Python path
         if (!mime.empty() && mime != "application/octet-stream")
-            return false;
+            return fb(kFbBodyShape);
         size_t dot = path.rfind('.');
         size_t slash = path.rfind('/');
         if (dot != std::string::npos &&
@@ -2108,17 +2273,13 @@ bool handle_filer_write(Engine* E, Worker* w, Conn* c,
                 ".rs", ".ts", ".sql", ".sh", ".pdf",
             };
             for (const char* t : kTextExt)
-                if (ext == t) return false;
+                if (ext == t) return fb(kFbBodyShape);
         }
     }
-    std::shared_ptr<FilerLease> L;
-    {
-        std::shared_lock<std::shared_mutex> l(E->flease_mu);
-        L = E->flease;
-    }
-    if (!L) return false;
-    uint64_t key = L->next_key.fetch_add(1, std::memory_order_relaxed);
-    if (key >= L->end_key) return false;  // lease spent: Python re-leases
+    uint64_t key = 0;
+    int lease_reason = kFbNoLease;
+    std::shared_ptr<FilerLease> L = take_filer_lease(E, &key, &lease_reason);
+    if (!L) return fb(lease_reason);
     char hex[32];
     format_fid_hex(key, L->cookie, hex);
     char fid[48];
@@ -2130,6 +2291,7 @@ bool handle_filer_write(Engine* E, Worker* w, Conn* c,
     b->mode = 1;
     b->target_ip = L->vol_ip;
     b->target_port = L->vol_port;
+    b->f_lease = L;  // a failed upload drops exactly this lease
     // kept for the failure path: a dead/moved/deleted lease volume makes
     // the finisher replay this request through the Python backend
     b->client_req.assign(req, hdr_len + body_len);
@@ -2138,6 +2300,7 @@ bool handle_filer_write(Engine* E, Worker* w, Conn* c,
     b->f_mime = mime;
     b->f_md5hex = md5hex;
     b->f_size = dlen;
+    b->f_trace = parse_trace_id(find_header(req, he, "x-sw-trace-id"));
     b->started = time(nullptr);
     std::string& r = b->req;
     r.reserve(dlen + 256 + path.size());
@@ -2161,6 +2324,14 @@ bool handle_filer_write(Engine* E, Worker* w, Conn* c,
         r += L->auth;
         r += "\r\n";
     }
+    if (b->f_trace) {
+        // the volume engine stamps this id on its append event, so the
+        // drain-synthesized span joins the caller's trace end to end
+        char th[48];
+        snprintf(th, sizeof th, "X-Sw-Trace-Id: %016llx\r\n",
+                 (unsigned long long)b->f_trace);
+        r += th;
+    }
     char cl[48];
     snprintf(cl, sizeof cl, "Content-Length: %zu\r\n\r\n", dlen);
     r += cl;
@@ -2169,7 +2340,7 @@ bool handle_filer_write(Engine* E, Worker* w, Conn* c,
     if (!backend_launch(E, w, b)) {
         c->upstream = nullptr;
         delete b;
-        return false;  // volume unreachable: Python's error surface
+        return fb(kFbUpstream);  // volume unreachable: Python's surface
     }
     w->pending.push_back(b);
     return true;
@@ -2212,10 +2383,382 @@ void filer_relay_launch(Engine* E, Worker* w, Conn* c,
     if (!backend_launch(E, w, b)) {
         c->upstream = nullptr;
         delete b;
+        front_fb_inc(E, kFrRead, kFbUpstream);
         proxy_request(E, w, c, req, req_len, false);
         return;
     }
     w->pending.push_back(b);
+}
+
+// native filer DELETE: known (cached) file entries tombstone + journal +
+// ack without a Python hop — the same journal-before-ack contract as the
+// write path, with frame kind 2 applied as Filer.delete_entry by the
+// drain. Returns false when the Python path must take it (with the typed
+// fallback reason counted).
+bool handle_filer_delete(Engine* E, Conn* c, const std::string& path) {
+    auto fb = [&](int reason) {
+        front_fb_inc(E, kFrDelete, reason);
+        return false;
+    };
+    // config-area deletes must be visible to Python consumers the moment
+    // they ack; fs.configure prefixes may be read_only (Python enforces)
+    if (path.compare(0, 5, "/etc/") == 0) return fb(kFbSystemPath);
+    if (path.compare(0, 16, "/topics/.system/") == 0)
+        return fb(kFbSystemPath);
+    if (path.size() > 60000) return fb(kFbOther);
+    {
+        std::shared_lock<std::shared_mutex> rl(E->frules_mu);
+        for (const auto& pre : E->frule_prefixes)
+            if (path.compare(0, pre.size(), pre) == 0)
+                return fb(kFbSystemPath);
+    }
+    std::shared_ptr<FilerCacheEnt> ent;
+    {
+        std::shared_lock<std::shared_mutex> l(E->fcache_mu);
+        auto it = E->fcache.find(path);
+        if (it != E->fcache.end()) ent = it->second;
+    }
+    // only entries the cache KNOWS to be plain files delete natively —
+    // a miss could be a directory (recursive semantics) or a missing
+    // path (409 surface); Python answers those exactly
+    if (ent == nullptr) return fb(kFbCacheMiss);
+    if (ent->tombstone) {
+        // double-delete before the drain lands: Python would 409 "not
+        // found" — route it there for the exact surface
+        return fb(kFbCacheMiss);
+    }
+    static const char kZeroMd5[33] = "00000000000000000000000000000000";
+    uint64_t mtime = (uint64_t)time(nullptr);
+    std::string frame =
+        filer_frame(2, ent->size, mtime, kZeroMd5, path, "", "", nullptr, 0);
+    if (!filer_commit(E, frame)) return fb(kFbBackpressure);
+    auto tomb = std::make_shared<FilerCacheEnt>();
+    tomb->tombstone = true;
+    tomb->size = ent->size;
+    tomb->mtime = mtime;
+    fcache_put(E, path, std::move(tomb));
+    append_response(c, 204, "No Content", "", "", "", 0, false);
+    observe_op(E, c, kOpDelete, 0);
+    E->stats.native_deletes++;
+    front_native_inc(E, kFrDelete);
+    return true;
+}
+
+// ---------------------------------------------------------------------------
+// s3 front mode: protocol-translating relays onto the FILER's engine front
+// door. The gateway's Python surface keeps everything stateful (sigv4,
+// policies, versioning, ACLs, CORS, x-amz metadata); the engine serves the
+// gated plain-object subset — which is the bench/production hot path — by
+// rewriting /bucket/key <-> /buckets/bucket/key and translating status
+// codes, so object bytes never cross the GIL.
+// ---------------------------------------------------------------------------
+
+void xml_escape(const std::string& s, std::string& out) {
+    for (char ch : s) {
+        switch (ch) {
+            case '<': out += "&lt;"; break;
+            case '>': out += "&gt;"; break;
+            case '&': out += "&amp;"; break;
+            default: out += ch;
+        }
+    }
+}
+
+// same XML error surface s3_server.py error_response produces
+void s3_error_response(Conn* c, int status, const char* reason,
+                       const char* code, const char* msg,
+                       const std::string& resource) {
+    std::string body =
+        "<?xml version=\"1.0\" encoding=\"UTF-8\"?><Error><Code>";
+    body += code;
+    body += "</Code><Message>";
+    body += msg;
+    body += "</Message><Resource>";
+    xml_escape(resource, body);
+    body += "</Resource></Error>";
+    append_response(c, status, reason, "application/xml", "", body.data(),
+                    body.size(), false);
+}
+
+// replay the original client request through the Python S3 surface (the
+// filer answered something the translation table doesn't cover)
+void s3_replay_python(Engine* E, Worker* w, BackendConn* b, int frop) {
+    front_fb_inc(E, frop, kFbUpstream);
+    Conn* c = b->client;
+    std::string original = std::move(b->client_req);
+    backend_finish(w, b, false);
+    drain_waiting(E, w);
+    if (c != nullptr) {
+        c->upstream = nullptr;
+        proxy_request(E, w, c, original.data(), original.size(), false);
+        flush_out(w, c);
+    }
+}
+
+void s3_finish_common(Engine* E, Worker* w, BackendConn* b, Conn* c) {
+    backend_finish(w, b, !b->backend_close);
+    drain_waiting(E, w);
+    if (c != nullptr) {
+        drain_buffered(E, w, c);
+    }
+}
+
+// mode 3: object GET — the filer front's response is already S3-shaped
+// (ETag = "md5", Content-Type, Accept-Ranges, Last-Modified); forward its
+// head verbatim and the body zero-copy
+void s3_get_finish(Engine* E, Worker* w, BackendConn* b, bool ok) {
+    Conn* c = b->client;
+    int status = 0;
+    if (ok && b->resp.size() > 12 &&
+        memcmp(b->resp.data(), "HTTP/1.1 ", 9) == 0)
+        status = atoi(b->resp.c_str() + 9);
+    if (ok && b->hdr_end != 0 &&
+        (status == 200 || status == 206 || status == 304)) {
+        if (c != nullptr) {
+            c->upstream = nullptr;
+            size_t blen = b->resp.size() - b->hdr_end;
+            observe_op(E, c, kOpRead, blen);
+            E->stats.native_reads++;
+            front_native_inc(E, kFrRead);
+            if (blen >= kZeroCopyMin && c->out2_len == 0) {
+                c->out.append(b->resp, 0, b->hdr_end);
+                c->out2 = std::move(b->resp);
+                c->out2_data = c->out2.data() + b->hdr_end;
+                c->out2_len = blen;
+                c->out2_off = 0;
+            } else {
+                c->out += b->resp;
+            }
+        }
+        s3_finish_common(E, w, b, c);
+        return;
+    }
+    if (ok && b->hdr_end != 0 && status == 404) {
+        if (c != nullptr) {
+            c->upstream = nullptr;
+            s3_error_response(c, 404, "Not Found", "NoSuchKey",
+                              "no such key", b->f_path);
+            observe_op(E, c, kOpRead, 0);
+            E->stats.native_reads++;
+            front_native_inc(E, kFrRead);
+        }
+        s3_finish_common(E, w, b, c);
+        return;
+    }
+    s3_replay_python(E, w, b, kFrRead);
+}
+
+// mode 4: object/part PUT — filer 201 becomes S3 200 with the ETag the
+// engine already computed (md5 of the body, exactly hashlib.md5 in
+// _put_object/_upload_part)
+void s3_put_finish(Engine* E, Worker* w, BackendConn* b, bool ok) {
+    Conn* c = b->client;
+    int status = 0;
+    if (ok && b->resp.size() > 12 &&
+        memcmp(b->resp.data(), "HTTP/1.1 ", 9) == 0)
+        status = atoi(b->resp.c_str() + 9);
+    if (ok && b->hdr_end != 0 && status == 201) {
+        if (c != nullptr) {
+            c->upstream = nullptr;
+            std::string extra = "ETag: \"" + b->f_md5hex + "\"\r\n";
+            append_response(c, 200, "OK", "", extra, "", 0, false);
+            observe_op(E, c, kOpWrite, b->f_size);
+            E->stats.native_writes++;
+            front_native_inc(E, kFrWrite);
+        }
+        s3_finish_common(E, w, b, c);
+        return;
+    }
+    s3_replay_python(E, w, b, kFrWrite);
+}
+
+// mode 5: object DELETE — S3 answers 204 whether or not the key existed,
+// so success and 404 translate to 204. A 409 is NOT accepted: the filer
+// answers 409 both for a missing entry AND for a non-empty directory, and
+// the Python path deletes directories recursively (fc.delete
+// recursive=True) — acking 409 as 204 would silently no-op a subtree
+// delete the slow path executes. Python resolves both 409 flavors to the
+// right outcome, so replay instead.
+void s3_delete_finish(Engine* E, Worker* w, BackendConn* b, bool ok) {
+    Conn* c = b->client;
+    int status = 0;
+    if (ok && b->resp.size() > 12 &&
+        memcmp(b->resp.data(), "HTTP/1.1 ", 9) == 0)
+        status = atoi(b->resp.c_str() + 9);
+    if (ok && b->hdr_end != 0 && (status < 300 || status == 404)) {
+        if (c != nullptr) {
+            c->upstream = nullptr;
+            append_response(c, 204, "No Content", "", "", "", 0, false);
+            observe_op(E, c, kOpDelete, 0);
+            E->stats.native_deletes++;
+            front_native_inc(E, kFrDelete);
+        }
+        s3_finish_common(E, w, b, c);
+        return;
+    }
+    s3_replay_python(E, w, b, kFrDelete);
+}
+
+// gate + launch for one s3-front request; returns false when the request
+// must take the Python path (typed fallback reason counted by the caller
+// only for transport failures — gates count their own)
+bool handle_s3_front(Engine* E, Worker* w, Conn* c, const std::string& method,
+                     const char* req, size_t req_len, size_t hdr_len,
+                     const char* body, size_t body_len, const char* path,
+                     const char* fid_end, const char* qmark,
+                     const char* path_end) {
+    const char* he = req + hdr_len;
+    int frop = method == "GET" ? kFrRead
+               : method == "DELETE" ? kFrDelete
+                                    : kFrWrite;
+    auto fb = [&](int reason) {
+        front_fb_inc(E, frop, reason);
+        return false;
+    };
+    // /<bucket>/<key...>: both parts non-empty, canonical (the Python side
+    // normalizes/unquotes anything else). Bucket-level requests are
+    // namespace ops, not object traffic — they proxy without front-door
+    // accounting.
+    std::string pstr(path, fid_end - path);
+    if (pstr.size() < 4 || pstr[0] != '/') return false;
+    size_t slash = pstr.find('/', 1);
+    if (slash == std::string::npos || slash + 1 >= pstr.size())
+        return false;  // bucket-level op
+    if (pstr.back() == '/') return fb(kFbOther);  // directory-style key
+    if (pstr.find('%') != std::string::npos ||
+        pstr.find("//") != std::string::npos ||
+        pstr.find("/./") != std::string::npos ||
+        pstr.find("/../") != std::string::npos)
+        return fb(kFbOther);
+    std::string bucket = pstr.substr(1, slash - 1);
+    if (bucket == "." || bucket.find('.') == 0) return fb(kFbOther);
+    // signed requests need sigv4 (Python); Origin-carrying ones need the
+    // bucket's CORS decoration; x-amz-* semantics (meta, copy, streaming
+    // bodies, tagging, acl) all live in the Python handlers
+    if (!find_header(req, he, "authorization").empty()) return fb(kFbAuth);
+    if (!find_header(req, he, "origin").empty()) return fb(kFbOther);
+    {
+        const char* p = req;
+        while (p < he) {
+            const char* eol = (const char*)memchr(p, '\n', he - p);
+            if (!eol) break;
+            if (eol - p >= 6 && strncasecmp(p, "x-amz-", 6) == 0 &&
+                strncasecmp(p, "x-amz-date:", 11) != 0 &&
+                strncasecmp(p, "x-amz-content-sha256:", 21) != 0)
+                return fb(kFbBodyShape);
+            p = eol + 1;
+        }
+        // streaming-framed bodies need Python's deframer
+        if (find_header(req, he, "x-amz-content-sha256")
+                .rfind("STREAMING-", 0) == 0)
+            return fb(kFbBodyShape);
+        // multipart/form-data bodies are browser POST-policy territory
+        if (find_header(req, he, "content-type").rfind("multipart/", 0) == 0)
+            return fb(kFbBodyShape);
+    }
+    // query: only the multipart part-upload shape is served natively
+    std::string up_path;  // filer-side target path
+    if (qmark != nullptr) {
+        if (method != "PUT") return fb(kFbQuery);
+        std::string q(qmark + 1, path_end - qmark - 1);
+        long part_num = -1;
+        std::string upload_id;
+        size_t pos = 0;
+        bool clean = true;
+        while (pos < q.size()) {
+            size_t amp = q.find('&', pos);
+            if (amp == std::string::npos) amp = q.size();
+            std::string kv = q.substr(pos, amp - pos);
+            if (kv.rfind("partNumber=", 0) == 0) {
+                const char* v = kv.c_str() + 11;
+                char* endp = nullptr;
+                part_num = strtol(v, &endp, 10);
+                if (endp == v || *endp != 0) clean = false;
+            } else if (kv.rfind("uploadId=", 0) == 0) {
+                upload_id = kv.substr(9);
+            } else {
+                clean = false;
+            }
+            pos = amp + 1;
+        }
+        if (!clean || part_num < 1 || part_num > 10000 || upload_id.empty()
+            || upload_id.find_first_not_of(
+                   "0123456789abcdefABCDEF") != std::string::npos)
+            return fb(kFbQuery);
+        {
+            std::shared_lock<std::shared_mutex> l(E->s3_mu);
+            if (E->s3_uploads.find(bucket + "/" + upload_id) ==
+                E->s3_uploads.end())
+                return fb(kFbBucketState);  // unknown upload: NoSuchUpload
+        }
+        char part[16];
+        snprintf(part, sizeof part, "%05ld.part", part_num);
+        up_path = "/buckets/" + bucket + "/.uploads/" + upload_id + "/" +
+                  part;
+    }
+    // bucket gate: Python installs flags only for buckets whose state the
+    // native path can honor (exists, open IAM, no policy/versioning/
+    // read-only/meta history) and re-validates them continuously
+    int need = frop == kFrRead ? kS3Read
+               : frop == kFrWrite ? kS3Write
+                                  : kS3Delete;
+    {
+        std::shared_lock<std::shared_mutex> l(E->s3_mu);
+        auto it = E->s3_buckets.find(bucket);
+        if (it == E->s3_buckets.end() || (it->second & need) == 0)
+            return fb(kFbBucketState);
+    }
+    if (up_path.empty()) up_path = "/buckets" + pstr;
+
+    auto* b = new BackendConn();
+    b->client = c;
+    b->target_ip = E->s3_filer_ip;
+    b->target_port = E->s3_filer_port;
+    b->client_req.assign(req, req_len);
+    b->f_path = pstr;
+    b->started = time(nullptr);
+    std::string& r = b->req;
+    if (frop == kFrRead) {
+        b->mode = 3;
+        r = "GET " + up_path + " HTTP/1.1\r\nHost: f\r\nX-Sw-S3: 1\r\n";
+        std::string range = find_header(req, he, "range");
+        if (range.find(',') != std::string::npos) {
+            delete b;
+            return fb(kFbBodyShape);  // multi-range: Python's surface
+        }
+        if (!range.empty()) r += "Range: " + range + "\r\n";
+        std::string inm = find_header(req, he, "if-none-match");
+        if (!inm.empty()) r += "If-None-Match: " + inm + "\r\n";
+        r += "\r\n";
+    } else if (frop == kFrWrite) {
+        b->mode = 4;
+        char md5hex[33];
+        md5_hex_of(body, body_len, md5hex);
+        b->f_md5hex = md5hex;
+        b->f_size = body_len;
+        r.reserve(body_len + 256);
+        r = "PUT " + up_path + " HTTP/1.1\r\nHost: f\r\nX-Sw-S3: 1\r\n";
+        std::string ctype = find_header(req, he, "content-type");
+        if (!ctype.empty() && ctype.size() < 250 &&
+            ctype.find_first_of("\r\n") == std::string::npos)
+            r += "Content-Type: " + ctype + "\r\n";
+        char cl[48];
+        snprintf(cl, sizeof cl, "Content-Length: %zu\r\n\r\n", body_len);
+        r += cl;
+        r.append(body, body_len);
+    } else {
+        b->mode = 5;
+        r = "DELETE " + up_path +
+            " HTTP/1.1\r\nHost: f\r\nX-Sw-S3: 1\r\n\r\n";
+    }
+    c->upstream = b;
+    if (!backend_launch(E, w, b)) {
+        c->upstream = nullptr;
+        delete b;
+        return fb(kFbUpstream);  // filer unreachable: Python's surface
+    }
+    w->pending.push_back(b);
+    return true;
 }
 
 // ---------------------------------------------------------------------------
@@ -2274,25 +2817,48 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
     }
 
     // filer mode: serve the path namespace natively where the cache/lease
-    // allow; every gate failure falls through to the Python proxy below.
-    // Percent-escapes and dot-segments would need Python's normalize();
-    // such paths (rare) always proxy so cache keys stay canonical.
-    if (E->filer_mode.load(std::memory_order_relaxed) && !has_query &&
-        path < fid_end && path[0] == '/' && fid_end[-1] != '/' &&
-        !((size_t)(fid_end - path) >= 3 && memcmp(path, "/__", 3) == 0)) {
+    // allow; every gate failure counts a typed fallback reason and falls
+    // through to the Python proxy below. Percent-escapes and dot-segments
+    // would need Python's normalize(); such paths (rare) always proxy so
+    // cache keys stay canonical. Directory listings (trailing /) are
+    // namespace ops, not chunk traffic — excluded from the accounting.
+    if (E->filer_mode.load(std::memory_order_relaxed) && path < fid_end &&
+        path[0] == '/' && fid_end[-1] != '/' &&
+        !((size_t)(fid_end - path) >= 3 && memcmp(path, "/__", 3) == 0) &&
+        (method == "GET" || method == "HEAD" || method == "POST" ||
+         method == "PUT" || method == "DELETE")) {
+        int frop = (method == "GET" || method == "HEAD") ? kFrRead
+                   : method == "DELETE"                  ? kFrDelete
+                                                         : kFrWrite;
         std::string pstr(path, fid_end - path);
         bool canonical = pstr.find('%') == std::string::npos &&
                          pstr.find("//") == std::string::npos &&
                          pstr.find("/./") == std::string::npos &&
                          pstr.find("/../") == std::string::npos;
-        if (canonical && (method == "GET" || method == "HEAD")) {
+        if (has_query) {
+            front_fb_inc(E, frop, kFbQuery);
+        } else if (!canonical) {
+            front_fb_inc(E, frop, kFbOther);
+        } else if (frop == kFrRead) {
             std::shared_ptr<FilerCacheEnt> ent;
             {
                 std::shared_lock<std::shared_mutex> l(E->fcache_mu);
                 auto it = E->fcache.find(pstr);
                 if (it != E->fcache.end()) ent = it->second;
             }
-            if (ent != nullptr) {
+            if (ent == nullptr) {
+                front_fb_inc(E, frop, kFbCacheMiss);
+            } else if (ent->tombstone) {
+                // natively-acked DELETE whose drain hasn't landed yet:
+                // read-your-deletes must hold on every engine core, so
+                // the tombstone answers 404 instead of proxying into the
+                // still-stale Python store
+                append_response(c, 404, "Not Found", "", "", "", 0, false);
+                observe_op(E, c, kOpRead, 0);
+                E->stats.native_reads++;
+                front_native_inc(E, kFrRead);
+                return;
+            } else {
                 if (!ent->inline_data.empty()) {
                     filer_serve_inline(E, c, ent, req, hdr_len,
                                        method == "HEAD");
@@ -2306,6 +2872,7 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
                                     "ETag: " + inm + "\r\n", "", 0, false);
                     observe_op(E, c, kOpRead, 0);
                     E->stats.native_reads++;
+                    front_native_inc(E, kFrRead);
                     return;
                 }
                 if (!range.empty() && !multi) {
@@ -2322,6 +2889,7 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
                                         cr, "", 0, false);
                         observe_op(E, c, kOpRead, 0);
                         E->stats.native_reads++;
+                        front_native_inc(E, kFrRead);
                         return;
                     }
                 }
@@ -2330,12 +2898,26 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
                                        hdr_len);
                     return;
                 }
+                front_fb_inc(E, frop, kFbBodyShape);  // HEAD/multi-range
             }
-        } else if (canonical && (method == "POST" || method == "PUT")) {
+        } else if (frop == kFrWrite) {
             if (handle_filer_write(E, w, c, pstr, req, hdr_len, body,
                                    body_len))
                 return;
+            // handle_filer_write counted its own fallback reason
+        } else if (handle_filer_delete(E, c, pstr)) {
+            return;
         }
+    }
+
+    // s3 front mode: gated object GET/PUT/DELETE relays to the filer
+    // engine; everything else (bucket ops, auth'd/versioned/meta'd
+    // requests) proxies to the Python S3 surface below
+    if (E->s3_mode.load(std::memory_order_relaxed) &&
+        (method == "GET" || method == "PUT" || method == "DELETE")) {
+        if (handle_s3_front(E, w, c, method, req, req_len, hdr_len, body,
+                            body_len, path, fid_end, qmark, path_end))
+            return;
     }
 
     uint32_t vid; uint64_t key; uint32_t cookie;
@@ -2425,7 +3007,9 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
                 if (mime == "application/octet-stream" || mime.size() >= 256)
                     mime.clear();  // common needle-set rule (both branches)
                 if (handle_write(E, c, v, key, cookie, wdata, wlen, fname,
-                                 mime))
+                                 mime,
+                                 parse_trace_id(
+                                     find_header(req, he, "x-sw-trace-id"))))
                     return;
             }
             proxy_request(E, w, c, req, req_len, bypass_cap);
@@ -2439,7 +3023,10 @@ void dispatch(Engine* E, Worker* w, Conn* c, const char* req, size_t req_len,
                                       path + 1, (size_t)(fid_end - path - 1));
             if (v && !has_query && jwt_ok && !E->secure_writes &&
                 !v->readonly.load() && !v->forward_writes.load()) {
-                if (handle_delete(E, c, v, key, cookie)) return;
+                if (handle_delete(E, c, v, key, cookie,
+                                  parse_trace_id(find_header(
+                                      req, he, "x-sw-trace-id"))))
+                    return;
             }
             proxy_request(E, w, c, req, req_len, bypass_cap);
             return;
@@ -2484,11 +3071,55 @@ void close_conn(Worker* w, Conn* c) {
 }
 
 void flush_out(Worker* w, Conn* c) {
-    while (c->out_off < c->out.size()) {
-        int n = conn_write(c, c->out.data() + c->out_off,
-                           (int)std::min(c->out.size() - c->out_off,
-                                         (size_t)1 << 20));
-        if (n > 0) { c->out_off += n; continue; }
+    // two output lanes: `out` (headers + small bodies, always first) and
+    // the zero-copy body channel out2. Plaintext sockets push both with a
+    // single sendmsg (writev) so a native read costs one syscall and zero
+    // body memcpys; TLS writes them sequentially through SSL_write.
+    for (;;) {
+        bool have_hdr = c->out_off < c->out.size();
+        bool have_body = c->out2_off < c->out2_len;
+        if (!have_hdr && !have_body) break;
+        if (have_hdr && have_body && c->ssl == nullptr) {
+            struct iovec iov[2];
+            iov[0].iov_base = (void*)(c->out.data() + c->out_off);
+            iov[0].iov_len = c->out.size() - c->out_off;
+            iov[1].iov_base = (void*)(c->out2_data + c->out2_off);
+            iov[1].iov_len = c->out2_len - c->out2_off;
+            struct msghdr mh;
+            memset(&mh, 0, sizeof mh);
+            mh.msg_iov = iov;
+            mh.msg_iovlen = 2;
+            ssize_t n = sendmsg(c->fd, &mh, MSG_NOSIGNAL);
+            if (n < 0) {
+                if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                    struct epoll_event ev;
+                    ev.events = EPOLLIN | EPOLLOUT;
+                    ev.data.ptr = c;
+                    epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+                    return;
+                }
+                close_conn(w, c);
+                return;
+            }
+            size_t hn = std::min((size_t)n, iov[0].iov_len);
+            c->out_off += hn;
+            c->out2_off += (size_t)n - hn;
+            continue;
+        }
+        const char* p;
+        size_t left;
+        if (have_hdr) {
+            p = c->out.data() + c->out_off;
+            left = c->out.size() - c->out_off;
+        } else {
+            p = c->out2_data + c->out2_off;
+            left = c->out2_len - c->out2_off;
+        }
+        int n = conn_write(c, p, (int)std::min(left, (size_t)1 << 20));
+        if (n > 0) {
+            if (have_hdr) c->out_off += n; else c->out2_off += n;
+            continue;
+        }
         if (n == -1) {
             struct epoll_event ev;
             ev.events = EPOLLIN | EPOLLOUT;
@@ -2501,11 +3132,56 @@ void flush_out(Worker* w, Conn* c) {
     }
     c->out.clear();
     c->out_off = 0;
+    std::string().swap(c->out2);  // release, don't retain multi-MB bodies
+    c->out2_pin.reset();
+    c->out2_data = nullptr;
+    c->out2_len = c->out2_off = 0;
     if (c->want_close) { close_conn(w, c); return; }
     struct epoll_event ev;
     ev.events = EPOLLIN;
     ev.data.ptr = c;
     epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+// zero-copy responders: headers build into c->out, the body parks on the
+// out2 channel (flush_out sends both with one writev). Worth the lane
+// juggling only for large bodies — small ones append_response directly.
+void respond_zc_head(Conn* c, int status, const char* reason,
+                     const std::string& ctype, const std::string& extra,
+                     size_t body_len) {
+    char hdr[512];
+    int hn = snprintf(hdr, sizeof hdr,
+                      "HTTP/1.1 %d %s\r\nContent-Length: %zu\r\n", status,
+                      reason, body_len);
+    c->out.append(hdr, hn);
+    if (!ctype.empty()) {
+        c->out += "Content-Type: ";
+        c->out += ctype;
+        c->out += "\r\n";
+    }
+    c->out += extra;
+    c->out += "\r\n";
+}
+
+void respond_zc_owned(Conn* c, int status, const char* reason,
+                      const std::string& ctype, const std::string& extra,
+                      std::string&& body, size_t off, size_t n) {
+    respond_zc_head(c, status, reason, ctype, extra, n);
+    c->out2 = std::move(body);
+    c->out2_data = c->out2.data() + off;
+    c->out2_len = n;
+    c->out2_off = 0;
+}
+
+void respond_zc_pinned(Conn* c, int status, const char* reason,
+                       const std::string& ctype, const std::string& extra,
+                       std::shared_ptr<const void> pin, const char* data,
+                       size_t n) {
+    respond_zc_head(c, status, reason, ctype, extra, n);
+    c->out2_pin = std::move(pin);
+    c->out2_data = data;
+    c->out2_len = n;
+    c->out2_off = 0;
 }
 
 // A chunked request body (curl -T -, streaming clients) carries no
@@ -2566,9 +3242,11 @@ int dechunk_request(Conn* c, size_t hdr_len) {
 }
 
 // drain complete buffered requests; stops while a proxied request is in
-// flight (responses must stay ordered per connection)
+// flight (responses must stay ordered per connection) or while a
+// zero-copy body occupies the out2 lane (a later response appended to
+// `out` would overtake it on the wire)
 void process_buffered(Engine* E, Worker* w, Conn* c) {
-    while (c->upstream == nullptr && !c->want_close) {
+    while (c->upstream == nullptr && !c->want_close && c->out2_len == 0) {
         size_t hdr_end = c->in.find("\r\n\r\n");
         if (hdr_end == std::string::npos) {
             if (c->in.size() > (1u << 20)) close_conn(w, c);
@@ -2604,6 +3282,32 @@ void process_buffered(Engine* E, Worker* w, Conn* c) {
                  c->in.data() + hdr_len, body_len);
         c->in.erase(0, req_len);
         c->sent_continue = false;
+    }
+}
+
+// serve every request already buffered in c->in, interleaving flushes:
+// a zero-copy response parks process_buffered until its out2 body lane
+// clears, and after a backend completion no further read event will
+// arrive to resume the pipeline — a single process_buffered+flush_out
+// pass would leave an already-buffered pipelined request stalled until
+// the idle sweep. Loops until blocked (partial flush, upstream hop,
+// close) or c->in stops shrinking.
+void drain_buffered(Engine* E, Worker* w, Conn* c) {
+    for (;;) {
+        // flush FIRST: when a backend completion parks its body on out2
+        // before calling here, process_buffered is gated until the lane
+        // clears — flushing last would read "no input consumed" as done
+        // and strand the buffered request
+        flush_out(w, c);
+        if (c->fd < 0 || c->upstream != nullptr || c->want_close ||
+            c->out_off < c->out.size() || c->out2_len != 0 || c->in.empty())
+            return;
+        size_t before = c->in.size();
+        process_buffered(E, w, c);
+        if (c->fd < 0) return;
+        if (c->in.size() == before && c->out_off >= c->out.size() &&
+            c->out2_len == 0)
+            return;  // no progress and nothing new to flush
     }
 }
 
@@ -2673,8 +3377,7 @@ void on_readable(Engine* E, Worker* w, Conn* c) {
         return;
     }
     c->last_active = time(nullptr);
-    process_buffered(E, w, c);
-    if (c->fd >= 0) flush_out(w, c);
+    drain_buffered(E, w, c);
 }
 
 void* worker_main(void* arg) {
@@ -3185,6 +3888,25 @@ int sw_fl_tls_client_ok(int h) {
     return (E->tls_ctx == nullptr || E->tls_client_ctx != nullptr) ? 1 : 0;
 }
 
+// typed error strings for the negative rcs this ABI returns — the Python
+// side logs these instead of a bare rc so a fallback regime names itself
+const char* sw_fl_error_str(int rc) {
+    switch (rc) {
+        case 0: return "ok";
+        case -1: return "engine handle invalid or already stopped";
+        case -2: return "host is not an IPv4 address (hostname targets"
+                        " stay on the Python path)";
+        case -3: return "mTLS configured but no native TLS client context"
+                        " (OpenSSL runtime missing)";
+        case -4: return "TLS requested but OpenSSL runtime unavailable";
+        case -5: return "TLS certificate/key/CA failed to load";
+        default: return "unknown error";
+    }
+}
+
+// upsert one volume's lease into the POOL (keyed by vid): chunk writes
+// round-robin across unspent leases, and a failed volume drops only its
+// own entry. Python tops the pool up via sw_fl_filer_lease_count.
 int sw_fl_filer_lease_set(int h, const char* vol_host, int vol_port,
                           uint32_t vid, uint32_t cookie,
                           unsigned long long key_start,
@@ -3209,7 +3931,38 @@ int sw_fl_filer_lease_set(int h, const char* vol_host, int vol_port,
     L->end_key = key_end;
     if (upload_auth && *upload_auth) L->auth = upload_auth;
     std::unique_lock<std::shared_mutex> l(E->flease_mu);
-    E->flease = std::move(L);
+    bool replaced = false;
+    for (auto& ex : E->fleases)
+        if (ex->vid == vid) {
+            uint64_t next = ex->next_key.load(std::memory_order_relaxed);
+            if (next < ex->end_key && ex->end_key - next >= 5000) {
+                // the held range is still healthy: inherit it instead of
+                // replacing (a replace abandons the unspent keys — on a
+                // cluster with fewer writable volumes than the pool
+                // target every top-up probe lands on an already-held
+                // vid, and the discard would waste ~count fids per probe
+                // forever) while refreshing endpoint + auth so a
+                // slow-draining range never outlives its JWT. The swap
+                // is safe under the unique lock: take_filer_lease mints
+                // under the shared lock, so no key can be drawn between
+                // the next_key load and the pointer swap, and in-flight
+                // writers hold their own shared_ptr to the immutable old
+                // object. rc=1 tells the filer the master granted a
+                // duplicate vid — the pool is as wide as the cluster
+                // allows, stop topping up.
+                L->cookie = ex->cookie;
+                L->next_key.store(next);
+                L->end_key = ex->end_key;
+                ex = std::move(L);
+                E->filer_read_auth =
+                    read_auth && *read_auth ? read_auth : "";
+                return 1;
+            }
+            ex = std::move(L);
+            replaced = true;
+            break;
+        }
+    if (!replaced) E->fleases.push_back(std::move(L));
     E->filer_read_auth = read_auth && *read_auth ? read_auth : "";
     return 0;
 }
@@ -3217,14 +3970,26 @@ int sw_fl_filer_lease_set(int h, const char* vol_host, int vol_port,
 unsigned long long sw_fl_filer_lease_remaining(int h) {
     Engine* E = engine_at(h);
     if (!E) return 0;
-    std::shared_ptr<FilerLease> L;
-    {
-        std::shared_lock<std::shared_mutex> l(E->flease_mu);
-        L = E->flease;
+    std::shared_lock<std::shared_mutex> l(E->flease_mu);
+    uint64_t total = 0;
+    for (const auto& L : E->fleases) {
+        uint64_t next = L->next_key.load(std::memory_order_relaxed);
+        if (next < L->end_key) total += L->end_key - next;
     }
-    if (!L) return 0;
-    uint64_t next = L->next_key.load(std::memory_order_relaxed);
-    return next >= L->end_key ? 0 : L->end_key - next;
+    return total;
+}
+
+// live (unspent) leases in the pool; -1 = bad handle so the Python side
+// can tell "engine stopped" from "pool empty" (the r05 shutdown race
+// logged a bare rc=-1 exactly because lease_remaining conflated the two)
+long sw_fl_filer_lease_count(int h) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    std::shared_lock<std::shared_mutex> l(E->flease_mu);
+    long n = 0;
+    for (const auto& L : E->fleases)
+        if (L->next_key.load(std::memory_order_relaxed) < L->end_key) n++;
+    return n;
 }
 
 int sw_fl_filer_cache_put(int h, const char* path, const char* host,
@@ -3361,6 +4126,80 @@ long sw_fl_get_metrics(int h, unsigned long long* out, size_t cap) {
             out[o++] = s.buckets[i].load(std::memory_order_relaxed);
     }
     return (long)o;
+}
+
+// Front-door accounting snapshot. Layout:
+//   out[0] = n_ops (read, write, delete — kNumFrontOps)
+//   out[1] = n_reasons (kNumFbReasons, in the kFb* order)
+//   out[2 .. 2+n_ops)                     native counts per op
+//   then n_ops rows of n_reasons fallback counts
+// Returns u64s written; -1 bad handle, -2 cap too small.
+long sw_fl_front_metrics(int h, unsigned long long* out, size_t cap) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    size_t need = 2 + kNumFrontOps + (size_t)kNumFrontOps * kNumFbReasons;
+    if (cap < need) return -2;
+    size_t o = 0;
+    out[o++] = (unsigned long long)kNumFrontOps;
+    out[o++] = (unsigned long long)kNumFbReasons;
+    for (int op = 0; op < kNumFrontOps; op++)
+        out[o++] = E->fr_native[op].load(std::memory_order_relaxed);
+    for (int op = 0; op < kNumFrontOps; op++)
+        for (int r = 0; r < kNumFbReasons; r++)
+            out[o++] = E->fr_fallback[op][r].load(std::memory_order_relaxed);
+    return (long)o;
+}
+
+// --- s3 front mode -----------------------------------------------------------
+
+// point the gateway's engine at the FILER's front door; object GET/PUT/
+// DELETE on natively-flagged buckets then relay without touching Python
+int sw_fl_s3_enable(int h, const char* filer_host, int filer_port) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    if (E->tls_ctx != nullptr && E->tls_client_ctx == nullptr) return -3;
+    uint32_t ip = htonl(INADDR_LOOPBACK);
+    if (filer_host && *filer_host && strcmp(filer_host, "0.0.0.0") != 0) {
+        ip = inet_addr(filer_host);
+        if (ip == INADDR_NONE) return -2;  // hostname: Python path only
+    }
+    E->s3_filer_ip = ip;
+    E->s3_filer_port = filer_port;
+    E->s3_mode.store(true, std::memory_order_release);
+    return 0;
+}
+
+int sw_fl_s3_disable(int h) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    E->s3_mode.store(false, std::memory_order_release);
+    std::unique_lock<std::shared_mutex> l(E->s3_mu);
+    E->s3_buckets.clear();
+    E->s3_uploads.clear();
+    return 0;
+}
+
+// flags: kS3Read|kS3Write|kS3Delete bits; negative = forget the bucket
+int sw_fl_s3_bucket_set(int h, const char* bucket, int flags) {
+    Engine* E = engine_at(h);
+    if (!E || !bucket || !*bucket) return -1;
+    std::unique_lock<std::shared_mutex> l(E->s3_mu);
+    if (flags < 0) E->s3_buckets.erase(bucket);
+    else E->s3_buckets[bucket] = flags;
+    return 0;
+}
+
+// multipart upload registry: parts for unknown uploadIds proxy to Python
+// (which answers NoSuchUpload); create/complete/abort maintain it
+int sw_fl_s3_upload_set(int h, const char* bucket, const char* upload_id,
+                        int on) {
+    Engine* E = engine_at(h);
+    if (!E || !bucket || !upload_id) return -1;
+    std::string key = std::string(bucket) + "/" + upload_id;
+    std::unique_lock<std::shared_mutex> l(E->s3_mu);
+    if (on) E->s3_uploads.insert(std::move(key));
+    else E->s3_uploads.erase(key);
+    return 0;
 }
 
 // Per-volume native-op counters: out6 = reads, writes, deletes,

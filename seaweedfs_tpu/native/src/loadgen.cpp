@@ -60,9 +60,9 @@ int lg_connect(uint32_t ip, int port) {
 
 extern "C" {
 
-// paths: npaths zero-terminated strings, back to back. method "GET" or
-// "POST". body posted to every path when non-null. out[0]=ok count,
-// out[1]=error count, out[2]=elapsed ns.
+// paths: npaths zero-terminated strings, back to back. method "GET",
+// "POST", "PUT" or "DELETE". body sent with every POST/PUT when non-null.
+// out[0]=ok count, out[1]=error count, out[2]=elapsed ns.
 int sw_loadgen(const char* host, int port, int n_conns, const char* method,
                const char* paths, size_t npaths, const char* body,
                size_t body_len, unsigned long long* out3) {
@@ -74,7 +74,8 @@ int sw_loadgen(const char* host, int port, int n_conns, const char* method,
         pv.push_back(p);
         p += strlen(p) + 1;
     }
-    bool is_post = strcmp(method, "POST") == 0;
+    bool is_post =
+        strcmp(method, "POST") == 0 || strcmp(method, "PUT") == 0;
     size_t next_path = 0, done = 0, ok = 0, errs = 0;
     int ep = epoll_create1(0);
     std::vector<LgConn> conns(n_conns);
@@ -86,11 +87,11 @@ int sw_loadgen(const char* host, int port, int n_conns, const char* method,
         int n;
         if (is_post)
             n = snprintf(hdr, sizeof hdr,
-                         "POST %s HTTP/1.1\r\nHost: lg\r\nContent-Length: %zu\r\n\r\n",
-                         pv[c.path_idx], body_len);
+                         "%s %s HTTP/1.1\r\nHost: lg\r\nContent-Length: %zu\r\n\r\n",
+                         method, pv[c.path_idx], body_len);
         else
-            n = snprintf(hdr, sizeof hdr, "GET %s HTTP/1.1\r\nHost: lg\r\n\r\n",
-                         pv[c.path_idx]);
+            n = snprintf(hdr, sizeof hdr, "%s %s HTTP/1.1\r\nHost: lg\r\n\r\n",
+                         method, pv[c.path_idx]);
         c.out.assign(hdr, n);
         if (is_post && body_len) c.out.append(body, body_len);
         c.out_off = 0;

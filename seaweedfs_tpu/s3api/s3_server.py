@@ -97,8 +97,13 @@ class S3Server:
         self._routes()
 
     def _start_fastlane(self) -> None:
-        """Same engine front as the filer: a concurrency governor
-        multiplexing client connections onto a capped backend."""
+        """Engine front for the gateway. Beyond the proxy governor the
+        filer uses, S3 FRONT MODE relays gated plain-object GET/PUT/DELETE
+        (open IAM, no policy/versioning/meta/CORS in play) straight to the
+        FILER's engine front door — object bytes never cross this process's
+        GIL. Python keeps the full S3 surface; per-bucket native flags are
+        computed here and re-validated continuously, so any state the
+        translation cannot honor falls back with a typed reason."""
         from seaweedfs_tpu.storage import fastlane as fl_mod
 
         self.fastlane = fl_mod.front_service(
@@ -106,8 +111,141 @@ class S3Server:
             guard_active=getattr(self.service, "guard", None) is not None,
             workers=1, max_backend=2,
         )
+        self._fl_s3_on = False
+        self._fl_native_buckets: dict[str, int] = {}
+        self._fl_meta_dirty: set[str] = set()
+        self._fl_uploads: set[tuple[str, str]] = set()
+        self._fl_collector = None
+        if self.fastlane is None:
+            return
+        import urllib.parse as _up
+
+        from seaweedfs_tpu.util import glog
+
+        u = _up.urlparse(self.fc.filer_url if "://" in self.fc.filer_url
+                         else "http://" + self.fc.filer_url)
+        if not u.hostname or not u.port:
+            return
+        rc = int(self.fastlane._lib.sw_fl_s3_enable(
+            self.fastlane.handle, u.hostname.encode(), int(u.port)))
+        if rc != 0:
+            glog.warning("s3 native front disabled: %s",
+                         fl_mod.error_str(self.fastlane._lib, rc))
+            return
+        self._fl_s3_on = True
+        self._register_front_collector()
+
+    FL_FRONT_FAMILIES = (
+        "SeaweedFS_s3_fastlane_native_total",
+        "SeaweedFS_s3_fastlane_fallback_total",
+    )
+
+    def _register_front_collector(self) -> None:
+        from seaweedfs_tpu.stats import default_registry
+        from seaweedfs_tpu.storage import fastlane as fl_mod
+
+        def lines() -> list[str]:
+            fl = self.fastlane
+            if fl is None or fl.stopped:
+                return []
+            server = f"{self.service.host}:{fl.port}"
+            return fl_mod.front_metric_lines(
+                fl, "SeaweedFS_s3_fastlane", server)
+
+        self._fl_collector = default_registry().register_collector(
+            lines, names=self.FL_FRONT_FAMILIES)
+
+    # --- s3 native-front bucket flags ---------------------------------------
+    def _fl_bucket_flags(self, bucket: str, entry: dict | None = None) -> int:
+        """Native permission bits for one bucket; 0 = every op falls back,
+        -1 = bucket gone (forget it). Conservative by construction: any
+        state the engine's translation can't honor drops the bit."""
+        if self.iam.identities:
+            return 0  # authenticated mode: requests need sigv4 (Python)
+        if entry is None:
+            entry = self.fc.get_entry(self._bucket_path(bucket))
+        if entry is None or not entry.get("is_directory"):
+            return -1
+        ext = entry.get("extended") or {}
+        if ext.get(self._EXT_POLICY) or ext.get(self._EXT_VERSIONING):
+            return 0  # policy evaluation / version retirement is Python's
+        flags = 0
+        if (bucket not in self._fl_meta_dirty
+                and not ext.get(self._EXT_META_DIRTY)):
+            # an object with x-amz-meta attributes was written: native GETs
+            # could not serve its metadata headers, so reads stay on Python.
+            # The marker is ALSO persisted on the bucket entry — a gateway
+            # restart (or a meta write through another gateway) must not
+            # re-grant the read bit off an empty in-memory set; the
+            # revalidation loop reads it back within one tick
+            flags |= 1
+        if not ext.get("s3-read-only"):
+            flags |= 2
+        flags |= 4  # deletes ignore the quota read-only flag (Python does)
+        return flags
+
+    def _fl_push_bucket(self, bucket: str, entry: dict | None = None) -> None:
+        """(Re)install one bucket's native flags in the engine."""
+        if not getattr(self, "_fl_s3_on", False) or self.fastlane is None:
+            return
+        try:
+            flags = self._fl_bucket_flags(bucket, entry)
+        except Exception:
+            flags = 0
+        if flags < 0:
+            self.fastlane._lib.sw_fl_s3_bucket_set(
+                self.fastlane.handle, bucket.encode(), -1)
+            self._fl_native_buckets.pop(bucket, None)
+            return
+        if self._fl_native_buckets.get(bucket) != flags:
+            self.fastlane._lib.sw_fl_s3_bucket_set(
+                self.fastlane.handle, bucket.encode(), flags)
+            self._fl_native_buckets[bucket] = flags
+
+    def _fl_revoke_bucket(self, bucket: str) -> None:
+        if not getattr(self, "_fl_s3_on", False) or self.fastlane is None:
+            return
+        self.fastlane._lib.sw_fl_s3_bucket_set(
+            self.fastlane.handle, bucket.encode(), -1)
+        self._fl_native_buckets.pop(bucket, None)
+
+    def _fl_upload_set(self, bucket: str, upload_id: str, on: bool) -> None:
+        if not getattr(self, "_fl_s3_on", False) or self.fastlane is None:
+            return
+        if on:
+            self._fl_uploads.add((bucket, upload_id))
+        else:
+            self._fl_uploads.discard((bucket, upload_id))
+        self.fastlane._lib.sw_fl_s3_upload_set(
+            self.fastlane.handle, bucket.encode(), upload_id.encode(),
+            1 if on else 0)
+
+    def _fl_revalidate_loop(self) -> None:  # pragma: no cover - timing loop
+        # out-of-band bucket state changes (quota enforcement via the
+        # shell, another gateway's policy put) reach the flags within one
+        # tick; same-gateway changes push synchronously from the handlers
+        while not self._fl_reval_stop.wait(2.0):
+            try:
+                for bucket in list(self._fl_native_buckets):
+                    self._fl_push_bucket(bucket)
+                # uploads completed/aborted through ANOTHER gateway leave
+                # this engine's registry stale — a late native part PUT
+                # would recreate the deleted staging dir as an orphan and
+                # 200 an upload that no longer exists. Unregister any
+                # registration whose manifest vanished; its part PUTs fall
+                # back to Python, which answers NoSuchUpload.
+                for bucket, uid in list(self._fl_uploads):
+                    gone = self.fc.get_entry(
+                        f"{self._uploads_dir(bucket, uid)}/upload.json"
+                    ) is None
+                    if gone:
+                        self._fl_upload_set(bucket, uid, False)
+            except Exception:
+                pass
 
     def start(self) -> None:
+        import threading
+
         self._start_fastlane()
         try:
             self.fc.mkdir(BUCKETS_DIR)
@@ -115,9 +253,11 @@ class S3Server:
             pass
         self._load_iam_from_filer()
         self._watch_iam()
+        self._fl_reval_stop = threading.Event()
+        if getattr(self, "_fl_s3_on", False):
+            threading.Thread(target=self._fl_revalidate_loop,
+                             daemon=True).start()
         if self.lifecycle_sweep_interval > 0:
-            import threading
-
             self._sweep_stop = threading.Event()
 
             def sweeper():  # pragma: no cover - timing loop
@@ -132,8 +272,15 @@ class S3Server:
     def stop(self) -> None:
         if self._sweep_stop is not None:
             self._sweep_stop.set()
+        if getattr(self, "_fl_reval_stop", None) is not None:
+            self._fl_reval_stop.set()
         if self._iam_subscriber is not None:
             self._iam_subscriber.stop()
+        if getattr(self, "_fl_collector", None) is not None:
+            from seaweedfs_tpu.stats import default_registry
+
+            default_registry().unregister_collector(self._fl_collector)
+            self._fl_collector = None
         if getattr(self, "fastlane", None) is not None:
             self.fastlane.stop()
             self.fastlane = None
@@ -154,6 +301,10 @@ class S3Server:
             status, _, body = self.fc.get(self.IAM_CONFIG_PATH)
             if status == 200 and body:
                 self.iam.load_json(body)
+                # identities appearing means every request now needs
+                # sigv4: drop all native flags immediately
+                for bucket in list(getattr(self, "_fl_native_buckets", {})):
+                    self._fl_push_bucket(bucket)
         except Exception:
             pass
 
@@ -484,6 +635,11 @@ class S3Server:
         entry = self.fc.get_entry(self._bucket_path(bucket))
         if entry is None or not entry.get("is_directory"):
             raise err("NoSuchBucket", bucket)
+        # discovery hook for the native front: the first Python-handled
+        # request on a bucket computes + installs its engine flags, so
+        # subsequent plain-object traffic serves natively
+        if bucket not in getattr(self, "_fl_native_buckets", {}):
+            self._fl_push_bucket(bucket, entry)
         return entry
 
     def _require_writable_bucket(self, bucket: str) -> dict:
@@ -528,10 +684,14 @@ class S3Server:
         if self.fc.exists(path):
             raise err("BucketAlreadyExists", bucket)
         self.fc.mkdir(path)
+        self._fl_push_bucket(bucket)
         return Response(b"", 200, {"Location": f"/{bucket}"})
 
     def _delete_bucket(self, bucket: str) -> Response:
         self._require_bucket(bucket)
+        # revoke the native flags BEFORE the namespace delete: a racing
+        # native PUT must not recreate the bucket path mid-removal
+        self._fl_revoke_bucket(bucket)
         listing = self.fc.list(self._bucket_path(bucket), limit=2)
         entries = [
             e for e in listing.get("Entries", [])
@@ -541,6 +701,9 @@ class S3Server:
         if entries:
             raise err("BucketNotEmpty", bucket)
         self.fc.delete(self._bucket_path(bucket), recursive=True)
+        # a bucket recreated under the same name starts meta-clean (the
+        # persistent marker died with the directory entry)
+        self._fl_meta_dirty.discard(bucket)
         return Response(b"", 204)
 
     def _head_bucket(self, bucket: str) -> Response:
@@ -556,6 +719,10 @@ class S3Server:
     _EXT_POLICY = "s3-policy"
     _EXT_CORS = "s3-cors"
     _EXT_LIFECYCLE = "s3-lifecycle"
+    # set once the bucket holds an x-amz-meta-carrying object: the native
+    # GET relay can't serve metadata headers, so reads stay on Python.
+    # Persisted (not just in-memory) so restarts and peer gateways see it.
+    _EXT_META_DIRTY = "s3-meta-objects"
 
     def _bucket_ext_get(self, bucket: str, attr: str) -> str | None:
         entry = self._require_bucket(bucket)
@@ -570,6 +737,10 @@ class S3Server:
         else:
             ext[attr] = value
         self.fc.put_entry(path, entry)
+        # every bucket-state mutation (policy/versioning/read-only/...)
+        # funnels through here: recompute the native flags synchronously so
+        # the engine never serves a request the new state forbids
+        self._fl_push_bucket(bucket, entry)
 
     def _delete_bucket_ext(self, bucket: str, kind: str, status: int) -> Response:
         attr = {"cors": self._EXT_CORS, "lifecycle": self._EXT_LIFECYCLE,
@@ -1119,6 +1290,16 @@ class S3Server:
                     {f"{AMZ_META_PREFIX}{k}": v for k, v in meta.items()}
                 )
                 self.fc.put_entry(path, entry)
+            # the native GET relay cannot serve x-amz-meta headers; once a
+            # bucket holds meta-carrying objects its reads stay on Python
+            # (persisted on the bucket entry so restarts and peer gateways
+            # drop the read bit too; _bucket_ext_set re-pushes the flags)
+            if bucket not in self._fl_meta_dirty:
+                self._fl_meta_dirty.add(bucket)
+                try:
+                    self._bucket_ext_set(bucket, self._EXT_META_DIRTY, "1")
+                except Exception:
+                    self._fl_push_bucket(bucket)
         headers = {"ETag": f'"{etag}"'}
         if vid:
             headers["x-amz-version-id"] = vid
@@ -1595,6 +1776,9 @@ class S3Server:
             },
         }
         self.fc.put(f"{staging}/upload.json", json.dumps(manifest).encode())
+        # register the live upload with the engine: part PUTs under this
+        # id relay natively to the filer's staging area
+        self._fl_upload_set(bucket, upload_id, True)
         inner = (
             f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
             f"<UploadId>{upload_id}</UploadId>"
@@ -1720,7 +1904,14 @@ class S3Server:
                 entry = part_entries[num]
                 entry["chunks"] = []
                 self.fc.put_entry(f"{staging}/{num:05d}.part", entry)
+        if manifest.get("meta") and bucket not in self._fl_meta_dirty:
+            self._fl_meta_dirty.add(bucket)
+            try:
+                self._bucket_ext_set(bucket, self._EXT_META_DIRTY, "1")
+            except Exception:
+                self._fl_push_bucket(bucket)
         multipart_etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
+        self._fl_upload_set(bucket, upload_id, False)
         self.fc.delete(staging, recursive=True)
         inner = (
             f"<Location>/{escape(bucket)}/{escape(manifest['key'])}</Location>"
@@ -1732,6 +1923,7 @@ class S3Server:
     def _abort_multipart(self, bucket: str, key: str, q: dict) -> Response:
         upload_id = q["uploadId"]
         self._get_upload_manifest(bucket, upload_id)
+        self._fl_upload_set(bucket, upload_id, False)
         self.fc.delete(self._uploads_dir(bucket, upload_id), recursive=True)
         return Response(b"", 204)
 

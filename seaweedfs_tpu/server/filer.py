@@ -149,6 +149,7 @@ class FilerServer:
             bytes(conf_entry.content) if conf_entry else b"")
         self.filer.subscribe(self._conf_on_meta)
         self._register_stop = __import__("threading").Event()
+        self._fl_collector = None
         self._routes()
 
     def _conf_on_meta(self, ev) -> None:
@@ -231,6 +232,30 @@ class FilerServer:
         self._fl_buf = __import__("ctypes").create_string_buffer(1 << 20)
         self.filer.subscribe(self._fl_on_meta)
         self._fl_push_rules()  # fs.configure prefixes defer to Python
+        self._register_front_collector()
+
+    FL_FRONT_FAMILIES = (
+        "SeaweedFS_filer_fastlane_native_total",
+        "SeaweedFS_filer_fastlane_fallback_total",
+    )
+
+    def _register_front_collector(self) -> None:
+        """Export the engine's front-door accounting so a silent fall-back
+        regime (like r05's rejected lease) is a rate on /metrics — and the
+        `fastlane_fallback` alert — instead of a log line."""
+        from seaweedfs_tpu.stats import default_registry
+        from seaweedfs_tpu.storage import fastlane as fl_mod
+
+        def lines() -> list[str]:
+            fl = self.fastlane
+            if fl is None or fl.stopped:
+                return []
+            server = f"{self.service.host}:{fl.port}"
+            return fl_mod.front_metric_lines(
+                fl, "SeaweedFS_filer_fastlane", server)
+
+        self._fl_collector = default_registry().register_collector(
+            lines, names=self.FL_FRONT_FAMILIES)
 
     def start(self) -> None:
         import threading
@@ -286,6 +311,16 @@ class FilerServer:
 
     def _fl_apply(self, kind: int, size: int, mtime: int, md5: str,
                   path: str, fid: str, mime: str, content: bytes) -> None:
+        if kind == 2:
+            # natively-acked DELETE (the engine tombstoned its cache and
+            # journaled this frame): apply to the store + reclaim chunks.
+            # Idempotent for journal replay — an already-gone path is fine.
+            try:
+                chunks = self.filer.delete_entry(path)
+            except FilerError:
+                return
+            self._reclaim_chunks(chunks)
+            return
         entry = Entry(full_path=path)
         entry.attributes.mime = mime
         entry.attributes.file_size = size
@@ -360,29 +395,31 @@ class FilerServer:
                     break
         return total
 
+    # how many volumes' leases the engine should hold at once: chunk
+    # writes round-robin across the pool, a spent/failed volume degrades
+    # throughput instead of zeroing it, and refreshes amortize N volumes
+    # per low-watermark instead of churning one
+    _FL_LEASE_POOL = 3
+
     def _fl_lease_refresh(self, count: int = 20000) -> None:
-        """Fetch a count=N fid lease from the master and install it: the
-        engine then mints fids locally, so a native write costs zero master
-        round-trips (the master-side equivalent of its own native assign
-        profiles). Wildcard upload/read JWTs are minted from the filer's
-        key copies, as the reference filer signs its own volume tokens."""
+        """Top up the engine's lease POOL from the master: each assign
+        (count=N) leases one volume's fid range, and the engine round-robins
+        chunk writes across unspent ranges so a native write costs zero
+        master round-trips. Wildcard upload/read JWTs are minted from the
+        filer's key copies, as the reference filer signs its own volume
+        tokens. Never touches a stopped engine (the r05 bench logged its
+        rc=-1 'lease rejected' from exactly that shutdown race)."""
+        from seaweedfs_tpu.storage import fastlane as fl_mod
         from seaweedfs_tpu.storage.file_id import parse_needle_id_cookie
 
-        if not self.fastlane.tls_client_ok:
+        fl = self.fastlane
+        if fl is None or fl.stopped or self._register_stop.is_set():
+            return
+        if not fl.tls_client_ok:
             # mTLS without the engine's TLS client context (OpenSSL
             # resolution failed): chunk uploads go through Python (inline
             # writes stay native — no volume hop)
             return
-        a = self.client.assign(
-            count=count, replication=self.default_replication,
-            collection=self.collection,
-        )
-        if a.get("error"):
-            return
-        vid_s, _, key_hash = a["fid"].partition(",")
-        key, cookie = parse_needle_id_cookie(key_hash)
-        loc = a.get("publicUrl") or a.get("url")
-        host, _, port = loc.rpartition(":")
         upload_auth = read_auth = ""
         from seaweedfs_tpu.security.jwt import encode_jwt
 
@@ -394,32 +431,77 @@ class FilerServer:
             tok = encode_jwt(self.security.read_key,
                              {"fid": "", "exp": int(time.time()) + 3600})
             read_auth = f"BEARER {tok}"
-        rc = int(self.fastlane._lib.sw_fl_filer_lease_set(
-            self.fastlane.handle, host.encode(), int(port), int(vid_s),
-            cookie, key, key + count, upload_auth.encode(),
-            read_auth.encode(),
-        ))
-        if rc != 0:
-            # e.g. the volume registered by hostname (the engine needs an
-            # IP): chunk writes stay on the Python path. Without a backoff
-            # the 20ms loop would burn a count=20000 master assignment per
-            # tick forever.
-            self._fl_lease_backoff_until = time.monotonic() + 30.0
-            glog.warning(
-                "filer native lease rejected by engine (rc=%s, volume %s);"
-                " chunk writes stay on the Python path", rc, loc)
+        live = fl.lease_count()
+        if live < 0:
+            return  # engine stopped between checks
+        self._fl_lease_top_at = time.monotonic()
+        for _ in range(max(1, self._FL_LEASE_POOL - live)):
+            if fl.stopped or self._register_stop.is_set():
+                return
+            a = self.client.assign(
+                count=count, replication=self.default_replication,
+                collection=self.collection,
+            )
+            if a.get("error"):
+                return
+            vid_s, _, key_hash = a["fid"].partition(",")
+            key, cookie = parse_needle_id_cookie(key_hash)
+            loc = a.get("publicUrl") or a.get("url")
+            host, _, port = loc.rpartition(":")
+            rc = int(fl._lib.sw_fl_filer_lease_set(
+                fl.handle, host.encode(), int(port), int(vid_s),
+                cookie, key, key + count, upload_auth.encode(),
+                read_auth.encode(),
+            ))
+            if rc == 1:
+                # the master granted a vid the engine already holds with a
+                # healthy unspent range (the engine kept the range,
+                # refreshing endpoint + auth): the cluster has fewer
+                # writable volumes than the pool target, so further
+                # top-up probes this round would only repeat the answer.
+                # Probe again in ~60s instead of burning a count=20000
+                # master assign every 5s forever.
+                self._fl_lease_small_until = time.monotonic() + 55.0
+                return
+            if rc != 0:
+                # e.g. the volume registered by hostname (the engine needs
+                # an IP): chunk writes stay on the Python path. Without a
+                # backoff the 20ms loop would burn a count=20000 master
+                # assignment per tick forever.
+                self._fl_lease_backoff_until = time.monotonic() + 30.0
+                glog.warning(
+                    "filer native lease rejected by engine (volume %s): %s;"
+                    " chunk writes stay on the Python path", loc,
+                    fl_mod.error_str(fl._lib, rc))
+                return
 
     def _fl_filer_loop(self) -> None:  # pragma: no cover - timing loop
         while not self._register_stop.is_set():
             try:
+                fl = self.fastlane
+                if fl is None or fl.stopped:
+                    return
                 applied = 0
                 while True:
                     # lease first, one drain buffer at a time: a heavy
                     # write backlog must not starve the fid lease (native
                     # writes fall back to the slow proxy when it runs dry)
-                    rem = int(self.fastlane._lib.sw_fl_filer_lease_remaining(
-                        self.fastlane.handle))
-                    if rem < 5000 and time.monotonic() >= getattr(
+                    live = fl.lease_count()
+                    if live < 0:
+                        return  # engine stopped: never re-lease against it
+
+                    rem = int(fl._lib.sw_fl_filer_lease_remaining(fl.handle))
+                    # top up when keys run low or the pool emptied; an
+                    # UNDER-TARGET pool (small cluster: fewer writable
+                    # volumes than the target — assigns keep landing on
+                    # the same vid) re-tops only every 5s, not per tick
+                    want = (rem < 5000 or live == 0
+                            or (live < self._FL_LEASE_POOL
+                                and time.monotonic() >= getattr(
+                                    self, "_fl_lease_top_at", 0.0) + 5.0
+                                and time.monotonic() >= getattr(
+                                    self, "_fl_lease_small_until", 0.0)))
+                    if want and time.monotonic() >= getattr(
                             self, "_fl_lease_backoff_until", 0.0):
                         try:
                             self._fl_lease_refresh()
@@ -532,6 +614,12 @@ class FilerServer:
 
     def stop(self) -> None:
         self._register_stop.set()
+        self._fl_filer_on = False
+        if self._fl_collector is not None:
+            from seaweedfs_tpu.stats import default_registry
+
+            default_registry().unregister_collector(self._fl_collector)
+            self._fl_collector = None
         if getattr(self, "fastlane", None) is not None:
             self.fastlane.stop()
             self.fastlane = None
@@ -586,8 +674,22 @@ class FilerServer:
         # upload (and of concurrent uploads) coalesces into one batch-kernel
         # call (`upload_content.go` md5 ETag semantics)
         etag_futures = get_hash_service().submit_many(pieces)
+        # batched Assign: one master RPC leases fids for EVERY chunk of
+        # this upload (base fid + _delta fids on one volume) instead of an
+        # assign round-trip per chunk — on multi-chunk uploads the master
+        # hop was costlier than the chunk POST itself
+        batch_fids: list[str] | None = None
+        batch_loc = batch_auth = ""
+        if len(pieces) > 1:
+            try:
+                batch_fids, batch_loc, batch_auth = self.client.assign_batch(
+                    len(pieces), replication=replication,
+                    collection=collection, ttl=ttl,
+                )
+            except IOError:
+                batch_fids = None  # per-chunk assigns still work
         offset = 0
-        for piece in pieces:
+        for i, piece in enumerate(pieces):
             md5.update(piece)
             logical_size = len(piece)
             payload, compressed = (
@@ -598,9 +700,17 @@ class FilerServer:
             if self.cipher:
                 payload, key = cipher_util.encrypt(payload)
                 key_b64 = base64.b64encode(key).decode()
-            out = self.client.upload(
-                payload, replication=replication, collection=collection, ttl=ttl
-            )
+            if batch_fids is not None:
+                out = self.client.upload_to(
+                    batch_fids[i], batch_loc, payload, ttl=ttl,
+                    auth=batch_auth,
+                )
+                out["fid"] = batch_fids[i]
+            else:
+                out = self.client.upload(
+                    payload, replication=replication, collection=collection,
+                    ttl=ttl,
+                )
             chunks.append(
                 FileChunk(
                     file_id=out["fid"],
@@ -1567,6 +1677,10 @@ class FilerServer:
         if req.query.get("metadata") == "true":
             return Response(entry.to_dict())
         if entry.is_directory:
+            if req.headers.get("X-Sw-S3"):
+                # S3-front relay: object keys never resolve to listings —
+                # the gateway translates this into NoSuchKey
+                return Response({"error": f"{path} is a directory"}, 404)
             return self._list_dir(req, entry)
         if (
             entry.attributes.ttl_sec > 0

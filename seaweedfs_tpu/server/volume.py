@@ -1155,6 +1155,13 @@ class VolumeServer:
             return Response({"error": str(e)}, 400)
         if not self._check_read_jwt(req):
             return Response({"error": "unauthorized"}, 401)
+        # cross-core delete fence: this handler only sees reads the engine
+        # proxied (query params, multi-range, secure reads), and a native
+        # DELETE acked up to one drain tick earlier may not be in the
+        # Python needle map yet — a stale hit would serve a deleted needle.
+        # Drain before the lookup so read-your-deletes holds on EVERY path.
+        if self.fastlane is not None and vid in self.fastlane._volumes:
+            self.fastlane.drain()
         try:
             n = self._store_read(vid, key, cookie)
         except NotFound:

@@ -534,8 +534,8 @@ def _fmt_uptime(sec: float | None) -> str:
 @command("cluster.top",
          "[-once] [-interval 2] [-window 60] [-count n] [-include url,url]"
          " — live dashboard: per-role request rates, 5xx%, p99, bytes/s,"
-         " uptime and firing alerts from every node's history ring."
-         " -once renders a single frame and returns")
+         " front-door native ratio, uptime and firing alerts from every"
+         " node's history ring. -once renders a single frame and returns")
 def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
     """The rates-over-time view cluster.check can't give: every reachable
     node serves its self-scraped history ring (/debug/metrics/history)
@@ -602,6 +602,7 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
         def row(role: str) -> dict:
             return roles.setdefault(role, {
                 "req_s": 0.0, "err_s": 0.0, "bytes_s": 0.0,
+                "fr_native": 0.0, "fr_fb": 0.0,
                 "buckets": {}, "uptime": None, "version": None,
             })
 
@@ -626,6 +627,14 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
                     b[bound] = b.get(bound, 0.0) + rate
                 elif fam == "SeaweedFS_volume_fastlane_bytes_total" and rate:
                     row("volume")["bytes_s"] += rate
+                elif fam in ("SeaweedFS_filer_fastlane_native_total",
+                             "SeaweedFS_s3_fastlane_native_total") and rate:
+                    role = "filer" if "filer" in fam else "s3"
+                    row(role)["fr_native"] += rate
+                elif fam in ("SeaweedFS_filer_fastlane_fallback_total",
+                             "SeaweedFS_s3_fastlane_fallback_total") and rate:
+                    role = "filer" if "filer" in fam else "s3"
+                    row(role)["fr_fb"] += rate
                 elif fam == "SeaweedFS_process_start_time_seconds":
                     start_ts = s.get("last")
                 elif fam == "SeaweedFS_build_info":
@@ -654,7 +663,7 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
             f"cluster.top @ {env.master_url}  window={window:g}s  "
             f"{len(by_proc)} process(es), {len(hist_res)} endpoint(s)",
             f"{'role':<10} {'req/s':>9} {'5xx%':>7} {'p99 ms':>9}"
-            f" {'bytes/s':>10} {'uptime':>8}  version",
+            f" {'bytes/s':>10} {'front%':>7} {'uptime':>8}  version",
         ]
         for role in sorted(roles):
             r = roles[role]
@@ -662,10 +671,17 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
             err_pct = (
                 f"{100.0 * r['err_s'] / r['req_s']:.1f}" if r["req_s"] else "-"
             )
+            # front-door ratio: share of data-plane-shaped requests the
+            # filer/S3 engine served without touching Python
+            fr_total = r["fr_native"] + r["fr_fb"]
+            front = (
+                f"{100.0 * r['fr_native'] / fr_total:.1f}" if fr_total else "-"
+            )
             lines.append(
                 f"{role:<10} {r['req_s']:>9.1f} {err_pct:>7}"
                 f" {('n/a' if p99 is None else f'{p99 * 1e3:.2f}'):>9}"
                 f" {_fmt_bytes_rate(r['bytes_s']):>10}"
+                f" {front:>7}"
                 f" {_fmt_uptime(r['uptime']):>8}  {r['version'] or '-'}"
             )
         if not roles:

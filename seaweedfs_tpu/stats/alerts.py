@@ -45,6 +45,11 @@ DEFAULT_PARAMS = {
     "push_error_rate": 0.0,
     # trace_ring_drops: eviction churn this fast means the ring is blind
     "trace_drop_rate": 100.0,
+    # fastlane_fallback: sustained PATHOLOGICAL front-door fallbacks per
+    # second (no_lease / lease_spent / backpressure / upstream) — expected
+    # gate traffic (cache misses, query reads, auth'd requests) never
+    # counts. r05's silently-rejected filer lease is the motivating case.
+    "fastlane_fallback_rate": 1.0,
     # ec_pipeline_starved: a stage waiting this many times longer than it
     # works (and at all meaningfully) is starved by its neighbor
     "starvation_wait_ratio": 3.0,
@@ -159,6 +164,38 @@ def _check_trace_drops(hist, now, p):
     return None
 
 
+def _check_fastlane_fallback(hist, now, p):
+    """A front-door engine silently falling back to the Python path for a
+    BROKEN reason (the filer lease rejected/spent, drain backpressure, the
+    upstream volume hop failing) — distinct from expected gate fallbacks
+    like cache misses or auth'd requests, which are business as usual."""
+    from seaweedfs_tpu.storage.fastlane import PATHOLOGICAL_REASONS
+
+    bad = set(PATHOLOGICAL_REASONS)
+    details, worst = [], None
+    for family, role in (
+        ("SeaweedFS_filer_fastlane_fallback_total", "filer"),
+        ("SeaweedFS_s3_fastlane_fallback_total", "s3"),
+    ):
+        per_reason: dict[str, float] = {}
+        for labels, rate in hist.rates(family, p["window"], now):
+            if rate is None or labels.get("reason", "") not in bad:
+                continue
+            r = labels.get("reason", "?")
+            per_reason[r] = per_reason.get(r, 0.0) + rate
+        total = sum(per_reason.values())
+        if total > p["fastlane_fallback_rate"]:
+            top = max(per_reason.items(), key=lambda kv: kv[1])
+            details.append(
+                f"{role} falling back at {total:.1f}/s"
+                f" (mostly '{top[0]}')"
+            )
+            worst = max(worst or 0.0, total)
+    if not details:
+        return None
+    return worst, "; ".join(details)
+
+
 def _check_ec_starved(hist, now, p):
     per_stage: dict[str, dict] = {}
     for labels, rate in hist.rates(
@@ -202,6 +239,10 @@ def default_rules() -> list[Rule]:
         Rule("ec_pipeline_starved", "warning",
              "an EC pipeline stage spends far longer waiting than working",
              _check_ec_starved),
+        Rule("fastlane_fallback", "warning",
+             "a filer/S3 front door is falling back to the Python path"
+             " for a pathological reason (lease, backpressure, upstream)",
+             _check_fastlane_fallback),
     ]
 
 

@@ -190,6 +190,14 @@ class MetricsHistory:
         now = time.time() if now is None else float(now)
         samples = parse_exposition(self.registry.render())
         with self._lock:
+            # lazily-built eviction pool for cap pressure: series that
+            # VANISHED from the registry (last sample predates the previous
+            # scrape — an unregistered collector, e.g. a stopped server's
+            # per-volume/per-node gauges) may be reclaimed to admit a live
+            # newcomer. Without this, a churning fleet permanently locks
+            # dead series into the cap and a brand-new series carrying an
+            # alert signal (the first 5xx of an error storm) is refused.
+            reclaim: list | None = None
             for name, labels, value in samples:
                 key = (name, tuple(sorted(labels.items())))
                 ent = self._series.get(key)
@@ -201,8 +209,29 @@ class MetricsHistory:
                     if len(self._ever_seen) < 8 * self.max_series:
                         self._ever_seen.add(key)
                     if len(self._series) >= self.max_series:
-                        self.dropped_series_total += 1
-                        continue
+                        if reclaim is None:
+                            reclaim = sorted(
+                                (k for k, (_, dq) in self._series.items()
+                                 if not dq or dq[-1][0] < self.last_scrape),
+                                key=lambda k: (
+                                    self._series[k][1][-1][0]
+                                    if self._series[k][1] else 0.0),
+                                reverse=True,  # pop() takes the oldest
+                            )
+                        victim = None
+                        while reclaim:
+                            k = reclaim.pop()
+                            kdq = self._series[k][1]
+                            # re-check at pop time: a vanished series can
+                            # REAPPEAR later in this same scrape's samples
+                            # — once updated it is live again, not a victim
+                            if not kdq or kdq[-1][0] < self.last_scrape:
+                                victim = k
+                                break
+                        if victim is None:
+                            self.dropped_series_total += 1
+                            continue
+                        del self._series[victim]
                     dq = collections.deque(maxlen=self.slots)
                     # a counter series appearing between scrapes was
                     # implicitly 0 at the previous one (the registry omits

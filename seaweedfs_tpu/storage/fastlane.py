@@ -23,8 +23,9 @@ import os
 import struct
 import threading
 
-_EVENT_SIZE = 40
-_EVENT = struct.Struct("<IIQQiIQ")  # vid, op, key, offset, size, pad, ns
+_EVENT_SIZE = 48
+# vid, op, key, offset, size, pad, ns, trace_id
+_EVENT = struct.Struct("<IIQQiIQQ")
 
 
 def _bind(lib) -> bool:
@@ -101,6 +102,28 @@ def _bind(lib) -> bool:
         ]
         lib.sw_fl_filer_lease_remaining.restype = ctypes.c_ulonglong
         lib.sw_fl_filer_lease_remaining.argtypes = [ctypes.c_int]
+        lib.sw_fl_filer_lease_count.restype = ctypes.c_long
+        lib.sw_fl_filer_lease_count.argtypes = [ctypes.c_int]
+        lib.sw_fl_error_str.restype = ctypes.c_char_p
+        lib.sw_fl_error_str.argtypes = [ctypes.c_int]
+        lib.sw_fl_front_metrics.restype = ctypes.c_long
+        lib.sw_fl_front_metrics.argtypes = [
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib.sw_fl_s3_enable.restype = ctypes.c_int
+        lib.sw_fl_s3_enable.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.sw_fl_s3_disable.restype = ctypes.c_int
+        lib.sw_fl_s3_disable.argtypes = [ctypes.c_int]
+        lib.sw_fl_s3_bucket_set.restype = ctypes.c_int
+        lib.sw_fl_s3_bucket_set.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.sw_fl_s3_upload_set.restype = ctypes.c_int
+        lib.sw_fl_s3_upload_set.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
         lib.sw_fl_filer_cache_put.restype = ctypes.c_int
         lib.sw_fl_filer_cache_put.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
@@ -204,11 +227,63 @@ class VolumeHook:
 
 METRIC_OPS = ("read", "write", "delete", "assign", "proxied")
 
+# front-door accounting name tables — mirror kFr*/kFb* in fastlane.cpp
+FRONT_OPS = ("read", "write", "delete")
+FALLBACK_REASONS = (
+    "cache_miss", "no_lease", "lease_spent", "too_large", "body_shape",
+    "system_path", "query", "backpressure", "upstream", "auth",
+    "bucket_state", "other",
+)
+# reasons that indicate a BROKEN native path (vs expected gate traffic);
+# the fastlane_fallback alert rate-filters on these
+PATHOLOGICAL_REASONS = (
+    "no_lease", "lease_spent", "backpressure", "upstream",
+)
+
+
+def error_str(lib, rc: int) -> str:
+    """Typed engine error for a negative rc (sw_fl_error_str)."""
+    try:
+        return (lib.sw_fl_error_str(int(rc)) or b"").decode()
+    except Exception:
+        return f"rc={rc}"
+
+
+def front_metric_lines(engine: "Fastlane", prefix: str,
+                       server: str) -> list[str]:
+    """Exposition lines for the front-door counters, shared by the filer
+    and S3 metrics collectors: `<prefix>_native_total{op}` and
+    `<prefix>_fallback_total{op,reason}` — a silent fall-back regime (like
+    r05's rejected lease) becomes a visible rate instead of a log line."""
+    from seaweedfs_tpu.stats.metrics import _fmt_labels
+
+    fm = engine.front_metrics() if engine is not None else None
+    lines = [
+        f"# HELP {prefix}_native_total front-door requests served natively",
+        f"# TYPE {prefix}_native_total counter",
+    ]
+    if fm is None:
+        return lines
+    for op, st in fm.items():
+        lines.append(
+            f"{prefix}_native_total"
+            f"{_fmt_labels(('server', 'op'), (server, op))}"
+            f" {st['native']}")
+    lines.append(f"# TYPE {prefix}_fallback_total counter")
+    for op, st in fm.items():
+        for reason, n in st["fallback"].items():
+            lines.append(
+                f"{prefix}_fallback_total"
+                f"{_fmt_labels(('server', 'op', 'reason'), (server, op, reason))}"
+                f" {n}")
+    return lines
+
 
 class Fastlane:
     def __init__(self, lib, handle: int, tls: bool = False) -> None:
         self._lib = lib
         self.handle = handle
+        self.stopped = False
         self.tls = tls  # engine terminates mTLS itself: URLs are https
         self._metrics_ok = _bind_metrics(lib)
         # can the engine natively reach upstream (volume) engines? Under
@@ -216,7 +291,10 @@ class Fastlane:
         self.tls_client_ok = bool(lib.sw_fl_tls_client_ok(handle))
         self.port = int(lib.sw_fl_port(handle))
         self._volumes: dict[int, object] = {}  # vid -> Volume (drain target)
-        self._drain_mu = threading.Lock()
+        # RLock: unregister_volume holds it around the volume write lock
+        # (lock order _drain_mu -> _write_lock, same as the drain loop) and
+        # then drains inline
+        self._drain_mu = threading.RLock()
         self._buf = ctypes.create_string_buffer(_EVENT_SIZE * 4096)
         # span-synthesis budget (tokens/second): the engine can push tens of
         # thousands of events/s, and unthrottled synthesis would churn every
@@ -251,37 +329,49 @@ class Fastlane:
         return Fastlane(lib, h, tls=bool(tls_cert))
 
     def stop(self) -> None:
+        # flagged BEFORE the C stop: background loops (lease refresh) check
+        # it so they never operate on a dead handle — the r05 "rc=-1 lease
+        # rejected" warning was exactly this shutdown race
+        self.stopped = True
         self._lib.sw_fl_stop(self.handle)
         self._volumes.clear()
 
     # --- volume lifecycle ---------------------------------------------------
     def register_volume(self, volume, forward_writes: bool = False) -> bool:
         """Hand a Volume's data plane to the engine. Returns False for
-        shapes the engine does not serve (tiered/remote .dat, v1)."""
+        shapes the engine does not serve (tiered/remote .dat, v1).
+
+        Runs entirely under the volume's write lock: a Python-path append
+        racing the handoff could otherwise land between the map snapshot
+        and the hook installation — invisible to the engine's map (native
+        reads 404 an acked write) and, worse, behind the engine's tail
+        (the next native append overwrites it). With the lock held, every
+        Python append either fully precedes the snapshot or sees the hook."""
         from seaweedfs_tpu.storage.backend import DiskFile, MmapFile
 
         if not isinstance(volume._dat, (DiskFile, MmapFile)):
             return False  # remote-tiered: reads proxy to Python
         if volume.version() not in (2, 3):
             return False
-        dat_fd = os.dup(volume._dat._fd)
-        idx_fd = os.open(volume.base_name + ".idx",
-                         os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
-        rc = self._lib.sw_fl_register_volume(
-            self.handle, volume.id, dat_fd, idx_fd, volume.version(),
-            volume._size, volume.last_append_at_ns,
-            1 if volume.readonly else 0, 1 if forward_writes else 0,
-        )
-        if rc != 0:
-            os.close(dat_fd)
-            os.close(idx_fd)
-            return False
-        self._load_map(volume)
-        volume._fl_hook = VolumeHook(self, volume.id)
-        self._volumes[volume.id] = volume
-        # until this call the engine proxies the volume's traffic: arming
-        # it before the bulk load would 404 existing needles
-        self._lib.sw_fl_volume_serving(self.handle, volume.id)
+        with volume._write_lock:
+            dat_fd = os.dup(volume._dat._fd)
+            idx_fd = os.open(volume.base_name + ".idx",
+                             os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+            rc = self._lib.sw_fl_register_volume(
+                self.handle, volume.id, dat_fd, idx_fd, volume.version(),
+                volume._size, volume.last_append_at_ns,
+                1 if volume.readonly else 0, 1 if forward_writes else 0,
+            )
+            if rc != 0:
+                os.close(dat_fd)
+                os.close(idx_fd)
+                return False
+            self._load_map(volume)
+            volume._fl_hook = VolumeHook(self, volume.id)
+            self._volumes[volume.id] = volume
+            # until this call the engine proxies the volume's traffic:
+            # arming it before the bulk load would 404 existing needles
+            self._lib.sw_fl_volume_serving(self.handle, volume.id)
         return True
 
     def _load_map(self, volume) -> None:
@@ -303,26 +393,41 @@ class Fastlane:
         # order matters: the C call waits out any in-flight append (whose
         # event lands in the queue), the drain then applies every event
         # while the volume is still a drain target, and only then does the
-        # vid stop being tracked — no acked write can slip through
-        self._lib.sw_fl_unregister_volume(self.handle, vid)
-        self.drain()
-        v = self._volumes.pop(vid, None)
-        if v is not None:
-            v._fl_hook = None
+        # vid stop being tracked — no acked write can slip through. The
+        # whole sequence holds the volume's write lock: a Python append
+        # racing it would find the engine's per-volume lock/tail already
+        # gone (hook no-ops) and append at a stale _size, overwriting
+        # engine-written records the drain had not yet applied.
+        v = self._volumes.get(vid)
+        if v is None:
+            self._lib.sw_fl_unregister_volume(self.handle, vid)
+            self.drain()
+            return
+        # lock order matches the drain loop (_drain_mu -> _write_lock);
+        # _drain_mu is an RLock so the inline drain re-enters it
+        with self._drain_mu:
+            with v._write_lock:
+                self._lib.sw_fl_unregister_volume(self.handle, vid)
+                self.drain(locked_vid=vid)
+                self._volumes.pop(vid, None)
+                v._fl_hook = None
 
     def set_flags(self, vid: int, readonly: bool, forward_writes: bool) -> None:
         self._lib.sw_fl_set_flags(self.handle, vid, 1 if readonly else 0,
                                   1 if forward_writes else 0)
 
     # --- event drain --------------------------------------------------------
-    def drain(self) -> int:
+    def drain(self, locked_vid: int | None = None) -> int:
         """Apply engine-side appends/deletes to the Python needle maps
         (memory-only — the engine already wrote .dat and .idx), and
         synthesize events into finished spans in the shared trace ring:
         natively-served writes never touch a Python handler, so without
         this `cluster.trace` was blind to the whole data plane. Span
         synthesis is budgeted per second so a native write storm cannot
-        evict every real request trace from the bounded ring."""
+        evict every real request trace from the bounded ring.
+
+        locked_vid: a volume whose _write_lock the CALLER already holds
+        (unregister_volume) — its events apply without re-taking it."""
         import time as _time
 
         from seaweedfs_tpu.stats import trace as _trace
@@ -335,18 +440,24 @@ class Fastlane:
                 if n <= 0:
                     break
                 for i in range(n):
-                    vid, op, key, offset, size, _, ns = _EVENT.unpack_from(
-                        self._buf, i * _EVENT_SIZE)
+                    (vid, op, key, offset, size, _, ns,
+                     tid) = _EVENT.unpack_from(self._buf, i * _EVENT_SIZE)
                     sec = int(_time.monotonic())
                     if sec != self._span_sec:
                         self._span_sec = sec
                         self._span_quota = 128
-                    if self._span_quota > 0:
-                        self._span_quota -= 1
+                    # a traced event (filer-relayed chunk PUT carrying the
+                    # originating X-Sw-Trace-Id) always synthesizes — its
+                    # span completes an end-to-end chain in cluster.trace;
+                    # only anonymous storm traffic is budget-sampled
+                    if tid or self._span_quota > 0:
+                        if not tid:
+                            self._span_quota -= 1
                         _trace.record_span(
                             "fastlane.append" if op == 0
                             else "fastlane.delete",
                             role="volume", start=ns / 1e9,
+                            trace_id=f"{tid:016x}" if tid else None,
                             attrs={"vid": vid, "key": f"{key:x}",
                                    "size": size, "native": True},
                         )
@@ -361,9 +472,13 @@ class Fastlane:
                     # Python append's own store (Volume._append_lock holds
                     # the same lock)
                     end = offset + v._record_size(size if op == 0 else 0)
-                    with v._write_lock:
+                    if vid == locked_vid:  # caller already holds it
                         v._size = max(v._size, end)
                         v.last_append_at_ns = max(v.last_append_at_ns, ns)
+                    else:
+                        with v._write_lock:
+                            v._size = max(v._size, end)
+                            v.last_append_at_ns = max(v.last_append_at_ns, ns)
                 total += n
                 if n < 4096:
                     break
@@ -429,6 +544,41 @@ class Fastlane:
             }
             o += 3 + n_buckets + 1
         return {"bounds_s": bounds_s, "ops": ops}
+
+    def front_metrics(self) -> dict | None:
+        """Front-door accounting: per-op native vs typed-reason fallback
+        counts from the engine (filer/S3 modes), or None when the loaded
+        .so predates sw_fl_front_metrics. Shape:
+        {op: {"native": n, "fallback": {reason: n}}}."""
+        try:
+            fn = self._lib.sw_fl_front_metrics
+        except AttributeError:
+            return None
+        cap = 2 + len(FRONT_OPS) + len(FRONT_OPS) * len(FALLBACK_REASONS) + 64
+        buf = (ctypes.c_ulonglong * cap)()
+        n = int(fn(self.handle, buf, cap))
+        if n < 2:
+            return None
+        n_ops, n_reasons = int(buf[0]), int(buf[1])
+        if n < 2 + n_ops + n_ops * n_reasons:
+            return None
+        out: dict[str, dict] = {}
+        for i in range(n_ops):
+            op = FRONT_OPS[i] if i < len(FRONT_OPS) else f"op{i}"
+            fb_base = 2 + n_ops + i * n_reasons
+            out[op] = {
+                "native": int(buf[2 + i]),
+                "fallback": {
+                    (FALLBACK_REASONS[j] if j < len(FALLBACK_REASONS)
+                     else f"r{j}"): int(buf[fb_base + j])
+                    for j in range(n_reasons)
+                },
+            }
+        return out
+
+    def lease_count(self) -> int:
+        """Live (unspent) filer leases in the pool; -1 = engine stopped."""
+        return int(self._lib.sw_fl_filer_lease_count(self.handle))
 
     def volume_metrics(self, vid: int) -> dict | None:
         """Per-volume native-op counters, or None (old .so / unknown vid)."""

@@ -31,6 +31,12 @@ class LogBuffer:
         # locks held by appenders (the filer writes segments through its own
         # store), so nesting it under _lock would be an AB-BA deadlock
         self._flush_mutex = threading.Lock()
+        # appenders must not flush synchronously either: an appender may hold
+        # the filer's entry lock, and flush_fn (segment write → _insert_quiet)
+        # takes that same lock — appender(filer lock → _flush_mutex) vs
+        # flusher(_flush_mutex → filer lock) deadlocks. Byte-threshold flushes
+        # instead wake the flusher thread early via this event.
+        self._flush_wake = threading.Event()
         self._flush_fn = flush_fn
         self._flush_bytes = flush_bytes
         self._flush_interval = flush_interval
@@ -66,7 +72,10 @@ class LogBuffer:
                 self._flush_fn is not None and self._bytes >= self._flush_bytes
             )
         if need_flush:
-            self.flush()
+            if self._flusher is not None:
+                self._flush_wake.set()
+            else:
+                self.flush()
         return ts
 
     def flush(self) -> None:
@@ -92,7 +101,8 @@ class LogBuffer:
 
     def _flush_loop(self) -> None:
         while not self._closed:
-            time.sleep(self._flush_interval)
+            self._flush_wake.wait(self._flush_interval)
+            self._flush_wake.clear()
             try:
                 self.flush()
             except Exception:

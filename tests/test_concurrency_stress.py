@@ -115,6 +115,61 @@ class TestVolumeServerConcurrency:
             v.stop()
             m.stop()
 
+    def test_register_handoff_visibility(self, cluster):
+        """Regression for the delete/write visibility flake: a Python-path
+        append or delete racing the engine's volume registration could land
+        between the bulk map snapshot and the hook installation — invisible
+        to the engine's needle map, so native GETs 404'd acked writes (or
+        kept serving acked deletes). register/unregister now run under the
+        volume write lock; this hammers the handoff window directly."""
+        import pytest
+
+        from seaweedfs_tpu.server.httpd import get_json, http_request
+        from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+        from seaweedfs_tpu.storage.needle import Needle
+
+        m, v = cluster
+        if v.fastlane is None:
+            pytest.skip("fastlane unavailable in this environment")
+        a = get_json(f"{m.url}/dir/assign")
+        pub = a["publicUrl"]
+        assert http_request(
+            "POST", f"http://{pub}/{a['fid']}", b"seed")[0] == 201
+        vid = int(a["fid"].split(",")[0])
+        vol = v.store.get_volume(vid)
+        stop = threading.Event()
+
+        def mutator(i):
+            # Python-path appends + deletes (what a proxied request runs),
+            # each immediately read back through the ENGINE's front door
+            base = 0x10000000 * (i + 1)
+            j = 0
+            while not stop.is_set() and j < 400:
+                key, cookie = base + j, 0x1234ABCD
+                j += 1
+                vol.write_needle(Needle(cookie=cookie, id=key, data=b"r" * 64))
+                fid = f"{vid},{format_needle_id_cookie(key, cookie)}"
+                st, _, got = http_request("GET", f"http://{pub}/{fid}")
+                assert st == 200 and got == b"r" * 64, (st, fid, "after write")
+                if j % 3 == 0:
+                    vol.delete_needle(Needle(cookie=cookie, id=key))
+                    st, _, _ = http_request("GET", f"http://{pub}/{fid}")
+                    assert st == 404, (st, fid, "after delete")
+
+        def churner():
+            # re-run the registration handoff continuously underneath
+            while not stop.is_set():
+                v._fl_unregister(vid)
+                v._fl_register(vid)
+
+        ct = threading.Thread(target=churner)
+        ct.start()
+        try:
+            run_threads(3, mutator)
+        finally:
+            stop.set()
+            ct.join()
+
     def test_concurrent_write_read_delete(self, cluster):
         from seaweedfs_tpu.server.httpd import PooledHTTP, get_json
 

@@ -222,6 +222,240 @@ class TestNativeFilerPath:
             v2.stop()
 
 
+class TestNativeDeleteAndFrontDoor:
+    def test_native_delete_read_your_deletes(self, cluster):
+        """PR-6: DELETE of a cached entry acks natively (journal + cache
+        tombstone) and an immediate GET — on any engine core — 404s even
+        before the drain lands; the store catches up asynchronously."""
+        import time
+
+        f = _filer(cluster)
+        if not f._fl_filer_on:
+            f.stop()
+            pytest.skip("engine unavailable")
+        try:
+            st, _, _ = http_request("POST", f.url + "/d/i.txt", b"inline")
+            assert st == 201
+            st, _, _ = http_request("POST", f.url + "/d/c.bin",
+                                    os.urandom(20000))
+            assert st == 201
+            before = f.fastlane.front_metrics()["delete"]["native"]
+            for path in ("/d/i.txt", "/d/c.bin"):
+                st, _, _ = http_request("DELETE", f.url + path)
+                assert st == 204
+                st, _, _ = http_request("GET", f.url + path)
+                assert st == 404, f"read-your-deletes violated for {path}"
+            assert f.fastlane.front_metrics()["delete"]["native"] == \
+                before + 2, "deletes left the native path"
+            deadline = time.time() + 5
+            while time.time() < deadline and (
+                    f.filer.find_entry("/d/i.txt") is not None
+                    or f.filer.find_entry("/d/c.bin") is not None):
+                time.sleep(0.05)
+            assert f.filer.find_entry("/d/i.txt") is None
+            assert f.filer.find_entry("/d/c.bin") is None
+            # write-after-delete reuses the path cleanly
+            st, _, _ = http_request("POST", f.url + "/d/i.txt", b"again")
+            assert st == 201
+            st, _, body = http_request("GET", f.url + "/d/i.txt")
+            assert st == 200 and body == b"again"
+        finally:
+            f.stop()
+
+    def test_front_metrics_exported_and_typed(self, cluster):
+        """The front-door counters reach the process registry as
+        SeaweedFS_filer_fastlane_{native,fallback}_total with typed
+        reasons — the fastlane_fallback alert's input."""
+        from seaweedfs_tpu.stats import default_registry
+
+        f = _filer(cluster)
+        if not f._fl_filer_on:
+            f.stop()
+            pytest.skip("engine unavailable")
+        try:
+            st, _, _ = http_request("POST", f.url + "/fm/x.txt", b"hello")
+            assert st == 201
+            st, _, _ = http_request("GET", f.url + "/fm/x.txt")
+            assert st == 200
+            # a query read is an EXPECTED fallback with reason=query
+            st, _, _ = http_request("GET", f.url + "/fm/x.txt?metadata=true")
+            assert st == 200
+            fm = f.fastlane.front_metrics()
+            assert fm["write"]["native"] >= 1
+            assert fm["read"]["native"] >= 1
+            assert fm["read"]["fallback"]["query"] >= 1
+            text = default_registry().render()
+            assert "SeaweedFS_filer_fastlane_native_total" in text
+            assert 'reason="query"' in text
+        finally:
+            f.stop()
+
+    def test_lease_pool_upserts_by_volume(self, cluster):
+        """The engine holds one lease PER VOLUME: installs upsert by vid,
+        remaining sums the pool, and lease_count reports live entries
+        (-1 only for a stopped engine — the r05 shutdown-race signature)."""
+        f = _filer(cluster)
+        if not f._fl_filer_on:
+            f.stop()
+            pytest.skip("engine unavailable")
+        lib, h = f.fastlane._lib, f.fastlane.handle
+        try:
+            import time
+
+            # freeze the background refresh loop so the pool arithmetic
+            # below can't race a concurrent top-up
+            f._fl_lease_backoff_until = time.monotonic() + 300
+            time.sleep(0.1)  # let an in-flight refresh finish
+            lib.sw_fl_filer_lease_set(h, b"127.0.0.1", 1, 901, 7, 0, 100,
+                                      b"", b"")
+            lib.sw_fl_filer_lease_set(h, b"127.0.0.1", 1, 902, 7, 0, 50,
+                                      b"", b"")
+            base = int(lib.sw_fl_filer_lease_remaining(h))
+            assert base >= 150 and f.fastlane.lease_count() >= 2
+            # re-leasing vid 901 REPLACES its range, not a second entry
+            n = f.fastlane.lease_count()
+            lib.sw_fl_filer_lease_set(h, b"127.0.0.1", 1, 901, 7, 1000,
+                                      1200, b"", b"")
+            assert f.fastlane.lease_count() == n
+            assert int(lib.sw_fl_filer_lease_remaining(h)) == base + 100
+            # typed error strings replace the bare rc
+            from seaweedfs_tpu.storage import fastlane as fl_mod
+
+            rc = int(lib.sw_fl_filer_lease_set(
+                h, b"not-an-ip.example", 1, 903, 7, 0, 10, b"", b""))
+            assert rc == -2
+            assert "IPv4" in fl_mod.error_str(lib, rc)
+        finally:
+            f.stop()
+        # a stopped engine reports -1 (not "pool empty"), so the refresh
+        # loop can tell shutdown from a spent lease and never re-leases —
+        # the exact ambiguity behind r05's bogus "lease rejected" warning
+        assert int(lib.sw_fl_filer_lease_count(h)) == -1
+
+    def test_lease_duplicate_grant_keeps_healthy_range(self, cluster):
+        """A top-up probe on a cluster with fewer writable volumes than
+        the pool target lands on an already-held vid. A healthy (>=5000
+        unspent keys) range is KEPT (rc=1) — replacing it would abandon
+        the unspent keys on every probe forever — while a nearly-spent
+        range is still replaced (rc=0, the low-watermark renewal)."""
+        f = _filer(cluster)
+        if not f._fl_filer_on:
+            f.stop()
+            pytest.skip("engine unavailable")
+        lib, h = f.fastlane._lib, f.fastlane.handle
+        try:
+            import time
+
+            f._fl_lease_backoff_until = time.monotonic() + 300
+            time.sleep(0.1)  # let an in-flight refresh finish
+            rc = int(lib.sw_fl_filer_lease_set(
+                h, b"127.0.0.1", 1, 911, 7, 0, 20000, b"", b""))
+            assert rc == 0
+            base = int(lib.sw_fl_filer_lease_remaining(h))
+            # duplicate grant with a SMALLER fresh range: kept, not
+            # replaced (remaining would drop by 14000 on a replace)
+            rc = int(lib.sw_fl_filer_lease_set(
+                h, b"127.0.0.1", 1, 911, 9, 50000, 56000, b"", b""))
+            assert rc == 1
+            assert int(lib.sw_fl_filer_lease_remaining(h)) == base
+            # nearly-spent (< 5000 keys) still replaces: renewal must win
+            rc = int(lib.sw_fl_filer_lease_set(
+                h, b"127.0.0.1", 1, 912, 7, 0, 1000, b"", b""))
+            assert rc == 0
+            base = int(lib.sw_fl_filer_lease_remaining(h))
+            rc = int(lib.sw_fl_filer_lease_set(
+                h, b"127.0.0.1", 1, 912, 7, 30000, 50000, b"", b""))
+            assert rc == 0
+            assert int(lib.sw_fl_filer_lease_remaining(h)) == base + 19000
+        finally:
+            f.stop()
+
+    def test_pipelined_request_after_zero_copy_relay(self, cluster):
+        """Two GETs pipelined on one connection where the first's relay
+        body rides the zero-copy (out2) lane: the backend-completion path
+        must drain the second, already-buffered request — pre-fix it
+        stalled until the 300s idle sweep closed the connection (the
+        completion's single process_buffered pass no-oped while out2 was
+        occupied, and no further read event ever arrived)."""
+        import re as _re
+        import socket
+        import urllib.parse as _up
+
+        f = _filer(cluster)
+        if not f._fl_filer_on:
+            f.stop()
+            pytest.skip("engine unavailable")
+        try:
+            # > promotion cap (65536): every GET relays from the volume
+            payload = os.urandom(100 * 1024)
+            st, _, _ = http_request("POST", f.url + "/pl/a.bin", payload)
+            assert st == 201
+            u = _up.urlparse(f.url)
+            req = (f"GET /pl/a.bin HTTP/1.1\r\n"
+                   f"Host: {u.hostname}\r\n\r\n").encode()
+
+            def read_response(s, buf):
+                while b"\r\n\r\n" not in buf:
+                    chunk = s.recv(65536)
+                    assert chunk, "connection closed mid-response"
+                    buf += chunk
+                head, _, rest = buf.partition(b"\r\n\r\n")
+                n = int(_re.search(rb"content-length:\s*(\d+)", head,
+                                   _re.I).group(1))
+                while len(rest) < n:
+                    chunk = s.recv(65536)
+                    assert chunk, "connection closed mid-body"
+                    rest += chunk
+                return head, rest[:n], rest[n:]
+
+            with socket.create_connection((u.hostname, u.port),
+                                          timeout=15) as s:
+                s.sendall(req + req)  # both requests in one packet
+                head1, body1, buf = read_response(s, b"")
+                assert b" 200 " in head1.split(b"\r\n", 1)[0]
+                assert body1 == payload
+                head2, body2, _ = read_response(s, buf)  # pre-fix: timeout
+                assert b" 200 " in head2.split(b"\r\n", 1)[0]
+                assert body2 == payload
+        finally:
+            f.stop()
+
+    def test_filer_relayed_write_joins_caller_trace(self, cluster):
+        """Drain-synthesized spans for filer-relayed chunk PUTs carry the
+        originating X-Sw-Trace-Id, so cluster.trace shows one end-to-end
+        chain instead of an orphaned volume span."""
+        import time
+
+        from seaweedfs_tpu.stats import trace as trace_mod
+
+        m, v, _ = cluster
+        f = _filer(cluster)
+        if not f._fl_filer_on or v.fastlane is None:
+            f.stop()
+            pytest.skip("engines unavailable")
+        try:
+            tid = "ab54feedcafe0042"
+            st, _, _ = http_request(
+                "POST", f.url + "/tr/chunk.bin", os.urandom(20000),
+                {"X-Sw-Trace-Id": tid})
+            assert st == 201
+            deadline = time.time() + 5
+            found = None
+            while time.time() < deadline and found is None:
+                v.fastlane.drain()
+                for t in trace_mod.collector().traces(limit=200):
+                    if t["trace_id"] == tid and any(
+                            s["name"] == "fastlane.append"
+                            for s in t["spans"]):
+                        found = t
+                        break
+                time.sleep(0.05)
+            assert found is not None, (
+                "fastlane.append span never joined the caller's trace")
+        finally:
+            f.stop()
+
+
 def test_lease_survives_volume_deletion(cluster):
     """volume.delete.empty (or a move/evacuation) can remove the volume a
     filer's fid lease points at before anything was written to it. The
@@ -253,18 +487,24 @@ def test_lease_survives_volume_deletion(cluster):
         assert st == 201
         st, _, body = http_request("GET", f.url + "/dead/a.bin")
         assert st == 200 and body == payload
-        # the loop re-leases against live topology; native writes resume
-        deadline = time.time() + 10
-        while time.time() < deadline:
-            if int(lib.sw_fl_filer_lease_remaining(h)) > 0:
-                break
-            time.sleep(0.1)
-        assert int(lib.sw_fl_filer_lease_remaining(h)) > 0
-        before = f.fastlane.stats()["native_writes"]
-        st, _, _ = http_request("POST", f.url + "/dead/b.bin",
-                                os.urandom(30000))
-        assert st == 201
-        assert f.fastlane.stats()["native_writes"] > before
+        # the loop re-leases against live topology and native writes
+        # resume. With the lease POOL, other entries may still point at
+        # deleted volumes — each such write is an acked (201) fallback
+        # that prunes exactly one dead lease, so give it a few writes.
+        deadline = time.time() + 15
+        native_resumed = False
+        i = 0
+        while time.time() < deadline and not native_resumed:
+            if int(lib.sw_fl_filer_lease_remaining(h)) == 0:
+                time.sleep(0.1)
+                continue
+            before = f.fastlane.stats()["native_writes"]
+            st, _, _ = http_request("POST", f.url + f"/dead/b{i}.bin",
+                                    os.urandom(30000))
+            i += 1
+            assert st == 201
+            native_resumed = f.fastlane.stats()["native_writes"] > before
+        assert native_resumed, "native writes never resumed after re-lease"
     finally:
         f.stop()
 

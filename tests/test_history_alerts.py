@@ -82,6 +82,56 @@ class TestHistoryRing:
         with h._lock:
             assert len(h._series) <= 10
 
+    def test_cap_reclaims_vanished_series_for_live_newcomers(self):
+        """At the series cap, a series that VANISHED from the registry (a
+        stopped server's unregistered collector) is evicted — oldest
+        first — to admit a live newcomer. A long-lived process with a
+        churning fleet must not permanently lock dead series into the cap
+        and refuse the series carrying a fresh alert signal (the exact
+        mechanism behind the 5xx-burst acceptance flake in long suite
+        runs: thousands of per-test server series filled the ring before
+        the burst's new code=\"500\" series appeared)."""
+        reg = Registry()
+        dead = []
+
+        def dead_lines():
+            return dead
+
+        col = reg.register_collector(
+            dead_lines, names=["SeaweedFS_volume_disk_free_bytes"])
+        dead = [
+            f'SeaweedFS_volume_disk_free_bytes{{dir="/d{i}"}} {i}'
+            for i in range(10)
+        ]
+        h = MetricsHistory(reg, interval=1.0, slots=8, max_series=10)
+        h.scrape_once(now=100.0)
+        with h._lock:
+            assert len(h._series) == 10
+        reg.unregister_collector(col)  # the "server" stops
+        h.scrape_once(now=101.0)  # ring now knows the series vanished
+        c = reg.counter("SeaweedFS_http_request_total", "", ("code",))
+        c.labels("500").inc(50)
+        h.scrape_once(now=102.0)
+        # the newcomer was admitted by evicting a vanished series, was
+        # zero-seeded (genuinely new), and rates immediately
+        rates = dict(
+            (labels["code"], rate)
+            for labels, rate in h.rates(
+                "SeaweedFS_http_request_total", 60, now=102.0)
+        )
+        assert rates["500"] == pytest.approx(50.0)
+        # live series are never evicted: cap pressure with NO vanished
+        # series still counts drops
+        c.labels("200").inc()
+        for code in range(10):
+            c.labels(str(300 + code)).inc()
+        before = h.dropped_series_total
+        h.scrape_once(now=103.0)
+        assert h.dropped_series_total > before
+        with h._lock:
+            assert ("SeaweedFS_http_request_total",
+                    (("code", "500"),)) in h._series
+
     def test_new_counter_series_seeded_from_previous_scrape(self):
         # the first 5xx of a burst must produce a rate immediately: the
         # series was implicitly 0 at the previous scrape
@@ -265,6 +315,30 @@ class TestAlertRules:
         h.scrape_once(now=1010.0)
         st = eng.firing["ec_pipeline_starved"]
         assert st["severity"] == "warning" and "read" in st["detail"]
+
+    def test_fastlane_fallback_fires_on_pathological_reasons(self):
+        """PR-6: expected gate fallbacks (cache misses, auth'd requests)
+        never fire; a sustained no_lease/backpressure/upstream regime —
+        like r05's silently rejected filer lease — does."""
+        reg = Registry()
+        c = reg.counter("SeaweedFS_filer_fastlane_fallback_total", "",
+                        ("server", "op", "reason"))
+        h, eng = _engine(reg)
+        c.labels("n1:1", "read", "cache_miss").inc(100)
+        h.scrape_once(now=1000.0)
+        c.labels("n1:1", "read", "cache_miss").inc(500)  # benign traffic
+        c.labels("n1:1", "read", "auth").inc(500)
+        h.scrape_once(now=1010.0)
+        assert "fastlane_fallback" not in eng.firing
+        c.labels("n1:1", "write", "no_lease").inc(200)  # 20/s > 1/s
+        h.scrape_once(now=1020.0)
+        st = eng.firing["fastlane_fallback"]
+        assert st["severity"] == "warning"
+        assert "no_lease" in st["detail"] and "filer" in st["detail"]
+        # the regime ages out of the window -> clears
+        h.scrape_once(now=2000.0)
+        h.scrape_once(now=2010.0)
+        assert "fastlane_fallback" not in eng.firing
 
     def test_configure_rejects_unknown_param(self):
         reg = Registry()

@@ -43,6 +43,37 @@ class TestLogBuffer:
         batch, ok = lb.wait_since(0, timeout=0.05)
         assert ok and batch == []
 
+    def test_byte_threshold_flush_no_deadlock_with_appender_lock(self):
+        """Regression: an appender holding an external lock (the filer's
+        entry lock) crossing flush_bytes must NOT flush inline — flush_fn
+        re-enters that lock (segment write -> _insert_quiet), so
+        appender(lock -> flush) vs flusher(flush -> lock) deadlocked the
+        native drain loop mid-bench. The appender now wakes the flusher."""
+        import threading
+
+        entry_lock = threading.Lock()
+        flushed = []
+
+        def flush_fn(s, e, b):
+            with entry_lock:  # what filer_notify.flush does via _insert_quiet
+                flushed.extend(b)
+
+        lb = LogBuffer(flush_fn=flush_fn, flush_bytes=64, flush_interval=0.01)
+
+        def writer():
+            for i in range(200):
+                with entry_lock:
+                    lb.append(b"x" * 32)
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not any(t.is_alive() for t in threads), "appender deadlocked"
+        lb.close()
+        assert len(flushed) == 800
+
 
 class TestFilerMetaLog:
     def test_events_since_and_segments(self):

@@ -1,0 +1,254 @@
+"""S3 front door through the fastlane engines (PR-6): gated plain-object
+GET/PUT/DELETE and multipart part uploads relay from the gateway's engine
+straight to the FILER's engine — object bytes never cross the Python GIL.
+Every test asserts the ENGINE COUNTERS, not just response codes, so a
+silent regression back to the Python path fails tier-1.
+
+Reference: `weed/s3api/s3api_object_handlers*.go`.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import pytest
+
+from seaweedfs_tpu.s3api.s3_server import S3Server
+from seaweedfs_tpu.server.filer import FilerServer
+from seaweedfs_tpu.server.httpd import http_request
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    m = MasterServer(port=0, pulse_seconds=1)
+    m.start()
+    v = VolumeServer([str(tmp_path / "v")], m.url, port=0, pulse_seconds=1)
+    v.start()
+    f = FilerServer(m.url, port=0)
+    f.start()
+    s3 = S3Server(f.url, port=0)
+    s3.start()
+    yield m, v, f, s3
+    s3.stop()
+    f.stop()
+    v.stop()
+    m.stop()
+
+
+def _front(s3, op: str) -> tuple[int, int]:
+    """(native, total fallback) for one op on the gateway's engine."""
+    fm = s3.fastlane.front_metrics()
+    return fm[op]["native"], sum(fm[op]["fallback"].values())
+
+
+class TestS3NativeFront:
+    def test_object_put_get_ranged_delete_native(self, cluster):
+        _, _, f, s3 = cluster
+        if not getattr(s3, "_fl_s3_on", False) or not f._fl_filer_on:
+            pytest.skip("engines unavailable")
+        st, _, _ = http_request("PUT", s3.url + "/b")
+        assert st == 200
+        payload = os.urandom(30000)
+        w0, _ = _front(s3, "write")
+        st, hdrs, _ = http_request("PUT", s3.url + "/b/obj.bin", payload)
+        assert st == 200
+        import hashlib
+
+        assert hdrs["ETag"] == f'"{hashlib.md5(payload).hexdigest()}"'
+        assert _front(s3, "write")[0] == w0 + 1, "PUT left the native path"
+        r0, _ = _front(s3, "read")
+        st, hdrs, body = http_request("GET", s3.url + "/b/obj.bin")
+        assert st == 200 and body == payload
+        assert hdrs["ETag"] == f'"{hashlib.md5(payload).hexdigest()}"'
+        # ranged GET rides the same native relay
+        st, hdrs, body = http_request(
+            "GET", s3.url + "/b/obj.bin", headers={"Range": "bytes=100-299"})
+        assert st == 206 and body == payload[100:300]
+        assert "Content-Range" in hdrs
+        assert _front(s3, "read")[0] == r0 + 2, "GET left the native path"
+        # missing key: native 404 with the S3 XML error surface
+        st, _, body = http_request("GET", s3.url + "/b/nope.bin")
+        assert st == 404 and b"<Code>NoSuchKey</Code>" in body
+        d0, _ = _front(s3, "delete")
+        st, _, _ = http_request("DELETE", s3.url + "/b/obj.bin")
+        assert st == 204
+        assert _front(s3, "delete")[0] == d0 + 1, "DELETE left native path"
+        st, _, _ = http_request("GET", s3.url + "/b/obj.bin")
+        assert st == 404
+
+    def test_multipart_parts_upload_natively(self, cluster):
+        _, _, f, s3 = cluster
+        if not getattr(s3, "_fl_s3_on", False) or not f._fl_filer_on:
+            pytest.skip("engines unavailable")
+        http_request("PUT", s3.url + "/mp")
+        st, _, body = http_request("POST", s3.url + "/mp/big.obj?uploads",
+                                   b"")
+        assert st == 200
+        uid = re.search(rb"<UploadId>([0-9a-f]+)</UploadId>", body).group(
+            1).decode()
+        parts = [os.urandom(5 * 1024) for _ in range(3)]
+        w0, _ = _front(s3, "write")
+        etags = []
+        for i, p in enumerate(parts, 1):
+            st, hdrs, _ = http_request(
+                "PUT",
+                s3.url + f"/mp/big.obj?partNumber={i}&uploadId={uid}", p)
+            assert st == 200
+            etags.append(hdrs["ETag"])
+        assert _front(s3, "write")[0] == w0 + len(parts), (
+            "part uploads left the native path")
+        # an unknown uploadId must NOT relay natively (NoSuchUpload is
+        # Python's check) — and must not create stray staging files
+        st, _, _ = http_request(
+            "PUT", s3.url + "/mp/big.obj?partNumber=1&uploadId=" + "0" * 32,
+            b"x")
+        assert st == 404
+        comp = "<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{i}</PartNumber><ETag>{e}</ETag></Part>"
+            for i, e in enumerate(etags, 1)) + "</CompleteMultipartUpload>"
+        st, _, _ = http_request(
+            "POST", s3.url + f"/mp/big.obj?uploadId={uid}", comp.encode())
+        assert st == 200
+        st, _, body = http_request("GET", s3.url + "/mp/big.obj")
+        assert st == 200 and body == b"".join(parts)
+        # the completed upload is forgotten: late parts fall back to
+        # Python's NoSuchUpload
+        st, _, _ = http_request(
+            "PUT", s3.url + f"/mp/big.obj?partNumber=9&uploadId={uid}", b"x")
+        assert st == 404
+
+    def test_bucket_state_revokes_native(self, cluster):
+        """Versioning (and any state the translation can't honor) drops
+        the native flags synchronously; requests still succeed via
+        Python."""
+        _, _, f, s3 = cluster
+        if not getattr(s3, "_fl_s3_on", False) or not f._fl_filer_on:
+            pytest.skip("engines unavailable")
+        http_request("PUT", s3.url + "/vb")
+        st, _, _ = http_request("PUT", s3.url + "/vb/a.bin", b"x" * 9000)
+        assert st == 200
+        vconf = (b'<VersioningConfiguration>'
+                 b'<Status>Enabled</Status></VersioningConfiguration>')
+        st, _, _ = http_request("PUT", s3.url + "/vb?versioning", vconf)
+        assert st == 200
+        w0, fb0 = _front(s3, "write")
+        st, hdrs, _ = http_request("PUT", s3.url + "/vb/a.bin", b"y" * 9000)
+        assert st == 200 and hdrs.get("x-amz-version-id")
+        w1, fb1 = _front(s3, "write")
+        assert w1 == w0 and fb1 > fb0, (
+            "versioned bucket must not serve writes natively")
+
+    def test_meta_objects_keep_python_reads(self, cluster):
+        """x-amz-meta headers only exist on the Python surface: writing a
+        meta-carrying object flips the bucket's reads off the native path
+        so GET keeps returning the metadata."""
+        _, _, f, s3 = cluster
+        if not getattr(s3, "_fl_s3_on", False) or not f._fl_filer_on:
+            pytest.skip("engines unavailable")
+        http_request("PUT", s3.url + "/meta")
+        st, _, _ = http_request(
+            "PUT", s3.url + "/meta/tagged.bin", b"z" * 9000,
+            {"x-amz-meta-owner": "me"})
+        assert st == 200
+        st, hdrs, _ = http_request("GET", s3.url + "/meta/tagged.bin")
+        assert st == 200 and hdrs.get("x-amz-meta-owner") == "me"
+        r_native, _ = _front(s3, "read")
+        st, hdrs, _ = http_request("GET", s3.url + "/meta/tagged.bin")
+        assert st == 200 and hdrs.get("x-amz-meta-owner") == "me"
+        assert _front(s3, "read")[0] == r_native, (
+            "meta-dirty bucket reads must stay on Python")
+
+    def test_delete_prefix_directory_recursive_parity(self, cluster):
+        """DELETE of a key that is a non-empty 'directory' must not be
+        acked natively off the filer's 409 (missing and not-empty share
+        that status): Python deletes the subtree recursively, so a native
+        204 no-op would leave the objects alive while telling the client
+        they're gone."""
+        _, _, f, s3 = cluster
+        if not getattr(s3, "_fl_s3_on", False) or not f._fl_filer_on:
+            pytest.skip("engines unavailable")
+        http_request("PUT", s3.url + "/dd")
+        st, _, _ = http_request("PUT", s3.url + "/dd/a/b.txt", b"x" * 9000)
+        assert st == 200
+        st, _, _ = http_request("DELETE", s3.url + "/dd/a")
+        assert st == 204
+        st, _, _ = http_request("GET", s3.url + "/dd/a/b.txt")
+        assert st == 404, "directory delete must remove the subtree"
+        # deleting a missing key still answers 204 (S3 semantics)
+        st, _, _ = http_request("DELETE", s3.url + "/dd/nope")
+        assert st == 204
+
+    def test_meta_dirty_survives_gateway_restart(self, cluster):
+        """The meta-dirty marker persists on the bucket entry: a fresh
+        gateway (a restart, or a peer behind the load balancer) must not
+        re-grant the native read bit off its empty in-memory set and
+        serve GETs without their x-amz-meta headers."""
+        _, _, f, s3 = cluster
+        if not getattr(s3, "_fl_s3_on", False) or not f._fl_filer_on:
+            pytest.skip("engines unavailable")
+        http_request("PUT", s3.url + "/pm")
+        st, _, _ = http_request(
+            "PUT", s3.url + "/pm/t.bin", b"z" * 9000, {"x-amz-meta-k": "v"})
+        assert st == 200
+        s3b = S3Server(f.url, port=0)
+        s3b.start()
+        try:
+            if not getattr(s3b, "_fl_s3_on", False):
+                pytest.skip("second engine unavailable")
+            assert s3b._fl_bucket_flags("pm") & 1 == 0, (
+                "fresh gateway must see the persisted meta marker")
+            st, hdrs, _ = http_request("GET", s3b.url + "/pm/t.bin")
+            assert st == 200 and hdrs.get("x-amz-meta-k") == "v"
+        finally:
+            s3b.stop()
+
+    def test_stale_upload_registration_swept(self, cluster):
+        """An upload completed/aborted through ANOTHER gateway leaves this
+        engine's multipart registry stale; the revalidation loop must
+        unregister it so a late native part PUT can't recreate the deleted
+        staging dir as an orphan and 200 a dead upload — it falls back to
+        Python's NoSuchUpload instead."""
+        import time
+
+        _, _, f, s3 = cluster
+        if not getattr(s3, "_fl_s3_on", False) or not f._fl_filer_on:
+            pytest.skip("engines unavailable")
+        http_request("PUT", s3.url + "/sw")
+        st, _, body = http_request("POST", s3.url + "/sw/o.bin?uploads", b"")
+        assert st == 200
+        uid = re.search(rb"<UploadId>([0-9a-f]+)</UploadId>", body).group(
+            1).decode()
+        assert ("sw", uid) in s3._fl_uploads
+        # simulate the peer gateway's abort: the staging dir disappears
+        # from the filer without this gateway's handlers running
+        s3.fc.delete(s3._uploads_dir("sw", uid), recursive=True)
+        deadline = time.time() + 8
+        while time.time() < deadline and ("sw", uid) in s3._fl_uploads:
+            time.sleep(0.2)
+        assert ("sw", uid) not in s3._fl_uploads, (
+            "revalidation loop never swept the vanished upload")
+        st, _, _ = http_request(
+            "PUT", s3.url + f"/sw/o.bin?partNumber=1&uploadId={uid}",
+            b"x" * 8192)
+        assert st == 404
+
+    def test_auth_and_origin_fall_back(self, cluster):
+        """Signed requests (sigv4) and CORS-decorated responses are
+        Python's: the engine proxies them with typed reasons."""
+        _, _, f, s3 = cluster
+        if not getattr(s3, "_fl_s3_on", False) or not f._fl_filer_on:
+            pytest.skip("engines unavailable")
+        http_request("PUT", s3.url + "/auth")
+        http_request("PUT", s3.url + "/auth/o.bin", b"q" * 9000)
+        fm0 = s3.fastlane.front_metrics()["read"]["fallback"]["auth"]
+        st, _, _ = http_request(
+            "GET", s3.url + "/auth/o.bin",
+            headers={"Authorization": "AWS4-HMAC-SHA256 nope"})
+        # Python answers (here: 400 for the malformed header) — the point
+        # is WHICH path answered, not the status
+        assert st in (200, 400, 403)
+        assert s3.fastlane.front_metrics()["read"]["fallback"]["auth"] == \
+            fm0 + 1

@@ -76,9 +76,14 @@ def collect() -> tuple[dict[str, str], list[str]]:
         kinds = {name: m.kind for name, m in reg._metrics.items()}
     # collector-declared families: the master/volume scrape-time sources
     # plus the PR-3 self-observability collectors (trace ring, profiler)
+    from seaweedfs_tpu.s3api.s3_server import S3Server
+    from seaweedfs_tpu.server.filer import FilerServer
+
     collector_names = sorted(
         set(MasterServer.MASTER_METRIC_FAMILIES)
         | set(VolumeServer.FL_FAMILIES)
+        | set(FilerServer.FL_FRONT_FAMILIES)
+        | set(S3Server.FL_FRONT_FAMILIES)
         | set(trace.TRACE_SELF_FAMILIES)
         | set(profiler.PROFILER_FAMILIES)
         | set(history.HISTORY_FAMILIES)
@@ -137,6 +142,32 @@ def task_type_violations() -> list[str]:
     return bad
 
 
+def front_reason_violations() -> list[str]:
+    """Front-door fallback reasons ride into the `reason` label of the
+    SeaweedFS_{filer,s3}_fastlane_fallback_total families — lint them
+    (unique snake_case) and require the alert's pathological subset to be
+    a real subset, so a renamed reason can't silently un-wire the
+    fastlane_fallback rule."""
+    from seaweedfs_tpu.storage import fastlane
+
+    bad: list[str] = []
+    seen: set[str] = set()
+    for name in fastlane.FALLBACK_REASONS:
+        if not ALERT_RULE_RE.match(name):
+            bad.append(f"fallback reason {name!r}: not snake_case")
+        if name in seen:
+            bad.append(f"fallback reason {name!r}: duplicate")
+        seen.add(name)
+    for name in fastlane.PATHOLOGICAL_REASONS:
+        if name not in seen:
+            bad.append(f"pathological reason {name!r}: not a declared"
+                       f" fallback reason")
+    for name in fastlane.FRONT_OPS:
+        if not ALERT_RULE_RE.match(name):
+            bad.append(f"front op {name!r}: not snake_case")
+    return bad
+
+
 def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
     bad: list[str] = []
     for name in sorted(set(kinds) | set(collector_names)):
@@ -159,7 +190,7 @@ def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
 def main() -> int:
     kinds, collector_names = collect()
     bad = violations(kinds, collector_names) + alert_rule_violations() \
-        + task_type_violations()
+        + task_type_violations() + front_reason_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
         print(f"{len(bad)} metric-name violation(s) in {total} families:")
