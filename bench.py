@@ -479,6 +479,94 @@ def bench_device_pipeline(staging_base: str, mb: int = 128) -> float:
     return best
 
 
+def bench_ec_online(staging: str, total_mb: int = 256,
+                    needle_kb: int = 1024) -> dict:
+    """Online (write-path) erasure coding through the real ingest path:
+    a live Volume with an OnlineEcWriter attached, needles appended via
+    write_needle, parity streamed per stripe row. Records:
+
+      * ec_online_encode_gbps — .dat bytes parity-encoded per second of
+        read+encode+parity-write time on the ingest path (the number the
+        encoder must keep above ingest for online EC to be free);
+      * write_amplification — bytes-to-disk / bytes-ingested
+        (dat + parity over dat; replication baseline is 2.0x);
+      * fallbacks — per-reason degrade counters (steady state must show
+        zero pathological reasons: backpressure/encoder_error/journal_io).
+    """
+    import shutil
+
+    from seaweedfs_tpu.storage.erasure_coding.online import OnlineEcWriter
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    rng = np.random.RandomState(7)
+    blob = rng.randint(0, 256, size=needle_kb * 1024,
+                       dtype=np.uint8).tobytes()
+    total = total_mb * 1024 * 1024
+    # best of 3 like bench_verb: a long-running volume server recycles
+    # its pages, but this microVM (free-page reporting) hands freed guest
+    # pages back to the hypervisor and re-faults the FIRST touch of every
+    # fresh page at ~0.15 GB/s. Trial 1 pays the balloon refill for the
+    # whole .dat+parity working set; later trials run on recycled pages,
+    # i.e. the steady state a server actually sustains. Raw per-trial
+    # rates are reported unedited.
+    trials = []
+    best = None
+    for trial in range(3):
+        d = os.path.join(staging, "ec_online")
+        shutil.rmtree(d, ignore_errors=True)
+        os.makedirs(d, exist_ok=True)
+        # refill the guest free list right before the trial (bench_verb's
+        # prewarm): freed pages linger in the guest pool briefly before
+        # free-page reporting hands them back, so allocate-and-free the
+        # working set now and the trial's tmpfs pages come from recycle
+        pool = np.ones((total_mb * 3 // 2) * 1024**2 // 8, dtype=np.int64)
+        del pool
+        v = Volume(d, "", 77)
+        w = OnlineEcWriter(v, block_size=1024 * 1024)
+        v.online_ec = w  # v.close() then closes the writer's fds/thread
+        try:
+            key = 1
+            t0 = time.perf_counter()
+            while v.size() < total:
+                v.write_needle(Needle(cookie=0x42, id=key, data=blob))
+                key += 1
+                if key % 32 == 0:  # the server's drain loop is batchy too
+                    w.pump()
+            w.pump(force=True)
+            wall = time.perf_counter() - t0
+            ingested = v.size()
+            to_disk = ingested + w.parity_bytes
+            gbps = (
+                w.encoded_bytes / w.encode_seconds / 1e9
+                if w.encode_seconds > 0 else 0.0
+            )
+            res = {
+                "ec_online_encode_gbps": round(gbps, 3),
+                "ingest_gbps": round(ingested / wall / 1e9, 3),
+                "write_amplification": round(to_disk / max(ingested, 1), 3),
+                "bytes_ingested": ingested,
+                "bytes_to_disk": to_disk,
+                "stripes": w.stripes,
+                "block_size": w.block,
+                "fallbacks": dict(w.fallbacks),
+                "pathological_fallbacks": sum(
+                    n for r, n in w.fallbacks.items()
+                    if r in ("backpressure", "encoder_error", "journal_io")
+                ),
+                "active": w.active,
+            }
+        finally:
+            v.close()
+            shutil.rmtree(d, ignore_errors=True)
+        trials.append(res["ec_online_encode_gbps"])
+        if best is None or res["ec_online_encode_gbps"] > \
+                best["ec_online_encode_gbps"]:
+            best = res
+    best["trial_encode_gbps"] = trials
+    return best
+
+
 def bench_rebuild(staging_base: str, trials: int = 3) -> dict:
     """BASELINE config 2: single-missing-shard recovery on the 1GiB volume.
     Rate is source-volume GB/s (same convention as ec.encode: the rebuild
@@ -1064,7 +1152,14 @@ def main() -> None:
         run_with_timeout,
     )
 
-    dev = probe_device_status()
+    # the ROADMAP trajectory tracks device_status every round: a probe
+    # CRASH (not just a down link) must still record the key as a fact
+    # instead of killing the run or omitting it
+    try:
+        dev = probe_device_status()
+    except Exception as e:
+        dev = {"status": "down", "h2d_mbps": None, "attempts": 0,
+               "error": str(e)[:120]}
     detail["device_status"] = dev
     device_dead = dev["status"] == "down"
     if device_dead:
@@ -1114,6 +1209,11 @@ def main() -> None:
         detail["ec_rebuild"] = bench_rebuild(staging_base)  # BASELINE config 2
     except Exception as e:
         detail["ec_rebuild"] = {"error": str(e)[:120]}
+    # online (write-path) EC: encode rate through ingest + amplification
+    try:
+        detail["ec_online"] = bench_ec_online(BENCH_DIR)
+    except Exception as e:
+        detail["ec_online"] = {"error": str(e)[:120]}
     try:
         detail["cdc_dedup"] = bench_cdc_dedup()  # BASELINE config 4
     except Exception as e:
@@ -1208,6 +1308,7 @@ def summary_line(
     vs = verb_gbps / seq_gfni if seq_gfni == seq_gfni and seq_gfni > 0 else 0.0
     hsh = detail.get("hash_1m_4k", {})
     reb = detail.get("ec_rebuild", {})
+    onl = detail.get("ec_online", {})
     cdc = detail.get("cdc_dedup", {})
     sf = detail.get("small_files", {})
     fsf = detail.get("filer_small_files", {})
@@ -1222,12 +1323,17 @@ def summary_line(
             "backend": backend,
             "baseline_seq_gfni_gbps": round(seq_gfni, 3),
             "trial_seconds": verb_info.get("trial_seconds"),
-            "device_status": dev["status"],
-            "device_h2d_mbps": dev["h2d_mbps"],
+            # .get: a dict from a degraded/crashed probe must never cost
+            # the whole summary line (the key is required every round)
+            "device_status": dev.get("status", "down"),
+            "device_h2d_mbps": dev.get("h2d_mbps"),
             "device_kernel_gbps": detail.get("device_kernel_gbps"),
             "device_pipeline_e2e_gbps": detail.get("device_pipeline_e2e_gbps"),
             "ec_rebuild_gbps": reb.get("gbps"),
             "ec_rebuild_trials": reb.get("trial_seconds"),
+            "ec_online_encode_gbps": onl.get("ec_online_encode_gbps"),
+            "ec_online_wa": onl.get("write_amplification"),
+            "ec_online_bad_fallbacks": onl.get("pathological_fallbacks"),
             "hash_mhashes_s": hsh.get("native_batch_mhashes_s"),
             "hash_gbps": hsh.get("native_batch_gbps"),
             "hash_device_gbps": hsh.get("device_batch_gbps"),
@@ -1259,7 +1365,7 @@ def summary_line(
     line = json.dumps(summary, allow_nan=False)
     if len(line) > 1500:  # hard guard: never hand the driver an unparseable tail
         summary["extra"] = {
-            "device_status": dev["status"],
+            "device_status": dev.get("status", "down"),
             "note": "summary truncated; see BENCH_full.json",
         }
         line = json.dumps(summary, allow_nan=False)

@@ -58,6 +58,14 @@ def run_master(args: list[str]) -> int:
                    type=float, default=None,
                    help="maintenance scan interval seconds "
                         "(default: pulseSeconds)")
+    p.add_argument("-ec.online", dest="ec_online", default="",
+                   help="comma-separated collections whose volumes stream-"
+                        "encode RS(10,4) parity on ingest ('*' = all); "
+                        "replication degrades to parity-only for them")
+    p.add_argument("-ec.online.block", dest="ec_online_block", type=int,
+                   default=None,
+                   help="online-EC stripe block bytes per shard "
+                        "(default 1MB)")
     opts = p.parse_args(args)
     from seaweedfs_tpu.server.master import MasterServer
 
@@ -78,6 +86,8 @@ def run_master(args: list[str]) -> int:
         maintenance=opts.maintenance or opts.maintenance_dry_run,
         maintenance_dry_run=opts.maintenance_dry_run,
         maintenance_interval=opts.maintenance_interval,
+        ec_online=opts.ec_online,
+        ec_online_block=opts.ec_online_block,
     )
     m.start()
     print(f"master listening at {m.url}")
@@ -224,6 +234,13 @@ def run_server(args: list[str]) -> int:
                    type=float, default=None,
                    help="maintenance scan interval seconds "
                         "(default: pulseSeconds)")
+    p.add_argument("-ec.online", dest="ec_online", default="",
+                   help="comma-separated collections whose volumes stream-"
+                        "encode RS(10,4) parity on ingest ('*' = all)")
+    p.add_argument("-ec.online.block", dest="ec_online_block", type=int,
+                   default=None,
+                   help="online-EC stripe block bytes per shard "
+                        "(default 1MB)")
     opts = p.parse_args(args)
 
     from seaweedfs_tpu.server.master import MasterServer
@@ -239,6 +256,8 @@ def run_server(args: list[str]) -> int:
         maintenance=opts.maintenance or opts.maintenance_dry_run,
         maintenance_dry_run=opts.maintenance_dry_run,
         maintenance_interval=opts.maintenance_interval,
+        ec_online=opts.ec_online,
+        ec_online_block=opts.ec_online_block,
     )
     m.start()
     print(f"master listening at {m.url}")

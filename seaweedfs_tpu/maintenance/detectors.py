@@ -96,9 +96,16 @@ def _task(type_: str, **kw) -> RepairTask:
 
 def detect_under_replicated(master) -> list[RepairTask]:
     """volume_layout.under_replicated(), the source feeding the
-    `SeaweedFS_master_volumes_underreplicated` gauge."""
+    `SeaweedFS_master_volumes_underreplicated` gauge. Healthy online-EC
+    volumes are parity-only BY DESIGN (the layout already excludes them;
+    the explicit filter keeps a heartbeat-ordering race from queueing a
+    copy of a volume whose redundancy is its parity shards — only a
+    volume that FELL BACK to replication becomes a repair)."""
+    online = master.topo.ec_online_volumes()
     tasks = []
     for coll, vid, have, want in master.topo.under_replicated_volumes():
+        if vid in online:
+            continue
         holders = master.topo.lookup(vid, coll)
         if not holders:
             continue  # nothing left to copy from

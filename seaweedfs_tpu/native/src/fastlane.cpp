@@ -273,6 +273,12 @@ struct Vol {
     std::atomic<uint64_t> last_ns{0};
     std::atomic<bool> readonly{false};
     std::atomic<bool> forward_writes{false};
+    // online-EC stripe accumulator (sw_fl_ec_online_*): the Python-side
+    // striper arms stripe_bytes + its encode watermark; the drain loop
+    // polls readiness in O(1) off the append tail instead of draining
+    // events just to learn nothing new accumulated. 0 = not armed.
+    std::atomic<uint64_t> ec_stripe{0};
+    std::atomic<uint64_t> ec_watermark{0};
     // per-volume native-op counters (sw_fl_get_volume_metrics)
     std::atomic<uint64_t> m_reads{0}, m_writes{0}, m_deletes{0},
         m_read_bytes{0}, m_write_bytes{0};
@@ -1882,9 +1888,22 @@ void fcache_put(Engine* E, const std::string& path,
                 std::shared_ptr<FilerCacheEnt> ent) {
     std::unique_lock<std::shared_mutex> l(E->fcache_mu);
     auto old = E->fcache.find(path);
-    if (old != E->fcache.end() && !old->second->inline_data.empty())
-        E->fcache_inline_bytes -= old->second->inline_data.size();
-    if (!ent->inline_data.empty())
+    bool carried = false;
+    if (old != E->fcache.end() && !old->second->inline_data.empty()) {
+        if (ent->inline_data.empty() && old->second->md5_hex == ent->md5_hex) {
+            // same entity (md5 = full-body hash), chunk-backed re-put —
+            // a meta-log replay or Python-read cache refresh must not
+            // DEMOTE a promoted object back to relaying (slow boxes hit
+            // this every refresh; the promotion looked permanently hot
+            // but quietly died). Carry the inline body over; its bytes
+            // are already accounted in fcache_inline_bytes.
+            ent->inline_data = old->second->inline_data;
+            carried = true;
+        } else {
+            E->fcache_inline_bytes -= old->second->inline_data.size();
+        }
+    }
+    if (!ent->inline_data.empty() && !carried)
         E->fcache_inline_bytes += ent->inline_data.size();
     ent->seq = ++E->fcache_seq;
     E->fcache_fifo.emplace_back(path, ent->seq);
@@ -3814,6 +3833,52 @@ int sw_fl_tail_set(int h, uint32_t vid, unsigned long long tail,
     if (!v) return -2;
     v->tail.store(tail);
     if (last_ns) v->last_ns.store(last_ns);
+    return 0;
+}
+
+// --- online-EC stripe accumulator ------------------------------------------
+// Arms per-volume stripe tracking for the write-path erasure coder
+// (storage/erasure_coding/online.py): stripe_bytes is one full row
+// (DATA_SHARDS x block), watermark the .dat offset parity covers so far.
+int sw_fl_ec_online_arm(int h, uint32_t vid, unsigned long long stripe_bytes,
+                        unsigned long long watermark) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto v = E->vol_raw(vid);
+    if (!v) return -2;
+    v->ec_stripe.store(stripe_bytes);
+    v->ec_watermark.store(watermark);
+    return 0;
+}
+
+// Complete stripes accumulated past the watermark (the drain hook's O(1)
+// readiness check). out2 (optional, 2 slots) receives {watermark, tail}.
+// -1 bad handle, -2 unknown volume, -3 not armed.
+long long sw_fl_ec_online_pending(int h, uint32_t vid,
+                                  unsigned long long* out2) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto v = E->vol_raw(vid);
+    if (!v) return -2;
+    uint64_t stripe = v->ec_stripe.load(std::memory_order_relaxed);
+    uint64_t wm = v->ec_watermark.load(std::memory_order_relaxed);
+    uint64_t tail = v->tail.load(std::memory_order_relaxed);
+    if (out2 != nullptr) {
+        out2[0] = wm;
+        out2[1] = tail;
+    }
+    if (stripe == 0) return -3;
+    if (tail <= wm) return 0;
+    return (long long)((tail - wm) / stripe);
+}
+
+int sw_fl_ec_online_advance(int h, uint32_t vid,
+                            unsigned long long watermark) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    auto v = E->vol_raw(vid);
+    if (!v) return -2;
+    v->ec_watermark.store(watermark);
     return 0;
 }
 

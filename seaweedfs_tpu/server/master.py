@@ -38,6 +38,8 @@ class MasterServer:
         maintenance: bool = False,
         maintenance_dry_run: bool = False,
         maintenance_interval: float | None = None,
+        ec_online: str = "",
+        ec_online_block: int | None = None,
     ) -> None:
         seq = MemorySequencer(f"{meta_dir}/sequence.json" if meta_dir else None)
         self.topo = Topology(
@@ -47,6 +49,13 @@ class MasterServer:
         )
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
+        # -ec.online policy: collections whose volumes stream-encode
+        # RS(10,4) parity on ingest instead of replica fan-out
+        # (comma-separated names; "*" = every collection incl. default)
+        self.ec_online_collections = {
+            c.strip() for c in ec_online.split(",") if c.strip()
+        }
+        self.ec_online_block = ec_online_block
         self.security = security or SecurityConfig()
         self.service = HTTPService(host, port)
         if self.security.white_list:
@@ -398,6 +407,12 @@ class MasterServer:
                 pass
 
     # --- growth ----------------------------------------------------------------
+    def _is_ec_online(self, collection: str) -> bool:
+        return (
+            "*" in self.ec_online_collections
+            or collection in self.ec_online_collections
+        )
+
     def _grow_volumes(
         self, collection: str, rp: ReplicaPlacement, ttl_u32: int, dc: str
     ) -> None:
@@ -410,20 +425,34 @@ class MasterServer:
             lo = self.topo.layout(collection, rp, ttl_u32)
             if lo.active_volume_count(dc) > 0:
                 return  # another request already grew (in this DC if pinned)
-            grown = self.topo.grow(collection, rp, ttl_u32, dc)
+            ec_online = self._is_ec_online(collection)
+            # parity-only durability wants ONE holder while the volume
+            # streams (no replica ever receives bytes — an empty replica
+            # would 404 reads), so slot-finding places a single copy. The
+            # volume's superblock still records the REQUESTED placement:
+            # if online mode degrades, the heartbeat drops ec_online and
+            # the layout re-demands the real replica count, so
+            # fix_replication can heal it.
+            rp_slots = ReplicaPlacement.parse("000") if ec_online else rp
+            grown = self.topo.grow(collection, rp_slots, ttl_u32, dc)
             ttl_s = str(TTL.from_u32(ttl_u32))
             for vid, nodes in grown:
                 ok_nodes = []
                 for node in nodes:
                     try:
+                        body = {
+                            "volume": vid,
+                            "collection": collection,
+                            "replication": str(rp),
+                            "ttl": ttl_s,
+                        }
+                        if ec_online:
+                            body["ecOnline"] = True
+                            if self.ec_online_block:
+                                body["ecOnlineBlock"] = self.ec_online_block
                         post_json(
                             peer_url(node.url) + "/admin/allocate_volume",
-                            {
-                                "volume": vid,
-                                "collection": collection,
-                                "replication": str(rp),
-                                "ttl": ttl_s,
-                            },
+                            body,
                             timeout=10,
                         )
                         ok_nodes.append(node)
@@ -433,13 +462,15 @@ class MasterServer:
                 # assign usable immediately, register optimistically
                 from seaweedfs_tpu.topology.node import VolumeInfo
 
-                if len(ok_nodes) == rp.copy_count():
+                want_nodes = 1 if ec_online else rp.copy_count()
+                if len(ok_nodes) == want_nodes:
                     for node in ok_nodes:
                         info = VolumeInfo(
                             id=vid,
                             collection=collection,
                             replica_placement=rp.to_byte(),
                             ttl=ttl_u32,
+                            ec_online=ec_online,
                         )
                         node.volumes[vid] = info
                         self.topo._register_volume(info, node)

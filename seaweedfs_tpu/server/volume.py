@@ -160,7 +160,11 @@ class VolumeServer:
     # --- fastlane lifecycle -----------------------------------------------------
     def _fl_forward_writes(self, v) -> bool:
         """Writes the engine must hand to Python: replicated volumes (the
-        fan-out runs here) — see _do_write."""
+        fan-out runs here) — see _do_write. Online-EC volumes ack on local
+        durability + parity emit, so they stay native even when their
+        placement nominally demands replicas."""
+        if v.online_ec is not None and v.online_ec.active:
+            return False
         rp = v.super_block.replica_placement
         return rp is not None and rp.copy_count() > 1
 
@@ -169,7 +173,13 @@ class VolumeServer:
             return
         v = self.store.get_volume(vid)
         if v is not None:
-            self.fastlane.register_volume(v, self._fl_forward_writes(v))
+            if self.fastlane.register_volume(v, self._fl_forward_writes(v)) \
+                    and v.online_ec is not None:
+                # arm the engine's O(1) stripe accumulator: the drain
+                # loop polls readiness instead of re-checking tails
+                self.fastlane.ec_online_arm(
+                    vid, v.online_ec.stripe, v.online_ec.watermark
+                )
 
     def _fl_unregister(self, vid: int) -> None:
         if self.fastlane:
@@ -189,6 +199,7 @@ class VolumeServer:
         while not self._stop.is_set():
             try:
                 self.fastlane.drain()
+                self._pump_online_ec()
                 tick += 1
                 if tick % 50 == 0:  # ~1s flag reconcile (low-disk readonly...)
                     for vid in list(self.fastlane._volumes):
@@ -197,6 +208,36 @@ class VolumeServer:
             except Exception:
                 pass
             self._stop.wait(0.02)
+
+    def _pump_online_ec(self) -> None:
+        """Stream engine-written bytes through the online RS encoder:
+        native appends never touch a Python handler, so the drain loop is
+        their encode hook. The engine's stripe accumulator answers
+        readiness in O(1); only a full stripe (or an aged partial row —
+        the timed trickle flush) invokes the Python-side encode."""
+        if self.store is None:
+            return
+        for loc in self.store.locations:
+            for v in list(loc.volumes.values()):
+                w = v.online_ec
+                if w is None or not w.active or w.sealed:
+                    continue
+                pend = (
+                    self.fastlane.ec_online_pending(v.id)
+                    if self.fastlane else None
+                )
+                if pend is not None:
+                    full_stripes, tail = pend
+                    if full_stripes <= 0 and tail <= w.watermark and \
+                            w._pending_since is None:
+                        continue  # nothing new, nothing aging out
+                w.pump()
+                if pend is not None:
+                    # unconditional re-sync: a Python-path handler pump
+                    # advances the watermark without touching the engine,
+                    # and a stale armed watermark would report 'pending'
+                    # forever (defeating this very skip)
+                    self.fastlane.ec_online_advance(v.id, w.watermark)
 
     def _fl_fold_metrics(self, last: dict) -> None:
         """Natively-served requests never reach the instrumented Python
@@ -405,6 +446,14 @@ class VolumeServer:
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.pulse_seconds):
+            # without a fastlane drain loop, the pulse drives online-EC
+            # stripe pumps (incl. the timed trickle flush); Python-path
+            # writes also pump inline, so this is the aging backstop
+            if self.fastlane is None:
+                try:
+                    self._pump_online_ec()
+                except Exception:
+                    pass
             if getattr(self, "_leaving", False):
                 continue  # volume.server.leave: stay up, stop heartbeating
             self.heartbeat_once()
@@ -508,6 +557,14 @@ class VolumeServer:
             out = {"Version": "seaweedfs-tpu", **hb}
             if self.fastlane:
                 out["fastlane"] = self.fastlane.stats()
+            online = {
+                str(v.id): v.online_ec.stats()
+                for loc in self.store.locations
+                for v in loc.volumes.values()
+                if v.online_ec is not None
+            }
+            if online:
+                out["ec_online"] = online
             return Response(out)
 
         @svc.route("POST", r"/admin/allocate_volume")
@@ -518,6 +575,10 @@ class VolumeServer:
                 p.get("collection", ""),
                 p.get("replication", "000"),
                 p.get("ttl", ""),
+                ec_online=bool(p.get("ecOnline", False)),
+                ec_online_block=(
+                    int(p["ecOnlineBlock"]) if p.get("ecOnlineBlock") else None
+                ),
             )
             self._fl_register(int(p["volume"]))
             return Response({"ok": True})
@@ -675,14 +736,41 @@ class VolumeServer:
             # still be mid-pwrite; unregister waits it out so the encoder
             # reads a quiescent .dat/.idx
             self._fl_unregister(vid)
+            sealed_online = False
             try:
                 base = v.base_name
-                ec_encoder.write_ec_files(base)
+                if v.online_ec is not None and v.online_ec.active:
+                    # ingest already paid the GF math: the seal flushes
+                    # the tail row and materializes data shards with a
+                    # sequential copy — no re-encode
+                    try:
+                        v.online_ec.seal()
+                        sealed_online = True
+                    except RuntimeError:
+                        pass  # degraded mid-seal: classic encode below
+                if not sealed_online:
+                    ec_encoder.write_ec_files(base)
                 ec_encoder.write_sorted_file_from_idx(base)
             finally:
                 self._fl_register(vid)  # readonly: native reads, proxied writes
-            ec_encoder.save_volume_info(base + ".vif", version=v.version())
-            return Response({"ok": True, "shards": list(range(14))})
+            if not sealed_online:
+                # classic path: the shards now belong to the EC volume —
+                # detach any (degraded) stripe writer so a later destroy
+                # can't mistake .ec10-.ec13 for its partial parity, and
+                # write a plain .vif (seal() writes the online one,
+                # recording the uniform stripe geometry)
+                if v.online_ec is not None:
+                    v.online_ec.close()
+                    v.online_ec = None
+                    import os as _os
+
+                    try:
+                        _os.unlink(base + ".ecp")
+                    except OSError:
+                        pass
+                ec_encoder.save_volume_info(base + ".vif", version=v.version())
+            return Response({"ok": True, "shards": list(range(14)),
+                             "online": sealed_online})
 
         @svc.route("POST", r"/admin/ec/mount")
         def ec_mount(req: Request) -> Response:
@@ -771,7 +859,16 @@ class VolumeServer:
                 base + geometry.to_ext(s)
                 for s in range(geometry.DATA_SHARDS_COUNT)
             ]
-            ec_decoder.write_dat_file(base, dat_size, shard_names)
+            # online-sealed volumes striped with a recorded uniform block
+            # geometry — the .vif is authoritative over the defaults
+            info = ec_encoder.load_volume_info(base + ".vif")
+            ec_decoder.write_dat_file(
+                base, dat_size, shard_names,
+                large_block_size=int(
+                    info.get("large_block_size", geometry.LARGE_BLOCK_SIZE)),
+                small_block_size=int(
+                    info.get("small_block_size", geometry.SMALL_BLOCK_SIZE)),
+            )
             ec_decoder.write_idx_file_from_ec_index(base)
             v = self.store.mount_volume(vid, collection)
             self._fl_register(vid)
@@ -780,13 +877,25 @@ class VolumeServer:
 
         @svc.route("GET", r"/admin/ec/shard")
         def ec_shard_read(req: Request) -> Response:
-            """Raw shard byte range — remote EC reads (`store_ec.go:281`)."""
+            """Raw shard byte range — remote EC reads (`store_ec.go:281`).
+            An OPEN online-EC volume serves the same ranges before any
+            seal: parity from the incrementally-written .ec1x files, data
+            shards as views into the live .dat (online.py
+            read_shard_range)."""
             vid = int(req.query["volume"])
             shard = int(req.query["shard"])
             offset = int(req.query.get("offset", 0))
             size = int(req.query.get("size", -1))
             ev = self.store.get_ec_volume(vid)
             if ev is None:
+                v = self.store.get_volume(vid)
+                if v is not None and v.online_ec is not None and size >= 0:
+                    data = v.online_ec.read_shard_range(shard, offset, size)
+                    if data is None:
+                        return Response(
+                            {"error": f"shard {shard} range unavailable"}, 404)
+                    return Response(
+                        data, content_type="application/octet-stream")
                 return Response({"error": "ec volume not mounted"}, 404)
             import os
 
@@ -1312,6 +1421,18 @@ class VolumeServer:
             return Response({"error": str(e)}, 500)
         if not is_replicate:
             v = self.store.get_volume(vid)
+            if v is not None and v.online_ec is not None \
+                    and v.online_ec.active:
+                # parity-only durability: the ack rides on local .dat
+                # durability + the streamed parity emit — no 2x replica
+                # fan-out (write amplification 1.4x instead of 2.0x)
+                v.online_ec.pump()
+                if v.size() >= self.volume_size_limit:
+                    self.heartbeat_once()
+                return Response(
+                    {"name": filename, "size": len(data), "eTag": n.etag()},
+                    201,
+                )
             rp = v.super_block.replica_placement if v else None
             if rp and rp.copy_count() > 1:
                 try:
@@ -1351,13 +1472,18 @@ class VolumeServer:
             return Response({"error": str(e)}, 500)
         if not is_replicate:
             v = self.store.get_volume(vid)
-            rp = v.super_block.replica_placement if v else None
-            if rp and rp.copy_count() > 1:
-                try:
-                    self._replicate(
-                        "DELETE", vid, req.match.group(2), b"",
-                        {"Authorization": req.headers.get("Authorization", "")},
-                    )
-                except VolumeError as e:
-                    return Response({"error": str(e)}, 500)
+            if v is not None and v.online_ec is not None \
+                    and v.online_ec.active:
+                v.online_ec.pump()  # the tombstone append rides the stripe
+            else:
+                rp = v.super_block.replica_placement if v else None
+                if rp and rp.copy_count() > 1:
+                    try:
+                        self._replicate(
+                            "DELETE", vid, req.match.group(2), b"",
+                            {"Authorization": req.headers.get(
+                                "Authorization", "")},
+                        )
+                    except VolumeError as e:
+                        return Response({"error": str(e)}, 500)
         return Response({"size": freed}, 202)

@@ -284,21 +284,45 @@ def plan_balance(
     """The move list `volume.balance` would perform, computed by running
     the convergence loop against a local copy of the topology snapshot —
     no mutations. Shared with the maintenance balance executor. Pass
-    `servers` to reuse an already-fetched snapshot."""
+    `servers` to reuse an already-fetched snapshot.
+
+    Collection affinity (the PR-5 known gap): when the target node
+    already hosts volumes of some collection, prefer moving one of THOSE
+    onto it — a collection placed together (online-EC collections
+    especially, whose sealed shards and repair traffic stay rack-local)
+    must not scatter one volume per rebalance tick across every node
+    that happens to be lightest. Ties still break by smallest size."""
     servers = env.servers() if servers is None else servers
     if len(servers) < 2:
         return []
     # simulated state: per-node eligible volumes + full membership (a move
-    # must not land a volume on a node already holding a replica of it)
+    # must not land a volume on a node already holding a replica of it).
+    # LIVE online-EC volumes never move: a volume copy transfers only
+    # .dat/.idx — the streamed parity and its journal would be destroyed
+    # with the source, leaving a single unprotected copy (they become
+    # movable once sealed to EC shards or fallen back to replication)
     vols = {
         sv.id: {
             vid: v for vid, v in sv.volumes.items()
-            if collection is None or v.get("collection", "") == collection
+            if (collection is None or v.get("collection", "") == collection)
+            and not v.get("ec_online")
         }
         for sv in servers
     }
     membership = {sv.id: set(sv.volumes) for sv in servers}
     urls = {sv.id: sv.http for sv in servers}
+    # live per-node collection counts for the affinity rank, over the
+    # FULL volume set (pinned online-EC volumes and filtered collections
+    # still anchor their collection to a node) and tracking the
+    # simulated moves
+    from collections import Counter
+
+    colls = {
+        sv.id: Counter(
+            v.get("collection", "") for v in sv.volumes.values()
+        )
+        for sv in servers
+    }
     actions = []
     for _ in range(100):  # converge
         order = sorted(servers, key=lambda sv: len(vols[sv.id]))
@@ -311,7 +335,13 @@ def plan_balance(
         ]
         if not movable:
             break
-        pick = min(movable, key=lambda v: v["size"])
+        pick = min(
+            movable,
+            key=lambda v: (
+                colls[low.id][v.get("collection", "")] == 0,
+                v["size"],
+            ),
+        )
         vid = pick["id"]
         actions.append({
             "volume": vid, "source": high.id, "source_url": urls[high.id],
@@ -321,6 +351,9 @@ def plan_balance(
         membership[high.id].discard(vid)
         vols[low.id][vid] = pick
         membership[low.id].add(vid)
+        coll = pick.get("collection", "")
+        colls[low.id][coll] += 1
+        colls[high.id][coll] -= 1
     return actions
 
 

@@ -4,7 +4,8 @@ File family per volume (reference `weed/storage/erasure_coding/`):
   .ec00–.ec13  10 data + 4 parity shards, striped in 1GB large / 1MB small rows
   .ecx         sorted needle index (same 16B entries as .idx, ascending key)
   .ecj         deletion journal: appended 8B needle ids
-  .vif         volume info (JSON: version, etc.)
+  .vif         volume info (JSON: version, block sizes for online-EC volumes)
+  .ecp         online-EC partial-stripe journal (online.py; live volumes only)
 
 The shard *math* runs through ops.rs_kernel.RSCodec (TPU bit-plane matmul /
 C++ / numpy, byte-identical to klauspost as used by the reference).
@@ -21,7 +22,11 @@ from .geometry import (
     to_ext,
 )
 
+from .online import OnlineEcWriter, online_info
+
 __all__ = [
+    "OnlineEcWriter",
+    "online_info",
     "DATA_SHARDS_COUNT",
     "PARITY_SHARDS_COUNT",
     "TOTAL_SHARDS_COUNT",

@@ -94,6 +94,14 @@ class EcVolume:
         self.version = int(info.get("version", 3)) or 3
         if not info:
             encoder.save_volume_info(self.data_base + ".vif", version=self.version)
+        # online-encoded volumes stripe with a uniform (recorded) block
+        # geometry; the .vif is authoritative over the constructor
+        # defaults so sealed online shards read correctly everywhere
+        # (mount, rebuild source, remote shard fetch)
+        if "large_block_size" in info:
+            self.large_block_size = int(info["large_block_size"])
+        if "small_block_size" in info:
+            self.small_block_size = int(info["small_block_size"])
 
         # local shard fds
         self.shards: dict[int, int] = {}

@@ -173,6 +173,34 @@ def _bind_metrics(lib) -> bool:
     return lib._fastlane_metrics_bound
 
 
+def _bind_ec_online(lib) -> bool:
+    """Declare the OPTIONAL online-EC stripe-accumulator ABI (the
+    write-path erasure coder's drain hook). A prebuilt .so from before
+    sw_fl_ec_online_* existed simply lacks the symbols — the striper
+    then re-derives readiness from the Python-side tail instead."""
+    cached = getattr(lib, "_fastlane_ec_online_bound", None)
+    if cached is not None:
+        return cached
+    try:
+        lib.sw_fl_ec_online_arm.restype = ctypes.c_int
+        lib.sw_fl_ec_online_arm.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_ulonglong,
+            ctypes.c_ulonglong,
+        ]
+        lib.sw_fl_ec_online_pending.restype = ctypes.c_longlong
+        lib.sw_fl_ec_online_pending.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_void_p,
+        ]
+        lib.sw_fl_ec_online_advance.restype = ctypes.c_int
+        lib.sw_fl_ec_online_advance.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_ulonglong,
+        ]
+        lib._fastlane_ec_online_bound = True
+    except AttributeError:
+        lib._fastlane_ec_online_bound = False
+    return lib._fastlane_ec_online_bound
+
+
 def _get_lib():
     if os.environ.get("SEAWEEDFS_TPU_DISABLE_FASTLANE") == "1":
         return None
@@ -286,6 +314,7 @@ class Fastlane:
         self.stopped = False
         self.tls = tls  # engine terminates mTLS itself: URLs are https
         self._metrics_ok = _bind_metrics(lib)
+        self._ec_online_ok = _bind_ec_online(lib)
         # can the engine natively reach upstream (volume) engines? Under
         # mTLS this needs the C++ TLS *client* context too
         self.tls_client_ok = bool(lib.sw_fl_tls_client_ok(handle))
@@ -575,6 +604,38 @@ class Fastlane:
                 },
             }
         return out
+
+    # --- online-EC stripe accumulator (optional ABI) -------------------------
+    def ec_online_arm(self, vid: int, stripe_bytes: int,
+                      watermark: int) -> bool:
+        """Arm (or re-sync) the engine's per-volume stripe accumulator so
+        the drain loop can poll encode-readiness in O(1)."""
+        if not self._ec_online_ok:
+            return False
+        return int(self._lib.sw_fl_ec_online_arm(
+            self.handle, vid, stripe_bytes, watermark)) == 0
+
+    def ec_online_pending(self, vid: int) -> tuple[int, int] | None:
+        """(complete stripes pending, append tail) for an armed volume;
+        None when the ABI/volume/arming is absent (caller re-derives from
+        the Python-side tail)."""
+        if not self._ec_online_ok:
+            return None
+        out = (ctypes.c_ulonglong * 2)()
+        n = int(self._lib.sw_fl_ec_online_pending(
+            self.handle, vid, ctypes.addressof(out)))
+        if n < 0:
+            return None
+        return n, int(out[1])
+
+    def ec_online_advance(self, vid: int, watermark: int) -> bool:
+        """Re-sync the engine's armed watermark after a Python-side pump
+        (Python-path writes pump inline and would otherwise leave the
+        accumulator permanently 'pending', defeating the O(1) skip)."""
+        if not self._ec_online_ok:
+            return False
+        return int(self._lib.sw_fl_ec_online_advance(
+            self.handle, vid, watermark)) == 0
 
     def lease_count(self) -> int:
         """Live (unspent) filer leases in the pool; -1 = engine stopped."""

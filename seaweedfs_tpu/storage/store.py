@@ -30,7 +30,10 @@ class DiskLocation:
 
     def load_existing_volumes(self) -> None:
         """Scan the directory for .dat/.idx pairs and .ecx files
-        (`disk_location.go:188` loads concurrently; sequential is fine here)."""
+        (`disk_location.go:188` loads concurrently; sequential is fine here).
+        A volume whose .vif carries an unsealed `ec_online` policy gets its
+        OnlineEcWriter re-attached, which replays the partial-stripe
+        journal (crash recovery: re-encode from the durable watermark)."""
         if not os.path.isdir(self.directory):
             os.makedirs(self.directory, exist_ok=True)
             return
@@ -41,9 +44,14 @@ class DiskLocation:
                 if vid is None or vid in self.volumes:
                     continue
                 try:
-                    self.volumes[vid] = Volume(self.directory, collection, vid)
+                    v = Volume(self.directory, collection, vid)
                 except Exception:
                     continue  # unloadable volume: skip, like the reference logs+skips
+                try:
+                    _attach_online_ec(v)
+                except Exception:
+                    pass  # degraded to classic; heartbeat stops advertising
+                self.volumes[vid] = v
             elif ext == ".ecx":
                 collection, vid = _parse_base(base)
                 if vid is None or vid in self.ec_volumes:
@@ -58,6 +66,23 @@ class DiskLocation:
             return False
         st = os.statvfs(self.directory)
         return st.f_bavail * st.f_frsize < self.min_free_space_bytes
+
+
+def _attach_online_ec(v: Volume, block_size: int | None = None,
+                      create: bool = False) -> None:
+    """(Re)attach the online-EC stripe writer when the volume's .vif
+    records an unsealed ec_online policy — or force-create one for a
+    freshly-allocated volume (`create=True`)."""
+    from .erasure_coding.online import OnlineEcWriter, online_info
+
+    if v.online_ec is not None or v.readonly:
+        return
+    if not create:
+        oe = online_info(v.base_name)
+        if oe is None or oe.get("sealed"):
+            return
+        block_size = block_size or oe.get("block_size")
+    v.online_ec = OnlineEcWriter(v, block_size=block_size)
 
 
 def _parse_base(base: str) -> tuple[str, int | None]:
@@ -122,6 +147,8 @@ class Store:
         collection: str = "",
         replica_placement: str = "000",
         ttl: str = "",
+        ec_online: bool = False,
+        ec_online_block: int | None = None,
     ) -> Volume:
         with self._lock:
             if self.has_volume(vid):
@@ -134,6 +161,8 @@ class Store:
                 replica_placement=ReplicaPlacement.parse(replica_placement),
                 ttl=TTL.parse(ttl),
             )
+            if ec_online:
+                _attach_online_ec(v, block_size=ec_online_block, create=True)
             loc.volumes[vid] = v
             return v
 
@@ -246,6 +275,12 @@ class Store:
                         "replica_placement": v.super_block.replica_placement.to_byte(),
                         "ttl": v.super_block.ttl.to_u32(),
                         "version": v.version(),
+                        # parity-only durability: the master's layout and
+                        # the maintenance detectors must not flag this
+                        # volume as under-replicated while it holds
+                        "ec_online": bool(
+                            v.online_ec is not None and v.online_ec.active
+                        ),
                     }
                 )
         ec_shards = []

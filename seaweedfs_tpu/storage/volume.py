@@ -75,6 +75,10 @@ class Volume:
         self.base_name = volume_file_name(dir_, collection, volume_id)
         self._write_lock = threading.Lock()
         self._fl_hook = None  # set while the fastlane engine fronts this volume
+        # OnlineEcWriter streaming this volume's appends through the RS
+        # encoder (erasure_coding/online.py), attached by the Store when
+        # the volume's policy is -ec.online; None = classic volume
+        self.online_ec = None
         self.readonly = False
         self.last_append_at_ns = 0
         # bumped by commit_compact's swap: readers that straddle it retry
@@ -161,6 +165,9 @@ class Volume:
         return self.super_block.version
 
     def close(self) -> None:
+        if self.online_ec is not None:
+            self.online_ec.close()
+            self.online_ec = None
         self.nm.close()
         self._dat.close()
 
@@ -400,6 +407,10 @@ class Volume:
                 self._compact_gen += 1
             old_nm.close()
             old_dat.close()
+        # compaction rewrote every .dat offset: any online-EC parity is
+        # stale — restart the stripe watermark (counted vacuum_reset)
+        if self.online_ec is not None:
+            self.online_ec.reset()
 
     def _makeup_diff(self, dst_dat: str, dst_idx: str) -> None:
         """Replay idx entries appended after the compact snapshot onto the
@@ -560,8 +571,15 @@ class Volume:
                 get_backend(tier["backend_id"]).delete_file(tier["key"])
             except Exception:
                 pass
+        # an UNSEALED online-EC volume owns its partial parity shards;
+        # a sealed one's shards belong to the EC volume and stay
+        drop_parity = (
+            self.online_ec is not None and not self.online_ec.sealed
+        )
         self.close()
-        exts = [".dat", ".idx", ".cpd", ".cpx"]
+        exts = [".dat", ".idx", ".cpd", ".cpx", ".ecp"]
+        if drop_parity:
+            exts += [f".ec{i:02d}" for i in range(10, 14)]
         # keep the .vif when EC shards share this base name — the EC volume
         # still needs it after `ec.encode` deletes the source volume
         if not any(

@@ -21,6 +21,9 @@ class VolumeInfo:
     replica_placement: int = 0
     ttl: int = 0
     version: int = 3
+    # volume streams its appends through the online RS encoder: its
+    # durability is local-dat + parity shards, not replica fan-out
+    ec_online: bool = False
 
     @staticmethod
     def from_dict(d: dict) -> "VolumeInfo":
@@ -35,6 +38,7 @@ class VolumeInfo:
             replica_placement=int(d.get("replica_placement", 0)),
             ttl=int(d.get("ttl", 0)),
             version=int(d.get("version", 3)),
+            ec_online=bool(d.get("ec_online", False)),
         )
 
 

@@ -289,9 +289,26 @@ class Topology:
             for vid, info in list(node.volumes.items()):
                 if info.size == 0 or info.read_only:
                     continue
+                if info.ec_online:
+                    # compaction rewrites every .dat offset and discards
+                    # the streamed parity (vacuum_reset); online volumes
+                    # reclaim garbage at seal time instead
+                    continue
                 ratio = info.deleted_byte_count / max(info.size, 1)
                 if ratio > garbage_threshold:
                     out.append((node, vid, ratio))
+        return out
+
+    def ec_online_volumes(self) -> set[int]:
+        """Volume ids whose latest heartbeat reports online-EC mode —
+        parity-only durability by design, never an under-replication
+        fault (maintenance detectors consult this)."""
+        out: set[int] = set()
+        with self._lock:
+            layouts = list(self._layouts.values())
+        for lo in layouts:
+            with lo._lock:  # heartbeats mutate the set concurrently
+                out |= lo.ec_online
         return out
 
     def ec_missing_shards(self) -> dict[int, int]:
@@ -337,6 +354,7 @@ class Topology:
                                             "read_only": v.read_only,
                                             "replica_placement": v.replica_placement,
                                             "ttl": v.ttl,
+                                            "ec_online": v.ec_online,
                                         }
                                         for v in n.volumes.values()
                                     ],

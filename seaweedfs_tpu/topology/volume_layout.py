@@ -25,6 +25,9 @@ class VolumeLayout:
     writables: set[int] = field(default_factory=set)
     readonly: set[int] = field(default_factory=set)
     oversized: set[int] = field(default_factory=set)
+    # volumes whose heartbeat reports online-EC: durability is parity,
+    # not replicas — one live holder is a full complement
+    ec_online: set[int] = field(default_factory=set)
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def register_volume(self, v: VolumeInfo, node: DataNode) -> None:
@@ -36,6 +39,10 @@ class VolumeLayout:
                 self.readonly.add(v.id)
             else:
                 self.readonly.discard(v.id)
+            if v.ec_online:
+                self.ec_online.add(v.id)
+            else:
+                self.ec_online.discard(v.id)  # fell back to replication
             if v.size >= self.volume_size_limit:
                 self.oversized.add(v.id)
             else:
@@ -52,15 +59,24 @@ class VolumeLayout:
                 self.writables.discard(vid)
                 self.readonly.discard(vid)
                 self.oversized.discard(vid)
+                self.ec_online.discard(vid)
             else:
                 self._refresh_writable(vid)
+
+    def _required_copies(self, vid: int) -> int:
+        """Online-EC volumes ack on local durability + parity emit: one
+        live holder is a full complement regardless of the placement's
+        replica demand (the parity shards are the redundancy)."""
+        if vid in self.ec_online:
+            return 1
+        return self.replica_placement.copy_count()
 
     def _refresh_writable(self, vid: int) -> None:
         """Writable iff full replica count present, not oversized, not RO
         (`volume_layout.go:enoughCopies`)."""
         locs = self.locations.get(vid, [])
         ok = (
-            len(locs) >= self.replica_placement.copy_count()
+            len(locs) >= self._required_copies(vid)
             and vid not in self.readonly
             and vid not in self.oversized
         )
@@ -107,11 +123,10 @@ class VolumeLayout:
         `SeaweedFS_master_volumes_underreplicated` and `cluster.check`
         render (`volume_layout.go` enoughCopies, inverted)."""
         with self._lock:
-            want = self.replica_placement.copy_count()
             return sorted(
                 (vid, len(locs))
                 for vid, locs in self.locations.items()
-                if len(locs) < want
+                if len(locs) < self._required_copies(vid)
             )
 
     def active_volume_count(self, data_center: str = "") -> int:

@@ -109,6 +109,28 @@ def test_fastlane_summary_from_metrics():
     assert empty["fastlane_native_ratio"] is None and empty["ops"] == {}
 
 
+def test_summary_line_survives_degraded_probe_dict():
+    # a probe CRASH degrades to a minimal dict (bench.main's guard) —
+    # the line must still carry device_status and parse strictly
+    line = bench.summary_line(
+        verb_gbps=1.0,
+        seq_gfni=1.0,
+        backend="native",
+        verb_info={},
+        dev={"status": "down", "error": "probe exploded"},  # no h2d/attempts
+        detail={"ec_online": {"ec_online_encode_gbps": 2.1,
+                              "write_amplification": 1.41,
+                              "pathological_fallbacks": 0}},
+    )
+    parsed = json.loads(line)
+    assert parsed["extra"]["device_status"] == "down"
+    assert parsed["extra"]["device_h2d_mbps"] is None
+    # the online-EC acceptance scalars ride in the compact line
+    assert parsed["extra"]["ec_online_encode_gbps"] == 2.1
+    assert parsed["extra"]["ec_online_wa"] == 1.41
+    assert parsed["extra"]["ec_online_bad_fallbacks"] == 0
+
+
 def test_probe_device_status_shape():
     # under the CPU-forced test env there is no accelerator: status must be
     # a reported fact with the attempt count, never an exception

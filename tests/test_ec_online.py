@@ -1,0 +1,555 @@
+"""Online (write-path) erasure coding: stream-encode on ingest.
+
+Covers the OnlineEcWriter against the offline encoder as the oracle
+(shards must be byte-identical for the same .dat and geometry), the
+partial-stripe journal's crash replay (no needle lost or double-encoded,
+missing-shard gauge stays 0), trickle/backpressure degrade paths, the
+open-shard read view, vacuum reset, the master's parity-only
+under-replication accounting, and the end-to-end server flow (allocate
+with -ec.online policy -> write without replica fan-out -> seal without
+re-encode -> EC mount -> read back).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops.rs_kernel import RSCodec
+from seaweedfs_tpu.storage.erasure_coding import encoder, geometry
+from seaweedfs_tpu.storage.erasure_coding.ec_volume import EcVolume
+from seaweedfs_tpu.storage.erasure_coding.online import (
+    FALLBACK_REASONS,
+    PATHOLOGICAL_REASONS,
+    OnlineEcWriter,
+)
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+BLOCK = 4096  # small uniform stripe: 40KB rows keep the tests quick
+
+
+def _write_needles(v: Volume, w: OnlineEcWriter | None, ids, seed=0,
+                   lo=100, hi=9000) -> None:
+    rng = np.random.default_rng(seed)
+    for i in ids:
+        data = rng.integers(
+            0, 256, size=int(rng.integers(lo, hi))
+        ).astype(np.uint8).tobytes()
+        v.write_needle(Needle(cookie=0x77, id=i, data=data))
+        if w is not None:
+            w.pump()
+
+
+def _offline_shards(d, dat_base: str, block: int) -> str:
+    """EC-encode a copy of the volume with the offline pipeline (numpy
+    oracle) using the same uniform geometry; returns the copy's base."""
+    ref = os.path.join(str(d), "ref")
+    os.makedirs(ref, exist_ok=True)
+    shutil.copy(dat_base + ".dat", os.path.join(ref, "1.dat"))
+    shutil.copy(dat_base + ".idx", os.path.join(ref, "1.idx"))
+    base = os.path.join(ref, "1")
+    encoder.write_ec_files(
+        base, codec=RSCodec(backend="numpy"),
+        large_block_size=block, small_block_size=block,
+    )
+    return base
+
+
+def _assert_shards_match(dat_base: str, ref_base: str) -> None:
+    for s in range(geometry.TOTAL_SHARDS_COUNT):
+        a = open(dat_base + geometry.to_ext(s), "rb").read()
+        b = open(ref_base + geometry.to_ext(s), "rb").read()
+        assert a == b, f"shard {s} differs from the offline encoder"
+
+
+class TestWriter:
+    def test_shards_byte_identical_to_offline_encoder(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        w = OnlineEcWriter(v, block_size=BLOCK)
+        _write_needles(v, w, range(1, 60))
+        w.seal()
+        _assert_shards_match(
+            v.base_name, _offline_shards(tmp_path, v.base_name, BLOCK)
+        )
+        # no pathological degrade in a clean streaming run
+        assert not any(r in w.fallbacks for r in PATHOLOGICAL_REASONS)
+        v.close()
+
+    def test_sealed_volume_reads_through_ec_volume(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        w = OnlineEcWriter(v, block_size=BLOCK)
+        _write_needles(v, w, range(1, 40))
+        expected = {
+            i: v.read_needle(i).data for i in range(1, 40)
+        }
+        w.seal()
+        encoder.write_sorted_file_from_idx(v.base_name)
+        v.close()
+        # the .vif records the uniform geometry: EcVolume defaults work
+        ev = EcVolume(str(tmp_path), "", 1)
+        assert ev.large_block_size == BLOCK and ev.small_block_size == BLOCK
+        for i, data in expected.items():
+            assert ev.read_needle(i).data == data
+        ev.close()
+
+    def test_trickle_timed_flush_and_refill(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        w = OnlineEcWriter(v, block_size=BLOCK, flush_age=5.0)
+        _write_needles(v, w, [1], hi=300)  # far less than one row
+        assert w.stripes == 0  # young partial: nothing encoded yet
+        w.pump(now=1e9)  # aged past flush_age: padded row flushes
+        assert w.stripes == 1
+        assert w.fallbacks.get("trickle_flush") == 1
+        assert "trickle_flush" not in PATHOLOGICAL_REASONS
+        # a second aged pump with NO new bytes must not re-flush
+        w.pump(now=2e9)
+        assert w.stripes == 1
+        # the row refills and re-encodes; the final shards stay correct
+        _write_needles(v, w, range(2, 30), seed=2)
+        w.seal()
+        _assert_shards_match(
+            v.base_name, _offline_shards(tmp_path, v.base_name, BLOCK)
+        )
+        v.close()
+
+    def test_backpressure_degrades_to_classic(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        w = OnlineEcWriter(v, block_size=BLOCK, max_lag_stripes=2)
+        _write_needles(v, None, range(1, 40), hi=9000)  # no pumps: backlog
+        assert w.pump() == 0
+        assert not w.active and w.fallback_reason == "backpressure"
+        assert w.fallbacks["backpressure"] == 1
+        # degraded writer refuses to seal (classic encode must run)
+        with pytest.raises(RuntimeError):
+            w.seal()
+        v.close()
+
+    def test_read_shard_range_serves_open_state(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        w = OnlineEcWriter(v, block_size=BLOCK)
+        _write_needles(v, w, range(1, 40))
+        w.pump(force=True)  # tail row padded so parity exists everywhere
+        ref = _offline_shards(tmp_path, v.base_name, BLOCK)
+        rows = -(-v.size() // w.stripe)
+        for s in (0, 7, 10, 13):  # data shards from .dat, parity from fds
+            want = open(ref + geometry.to_ext(s), "rb").read()
+            got = w.read_shard_range(s, 0, rows * BLOCK)
+            assert got == want[: rows * BLOCK], f"open shard {s} differs"
+        # unaligned interior range of a data shard
+        want = open(ref + geometry.to_ext(3), "rb").read()
+        assert w.read_shard_range(3, 1000, 5000) == want[1000:6000]
+        # parity past the encoded watermark is a miss, not garbage
+        assert w.read_shard_range(12, rows * BLOCK, BLOCK) is None
+        v.close()
+
+    def test_deep_backlog_takes_pipelined_path(self, tmp_path):
+        """A >16-row backlog (journal replay / seal catch-up) streams
+        through encoder._run_pipeline; shards must stay byte-identical
+        and the watermark must land exactly on the encoded rows."""
+        v = Volume(str(tmp_path), "", 1)
+        rng = np.random.default_rng(11)
+        for i in range(1, 200):  # ~25 stripe rows, written with NO pumps
+            v.write_needle(Needle(
+                cookie=0x77, id=i,
+                data=rng.integers(0, 256, size=5000).astype(
+                    np.uint8).tobytes(),
+            ))
+        w = OnlineEcWriter(v, block_size=BLOCK, max_lag_stripes=10_000)
+        assert (v.size() - w.watermark) // w.stripe > 16
+        w.pump(force=True)
+        assert w.watermark % w.stripe == 0 or w._partial > 0
+        w.seal()
+        _assert_shards_match(
+            v.base_name, _offline_shards(tmp_path, v.base_name, BLOCK)
+        )
+        v.close()
+
+    def test_crash_replay_no_needle_lost_or_double_encoded(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        w = OnlineEcWriter(v, block_size=BLOCK)
+        _write_needles(v, w, range(1, 30))
+        # appends the writer never saw (crash window: bytes past the
+        # durable watermark), plus a torn journal tail record
+        _write_needles(v, None, range(30, 45), seed=3)
+        with open(v.base_name + ".ecp", "ab") as f:
+            f.write(b"\x50\x45\x57\x53\x00garbage")  # torn/corrupt record
+        wm_before = w.watermark
+        v.close()  # crash: writer abandoned, no seal, no flush
+
+        # restart: reload volume, re-attach writer, journal replays
+        v2 = Volume(str(tmp_path), "", 1)
+        w2 = OnlineEcWriter(v2, block_size=BLOCK)
+        assert w2.journal_replays == 1
+        assert w2.watermark >= wm_before  # nothing durable was lost
+        _write_needles(v2, w2, range(45, 50), seed=4)
+        w2.seal()
+        encoder.write_sorted_file_from_idx(v2.base_name)
+        _assert_shards_match(
+            v2.base_name, _offline_shards(tmp_path, v2.base_name, BLOCK)
+        )
+        v2.close()
+        # every needle written before AND after the crash reads back
+        ev = EcVolume(str(tmp_path), "", 1)
+        for i in range(1, 50):
+            ev.read_needle(i)
+        ev.close()
+        # the missing-shard gauge stays 0: a master fed this node's
+        # heartbeat sees a complete 14-shard complement
+        from seaweedfs_tpu.storage.store import Store
+        from seaweedfs_tpu.topology import Topology
+
+        store = Store([str(tmp_path)], port=18080)
+        store.mount_ec_volume(1, "")
+        topo = Topology()
+        topo.sync_heartbeat(store.collect_heartbeat())
+        assert topo.ec_missing_shards() == {}
+        store.close()
+
+    def test_store_reattaches_writer_after_restart(self, tmp_path):
+        from seaweedfs_tpu.storage.store import Store
+
+        store = Store([str(tmp_path)], port=18081)
+        v = store.add_volume(5, ec_online=True, ec_online_block=BLOCK)
+        assert v.online_ec is not None and v.online_ec.block == BLOCK
+        _write_needles(v, v.online_ec, range(1, 20))
+        hb = store.collect_heartbeat()
+        assert hb["volumes"][0]["ec_online"] is True
+        store.close()
+        # reload from disk: the .vif policy re-attaches + replays
+        store2 = Store([str(tmp_path)], port=18081)
+        v2 = store2.get_volume(5)
+        assert v2.online_ec is not None and v2.online_ec.block == BLOCK
+        store2.close()
+
+    def test_vacuum_resets_parity(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        w = OnlineEcWriter(v, block_size=BLOCK)
+        v.online_ec = w  # attached: commit_compact must reset the stripes
+        _write_needles(v, w, range(1, 30))
+        for i in range(1, 15):  # delete half, then compact
+            v.delete_needle(Needle(cookie=0x77, id=i))
+        w.pump(force=True)
+        v.compact()
+        v.commit_compact()
+        assert w.watermark == 0 and w.fallbacks.get("vacuum_reset") == 1
+        assert w.active  # vacuum reset is a restart, not a degrade
+        _write_needles(v, w, range(100, 110), seed=9)
+        w.seal()
+        _assert_shards_match(
+            v.base_name, _offline_shards(tmp_path, v.base_name, BLOCK)
+        )
+        v.close()
+
+
+class TestTopologyAccounting:
+    def _info(self, vid, ec_online):
+        from seaweedfs_tpu.topology.node import VolumeInfo
+
+        # replica_placement byte 001 -> copy_count 2
+        return VolumeInfo(id=vid, replica_placement=1, ec_online=ec_online)
+
+    def test_parity_only_volume_not_under_replicated(self):
+        from seaweedfs_tpu.storage.types import ReplicaPlacement
+        from seaweedfs_tpu.topology.node import DataCenter
+        from seaweedfs_tpu.topology.volume_layout import VolumeLayout
+
+        dc = DataCenter("dc")
+        node = dc.get_or_create_rack("r").get_or_create_node("h", 1)
+        lo = VolumeLayout(
+            replica_placement=ReplicaPlacement.from_byte(1), ttl_u32=0
+        )
+        lo.register_volume(self._info(7, ec_online=True), node)
+        # one holder of an rp=010 volume: writable, NOT under-replicated
+        assert lo.under_replicated() == []
+        assert 7 in lo.writables
+        # the same volume falling back to replication IS a fault again
+        lo.register_volume(self._info(7, ec_online=False), node)
+        assert lo.under_replicated() == [(7, 1)]
+        assert 7 not in lo.writables
+
+    def test_detector_skips_healthy_online_ec(self):
+        from types import SimpleNamespace
+
+        from seaweedfs_tpu.maintenance import detectors as det
+        from seaweedfs_tpu.topology import Topology
+
+        topo = Topology()
+        topo.sync_heartbeat({
+            "ip": "h1", "port": 1, "volumes": [
+                {"id": 3, "replica_placement": 1, "ec_online": True},
+            ],
+        })
+        master = SimpleNamespace(topo=topo)
+        assert topo.ec_online_volumes() == {3}
+        assert det.detect_under_replicated(master) == []
+        # fallback reported on the next heartbeat: repair task appears
+        topo.sync_heartbeat({
+            "ip": "h1", "port": 1, "volumes": [
+                {"id": 3, "replica_placement": 1, "ec_online": False},
+            ],
+        })
+        tasks = det.detect_under_replicated(master)
+        assert [t.volume_id for t in tasks] == [3]
+        assert tasks[0].type == "fix_replication"
+
+    def test_vacuum_candidates_skip_online_volumes(self):
+        from seaweedfs_tpu.topology import Topology
+
+        topo = Topology()
+        topo.sync_heartbeat({
+            "ip": "h1", "port": 1, "volumes": [
+                {"id": 1, "size": 100, "deleted_byte_count": 90,
+                 "ec_online": True},
+                {"id": 2, "size": 100, "deleted_byte_count": 90},
+            ],
+        })
+        vids = [vid for _, vid, _ in topo.vacuum_candidates(0.3)]
+        assert vids == [2]
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    master = MasterServer(port=0, pulse_seconds=1, ec_online="hot",
+                          ec_online_block=BLOCK)
+    master.start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url, port=0,
+                      pulse_seconds=1, max_volume_count=20)
+    vs.start()
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+class TestServerFlow:
+    def _assign(self, master, **params):
+        from seaweedfs_tpu.server.httpd import get_json
+
+        qs = "&".join(f"{k}={v}" for k, v in params.items())
+        return get_json(f"{master.url}/dir/assign?{qs}")
+
+    def test_online_collection_end_to_end(self, cluster):
+        from seaweedfs_tpu.maintenance import detectors as det
+        from seaweedfs_tpu.server.httpd import get_json, http_request, \
+            post_json
+
+        master, vs = cluster
+        # client asks for 2x replication; the policy degrades it to
+        # parity-only (single holder + streamed parity)
+        a = self._assign(master, collection="hot", replication="010")
+        assert a.get("replicas", []) == []
+        vid = int(a["fid"].split(",")[0])
+        v = vs.store.get_volume(vid)
+        assert v.online_ec is not None and v.online_ec.block == BLOCK
+        url = f"http://{a['publicUrl']}/{a['fid']}"
+        # ~20 stripe rows so the padded tail row doesn't skew the
+        # write-amplification math (it converges to 1.4x with volume size)
+        payload = os.urandom(BLOCK * geometry.DATA_SHARDS_COUNT * 20 + 999)
+        st, _, body = http_request("POST", url, payload)
+        assert st == 201
+        if vs.fastlane:  # native appends encode via the drain loop
+            vs.fastlane.drain()
+        v.online_ec.pump()
+        assert v.online_ec.stripes >= 1  # a full stripe row went through
+        # parity-only is not under-replication; no repair task appears
+        vs.heartbeat_once()
+        assert master.topo.under_replicated_volumes() == []
+        assert det.detect_under_replicated(master) == []
+        # open-shard reads serve BEFORE any seal (data + parity)
+        st, _, frag = http_request(
+            "GET", f"{vs.url}/admin/ec/shard?volume={vid}&shard=0"
+            f"&offset=0&size=64")
+        assert st == 200 and len(frag) == 64
+        st, _, pfrag = http_request(
+            "GET", f"{vs.url}/admin/ec/shard?volume={vid}&shard=12"
+            f"&offset=0&size=64")
+        assert st == 200 and len(pfrag) == 64
+        # seal through the admin verb: the online path skips re-encode
+        stripes_before = v.online_ec.stripes
+        r = post_json(f"{vs.url}/admin/ec/generate", {"volume": vid},
+                      timeout=60)
+        assert r["online"] is True
+        # at most the padded tail row was (re)encoded at seal — the seal
+        # did NOT re-run the GF math over the whole volume
+        assert v.online_ec.stripes <= stripes_before + 1
+        post_json(f"{vs.url}/admin/ec/mount",
+                  {"volume": vid, "collection": "hot"})
+        ev = vs.store.get_ec_volume(vid)
+        assert ev is not None and len(ev.shard_ids()) == 14
+        assert ev.large_block_size == BLOCK
+        n = ev.read_needle(v.nm.metrics.maximum_key)
+        assert n.data == payload
+        # write amplification accounting: dat + parity only (no replicas)
+        stats = v.online_ec.stats()
+        wa = (v.size() + stats["parity_bytes"]) / v.size()
+        assert wa <= 1.5
+
+    def test_native_stripe_accumulator(self, cluster):
+        """The engine's O(1) drain hook: pending stripes derive from the
+        append tail vs the armed watermark, and native appends stream
+        through the encoder via the drain loop without Python handlers."""
+        from seaweedfs_tpu.server.httpd import http_request
+
+        master, vs = cluster
+        if vs.fastlane is None or not vs.fastlane._ec_online_ok:
+            pytest.skip("fastlane / ec-online ABI unavailable")
+        a = self._assign(master, collection="hot")
+        vid = int(a["fid"].split(",")[0])
+        v = vs.store.get_volume(vid)
+        assert vs.fastlane.ec_online_pending(vid) is not None  # armed
+        url = f"http://{a['publicUrl']}/{a['fid']}"
+        body = os.urandom(BLOCK * geometry.DATA_SHARDS_COUNT * 2)
+        assert http_request("POST", url, body)[0] == 201  # native append
+        pending, tail = vs.fastlane.ec_online_pending(vid)
+        assert pending >= 1 and tail > v.online_ec.watermark
+        vs._pump_online_ec()  # what the drain loop runs every tick
+        assert v.online_ec.stripes >= 2
+        # pump re-armed the accumulator at the new watermark
+        pending2, _ = vs.fastlane.ec_online_pending(vid)
+        assert pending2 == 0
+
+    def test_degraded_volume_seals_via_classic_encode(self, cluster):
+        """A volume that fell back mid-life still seals: the classic
+        encoder runs, the stripe writer detaches, and the resulting
+        shards are REAL (a later destroy must not mistake .ec10-.ec13
+        for partial online parity — regression)."""
+        from seaweedfs_tpu.server.httpd import http_request, post_json
+
+        master, vs = cluster
+        a = self._assign(master, collection="hot")
+        vid = int(a["fid"].split(",")[0])
+        v = vs.store.get_volume(vid)
+        url = f"http://{a['publicUrl']}/{a['fid']}"
+        payload = os.urandom(BLOCK * 3)
+        assert http_request("POST", url, payload)[0] == 201
+        v.online_ec._degrade("backpressure")
+        r = post_json(f"{vs.url}/admin/ec/generate", {"volume": vid},
+                      timeout=60)
+        assert r["online"] is False  # classic re-encode ran
+        assert v.online_ec is None  # writer detached with its journal
+        assert not os.path.exists(v.base_name + ".ecp")
+        post_json(f"{vs.url}/admin/ec/mount",
+                  {"volume": vid, "collection": "hot"})
+        ev = vs.store.get_ec_volume(vid)
+        assert len(ev.shard_ids()) == 14
+        # classic geometry: the .vif carries no block-size override
+        assert ev.large_block_size == geometry.LARGE_BLOCK_SIZE
+        key = v.nm.metrics.maximum_key
+        assert ev.read_needle(key).data == payload
+        # the volume can be destroyed without clobbering the EC shards
+        post_json(f"{vs.url}/admin/ec/delete_volume", {"volume": vid})
+        assert os.path.exists(v.base_name + geometry.to_ext(12))
+        assert vs.store.get_ec_volume(vid).read_needle(key).data == payload
+
+    def test_degrade_restores_replication_demand(self, cluster):
+        from seaweedfs_tpu.maintenance import detectors as det
+
+        master, vs = cluster
+        # the REQUESTED placement survives into the superblock even
+        # though online mode grows a single holder
+        a = self._assign(master, collection="hot", replication="010")
+        assert a.get("replicas", []) == []  # parity-only: one holder
+        vid = int(a["fid"].split(",")[0])
+        v = vs.store.get_volume(vid)
+        assert v.super_block.replica_placement.copy_count() == 2
+        vs.heartbeat_once()
+        assert master.topo.under_replicated_volumes() == []
+        v.online_ec._degrade("backpressure")
+        vs.heartbeat_once()
+        # the heartbeat stopped advertising ec_online -> the layout
+        # re-applies the volume's REAL replica demand (2 copies), the
+        # gauge flags it, and fix_replication queues the heal (its
+        # siblings from the same growth stay online)
+        assert vid not in master.topo.ec_online_volumes()
+        under = {t[1] for t in master.topo.under_replicated_volumes()}
+        assert vid in under
+        from types import SimpleNamespace
+
+        tasks = det.detect_under_replicated(SimpleNamespace(topo=master.topo))
+        assert vid in {t.volume_id for t in tasks}
+        # and the degrade is visible in the status plane
+        from seaweedfs_tpu.server.httpd import get_json
+
+        st = get_json(f"{vs.url}/status")
+        assert st["ec_online"][str(vid)]["fallback_reason"] == "backpressure"
+
+
+class TestBalanceAffinity:
+    """PR-5 known gap: the balance planner must respect collection
+    placement when picking what to move."""
+
+    def _sv(self, id_, vols):
+        from types import SimpleNamespace
+
+        return SimpleNamespace(
+            id=id_, url=id_, http=f"http://{id_}", dc="d", rack="r",
+            volumes={v["id"]: v for v in vols},
+            free_slots=lambda: 10,
+        )
+
+    def test_moves_prefer_collection_present_on_target(self):
+        from seaweedfs_tpu.shell.commands_volume import plan_balance
+
+        # high node holds volumes of collections a+b; the light node
+        # already hosts collection a — the move must pick an 'a' volume
+        # (even though the 'b' volume is smaller) so 'b' doesn't scatter
+        high = self._sv("h1", [
+            {"id": 1, "size": 500, "collection": "a"},
+            {"id": 2, "size": 500, "collection": "a"},
+            {"id": 3, "size": 100, "collection": "b"},
+            {"id": 4, "size": 100, "collection": "b"},
+        ])
+        low = self._sv("h2", [{"id": 9, "size": 500, "collection": "a"}])
+        actions = plan_balance(None, servers=[high, low])
+        assert actions, "imbalance of 3 must produce a move"
+        first = actions[0]["volume"]
+        assert first in (1, 2), f"moved volume {first}, scattering 'b'"
+
+    def test_live_online_volumes_never_move(self):
+        """A balance move copies only .dat/.idx — the streamed parity and
+        its journal would die with the source. Live online-EC volumes
+        are pinned until sealed or fallen back."""
+        from seaweedfs_tpu.shell.commands_volume import plan_balance
+
+        high = self._sv("h1", [
+            {"id": 1, "size": 100, "collection": "a", "ec_online": True},
+            {"id": 2, "size": 100, "collection": "a", "ec_online": True},
+            {"id": 3, "size": 900, "collection": "a"},
+            {"id": 4, "size": 800, "collection": "a"},
+        ])
+        low = self._sv("h2", [])
+        actions = plan_balance(None, servers=[high, low])
+        moved = [a["volume"] for a in actions]
+        assert moved and set(moved) <= {3, 4}, moved
+
+    def test_smallest_wins_without_affinity_signal(self):
+        from seaweedfs_tpu.shell.commands_volume import plan_balance
+
+        high = self._sv("h1", [
+            {"id": 1, "size": 500, "collection": "a"},
+            {"id": 2, "size": 100, "collection": "b"},
+            {"id": 3, "size": 300, "collection": "a"},
+        ])
+        low = self._sv("h2", [])  # no collections at all on the target
+        actions = plan_balance(None, servers=[high, low])
+        assert actions[0]["volume"] == 2  # plain smallest-size tie-break
+
+
+class TestReasonLint:
+    def test_reason_sets_are_linted(self):
+        import importlib
+        import pathlib
+        import sys
+
+        sys.path.insert(
+            0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        tool = importlib.import_module("check_metric_names")
+        assert tool.ec_online_reason_violations() == []
+        assert set(PATHOLOGICAL_REASONS) <= set(FALLBACK_REASONS)

@@ -348,6 +348,16 @@ class TestMetricNameLint:
         assert kinds["SeaweedFS_maintenance_task_seconds"] == "histogram"
         assert kinds["SeaweedFS_maintenance_failures_total"] == "counter"
         assert tool.task_type_violations() == []
+        # PR-8: online (write-path) EC families + degrade-reason labels
+        assert kinds["SeaweedFS_volume_ec_online_stripes_total"] == "counter"
+        assert kinds["SeaweedFS_volume_ec_online_encode_seconds"] \
+            == "histogram"
+        assert kinds["SeaweedFS_volume_ec_online_buffered_bytes"] == "gauge"
+        assert kinds["SeaweedFS_volume_ec_online_journal_replays_total"] \
+            == "counter"
+        assert kinds["SeaweedFS_volume_ec_online_fallbacks_total"] \
+            == "counter"
+        assert tool.ec_online_reason_violations() == []
 
     def test_task_type_lint_catches_violations(self, monkeypatch):
         from seaweedfs_tpu import maintenance

@@ -66,6 +66,9 @@ def collect() -> tuple[dict[str, str], list[str]]:
                 trace.FILER_HASH_SECONDS, crc.VOLUME_CRC32C_SECONDS):
         trace._kernel_metrics(fam)
     ec_encoder._pipeline_hist()  # SeaweedFS_volume_ec_pipeline_seconds
+    from seaweedfs_tpu.storage.erasure_coding import online as ec_online
+
+    ec_online.ensure_metrics()  # SeaweedFS_volume_ec_online_* families
     maintenance.ensure_metrics()  # SeaweedFS_maintenance_* families
     svc = HTTPService(port=0)  # never started: registration side effect only
     svc.enable_metrics("lint", serve_route=False)
@@ -168,6 +171,29 @@ def front_reason_violations() -> list[str]:
     return bad
 
 
+def ec_online_reason_violations() -> list[str]:
+    """Online-EC degrade reasons ride into the `reason` label of
+    SeaweedFS_volume_ec_online_fallbacks_total — lint them like the
+    front-door reason set (unique snake_case, the pathological subset —
+    what bench asserts is zero in steady state — must stay a real
+    subset so a renamed reason can't silently pass the acceptance)."""
+    from seaweedfs_tpu.storage.erasure_coding import online
+
+    bad: list[str] = []
+    seen: set[str] = set()
+    for name in online.FALLBACK_REASONS:
+        if not ALERT_RULE_RE.match(name):
+            bad.append(f"ec_online fallback reason {name!r}: not snake_case")
+        if name in seen:
+            bad.append(f"ec_online fallback reason {name!r}: duplicate")
+        seen.add(name)
+    for name in online.PATHOLOGICAL_REASONS:
+        if name not in seen:
+            bad.append(f"ec_online pathological reason {name!r}: not a"
+                       f" declared fallback reason")
+    return bad
+
+
 def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
     bad: list[str] = []
     for name in sorted(set(kinds) | set(collector_names)):
@@ -190,7 +216,8 @@ def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
 def main() -> int:
     kinds, collector_names = collect()
     bad = violations(kinds, collector_names) + alert_rule_violations() \
-        + task_type_violations() + front_reason_violations()
+        + task_type_violations() + front_reason_violations() \
+        + ec_online_reason_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
         print(f"{len(bad)} metric-name violation(s) in {total} families:")
