@@ -1040,6 +1040,169 @@ def maintenance_summary(trials: int = 2, blobs: int = 8) -> dict:
     return out
 
 
+def availability_summary(
+    outage_s: float = 10.0, blobs: int = 60, readers: int = 4,
+) -> dict:
+    """PR-9: availability UNDER a fault, not after it. A 3-node cluster
+    with the maintenance daemon serves a concurrent read workload while
+    one volume holder is killed for real; reports the client-visible
+    error rate, the degraded/retried share, read p99 inside the outage
+    window, and time-to-heal — the service-through-repair coexistence
+    RapidRAID (arXiv:1207.6744) argues for, measured instead of assumed."""
+    import tempfile
+    import threading
+
+    from seaweedfs_tpu.filer.wdclient import WeedClient
+    from seaweedfs_tpu.server.httpd import get_json, http_request, post_json
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.shell import CommandEnv
+    from seaweedfs_tpu.stats import default_registry, parse_exposition
+
+    d = os.path.join(BENCH_DIR, "availability")
+    os.makedirs(d, exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=d)
+    master = MasterServer(port=0, pulse_seconds=1, volume_size_limit_mb=64,
+                          maintenance_interval=0.25)
+    master.start()
+    vols = []
+    out: dict = {"outage_s": outage_s, "readers": readers, "blobs": blobs}
+    try:
+        for i in range(3):
+            vs = VolumeServer(
+                [os.path.join(tmp, f"v{i}")], master.url, port=0,
+                rack=f"r{i}", pulse_seconds=1, max_volume_count=30,
+            )
+            vs.start()
+            vols.append(vs)
+        env = CommandEnv(master.url)
+        data = os.urandom(4096)
+        fids = []
+        for _ in range(blobs):
+            a = get_json(f"{master.url}/dir/assign?replication=010"
+                         "&collection=avail")
+            http_request("POST", f"http://{a['publicUrl']}/{a['fid']}", data)
+            fids.append(a["fid"])
+        post_json(f"{master.url}/maintenance/enable")
+
+        def degraded_total() -> float:
+            return sum(
+                v for name, _, v in parse_exposition(
+                    default_registry().render())
+                if name == "SeaweedFS_volume_degraded_reads_total"
+            )
+
+        wc = WeedClient(master.url, cache_ttl=2.0)
+        lock = threading.Lock()
+        stats = {"ok": 0, "err": 0}
+        lat_outage: list[float] = []
+        window = {"t0": None, "t1": None}
+        stop = threading.Event()
+
+        def reader(seed: int) -> None:
+            i = seed
+            while not stop.is_set():
+                fid = fids[i % len(fids)]
+                i += 1
+                t0 = time.perf_counter()
+                try:
+                    wc.fetch(fid)
+                    ok = True
+                except Exception:
+                    ok = False
+                dt = time.perf_counter() - t0
+                with lock:
+                    stats["ok" if ok else "err"] += 1
+                    w0, w1 = window["t0"], window["t1"]
+                    if w0 is not None and w0 <= t0 and (
+                            w1 is None or t0 < w1):
+                        lat_outage.append(dt)
+
+        threads = [threading.Thread(target=reader, args=(s,), daemon=True)
+                   for s in range(readers)]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)  # healthy baseline running
+        retried_before = wc.retried_reads
+        degraded_before = degraded_total()
+        victim = next(
+            vs for vs in vols
+            if any(vs.store.has_volume(int(f.split(",")[0])) for f in fids)
+        )
+        victim_vids = {
+            int(f.split(",")[0]) for f in fids
+            if victim.store.has_volume(int(f.split(",")[0]))
+        }
+        # time-to-heal polls CONCURRENTLY with the outage window — the
+        # daemon usually re-replicates well inside outage_s, and polling
+        # only afterwards would floor the metric at the window length
+        heal = {"at": None}
+
+        victim_id = f"{victim._host}:{victim.data_port}"
+
+        def heal_poll(t0: float) -> None:
+            # count holders EXCLUDING the victim: the dead node rides the
+            # topology until heartbeat expiry (a stale "2 holders" view),
+            # and the evacuate pre-copy can heal BEFORE expiry ever makes
+            # the loss visible — surviving-holder count is the truth
+            deadline = t0 + 60
+            while time.time() < deadline:
+                live: dict = {}
+                try:
+                    for sv in env.servers():
+                        if sv.id == victim_id:
+                            continue
+                        for vid in sv.volumes:
+                            live[vid] = live.get(vid, 0) + 1
+                except Exception:
+                    time.sleep(0.2)
+                    continue
+                if all(live.get(vid, 0) >= 2 for vid in victim_vids):
+                    heal["at"] = time.time()
+                    return
+                time.sleep(0.2)
+
+        window["t0"] = time.perf_counter()
+        heal_t0 = time.time()
+        healer = threading.Thread(target=heal_poll, args=(heal_t0,),
+                                  daemon=True)
+        healer.start()
+        victim.stop()
+        time.sleep(outage_s)
+        window["t1"] = time.perf_counter()
+        healer.join(timeout=max(0.0, heal_t0 + 60 - time.time()))
+        healed_at = heal["at"]
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        total = stats["ok"] + stats["err"]
+        out["reads_total"] = total
+        out["reads_failed"] = stats["err"]
+        out["error_rate"] = round(stats["err"] / total, 6) if total else None
+        out["retried_reads"] = wc.retried_reads - retried_before
+        out["degraded_reads"] = degraded_total() - degraded_before
+        out["retried_ratio_outage"] = (
+            round((wc.retried_reads - retried_before) / len(lat_outage), 4)
+            if lat_outage else None
+        )
+        if lat_outage:
+            lat_outage.sort()
+            out["outage_reads"] = len(lat_outage)
+            out["outage_p50_ms"] = round(
+                lat_outage[len(lat_outage) // 2] * 1e3, 2)
+            out["outage_p99_ms"] = round(
+                lat_outage[min(len(lat_outage) - 1,
+                               int(len(lat_outage) * 0.99))] * 1e3, 2)
+        out["time_to_heal_s"] = (
+            round(healed_at - heal_t0, 3) if healed_at else None
+        )
+    finally:
+        for vs in vols:
+            vs.stop()
+        master.stop()
+    return out
+
+
 def bench_hash_1m_4k(
     total_blobs: int = 1_000_000, slab: int = 65536, device: bool = True
 ) -> dict:
@@ -1236,6 +1399,12 @@ def main() -> None:
         detail["maintenance_summary"] = maintenance_summary()
     except Exception as e:
         detail["maintenance_summary"] = {"error": str(e)[:120]}
+    # PR-9: availability under an injected single-holder outage (error
+    # rate, degraded/retried share, p99 through the fault, time-to-heal)
+    try:
+        detail["availability_under_fault"] = availability_summary()
+    except Exception as e:
+        detail["availability_under_fault"] = {"error": str(e)[:120]}
     # end-of-run per-kernel attribution over EVERYTHING this process ran
     # (verb trials + rebuild + hash benches), from the shared registry
     try:

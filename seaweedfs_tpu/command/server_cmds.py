@@ -34,6 +34,29 @@ def _wait_forever() -> int:
     return 0
 
 
+def _add_faults_flag(p) -> None:
+    p.add_argument(
+        "-faults", nargs="?", const="", default=None,
+        help="enable fault injection for this process and optionally arm"
+             " points at boot: point=mode[:k=v,...][;point=mode...] — e.g."
+             " 'volume.read.dat=error:rate=0.5;master.assign=latency:ms=20'."
+             " A bare -faults enables runtime control only"
+             " (POST /debug/faults / cluster.faults); without the flag the"
+             " runtime route 403s.",
+    )
+
+
+def _arm_faults(opts) -> None:
+    if getattr(opts, "faults", None) is None:
+        return
+    from seaweedfs_tpu.util import faults
+
+    faults.enable()  # opt the process into runtime POST /debug/faults
+    if opts.faults:
+        armed = faults.arm_from_spec(opts.faults)
+        print(f"fault injection armed: {', '.join(armed)}")
+
+
 def run_master(args: list[str]) -> int:
     p = argparse.ArgumentParser(prog="weed-tpu master")
     p.add_argument("-port", type=int, default=9333)
@@ -66,7 +89,9 @@ def run_master(args: list[str]) -> int:
                    default=None,
                    help="online-EC stripe block bytes per shard "
                         "(default 1MB)")
+    _add_faults_flag(p)
     opts = p.parse_args(args)
+    _arm_faults(opts)
     from seaweedfs_tpu.server.master import MasterServer
 
     sec = _load_security()
@@ -110,7 +135,9 @@ def run_volume(args: list[str]) -> int:
     p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
                    help="log requests slower than this many ms for this "
                         "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
+    _add_faults_flag(p)
     opts = p.parse_args(args)
+    _arm_faults(opts)
     from seaweedfs_tpu.server.volume import VolumeServer
 
     sec = _load_security()
@@ -165,7 +192,9 @@ def run_filer(args: list[str]) -> int:
     p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
                    help="log requests slower than this many ms for this "
                         "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
+    _add_faults_flag(p)
     opts = p.parse_args(args)
+    _arm_faults(opts)
     from seaweedfs_tpu.server.filer import FilerServer
 
     sec = _load_security()
@@ -241,7 +270,9 @@ def run_server(args: list[str]) -> int:
                    default=None,
                    help="online-EC stripe block bytes per shard "
                         "(default 1MB)")
+    _add_faults_flag(p)
     opts = p.parse_args(args)
+    _arm_faults(opts)
 
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume import VolumeServer
@@ -329,7 +360,9 @@ def run_s3(args: list[str]) -> int:
     p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
                    help="log requests slower than this many ms for this "
                         "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
+    _add_faults_flag(p)
     opts = p.parse_args(args)
+    _arm_faults(opts)
     _load_security()
     import json as _json
 

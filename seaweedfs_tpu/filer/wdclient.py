@@ -13,12 +13,19 @@ import threading
 import time
 
 from seaweedfs_tpu.server.httpd import PooledHTTP, get_json, http_request, peer_url
+from seaweedfs_tpu.util import faults
+from seaweedfs_tpu.util.retry import READ_POLICY, RetryPolicy
+
+# the filer -> volume chunk relay seam: latency/error here is what the
+# holder-retry ladder below must absorb without a client-visible failure
+_FP_CHUNK = faults.register("filer.chunk.read")
 
 
 class WeedClient:
     def __init__(
         self, master_url: str, cache_ttl: float = 30.0, jwt_key: str = "",
         read_jwt_key: str = "",
+        retry: RetryPolicy | None = None,
     ) -> None:
         # comma-separated master list; requests follow raft leader hints
         # (`wdclient/masterclient.go` leader failover)
@@ -38,6 +45,12 @@ class WeedClient:
         # keep-alive for the hot data-plane hops (assign, chunk upload,
         # chunk fetch) — urllib's conn-per-call dominates small chunks
         self._pool = PooledHTTP()
+        # the unified read-retry policy (exp backoff + jitter + deadline
+        # budget): every holder is tried each round, the vid cache is
+        # invalidated between rounds so a heal/move is picked up mid-retry
+        self.retry = retry or READ_POLICY
+        self.retried_reads = 0  # fetches that needed >1 round (bench: the
+        # "retried, not failed" share of a degraded window)
 
     # --- assignment -------------------------------------------------------------
     def assign(
@@ -201,23 +214,86 @@ class WeedClient:
         return json.loads(body)
 
     def fetch(self, file_id: str, range_header: str | None = None) -> bytes:
-        last_err: Exception | None = None
-        urls = self.lookup_file_id(file_id)
-        random.shuffle(urls)
+        """Chunk read with the unified RetryPolicy: each round tries every
+        holder (shuffled), a failed round invalidates the location cache
+        (a dead holder's entry must not outlive the outage), backs off
+        with jitter and re-looks-up — a killed holder mid-read-storm
+        surfaces as a retried read, not a client-visible error."""
+        vid = int(file_id.split(",")[0])
         auth = ""
         if self.read_jwt_key:
             from seaweedfs_tpu.security.jwt import gen_read_jwt
 
             auth = gen_read_jwt(self.read_jwt_key, file_id)
-        for url in urls:
-            headers = {"Range": range_header} if range_header else {}
-            if auth:
-                headers["Authorization"] = f"BEARER {auth}"
-            status, _, body = self._pool.request("GET", url, headers=headers)
-            if status in (200, 206):
-                return body
-            last_err = IOError(f"GET {url} -> {status}")
-        raise last_err or IOError(f"no locations for {file_id}")
+        policy = self.retry
+        start = time.monotonic()
+        attempt = 0
+        saw_failure = False
+        last_err: Exception | None = None
+        while True:
+            was_cached = self.lookup_cached(vid) is not None
+            try:
+                # the relay fault seam sits INSIDE the ladder: an
+                # error/partition injection here is a failed round the
+                # retries must absorb, not a bypass of them
+                _FP_CHUNK.hit()
+                urls = self.lookup_file_id(file_id)
+            except Exception as e:
+                urls, last_err = [], e
+                saw_failure = True
+            random.shuffle(urls)
+            all_404 = bool(urls)
+            for url in urls:
+                headers = {"Range": range_header} if range_header else {}
+                if auth:
+                    headers["Authorization"] = f"BEARER {auth}"
+                try:
+                    status, _, body = self._pool.request(
+                        "GET", url, headers=headers
+                    )
+                except (IOError, OSError) as e:
+                    last_err = e
+                    saw_failure = True
+                    all_404 = False
+                    continue
+                if status in (200, 206):
+                    if attempt or saw_failure:
+                        # served, but only after a holder failed us —
+                        # the "retried, not failed" share of an outage
+                        self.retried_reads += 1
+                    return body
+                saw_failure = True
+                if status == 404:
+                    # a 404 from a live holder is authoritative for THAT
+                    # holder; another replica may still serve it
+                    last_err = IOError(f"GET {url} -> 404")
+                    continue
+                all_404 = False
+                last_err = IOError(f"GET {url} -> {status}")
+                if 400 <= status < 500 and status != 429:
+                    # deterministic rejection (bad auth, bad request):
+                    # every holder will answer the same — fail fast
+                    # instead of burning the backoff ladder + master
+                    # lookups on a request that can never succeed
+                    raise last_err
+            if all_404:
+                if was_cached:
+                    # the 404s may only mean our CACHED holders are
+                    # stale (balance/evacuate moved the volume): one
+                    # immediate fresh-lookup round before believing them
+                    self.invalidate(vid)
+                    saw_failure = True
+                    continue
+                # every freshly-looked-up holder answered 404: the blob
+                # is GONE — retrying/backing off would only slow
+                # missing-key workloads and churn the location cache
+                raise last_err
+            delay = policy.delay(attempt)
+            attempt += 1
+            if not policy.should_retry(attempt, start, time.monotonic(), delay):
+                raise last_err or IOError(f"no locations for {file_id}")
+            self.invalidate(vid)
+            time.sleep(delay)
 
     def delete(self, file_id: str) -> None:
         headers = {}

@@ -37,10 +37,19 @@ from .scheduler import RepairScheduler
 # collector-backed family (scrape-time view of the scheduler's queues)
 MAINTENANCE_FAMILIES = ("SeaweedFS_maintenance_queue_depth",)
 
-# alert name -> detector subset to scan immediately on a rising edge
+# alert name -> detector subset to scan immediately on a rising edge.
+# NOTE: the AlertEngine evaluates the DAEMON's process-local metrics
+# history, so volume-server-side series (disk gauges, degraded-read
+# counters) only drive these hooks in single-process deployments and
+# test clusters; in a multi-process cluster the periodic detector scan
+# (which reads heartbeat-fed topology state, not metrics) is the heal
+# path and these hooks are an accelerator where visible.
 ALERT_SCANS = {
     "disk_near_cap": ("vacuum", "balance"),
     "heartbeat_stale": ("evacuate",),
+    # reads surviving only through reconstruction: something is lost or
+    # torn RIGHT NOW — race the repair scan instead of waiting a tick
+    "degraded_reads": ("ec_rebuild", "fix_replication"),
 }
 
 

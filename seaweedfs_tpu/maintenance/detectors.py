@@ -120,10 +120,28 @@ def detect_under_replicated(master) -> list[RepairTask]:
 
 def detect_ec_missing_shards(master) -> list[RepairTask]:
     """topology.ec_missing_shards(), the `SeaweedFS_master_ec_missing_shards`
-    source. Only recoverable volumes (>= 10 shards survive) become tasks."""
+    source. Only recoverable volumes (>= 10 shards survive) become tasks.
+    Also scans LIVE online-EC volumes whose holder audits its parity as
+    damaged (lost/torn shard vs the durable watermark): those were
+    previously skipped as "healthy" because the layout treats
+    holder+parity as fully replicated — the executor's online branch
+    re-arms the striper and re-encodes from the .dat instead of waiting
+    for seal + classic rebuild (the ROADMAP online-rebuild follow-up)."""
     total = geometry.TOTAL_SHARDS_COUNT
     data = geometry.DATA_SHARDS_COUNT
     tasks = []
+    for node in master.topo.all_nodes():
+        for vid, info in sorted(node.volumes.items()):
+            if not info.ec_online or info.ec_online_parity_damaged <= 0:
+                continue
+            tasks.append(_task(
+                "ec_rebuild", volume_id=vid, collection=info.collection,
+                node=node.id,
+                reason=(f"{info.ec_online_parity_damaged} damaged parity"
+                        f" shard(s) on a live online-EC volume"),
+                params={"online": True,
+                        "damaged": info.ec_online_parity_damaged},
+            ))
     for vid, missing in sorted(master.topo.ec_missing_shards().items()):
         present = total - missing
         if present < data:

@@ -45,6 +45,8 @@ def _exec_fix_replication(task: RepairTask, env, dry_run: bool) -> dict:
 
 
 def _exec_ec_rebuild(task: RepairTask, env, dry_run: bool) -> dict:
+    if task.params.get("online"):
+        return _exec_ec_rebuild_online(task, env, dry_run)
     plan = plan_rebuild(env, task.volume_id, task.collection)
     if plan is None:  # healed between detection and dispatch
         return {"planned": [], "applied": []}
@@ -54,6 +56,31 @@ def _exec_ec_rebuild(task: RepairTask, env, dry_run: bool) -> dict:
     rebuilt = apply_rebuild(env, plan)
     return {"planned": planned,
             "applied": [f"rebuilt shards {rebuilt} on {plan['rebuilder']}"]}
+
+
+def _exec_ec_rebuild_online(task: RepairTask, env, dry_run: bool) -> dict:
+    """A LIVE online-EC volume lost/tore a parity shard: the holder
+    re-arms its striper and re-encodes from the durable .dat
+    (/admin/ec/online/rebuild) — no shard pulls, the .dat IS the source."""
+    vid = task.volume_id
+    holder = next(
+        (sv for sv in env.servers() if vid in sv.volumes), None
+    )
+    if holder is None:  # holder gone entirely: classic repair owns it now
+        return {"planned": [], "applied": []}
+    planned = [
+        f"volume {vid}: re-arm online striper on {holder.id},"
+        f" re-encode parity from the durable .dat"
+    ]
+    if dry_run:
+        return {"planned": planned}
+    out = env.post(
+        f"{holder.http}/admin/ec/online/rebuild", {"volume": vid},
+        timeout=3600,
+    )
+    return {"planned": planned,
+            "applied": [f"volume {vid}: parity re-encoded to watermark"
+                        f" {out.get('watermark')} on {holder.id}"]}
 
 
 def _exec_vacuum(task: RepairTask, env, dry_run: bool) -> dict:
@@ -76,7 +103,14 @@ def _plan_evacuate(env, node_id: str) -> list[dict]:
     """Copy actions moving the stale node's replicas onto healthy nodes,
     sourcing from SURVIVING holders (the stale node is presumed
     unreachable — `command_volume_server_evacuate.go`, degraded variant).
-    Volumes with no other holder are reported, not silently skipped."""
+    Volumes with no other holder are reported, not silently skipped.
+
+    EC shards get a pre-copy plan too (the PR-5 gap): a shard has no
+    second holder to source from, so the plan pulls from the DRAINING
+    node itself — stale-heartbeat nodes are often alive-but-slow, and a
+    successful pull beats waiting for expiry + a full ec_rebuild. If the
+    node is truly dead the copy fails, the task backs off, and the
+    missing-shard detector takes over after expiry."""
     servers = env.servers()
     stale = next((sv for sv in servers if sv.id == node_id), None)
     if stale is None:
@@ -102,6 +136,43 @@ def _plan_evacuate(env, node_id: str) -> list[dict]:
                         "source_url": others[0].http,
                         "target": dst.id, "target_url": dst.http})
         dst.volumes[vid] = stale.volumes[vid]  # keep the local view fresh
+    for vid in sorted(stale.ec_shards):
+        shards = sorted(stale.ec_shards[vid])
+        # shards another node ALREADY holds need no copy (balance moves
+        # in flight); only this node's unique shards are at risk
+        elsewhere = {
+            s for sv in healthy for s in sv.ec_shards.get(vid, [])
+        }
+        at_risk = [s for s in shards if s not in elsewhere]
+        if not at_risk:
+            continue
+        # ANTI-AFFINITY: spread the at-risk shards across targets —
+        # piling 5 shards of one volume onto a single node would turn
+        # the NEXT single-node loss into >4 missing shards (RS(10,4)
+        # unrecoverable). Per shard, prefer the node holding the fewest
+        # of this volume's shards, then the most free slots.
+        per_target: dict[str, list[int]] = {}
+        for s in at_risk:
+            ranked = sorted(
+                (sv for sv in healthy if sv.free_slots() > 0),
+                key=lambda sv: (len(sv.ec_shards.get(vid, [])),
+                                -sv.free_slots()),
+            )
+            if not ranked:
+                actions.append({"ec_volume": vid, "shards": [s],
+                                "source": stale.id, "target": None})
+                continue
+            dst = ranked[0]
+            per_target.setdefault(dst.id, []).append(s)
+            dst.ec_shards.setdefault(vid, []).append(s)
+        for dst_id, batch in per_target.items():
+            dst = next(sv for sv in healthy if sv.id == dst_id)
+            actions.append({
+                "ec_volume": vid, "shards": batch,
+                "collection": stale.ec_collections.get(vid, ""),
+                "source": stale.id, "source_url": stale.http,
+                "target": dst.id, "target_url": dst.http,
+            })
     return actions
 
 
@@ -109,7 +180,18 @@ def _exec_evacuate(task: RepairTask, env, dry_run: bool) -> dict:
     actions = _plan_evacuate(env, task.node)
     planned = []
     for a in actions:
-        if a.get("target") is None:
+        if a.get("ec_volume") is not None:
+            if a.get("target") is None:
+                planned.append(
+                    f"ec volume {a['ec_volume']} shards {a['shards']}:"
+                    f" no candidate target"
+                )
+            else:
+                planned.append(
+                    f"ec volume {a['ec_volume']}: copy shards"
+                    f" {a['shards']} {a['source']} -> {a['target']}"
+                )
+        elif a.get("target") is None:
             planned.append(
                 f"volume {a['volume']}: "
                 + ("no surviving replica to copy from"
@@ -124,6 +206,22 @@ def _exec_evacuate(task: RepairTask, env, dry_run: bool) -> dict:
     applied = []
     for a in actions:
         if a.get("target") is None or a.get("source") is None:
+            continue
+        if a.get("ec_volume") is not None:
+            vid = a["ec_volume"]
+            env.post(
+                f"{a['target_url']}/admin/ec/copy",
+                {"volume": vid, "collection": a.get("collection", ""),
+                 "shards": a["shards"], "source": a["source_url"]},
+            )
+            env.post(
+                f"{a['target_url']}/admin/ec/mount",
+                {"volume": vid, "collection": a.get("collection", "")},
+            )
+            applied.append(
+                f"ec volume {vid}: copied shards {a['shards']}"
+                f" {a['source']} -> {a['target']}"
+            )
             continue
         env.post(
             f"{a['target_url']}/admin/volume/copy",

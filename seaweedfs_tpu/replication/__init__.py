@@ -108,7 +108,7 @@ class FilerSink(ReplicationSink):
         from seaweedfs_tpu.server.httpd import http_request
 
         url = self.client._u(path, q)
-        http_request("DELETE", url)
+        http_request("DELETE", url, timeout=30)
 
     @property
     def signature(self) -> int:
@@ -183,7 +183,8 @@ class FilerSyncer:
         from seaweedfs_tpu.server.httpd import http_request
 
         def info(url):
-            status, _, body = http_request("GET", url + "/__meta__/info")
+            status, _, body = http_request("GET", url + "/__meta__/info",
+                                           timeout=10)
             return _json.loads(body)
 
         self.source_signature = info(source_url.rstrip("/"))["signature"]

@@ -147,7 +147,10 @@ class AzureSink(ReplicationSink):
         # PUT always ships a body (possibly empty) so the wire carries the
         # same content-length the signature covered
         wire_body = body if (body or method == "PUT") else None
-        return http_request(method, url, wire_body, headers)
+        # data-bearing sink pushes may carry whole chunks: a longer,
+        # still-finite budget (the audit rule: explicit or default,
+        # never unbounded)
+        return http_request(method, url, wire_body, headers, timeout=120)
 
     def create_entry(self, path: str, entry: dict, data: bytes | None) -> None:
         if entry.get("is_directory"):
@@ -278,7 +281,8 @@ class GcsSink(ReplicationSink):
         )
         headers = self._headers()
         headers["Content-Type"] = mime or "application/octet-stream"
-        status, _, body = http_request("POST", url, data or b"", headers)
+        status, _, body = http_request("POST", url, data or b"", headers,
+                                       timeout=120)
         if status >= 400:
             raise CloudSinkError(status, body)
 
@@ -388,7 +392,7 @@ class B2Sink(ReplicationSink):
             "X-Bz-Content-Sha1": hashlib.sha1(data).hexdigest(),
         }
         status, _, body = http_request(
-            "POST", self._upload["uploadUrl"], data, headers
+            "POST", self._upload["uploadUrl"], data, headers, timeout=120,
         )
         if status == 401 and _retry:  # upload URLs expire on their own clock
             self._upload = None

@@ -559,6 +559,64 @@ def _register_debug_routes(service: "HTTPService") -> None:
         out["proc"] = prof_mod.PROCESS_TOKEN
         return Response(out)
 
+    @service.route("GET", r"/debug/faults")
+    def debug_faults_get(req: Request) -> Response:
+        from seaweedfs_tpu.util import faults as faults_mod
+
+        snap = faults_mod.snapshot()
+        return Response({
+            "points": snap,
+            "declared": list(faults_mod.ALL_POINTS),
+            "armed": sum(1 for p in snap if p["armed"] is not None),
+        })
+
+    @service.route("POST", r"/debug/faults")
+    def debug_faults_post(req: Request) -> Response:
+        """Runtime fault arming for THIS process — the cluster.faults
+        verb fans this out across discovered nodes. Body:
+          {"action": "arm", "point": ..., "mode": ...,
+           "rate"/"ms"/"frac"/"count"/"key": ...}
+          {"action": "disarm", "point": ...}
+          {"action": "disarm_all"}
+        Engine-side points additionally try the optional
+        sw_fl_inject_fault ABI via the serving fastlane when one exists
+        (hasattr-degraded: absence is reported, never an error)."""
+        from seaweedfs_tpu.util import faults as faults_mod
+
+        if not faults_mod.runtime_arming_enabled():
+            # mutating route on every role: 403 unless the operator
+            # opted this process in (-faults flag, even bare, or
+            # SEAWEEDFS_TPU_FAULTS=1) — a reachable port must not be
+            # enough to arm torn writes on a production server
+            return Response(
+                {"error": "fault injection disabled for this process"
+                          " (start with -faults or SEAWEEDFS_TPU_FAULTS=1)"},
+                403,
+            )
+        p = req.json()
+        action = p.get("action", "arm")
+        try:
+            if action == "arm":
+                spec = faults_mod.arm(
+                    p["point"], p["mode"],
+                    rate=p.get("rate", 1.0), ms=p.get("ms", 0.0),
+                    frac=p.get("frac", 0.5), count=p.get("count", -1),
+                    key=p.get("key", ""),
+                )
+                return Response({"ok": True, "point": p["point"],
+                                 "armed": spec.to_dict()})
+            if action == "disarm":
+                return Response({
+                    "ok": True, "point": p["point"],
+                    "was_armed": faults_mod.disarm(p["point"]),
+                })
+            if action == "disarm_all":
+                return Response({"ok": True,
+                                 "disarmed": faults_mod.disarm_all()})
+        except (KeyError, ValueError) as e:
+            return Response({"error": str(e)}, 400)
+        return Response({"error": f"unknown action {action!r}"}, 400)
+
     @service.route("GET", r"/debug/pprof/device")
     def debug_pprof_device(req: Request) -> Response:
         from seaweedfs_tpu.stats import profiler as prof_mod
@@ -613,12 +671,19 @@ def peer_url(hostport: str) -> str:
 
 
 # --- tiny client helpers ----------------------------------------------------
+# Every outbound call in this repo routes through these helpers (or
+# PooledHTTP); the default timeout is the shared RetryPolicy one so no
+# call anywhere can hang a worker forever — callers pass their own only
+# to tighten (heartbeats) or loosen (volume copies).
+from seaweedfs_tpu.util.retry import DEFAULT_TIMEOUT as _DEFAULT_TIMEOUT
+
+
 def http_request(
     method: str,
     url: str,
     body: bytes | None = None,
     headers: dict | None = None,
-    timeout: float = 30.0,
+    timeout: float = _DEFAULT_TIMEOUT,
 ) -> tuple[int, dict, bytes]:
     from seaweedfs_tpu.stats import trace as _trace
 
@@ -671,7 +736,7 @@ def _unix_http_request(
         conn.close()
 
 
-def get_json(url: str, timeout: float = 30.0) -> dict:
+def get_json(url: str, timeout: float = _DEFAULT_TIMEOUT) -> dict:
     status, _, body = http_request("GET", url, timeout=timeout)
     data = json.loads(body) if body else {}
     if status >= 400:
@@ -679,7 +744,8 @@ def get_json(url: str, timeout: float = 30.0) -> dict:
     return data
 
 
-def post_json(url: str, payload: dict | None = None, timeout: float = 30.0) -> dict:
+def post_json(url: str, payload: dict | None = None,
+              timeout: float = _DEFAULT_TIMEOUT) -> dict:
     body = json.dumps(payload or {}).encode()
     status, _, out = http_request(
         "POST", url, body, {"Content-Type": "application/json"}, timeout
@@ -699,7 +765,7 @@ class PooledHTTP:
     The reference's Go clients all reuse connections; this is the
     equivalent for the data-plane hot paths. Honors process mTLS."""
 
-    def __init__(self, timeout: float = 30.0) -> None:
+    def __init__(self, timeout: float = _DEFAULT_TIMEOUT) -> None:
         import weakref
 
         self._tl = threading.local()

@@ -17,8 +17,14 @@ from seaweedfs_tpu.storage.types import ReplicaPlacement, TTL
 from seaweedfs_tpu.topology import Topology
 from seaweedfs_tpu.topology.sequence import MemorySequencer
 from seaweedfs_tpu.topology.volume_layout import NoWritableVolume
+from seaweedfs_tpu.util import faults
 
 from .httpd import HTTPService, Request, Response, post_json, peer_url
+
+# control-plane fault seams: every client of assign/lookup must survive a
+# 500 (fresh assignment, alternate holder) — the chaos suite proves it
+_FP_ASSIGN = faults.register("master.assign")
+_FP_LOOKUP = faults.register("master.lookup")
 
 
 class MasterServer:
@@ -583,6 +589,8 @@ class MasterServer:
             return Response(out)
 
         def do_assign(req: Request) -> Response:
+            _FP_ASSIGN.hit()  # injected error -> 500 via _dispatch; the
+            # writer's retry/fresh-assignment path is what's under test
             if not self._is_leader():
                 return self._not_leader_response()
             count = int(req.query.get("count", 1))
@@ -645,6 +653,7 @@ class MasterServer:
         svc.route("POST", r"/dir/assign")(do_assign)
 
         def do_lookup(req: Request) -> Response:
+            _FP_LOOKUP.hit()
             if not self._is_leader():
                 # followers have empty topologies (heartbeats are
                 # leader-only) — redirect instead of a misleading 404

@@ -22,11 +22,20 @@ from seaweedfs_tpu.storage.file_id import parse_key_hash_with_delta
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.volume import NotFound, VolumeError, volume_file_name
+from seaweedfs_tpu.util import faults
+from seaweedfs_tpu.util.retry import READ_POLICY
 
 from .httpd import HTTPService, Request, Response, get_json, http_request, post_json, peer_url
 
 FID_RE = r"/(\d+),([0-9a-fA-F_]+)(?:\.[^/]*)?"
 _SAFE_EXT_RE = re.compile(r"\.(dat|idx|vif|ecx|ecj|ec\d\d)")
+
+# partition-from-peer faults: the heartbeat seam drops beats (the master
+# sees staleness, evacuate fires), the fan-out seam fails replica pushes
+# (the client retries with a fresh assignment). Each passes the server's
+# identity as the scope key so in-process test clusters can fault ONE node.
+_FP_HEARTBEAT = faults.register("volume.heartbeat.send")
+_FP_REPLICATE = faults.register("volume.replicate.fanout")
 
 
 class VolumeServer:
@@ -408,6 +417,10 @@ class VolumeServer:
     def _heartbeat_once(self) -> None:
         import json as _json
 
+        try:
+            _FP_HEARTBEAT.hit(key=f"{self._host}:{self.data_port}")
+        except (faults.FaultInjected, ConnectionError, OSError):
+            return  # partitioned from the master: the beat just vanishes
         if self.fastlane:  # report the engine's appends, not a stale view
             self.fastlane.drain()
         hb = self.store.collect_heartbeat()
@@ -504,12 +517,16 @@ class VolumeServer:
         """Fan out to the other replica locations (`store_replicate.go:26`).
         All-or-nothing: any replica failure surfaces as an error so the client
         can retry with a fresh assignment. The original request's ttl/headers
-        are forwarded so replicas store identical needles."""
+        are forwarded so replicas store identical needles. Each replica push
+        retries transient failures under the shared RetryPolicy (replicated
+        PUT/DELETEs are fid-addressed, so a re-send cannot duplicate) before
+        the all-or-nothing verdict."""
+        me = f"{self._host}:{self.data_port}"
+        _FP_REPLICATE.hit(key=me)
         try:
             info = get_json(f"{self.master_url}/dir/lookup?volumeId={vid}", timeout=5)
         except Exception as e:
             raise VolumeError(f"replicate lookup failed: {e}")
-        me = f"{self._host}:{self.data_port}"
         qs = "type=replicate"
         for k, v in (extra_query or {}).items():
             qs += f"&{k}={urllib.parse.quote(str(v))}"
@@ -517,12 +534,23 @@ class VolumeServer:
             target = loc["url"]
             if target == me:
                 continue
-            status, _, out = http_request(
-                method,
-                peer_url(target) + f"/{vid},{fid}?{qs}",
-                body=body,
-                headers={k: v for k, v in headers.items() if v},
-            )
+
+            def push(target=target):
+                status, _, out = http_request(
+                    method,
+                    peer_url(target) + f"/{vid},{fid}?{qs}",
+                    body=body,
+                    headers={k: v for k, v in headers.items() if v},
+                    timeout=READ_POLICY.deadline,
+                )
+                if status >= 500:  # transient server-side: worth a retry
+                    raise IOError(f"replica {target} -> {status}")
+                return status, out
+
+            try:
+                status, out = READ_POLICY.call(push)
+            except (IOError, OSError) as e:
+                raise VolumeError(f"replica write to {target} failed: {e}")
             if status >= 400:
                 raise VolumeError(f"replica write to {target} failed: {out[:200]!r}")
 
@@ -808,6 +836,32 @@ class VolumeServer:
                     rebuilt = ec_encoder.rebuild_ec_files(base)
                     return Response({"ok": True, "rebuilt": rebuilt})
             return Response({"error": f"no shards for volume {vid}"}, 404)
+
+        @svc.route("POST", r"/admin/ec/online/rebuild")
+        def ec_online_rebuild(req: Request) -> Response:
+            """Re-arm a LIVE online-EC volume's striper and re-encode its
+            parity from the durable .dat — the ec_rebuild executor's heal
+            for a lost/torn parity shard (the ROADMAP online-rebuild
+            follow-up). Safe under traffic: parity is a pure function of
+            the append-only .dat, and the engine's stripe accumulator is
+            re-synced to the fresh watermark."""
+            vid = int(req.json()["volume"])
+            v = self.store.get_volume(vid)
+            if v is None or v.online_ec is None:
+                return Response(
+                    {"error": f"volume {vid} has no online-EC striper"}, 404
+                )
+            if self.fastlane:  # re-encode must cover the engine's appends
+                self.fastlane.drain()
+            rows = v.online_ec.rearm()
+            if self.fastlane and vid in self.fastlane._volumes:
+                self.fastlane.ec_online_advance(vid, v.online_ec.watermark)
+            self.heartbeat_once()  # the parity-damage gauge clears now
+            return Response({
+                "ok": True, "rows": rows,
+                "watermark": v.online_ec.watermark,
+                "active": v.online_ec.active,
+            })
 
         @svc.route("POST", r"/admin/ec/delete_volume")
         def ec_delete(req: Request) -> Response:

@@ -722,6 +722,95 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
     return f"cluster.top stopped after {shown} frame(s)"
 
 
+@command("cluster.faults",
+         "[-list] | -arm <point> -mode <error|latency|torn|disk_full|"
+         "partition> [-rate r] [-ms n] [-frac f] [-count n] [-key id]"
+         " | -disarm <point> | -disarmAll  [-node url] [-include url,url]"
+         " — arm/disarm/list fault injection across discovered nodes")
+def cmd_cluster_faults(env: CommandEnv, args: list[str]) -> str:
+    """The cluster-wide switchboard for util/faults.py: every discovered
+    /debug-capable endpoint (master, volume servers, filers, -include'd
+    gateways) gets the POST; -node scopes to one endpoint. Single-process
+    clusters share one registry — the listing dedups by fault state, and
+    arming once is arming everywhere in-process (use -key to scope a
+    seam to one server's identity there)."""
+    flags = parse_flags(args)
+    endpoints = _discover_endpoints(env, flags.get("include", ""))
+    if "node" in flags:
+        node = flags["node"].rstrip("/")
+        if not node.startswith(("http://", "https://")):
+            node = "http://" + node
+        endpoints = {node}
+
+    if "arm" in flags or "disarm" in flags or "disarmAll" in flags:
+        if "arm" in flags:
+            if "mode" not in flags:
+                raise ShellError("cluster.faults -arm needs -mode")
+            body = {"action": "arm", "point": flags["arm"],
+                    "mode": flags["mode"]}
+            try:
+                for k in ("rate", "ms", "frac"):
+                    if k in flags:
+                        body[k] = float(flags[k])
+                if "count" in flags:
+                    body["count"] = int(flags["count"])
+            except ValueError as e:
+                raise ShellError(f"bad numeric flag: {e}")
+            if "key" in flags:
+                body["key"] = flags["key"]
+            verb = f"armed {flags['arm']} ({flags['mode']})"
+        elif "disarm" in flags:
+            body = {"action": "disarm", "point": flags["disarm"]}
+            verb = f"disarmed {flags['disarm']}"
+        else:
+            body = {"action": "disarm_all"}
+            verb = "disarmed all"
+        ok, failed = [], []
+        for ep in sorted(endpoints):
+            try:
+                env.post(f"{ep}/debug/faults", body, timeout=10)
+                ok.append(ep)
+            except Exception as e:
+                failed.append(f"{ep} ({e})")
+        lines = [f"{verb} on {len(ok)}/{len(endpoints)} endpoint(s)"]
+        lines.extend(f"  failed: {f}" for f in failed)
+        if not ok:
+            raise ShellError("\n".join(lines))
+        return "\n".join(lines)
+
+    # default: -list — aggregate state, deduped across shared processes
+    seen: dict[tuple, set[str]] = {}
+    reached = 0
+    for ep in sorted(endpoints):
+        try:
+            out = env.get(f"{ep}/debug/faults", timeout=10)
+        except Exception:
+            continue
+        reached += 1
+        for p in out.get("points", []):
+            armed = p.get("armed")
+            key = (
+                p["point"], p.get("fired", 0),
+                tuple(sorted(armed.items())) if armed else None,
+            )
+            seen.setdefault(key, set()).add(ep)
+    if not reached:
+        raise ShellError("no /debug/faults endpoint reachable")
+    lines = [f"fault points across {reached} endpoint(s):"]
+    # sort key must not compare None with a tuple (a point armed on some
+    # endpoints and disarmed on others yields both shapes)
+    for (point, fired, armed), eps in sorted(
+        seen.items(), key=lambda kv: (kv[0][0], kv[0][1], kv[0][2] or ())
+    ):
+        state = "disarmed" if armed is None else \
+            " ".join(f"{k}={v}" for k, v in armed)
+        lines.append(f"  {point}: {state}, fired={fired}"
+                     f" [{len(eps)} endpoint(s)]")
+    if len(seen) == 0:
+        lines.append("  (no seams registered yet — servers not started?)")
+    return "\n".join(lines)
+
+
 # --- mq.* (`weed/shell/command_mq_topic_list.go` etc.) -----------------------
 def _broker_url(env) -> str:
     ps = env.get(f"{env.master_url}/cluster/ps")

@@ -90,7 +90,7 @@ def cmd_fs_rm(env: CommandEnv, args: list[str]) -> str:
     url = f"{env.require_filer()}{path}"
     if "r" in flags:
         url += "?recursive=true"
-    status, _, body = http_request("DELETE", url)
+    status, _, body = http_request("DELETE", url, timeout=60)
     if status >= 400:
         raise ShellError(f"rm {path}: {status} {body[:100]!r}")
     return f"removed {path}"
@@ -101,8 +101,7 @@ def cmd_fs_mkdir(env: CommandEnv, args: list[str]) -> str:
     flags = parse_flags(args)
     path = flags.get("")
     status, _, _ = http_request(
-        "POST", f"{env.require_filer()}{path}?mkdir=true", b""
-    )
+        "POST", f"{env.require_filer()}{path}?mkdir=true", b"", timeout=60)
     if status >= 400:
         raise ShellError(f"mkdir {path}: {status}")
     return f"created {path}"
@@ -115,8 +114,7 @@ def cmd_fs_mv(env: CommandEnv, args: list[str]) -> str:
         raise ShellError("usage: fs.mv <src> <dst>")
     src, dst = positional
     status, _, body = http_request(
-        "POST", f"{env.require_filer()}{dst}?mv.from={src}", b""
-    )
+        "POST", f"{env.require_filer()}{dst}?mv.from={src}", b"", timeout=60)
     if status >= 400:
         raise ShellError(f"mv: {status} {body[:200]!r}")
     return f"moved {src} -> {dst}"
@@ -153,15 +151,14 @@ def cmd_fs_meta_load(env: CommandEnv, args: list[str]) -> str:
             entry = json.loads(line)
             path = entry["full_path"]
             if entry.get("is_directory"):
-                http_request("POST", f"{env.require_filer()}{path}?mkdir=true", b"")
+                http_request("POST", f"{env.require_filer()}{path}?mkdir=true", b"", timeout=60)
             else:
                 # restore the metadata record (chunks point at existing blobs)
                 http_request(
                     "POST",
                     f"{env.require_filer()}{path}?meta.entry=true",
                     json.dumps(entry).encode(),
-                    {"Content-Type": "application/json"},
-                )
+                    {"Content-Type": "application/json"}, timeout=60)
             count += 1
     return f"loaded {count} entries"
 
@@ -227,7 +224,7 @@ def cmd_fs_dedup_gc(env: CommandEnv, args: list[str]) -> str:
     """Triggers the filer's dedup GC (`filer/dedup.py` semantics): walk the
     namespace, delete every indexed blob no entry references, drop its index
     entry. New capability vs the reference (it has no CDC dedup)."""
-    status, _, body = http_request("POST", f"{env.require_filer()}/__dedup__/gc", b"")
+    status, _, body = http_request("POST", f"{env.require_filer()}/__dedup__/gc", b"", timeout=60)
     out = json.loads(body)
     if status >= 400:
         raise ShellError(out.get("error", f"gc failed: {status}"))
@@ -283,7 +280,7 @@ def cmd_fs_configure(env: CommandEnv, args: list[str]) -> str:
 
     flags = parse_flags(args)
     filer = env.require_filer()
-    status, _, body = http_request("GET", filer + FILER_CONF_PATH)
+    status, _, body = http_request("GET", filer + FILER_CONF_PATH, timeout=60)
     conf = FilerConf.from_bytes(body if status == 200 else b"")
     prefix = flags.get("locationPrefix")
     if prefix is None:
@@ -313,7 +310,7 @@ def cmd_fs_configure(env: CommandEnv, args: list[str]) -> str:
         return doc.decode() + "\n(not saved; add -apply)"
     st, _, resp = http_request(
         "PUT", filer + FILER_CONF_PATH, doc,
-        {"Content-Type": "application/json"})
+        {"Content-Type": "application/json"}, timeout=60)
     if st >= 300:
         raise ShellError(f"save failed: {st} {resp[:120]!r}")
     return doc.decode() + "\n(saved)"
@@ -343,7 +340,7 @@ def cmd_fs_log_purge(env: CommandEnv, args: list[str]) -> str:
         day = e["FullPath"].rsplit("/", 1)[-1]
         if e["IsDirectory"] and day < cutoff:
             st, _, _ = http_request(
-                "DELETE", f"{filer}{e['FullPath']}?recursive=true")
+                "DELETE", f"{filer}{e['FullPath']}?recursive=true", timeout=60)
             (purged if st < 300 else failed).append(day)
     out = f"purged {len(purged)} day(s)" + (
         ": " + ", ".join(sorted(purged)) if purged else "")

@@ -48,8 +48,7 @@ def cmd_s3_bucket_delete(env: CommandEnv, args: list[str]) -> str:
     if status == 404:
         raise ShellError(f"bucket {name!r} not found")
     http_request(
-        "DELETE", f"{_filer(env)}{BUCKETS_DIR}/{name}?recursive=true"
-    )
+        "DELETE", f"{_filer(env)}{BUCKETS_DIR}/{name}?recursive=true", timeout=60)
     return f"deleted bucket {name}"
 
 
@@ -71,8 +70,7 @@ def cmd_s3_bucket_quota(env: CommandEnv, args: list[str]) -> str:
         http_request(
             "PUT", f"{_filer(env)}{path}?meta.entry=true",
             body=json.dumps(entry).encode(),
-            headers={"Content-Type": "application/json"},
-        )
+            headers={"Content-Type": "application/json"}, timeout=60)
         return f"bucket {name} quota set to {flags['sizeMB']}MB"
     quota = (entry.get("extended") or {}).get("quota.bytes", "")
     return f"bucket {name} quota: {quota or '(none)'}"
@@ -103,8 +101,7 @@ def cmd_s3_clean_uploads(env: CommandEnv, args: list[str]) -> str:
         for u in json.loads(body2).get("Entries") or []:
             if u.get("Mtime", 0) < cutoff:
                 http_request(
-                    "DELETE", f"{_filer(env)}{u['FullPath']}?recursive=true"
-                )
+                    "DELETE", f"{_filer(env)}{u['FullPath']}?recursive=true", timeout=60)
                 removed.append(u["FullPath"])
     return f"removed {len(removed)} stale multipart uploads" + (
         "\n" + "\n".join(removed) if removed else ""
@@ -145,8 +142,7 @@ def cmd_s3_configure(env: CommandEnv, args: list[str]) -> str:
     http_request(
         "PUT", f"{_filer(env)}{path}",
         body=json.dumps(config, indent=2).encode(),
-        headers={"Content-Type": "application/json"},
-    )
+        headers={"Content-Type": "application/json"}, timeout=60)
     verb = "removed" if flags.get("delete") == "true" else "configured"
     return f"{verb} identity {name!r} ({len(identities)} identities total)"
 
@@ -171,8 +167,7 @@ def cmd_s3_circuitbreaker(env: CommandEnv, args: list[str]) -> str:
         http_request(
             "PUT", f"{_filer(env)}{path}",
             body=json.dumps(config).encode(),
-            headers={"Content-Type": "application/json"},
-        )
+            headers={"Content-Type": "application/json"}, timeout=60)
     return json.dumps(config, indent=2)
 
 
@@ -253,8 +248,7 @@ def cmd_s3_bucket_quota_enforce(env: CommandEnv, args: list[str]) -> str:
             http_request(
                 "PUT", f"{_filer(env)}{path}?meta.entry=true",
                 body=json.dumps(entry).encode(),
-                headers={"Content-Type": "application/json"},
-            )
+                headers={"Content-Type": "application/json"}, timeout=60)
         lines.append(
             f"{name}: used {used} / quota {quota}"
             f" ({'OVER' if over else 'ok'}){action}")

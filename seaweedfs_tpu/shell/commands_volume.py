@@ -175,8 +175,7 @@ def cmd_volume_check_disk(env: CommandEnv, args: list[str]) -> str:
                 blob_status, _, blob = http_request(
                     "GET",
                     f"{src.http}/admin/volume/needle_blob?volume={vid}"
-                    f"&offset={meta['offset']}&size={meta['size']}",
-                )
+                    f"&offset={meta['offset']}&size={meta['size']}", timeout=60)
                 if blob_status != 200:
                     lines.append(f"volume {vid}: read {nid} from {src.id} failed")
                     continue
@@ -184,8 +183,7 @@ def cmd_volume_check_disk(env: CommandEnv, args: list[str]) -> str:
                     "POST",
                     f"{sv.http}/admin/volume/write_needle_blob?volume={vid}"
                     f"&size={meta['size']}",
-                    blob,
-                )
+                    blob, timeout=60)
                 if st < 300:
                     lines.append(f"volume {vid}: copied needle {nid} "
                                  f"{src.id} -> {sv.id}")

@@ -21,6 +21,10 @@ class ServerView:
         self.max_volume_count = node.get("max_volume_count", 100)
         self.volumes = {v["id"]: v for v in node.get("volume_infos", [])}
         self.ec_shards = {e["id"]: e["shards"] for e in node.get("ec_shard_infos", [])}
+        self.ec_collections = {
+            e["id"]: e.get("collection", "")
+            for e in node.get("ec_shard_infos", [])
+        }
 
     @property
     def http(self) -> str:
@@ -101,4 +105,4 @@ class CommandEnv:
         url = f"{self.require_filer()}{path}"
         if query:
             url += f"?{query}"
-        return http_request("GET", url)
+        return http_request("GET", url, timeout=60)

@@ -54,6 +54,11 @@ DEFAULT_PARAMS = {
     # works (and at all meaningfully) is starved by its neighbor
     "starvation_wait_ratio": 3.0,
     "starvation_min_wait": 0.05,
+    # degraded_reads: needle reads surviving only through EC
+    # reconstruction / alternate sources at this sustained rate mean a
+    # fault is in flight (torn .dat, lost shard/holder) — the reads
+    # succeed, which is exactly why nothing else pages
+    "degraded_read_rate": 0.5,
 }
 
 
@@ -196,6 +201,29 @@ def _check_fastlane_fallback(hist, now, p):
     return worst, "; ".join(details)
 
 
+def _check_degraded_reads(hist, now, p):
+    """Reads are SUCCEEDING through reconstruction — client dashboards
+    stay green while redundancy quietly absorbs a fault. A sustained
+    rate is the signal the maintenance daemon's heal should already be
+    racing; per-reason breakdown rides in the detail."""
+    per_reason: dict[str, float] = {}
+    for labels, rate in hist.rates(
+        "SeaweedFS_volume_degraded_reads_total", p["window"], now
+    ):
+        if rate is None or rate <= 0:
+            continue
+        r = labels.get("reason", "?")
+        per_reason[r] = per_reason.get(r, 0.0) + rate
+    total = sum(per_reason.values())
+    if total <= p["degraded_read_rate"]:
+        return None
+    top = max(per_reason.items(), key=lambda kv: kv[1])
+    return total, (
+        f"reads degrading at {total:.2f}/s (mostly '{top[0]}') —"
+        f" a fault is being absorbed by EC reconstruction"
+    )
+
+
 def _check_ec_starved(hist, now, p):
     per_stage: dict[str, dict] = {}
     for labels, rate in hist.rates(
@@ -243,6 +271,10 @@ def default_rules() -> list[Rule]:
              "a filer/S3 front door is falling back to the Python path"
              " for a pathological reason (lease, backpressure, upstream)",
              _check_fastlane_fallback),
+        Rule("degraded_reads", "warning",
+             "needle reads are being served through EC reconstruction"
+             " at a sustained rate (a fault is in flight)",
+             _check_degraded_reads),
     ]
 
 

@@ -43,11 +43,18 @@ from .geometry import (
 )
 
 
-from seaweedfs_tpu.storage.volume import NotFound
+from seaweedfs_tpu.storage.volume import NotFound, degraded_reads_counter
+from seaweedfs_tpu.util import faults
 
 
 class NeedleNotFound(NotFound):
     pass
+
+
+# sealed-shard pread seam: error/latency here exercises the local ->
+# remote -> reconstruct ladder below (an injected local-read failure
+# must degrade into reconstruction, not a 500)
+_FP_SHARD_READ = faults.register("volume.ec.shard.read")
 
 
 def ec_shard_file_name(collection: str, dir_: str, vid: int) -> str:
@@ -157,6 +164,10 @@ class EcVolume:
     def _pread_shard(self, shard_id: int, off: int, size: int) -> bytes | None:
         """Full-length positional read, or None if the shard can't serve it
         (absent or truncated — both are 'missing' to the erasure code)."""
+        try:
+            _FP_SHARD_READ.hit()
+        except (faults.FaultInjected, OSError):
+            return None  # an injected local failure = a missing shard
         fd = self.shards.get(shard_id)
         if fd is None:
             return None
@@ -219,6 +230,7 @@ class EcVolume:
                 f"cannot recover shard {missing_shard}: only {len(present)} present"
             )
         out = self.codec.reconstruct(present, targets=[missing_shard])
+        degraded_reads_counter().labels("ec_reconstruct").inc()
         return out[missing_shard].tobytes()
 
     def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:

@@ -62,6 +62,7 @@ import numpy as np
 
 from seaweedfs_tpu.ops.rs_kernel import RSCodec
 from seaweedfs_tpu.storage import crc as crc_mod
+from seaweedfs_tpu.util import faults
 
 from . import encoder as encoder_mod
 from .geometry import (
@@ -83,11 +84,18 @@ FALLBACK_REASONS = (
                         # trickle traffic; the row re-encodes as it fills)
     "journal_io",       # .ecp journal unwritable
     "vacuum_reset",     # compaction rewrote the .dat; parity restarted
+    "parity_rearm",     # lost/torn parity shard: restarted + re-encoded
+                        # from the durable .dat (the heal, not the fault)
 )
 # reasons that mean online EC is BROKEN for the volume (bench asserts
-# zero of these in steady state); trickle_flush and vacuum_reset are
-# expected operation
+# zero of these in steady state); trickle_flush, vacuum_reset and
+# parity_rearm are expected operation
 PATHOLOGICAL_REASONS = ("backpressure", "encoder_error", "journal_io")
+
+# parity-emit fault seam: `torn` tears the parity file tail (the state a
+# crash mid-append leaves); error/disk_full surface as encoder_error
+# degrades — exactly what the maintenance rearm path must heal
+_FP_PARITY = faults.register("volume.ec.parity.write")
 
 # .ecp journal: fixed 24-byte records, last valid record wins.
 # magic u32 | watermark u64 | partial u64 | crc32c u32 (over bytes 0..19)
@@ -612,6 +620,7 @@ class OnlineEcWriter:
         rows_done = 0
         nrows = behind // self.stripe
         try:
+            _FP_PARITY.hit()  # error/disk_full degrade like a real emit
             batch_rows = max(1, encoder_mod.DEFAULT_BATCH_HOST // self.block)
             if nrows > max(16, 2 * batch_rows):
                 # deep backlog (journal replay, seal catch-up): overlap
@@ -655,10 +664,224 @@ class OnlineEcWriter:
             # reason, so the label stays honest either way
             self._degrade("encoder_error")
             return rows_done
+        if rows_done:
+            spec = _FP_PARITY.spec
+            if spec is not None and spec.mode == "torn":
+                spec = _FP_PARITY.draw()
+                if spec is not None:
+                    self._tear_parity(spec.frac)
         self._m_buffered.labels(self._vol_label).set(
             max(0, self._end() - self.watermark)
         )
         return rows_done
+
+    def _tear_parity(self, frac: float) -> None:
+        """Torn-parity-write injection: chop the tail off parity shard 0
+        — the on-disk state a crash mid-append leaves. Bookkeeping
+        follows the cut so the next mapped write cannot SIGBUS past the
+        new EOF; the WRITER believes its watermark, which is the point:
+        only the heartbeat's parity_health() audit can notice."""
+        fd = self._parity_fds[0]
+        # cut below the DURABLE watermark's rows: the parity files are
+        # pre-sized ahead of the write cursor (_size_parity), so a cut
+        # into that slack would tear nothing anyone claimed durable
+        need = (self.watermark // self.stripe) * self.block
+        cut = max(1, int(self.block * min(max(frac, 0.0), 1.0)))
+        new_size = max(0, min(os.fstat(fd).st_size, need) - cut)
+        self._drop_parity_maps()
+        os.ftruncate(fd, new_size)
+        self._parity_rows_sized = min(
+            self._parity_rows_sized, new_size // self.block
+        )
+
+    def parity_health(self) -> int:
+        """Missing-or-short parity shard count, audited against the
+        durable watermark (full rows only — a partial flush only ever
+        grows a file). Rides the heartbeat so the master's ec_rebuild
+        detector can see a LIVE online volume whose parity was lost or
+        torn, instead of reporting it healthy. No content scrub: a hole
+        backfilled by later growth is out of this audit's reach — loss
+        and tail tears (the crash/unlink class) are what it catches."""
+        if not self.active or self.sealed:
+            return 0
+        # under the writer lock: rearm() truncates the parity files a few
+        # statements before rewinding the watermark, and an unlocked audit
+        # in that window would report phantom damage (queueing a SECOND
+        # full re-encode). Bounded acquire: a long re-encode holding the
+        # lock must not stall the heartbeat — skip the audit this beat.
+        if not self._lock.acquire(timeout=0.2):
+            return 0
+        try:
+            if not self.active or self.sealed:
+                return 0
+            need = (self.watermark // self.stripe) * self.block
+            damaged = 0
+            for p in range(PARITY_SHARDS_COUNT):
+                path = self.volume.base_name + to_ext(DATA_SHARDS_COUNT + p)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    damaged += 1
+                    continue
+                if size < need:
+                    damaged += 1
+            return damaged
+        finally:
+            self._lock.release()
+
+    def reconstruct_range(self, offset: int, size: int) -> bytes | None:
+        """Rebuild .dat bytes [offset, offset+size) from parity + the
+        other data columns — the degraded-read path for a torn/unreadable
+        needle on a live online-EC volume.
+
+        Per stripe row, two regimes:
+          * narrow range (<= 4 columns overlapped): treat the overlapped
+            columns as erasures and RS-decode them outright;
+          * wide range (a needle spanning most of a row): the erasure
+            view can't name >4 missing columns, so LOCATE the damage
+            instead — recompute parity from the .dat columns; a clean
+            match means the row is intact, otherwise try each overlapped
+            column as the single corrupt one, reconstruct it, and accept
+            the candidate all surviving parity rows verify. (Needle CRC
+            re-checks the assembled record at the caller regardless.)
+
+        Data columns are read as they were at encode time (zero past the
+        covered watermark) so the tail row's stale-parity window stays
+        consistent. Returns None whenever parity cannot prove the range."""
+        with self._lock:
+            if not self._parity_fds or not self.active:
+                return None
+            block, stripe = self.block, self.stripe
+            covered = self.watermark + self._partial
+            if size <= 0 or offset < 0 or offset + size > covered:
+                return None  # parity hasn't durably covered the range
+            out = bytearray()
+            row0 = offset // stripe
+            row1 = (offset + size - 1) // stripe
+            for row in range(row0, row1 + 1):
+                row_start = row * stripe
+                lo = max(offset, row_start)
+                hi = min(offset + size, row_start + stripe)
+                targets = list(range((lo - row_start) // block,
+                                     (hi - 1 - row_start) // block + 1))
+
+                def read_col(c: int) -> np.ndarray:
+                    col_start = row_start + c * block
+                    if col_start >= covered:
+                        return np.zeros(block, dtype=np.uint8)
+                    take = min(block, covered - col_start)
+                    data = self._read_dat(col_start, take)
+                    if take < block:
+                        data = data + b"\0" * (block - take)
+                    return np.frombuffer(data, dtype=np.uint8)
+
+                parity: dict[int, np.ndarray] = {}
+                for p in range(PARITY_SHARDS_COUNT):
+                    data = os.pread(self._parity_fds[p], block, row * block)
+                    if len(data) == block:  # short = torn: unusable
+                        parity[p] = np.frombuffer(data, dtype=np.uint8)
+                if not parity:
+                    return None
+                row_data = self._recover_row(
+                    targets, read_col, parity, block
+                )
+                if row_data is None:
+                    return None
+                pos = lo
+                while pos < hi:
+                    c = (pos - row_start) // block
+                    inner = (pos - row_start) % block
+                    take = min(hi - pos, block - inner)
+                    out += row_data[c].tobytes()[inner:inner + take]
+                    pos += take
+            return bytes(out)
+
+    def _recover_row(self, targets, read_col, parity, block):
+        """One stripe row's data columns with the damage decoded out;
+        None when parity cannot prove a consistent row. See
+        reconstruct_range for the two regimes."""
+        present_parity = {
+            DATA_SHARDS_COUNT + p: blk for p, blk in parity.items()
+        }
+        if len(targets) <= min(PARITY_SHARDS_COUNT, len(parity)):
+            present = {
+                c: read_col(c)
+                for c in range(DATA_SHARDS_COUNT) if c not in targets
+            }
+            present.update(present_parity)
+            if len(present) < DATA_SHARDS_COUNT:
+                return None
+            try:
+                rec = self.codec.reconstruct(present, targets=targets)
+            except Exception:
+                return None
+            return {
+                c: (rec[c] if c in targets else present[c])
+                for c in range(DATA_SHARDS_COUNT)
+            }
+        # wide range: locate the corruption via parity verification
+        data = [read_col(c) for c in range(DATA_SHARDS_COUNT)]
+
+        def verifies(cols) -> bool:
+            expect = self.codec.encode(np.stack(cols))
+            return all(
+                np.array_equal(expect[p], blk)
+                for p, blk in parity.items()
+            )
+
+        try:
+            if verifies(data):
+                return dict(enumerate(data))  # row is intact as-read
+            for suspect in targets:
+                present = {
+                    c: data[c]
+                    for c in range(DATA_SHARDS_COUNT) if c != suspect
+                }
+                present.update(present_parity)
+                rec = self.codec.reconstruct(present, targets=[suspect])
+                candidate = list(data)
+                candidate[suspect] = rec[suspect]
+                if verifies(candidate):
+                    return dict(enumerate(candidate))
+        except Exception:
+            return None
+        return None  # multi-column damage in one row: not provable here
+
+    def rearm(self) -> int:
+        """Recreate the parity shard files and re-encode everything from
+        byte 0 — the ec_rebuild executor's online branch for a LIVE
+        volume whose parity was lost or torn. Parity is a pure function
+        of the append-only .dat, so a from-scratch re-encode off the
+        durable bytes is always correct; it also clears a degraded
+        writer (healing back to active is the point). Returns the rows
+        re-encoded."""
+        with self._lock:
+            self._drop_maps()
+            for fd in self._parity_fds:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            fds = []
+            for p in range(PARITY_SHARDS_COUNT):
+                path = self.volume.base_name + to_ext(DATA_SHARDS_COUNT + p)
+                fds.append(os.open(path, os.O_RDWR | os.O_CREAT, 0o644))
+            self._parity_fds = fds
+            for fd in fds:
+                os.ftruncate(fd, 0)
+            self._parity_rows_sized = 0
+            self.watermark = 0
+            self._partial = 0
+            self._pending_since = None
+            self.active = True
+            self.fallback_reason = None
+            self._count_fallback("parity_rearm")
+            try:
+                os.ftruncate(self._journal_fd, 0)
+            except OSError:
+                pass
+            self._journal_append()
+        return self.pump(force=True)
 
     # --- reads from the open state -------------------------------------------
     def read_shard_range(self, shard_id: int, off: int, size: int) -> bytes | None:

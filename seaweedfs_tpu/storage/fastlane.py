@@ -27,6 +27,15 @@ _EVENT_SIZE = 48
 # vid, op, key, offset, size, pad, ns, trace_id
 _EVENT = struct.Struct("<IIQQiIQQ")
 
+from seaweedfs_tpu.util import faults as _faults
+
+# drain-seam fault point: latency/error here widen the engine->Python
+# visibility window (read-your-writes across cores), the exact race the
+# delete-fence machinery must absorb. Engine-side injection rides the
+# OPTIONAL sw_fl_inject_fault ABI when the .so carries it (see
+# _bind_faults) — a stale .so degrades to this Python-side seam only.
+_FP_DRAIN = _faults.register("volume.fastlane.drain")
+
 
 def _bind(lib) -> bool:
     """Declare the fastlane ABI on the shared library; False if absent."""
@@ -199,6 +208,28 @@ def _bind_ec_online(lib) -> bool:
     except AttributeError:
         lib._fastlane_ec_online_bound = False
     return lib._fastlane_ec_online_bound
+
+
+def _bind_faults(lib) -> bool:
+    """Declare the OPTIONAL engine-side fault-injection ABI. A .so built
+    before sw_fl_inject_fault existed simply lacks the symbol — arming an
+    engine-side fault then reports unsupported and the Python-side drain
+    seam (the _FP_DRAIN point) carries the injection alone, the same
+    hasattr-degraded contract as the metrics/ec_online ABIs."""
+    cached = getattr(lib, "_fastlane_faults_bound", None)
+    if cached is not None:
+        return cached
+    try:
+        lib.sw_fl_inject_fault.restype = ctypes.c_int
+        # (handle, point, mode, arg) — point/mode are small enums shared
+        # with fastlane.cpp when a faults-aware engine is built
+        lib.sw_fl_inject_fault.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
+        ]
+        lib._fastlane_faults_bound = True
+    except AttributeError:
+        lib._fastlane_faults_bound = False
+    return lib._fastlane_faults_bound
 
 
 def _get_lib():
@@ -461,6 +492,9 @@ class Fastlane:
 
         from seaweedfs_tpu.stats import trace as _trace
 
+        _FP_DRAIN.hit()  # latency widens the cross-core visibility
+        # window; error skips a tick (the loop's except absorbs it) —
+        # both are what the delete-fence/read-retry paths must survive
         total = 0
         with self._drain_mu:
             while True:
@@ -512,6 +546,18 @@ class Fastlane:
                 if n < 4096:
                     break
         return total
+
+    # --- engine-side fault injection (optional ABI) ------------------------
+    def inject_fault(self, point: int, mode: int, arg: int = 0) -> bool:
+        """Arm an engine-side fault through the optional
+        sw_fl_inject_fault ABI; False when this .so predates it (the
+        Python-side drain seam still injects — callers treat False as
+        'engine untouched', not an error)."""
+        if not _bind_faults(self._lib):
+            return False
+        return int(self._lib.sw_fl_inject_fault(
+            self.handle, point, mode, arg
+        )) == 0
 
     # --- master assign profiles --------------------------------------------
     def assign_set(self, query: str, entries: list, key_start: int,

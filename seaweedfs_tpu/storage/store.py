@@ -281,6 +281,15 @@ class Store:
                         "ec_online": bool(
                             v.online_ec is not None and v.online_ec.active
                         ),
+                        # missing/torn parity shards audited against the
+                        # durable watermark — a LIVE online volume whose
+                        # parity was lost must surface as repairable
+                        # (detect_ec_missing_shards' online branch), not
+                        # read as healthy until seal time
+                        "ec_online_parity_damaged": (
+                            v.online_ec.parity_health()
+                            if v.online_ec is not None else 0
+                        ),
                     }
                 )
         ec_shards = []

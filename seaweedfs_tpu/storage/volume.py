@@ -24,6 +24,8 @@ import os
 import threading
 import time
 
+from seaweedfs_tpu.util import faults
+
 from . import crc as crc_mod
 from . import idx as idx_mod
 from .backend import DiskFile, RemoteFile, get_backend
@@ -51,6 +53,41 @@ class VolumeError(Exception):
 
 class NotFound(VolumeError):
     pass
+
+
+# data-plane fault points (util/faults.py): disarmed these are one
+# attribute check per call; armed they inject at the exact seam the
+# degraded-read machinery below must survive
+_FP_READ_DAT = faults.register("volume.read.dat")
+_FP_READ_IDX = faults.register("volume.read.idx")
+_FP_WRITE_DAT = faults.register("volume.write.dat")
+
+# `reason` label values of SeaweedFS_volume_degraded_reads_total —
+# declared (and linted by tools/check_metric_names.py) so dashboards and
+# the degraded_reads alert can't drift from the increments:
+#   dat_read     — the .dat pread failed or came back short
+#   needle_parse — the bytes read back torn (CRC/id/size mismatch)
+#   ec_reconstruct — a sealed EC interval was rebuilt from parity
+#     (counted in erasure_coding/ec_volume.py)
+DEGRADED_READ_REASONS = ("dat_read", "needle_parse", "ec_reconstruct")
+
+_degraded_metric = None
+
+
+def degraded_reads_counter():
+    """SeaweedFS_volume_degraded_reads_total{reason} — lazily registered
+    (library imports pay nothing), shared with ec_volume.py."""
+    global _degraded_metric
+    if _degraded_metric is None:
+        from seaweedfs_tpu.stats import default_registry
+
+        _degraded_metric = default_registry().counter(
+            "SeaweedFS_volume_degraded_reads_total",
+            "needle reads served by EC reconstruction or alternate-source"
+            " recovery instead of failing",
+            ("reason",),
+        )
+    return _degraded_metric
 
 
 def volume_file_name(dir_: str, collection: str, vid: int) -> str:
@@ -262,11 +299,15 @@ class Volume:
             return offset, n.size
 
     def _append(self, n: Needle) -> int:
+        _FP_WRITE_DAT.hit()  # error / disk_full / latency injection
         offset = self._size
         if offset % NEEDLE_PADDING_SIZE != 0:
             offset += NEEDLE_PADDING_SIZE - offset % NEEDLE_PADDING_SIZE
         blob = n.to_bytes(self.version())
-        self._dat.write_at(blob, offset)
+        # torn-write injection: part of the record never reaches disk,
+        # but the in-memory tail advances as if it did — the exact state
+        # a crash mid-pwrite leaves, which degraded reads must survive
+        self._dat.write_at(_FP_WRITE_DAT.mangle(blob), offset)
         self._size = offset + len(blob)
         return offset
 
@@ -290,6 +331,9 @@ class Volume:
 
     # --- read path -----------------------------------------------------------
     def _read_at(self, offset: int, size: int) -> Needle:
+        _FP_READ_DAT.hit()  # needle-level seam: reconstruction reads
+        # (block-level, via online_ec/_dat directly) bypass it, so a
+        # rate=1.0 error here still leaves the degraded path a way out
         total = get_actual_size(size, self.version())
         blob = self._dat.read_at(total, offset)
         if len(blob) < total:
@@ -312,29 +356,109 @@ class Volume:
                 continue
             try:
                 n = self._read_needle_once(needle_id, cookie)
-            except Exception:
+            except NotFound:
                 if self._compact_gen == gen:
-                    raise  # a real miss/corruption, not a swap race
+                    raise  # a real miss, not a swap race
                 continue
+            except Exception as e:
+                if self._compact_gen != gen:
+                    continue
+                # a real corruption/IO failure (torn .dat, bad CRC,
+                # injected fault) — not a miss: reconstruct from EC
+                # redundancy instead of surfacing a 500 for live data
+                n = self._degraded_read(needle_id, cookie, e)
             # a successful read must ALSO re-validate: a swap completing
             # mid-read can pair the old map's offset with the new file and
             # still parse cleanly if another needle sits there
             if self._compact_gen == gen:
                 return n
 
-    def _read_needle_once(self, needle_id: int, cookie: int | None) -> Needle:
+    def _degraded_read(
+        self, needle_id: int, cookie: int | None, cause: Exception
+    ) -> Needle:
+        """Serve a needle whose direct .dat read failed by rebuilding its
+        on-disk record from surviving redundancy: the open online-EC
+        parity (+ intact .dat columns) when this volume streams EC on
+        ingest, else sealed EC shards sitting alongside the .dat (the
+        encode-to-delete window). Raises the ORIGINAL error when no
+        redundancy can produce a verifying record — degraded reads never
+        turn a corruption into silently wrong bytes."""
+        from .needle import CRCError, SizeMismatchError
+
         nv = self.nm.get(needle_id)
         if nv is None or not size_is_valid(nv[1]):
-            raise NotFound(f"needle {needle_id:x} not found")
-        n = self._read_at(nv[0], nv[1])
-        if n.id != needle_id:  # wrong record at this offset (torn read)
-            raise NotFound(f"needle {needle_id:x} not found at offset")
+            raise NotFound(f"needle {needle_id:x} not found") from cause
+        offset, size = nv
+        blob = None
+        w = self.online_ec
+        if w is not None:
+            blob = w.reconstruct_range(
+                offset, get_actual_size(size, self.version())
+            )
+        if blob is None:
+            blob = self._reconstruct_from_sealed(offset, size)
+        if blob is None:
+            raise cause
+        try:  # from_bytes CRC-verifies: reconstruction must prove itself
+            n = Needle.from_bytes(blob, size=size, version=self.version())
+        except Exception:
+            raise cause
+        if n.id != needle_id:
+            raise cause
+        # the SAME validation the direct read path applies
+        self._validate_needle(n, needle_id, cookie)
+        reason = (
+            "needle_parse"
+            if isinstance(cause, (CRCError, SizeMismatchError, ValueError))
+            else "dat_read"
+        )
+        degraded_reads_counter().labels(reason).inc()
+        return n
+
+    def _reconstruct_from_sealed(self, offset: int, size: int) -> bytes | None:
+        """Rebuild a needle record from sealed EC shards sharing this
+        volume's base name (post-`ec.encode`, pre-delete) via the
+        standard interval ladder — local shards, then reconstruction."""
+        if not os.path.exists(self.base_name + ".ecx"):
+            return None
+        from .erasure_coding.ec_volume import EcVolume
+
+        try:
+            ev = EcVolume(self.dir, self.collection, self.id)
+        except Exception:
+            return None
+        try:
+            return b"".join(
+                ev._read_interval(iv)
+                for iv in ev.locate_intervals(offset, size)
+            )
+        except Exception:
+            return None
+        finally:
+            ev.close()
+
+    def _validate_needle(
+        self, n: Needle, needle_id: int, cookie: int | None
+    ) -> None:
+        """Cookie + TTL-expiry validation shared by the direct and
+        degraded read paths — recovered needles must validate exactly
+        like directly-read ones."""
         if cookie is not None and n.cookie != cookie:
             raise NotFound("cookie mismatch")
         if n.has_ttl() and n.ttl.minutes() > 0 and n.has_last_modified():
             expires = n.last_modified + n.ttl.minutes() * 60
             if expires < time.time():
                 raise NotFound("needle expired")
+
+    def _read_needle_once(self, needle_id: int, cookie: int | None) -> Needle:
+        _FP_READ_IDX.hit()
+        nv = self.nm.get(needle_id)
+        if nv is None or not size_is_valid(nv[1]):
+            raise NotFound(f"needle {needle_id:x} not found")
+        n = self._read_at(nv[0], nv[1])
+        if n.id != needle_id:  # wrong record at this offset (torn read)
+            raise NotFound(f"needle {needle_id:x} not found at offset")
+        self._validate_needle(n, needle_id, cookie)
         return n
 
     def read_needle_blob(self, offset: int, size: int) -> bytes:

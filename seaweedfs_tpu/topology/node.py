@@ -24,6 +24,10 @@ class VolumeInfo:
     # volume streams its appends through the online RS encoder: its
     # durability is local-dat + parity shards, not replica fan-out
     ec_online: bool = False
+    # missing-or-torn parity shards the holder audited against its
+    # durable watermark — >0 means this LIVE online volume's redundancy
+    # is damaged and an online ec_rebuild (re-arm + re-encode) is due
+    ec_online_parity_damaged: int = 0
 
     @staticmethod
     def from_dict(d: dict) -> "VolumeInfo":
@@ -39,6 +43,9 @@ class VolumeInfo:
             ttl=int(d.get("ttl", 0)),
             version=int(d.get("version", 3)),
             ec_online=bool(d.get("ec_online", False)),
+            ec_online_parity_damaged=int(
+                d.get("ec_online_parity_damaged", 0)
+            ),
         )
 
 

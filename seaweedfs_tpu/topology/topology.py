@@ -355,6 +355,8 @@ class Topology:
                                             "replica_placement": v.replica_placement,
                                             "ttl": v.ttl,
                                             "ec_online": v.ec_online,
+                                            "ec_online_parity_damaged":
+                                                v.ec_online_parity_damaged,
                                         }
                                         for v in n.volumes.values()
                                     ],

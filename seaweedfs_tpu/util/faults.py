@@ -1,0 +1,325 @@
+"""Named fault-point registry: inject errors, latency, torn writes, full
+disks and network partitions at the cluster's hot seams.
+
+Every repair path this repo grew (PR 5's detect->plan->heal, PR 8's
+online EC) was only ever tested by *polite* loss — admin APIs deleting
+shards. Real outages happen mid-request: a holder dies under a read
+storm, a parity write tears, a heartbeat partitions. This module is the
+cluster-wide switchboard for injecting exactly those faults
+(arXiv:1709.05365 measures degraded-mode behavior as the dominant tail
+in online-coded arrays; you cannot measure what you cannot inject).
+
+Design constraints, in order:
+
+  1. **Disarmed is free.** A fault point on the needle-read path runs on
+     every data-plane request; the disarmed check is one attribute load
+     and a None test — no dict lookup, no allocation, no closure. The
+     tier-1 suite asserts this with a hot-loop guard.
+  2. **Points are declared, not discovered.** `ALL_POINTS` is the
+     closed set of seam names; `register()` rejects anything else, so a
+     typo'd seam cannot silently never fire, and
+     tools/check_metric_names.py can lint that every declared point is
+     exercised by the chaos suite.
+  3. **Per-process arming.** In production each node is its own process
+     (`-faults` flag, `POST /debug/faults`); in-process test clusters
+     share one registry, so a spec may carry `key=` to scope a fault to
+     one server's seam invocations (the seam passes its identity).
+
+Seam API:
+
+    _FP = faults.register("volume.read.dat")   # module import time
+    ...
+    _FP.hit()                # raise/sleep per the armed spec, or no-op
+    data = _FP.mangle(data)  # torn-write seams: maybe truncate
+    spec = _FP.draw()        # custom seams: count the injection, act
+                             # themselves (e.g. tear a parity file)
+
+Injections count into SeaweedFS_faults_injected_total{point,mode}.
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+# The closed set of fault-point names (dotted lowercase, linted by
+# tools/check_metric_names.py; each must be exercised by tests/test_chaos.py).
+ALL_POINTS = (
+    "volume.read.dat",        # needle read from the .dat
+    "volume.read.idx",        # needle-map lookup on the read path
+    "volume.write.dat",       # needle append to the .dat
+    "volume.ec.shard.read",   # sealed EC shard pread
+    "volume.ec.parity.write", # online-EC parity emit (torn = tear the file)
+    "volume.heartbeat.send",  # volume server -> master heartbeat POST
+    "master.assign",          # /dir/assign handler
+    "master.lookup",          # /dir/lookup handler
+    "filer.chunk.read",       # filer -> volume chunk relay (wdclient.fetch)
+    "volume.replicate.fanout",# synchronous replica fan-out
+    "volume.fastlane.drain",  # engine event drain (ABI hook when present)
+)
+
+MODES = ("error", "latency", "torn", "disk_full", "partition")
+
+
+class FaultInjected(IOError):
+    """An `error`-mode fault fired. Derives from IOError so seams that
+    already treat IO failures as recoverable treat injections the same
+    way — the whole point is exercising the real failure handling."""
+
+
+class FaultPartition(ConnectionError):
+    """A `partition`-mode fault fired: the peer is unreachable."""
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault. `count` < 0 means unlimited; a positive count
+    decrements per firing and auto-disarms at zero. `rate` in (0, 1]
+    fires probabilistically. `key` scopes the fault to seam invocations
+    passing the same discriminator (in-process multi-server tests)."""
+
+    mode: str
+    rate: float = 1.0
+    ms: float = 0.0       # latency mode: injected delay
+    frac: float = 0.5     # torn mode: fraction of the payload DROPPED
+    count: int = -1       # firings remaining; <0 = unlimited
+    key: str = ""         # scope discriminator ("" = every invocation)
+
+    def to_dict(self) -> dict:
+        return {"mode": self.mode, "rate": self.rate, "ms": self.ms,
+                "frac": self.frac, "count": self.count, "key": self.key}
+
+
+_metric = None
+
+
+def _injected_counter():
+    global _metric
+    if _metric is None:
+        from seaweedfs_tpu.stats import default_registry
+
+        _metric = default_registry().counter(
+            "SeaweedFS_faults_injected_total",
+            "fault injections fired, by point and mode",
+            ("point", "mode"),
+        )
+    return _metric
+
+
+class FaultPoint:
+    """One named seam. `spec` is None when disarmed — the hot-path check
+    is a single attribute load (__slots__, no dict walk)."""
+
+    __slots__ = ("name", "spec", "fired")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.spec: FaultSpec | None = None
+        self.fired = 0
+
+    # --- hot path -----------------------------------------------------------
+    def draw(self, key: str | None = None) -> FaultSpec | None:
+        """Decide whether the armed fault fires for this invocation and
+        count it; returns the spec (caller acts) or None. Seams with
+        custom damage (torn parity) use this directly."""
+        spec = self.spec
+        if spec is None:
+            return None
+        return self._draw_slow(spec, key)
+
+    def _draw_slow(self, spec: FaultSpec, key: str | None) -> FaultSpec | None:
+        if spec.key and key is not None and key != spec.key:
+            return None
+        if spec.rate < 1.0 and random.random() >= spec.rate:
+            return None
+        with _lock:
+            if self.spec is not spec:  # disarmed/re-armed under us
+                return None
+            if spec.count == 0:
+                return None
+            if spec.count > 0:
+                spec.count -= 1
+                if spec.count == 0:
+                    self.spec = None
+            self.fired += 1
+        _injected_counter().labels(self.name, spec.mode).inc()
+        return spec
+
+    def hit(self, key: str | None = None) -> None:
+        """The standard seam check: no-op disarmed; armed, acts per mode
+        (error/partition/disk_full raise, latency sleeps; torn is a
+        no-op here — use mangle() at the byte seam, so a seam calling
+        both never double-counts one torn firing)."""
+        spec = self.spec
+        if spec is None or spec.mode == "torn":
+            return
+        spec = self.draw(key)
+        if spec is not None:
+            act(self.name, spec)
+
+    def mangle(self, data: bytes, key: str | None = None) -> bytes:
+        """Torn-write seams: return the payload truncated by `frac` when
+        a torn fault fires; every other mode is handled by hit()."""
+        spec = self.spec
+        if spec is None or spec.mode != "torn":
+            return data
+        spec = self.draw(key)
+        if spec is None:
+            return data
+        keep = max(0, int(len(data) * (1.0 - spec.frac)))
+        return data[:keep]
+
+
+def act(name: str, spec: FaultSpec) -> None:
+    """Perform a drawn spec's generic behavior (raise/sleep)."""
+    mode = spec.mode
+    if mode == "latency":
+        time.sleep(spec.ms / 1000.0)
+    elif mode == "error":
+        raise FaultInjected(f"injected fault at {name}")
+    elif mode == "disk_full":
+        raise OSError(errno.ENOSPC, f"injected disk-full at {name}")
+    elif mode == "partition":
+        raise FaultPartition(f"injected partition at {name}")
+    # torn: byte-level, handled at the seam via mangle()/draw()
+
+
+_lock = threading.Lock()
+_points: dict[str, FaultPoint] = {}
+
+# Runtime-arming gate for the HTTP surface: every other debug route is
+# read-only, but POST /debug/faults can tear writes — so it 403s unless
+# the operator opted the PROCESS in (the -faults flag, even bare, or
+# SEAWEEDFS_TPU_FAULTS=1). In-process callers (tests, the flag parser)
+# use arm() directly and are unaffected.
+_enabled = False
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def runtime_arming_enabled() -> bool:
+    import os
+
+    return _enabled or os.environ.get("SEAWEEDFS_TPU_FAULTS") == "1"
+
+
+def register(name: str) -> FaultPoint:
+    """Module-import-time seam registration. Idempotent; the name must
+    be declared in ALL_POINTS (a seam nobody can lint is a seam nobody
+    tests)."""
+    if name not in ALL_POINTS:
+        raise ValueError(f"undeclared fault point {name!r}"
+                         f" (add it to faults.ALL_POINTS)")
+    with _lock:
+        p = _points.get(name)
+        if p is None:
+            p = _points[name] = FaultPoint(name)
+        return p
+
+
+def point(name: str) -> FaultPoint:
+    """Lookup-or-register — the arming side's handle."""
+    return register(name)
+
+
+def registered_points() -> list[str]:
+    with _lock:
+        return sorted(_points)
+
+
+def arm(name: str, mode: str, rate: float = 1.0, ms: float = 0.0,
+        frac: float = 0.5, count: int = -1, key: str = "") -> FaultSpec:
+    """Arm one point. Validates the mode and numeric ranges; replaces
+    any existing spec on the point."""
+    if mode not in MODES:
+        raise ValueError(f"unknown fault mode {mode!r} (one of {MODES})")
+    rate = float(rate)
+    ms = float(ms)
+    frac = float(frac)
+    count = int(count)
+    if not (0.0 < rate <= 1.0):
+        raise ValueError(f"rate {rate} not in (0, 1]")
+    if ms < 0 or not (0.0 < frac <= 1.0) or ms != ms:
+        raise ValueError(f"bad latency/frac ({ms}, {frac})")
+    spec = FaultSpec(mode=mode, rate=rate, ms=ms, frac=frac, count=count,
+                     key=key)
+    p = point(name)
+    with _lock:
+        p.spec = spec
+    return spec
+
+
+def disarm(name: str) -> bool:
+    """Disarm one point; True if it was armed."""
+    p = point(name)
+    with _lock:
+        was = p.spec is not None
+        p.spec = None
+    return was
+
+
+def disarm_all() -> int:
+    """Back to the zero-injection steady state; returns how many points
+    were armed."""
+    n = 0
+    with _lock:
+        for p in _points.values():
+            if p.spec is not None:
+                p.spec = None
+                n += 1
+    return n
+
+
+def armed() -> dict[str, FaultSpec]:
+    with _lock:
+        return {n: p.spec for n, p in _points.items() if p.spec is not None}
+
+
+def snapshot() -> list[dict]:
+    """Full state for /debug/faults and cluster.faults -list."""
+    with _lock:
+        return [
+            {"point": n, "fired": p.fired,
+             "armed": p.spec.to_dict() if p.spec is not None else None}
+            for n, p in sorted(_points.items())
+        ]
+
+
+def arm_from_spec(text: str) -> list[str]:
+    """Parse the `-faults` flag grammar and arm each entry:
+
+        point=mode[:k=v[,k=v...]][;point=mode...]
+
+    e.g. `-faults "volume.read.dat=error:rate=0.5;master.assign=latency:ms=20"`.
+    Returns the armed point names; raises ValueError on any bad entry
+    (a half-armed process would lie about what it injects)."""
+    out: list[str] = []
+    for entry in text.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, rest = entry.partition("=")
+        mode, _, opts_s = rest.partition(":")
+        name, mode = name.strip(), mode.strip()
+        if not mode:
+            raise ValueError(f"fault spec {entry!r}: missing =mode")
+        opts: dict = {}
+        for kv in opts_s.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            if k not in ("rate", "ms", "frac", "count", "key"):
+                raise ValueError(f"fault spec {entry!r}: unknown option {k!r}")
+            opts[k] = v if k == "key" else float(v)
+        if "count" in opts:
+            opts["count"] = int(opts["count"])
+        arm(name, mode, **opts)
+        out.append(name)
+    return out

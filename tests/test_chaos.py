@@ -1,0 +1,440 @@
+"""Chaos suite: REAL faults armed on live 3-node clusters.
+
+Every scenario here injects through util/faults.py (the `-faults` /
+POST /debug/faults / cluster.faults switchboard) and asserts the
+cluster SERVES THROUGH the fault: reads keep succeeding (degraded or
+retried, no client-visible failures beyond the acceptance budget), the
+maintenance daemon heals within its scan budget, and disarm_all()
+restores the zero-injection steady state.
+
+Coverage contract: every fault point declared in faults.ALL_POINTS must
+fire at least once in this file — tools/check_metric_names.py lints the
+names against this source, and test_every_fault_point_fires asserts the
+firing counts at runtime:
+
+    volume.read.dat volume.read.idx volume.write.dat
+    volume.ec.shard.read volume.ec.parity.write volume.heartbeat.send
+    master.assign master.lookup filer.chunk.read
+    volume.replicate.fanout volume.fastlane.drain
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.wdclient import WeedClient
+from seaweedfs_tpu.server.httpd import get_json, http_request, post_json
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.storage.file_id import parse_key_hash_with_delta
+from seaweedfs_tpu.util import faults
+
+BLOCK = 4096  # small uniform online-EC stripe keeps the suite quick
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.enable()  # opt the test process into runtime POST /debug/faults
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=1, volume_size_limit_mb=64,
+                          maintenance_interval=0.25,
+                          ec_online="hot", ec_online_block=BLOCK)
+    master.start()
+    vols = []
+    for i, rack in enumerate(["r1", "r2", "r3"]):
+        vs = VolumeServer(
+            [str(tmp_path / f"v{i}")], master.url, port=0, rack=rack,
+            pulse_seconds=1, max_volume_count=30,
+        )
+        vs.start()
+        vols.append(vs)
+    env = CommandEnv(master.url)
+    yield master, vols, env
+    for vs in vols:
+        vs.stop()
+    master.stop()
+
+
+def assign(master, **params):
+    qs = "&".join(f"{k}={v}" for k, v in params.items())
+    return get_json(f"{master.url}/dir/assign?{qs}")
+
+
+def wait_until(fn, timeout=30.0, interval=0.2, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def fired(point: str) -> int:
+    return faults.point(point).fired
+
+
+class TestEveryPointFires:
+    def test_every_fault_point_fires(self, cluster):
+        """Arm each declared point (latency mode: benign) and drive its
+        seam; every one must count an injection — the registry-vs-tests
+        lint plus the runtime proof the seams are actually wired."""
+        master, vols, env = cluster
+        before = {p: fired(p) for p in faults.ALL_POINTS}
+
+        # master.assign / master.lookup — control plane handlers
+        faults.arm("master.assign", "latency", ms=1)
+        a = assign(master)
+        faults.arm("master.lookup", "latency", ms=1)
+        get_json(f"{master.url}/dir/lookup?volumeId={a['fid'].split(',')[0]}")
+
+        # volume.write.dat + volume.replicate.fanout — a replicated
+        # write runs the Python write path and the synchronous fan-out
+        faults.arm("volume.write.dat", "latency", ms=1)
+        faults.arm("volume.replicate.fanout", "latency", ms=1)
+        ar = assign(master, replication="010")
+        url = f"http://{ar['publicUrl']}/{ar['fid']}"
+        st, _, _ = http_request("POST", url, b"chaos-write " * 100)
+        assert st == 201
+
+        # volume.read.dat + volume.read.idx — a query-string GET rides
+        # the Python read path even behind the native engine
+        faults.arm("volume.read.dat", "latency", ms=1)
+        faults.arm("volume.read.idx", "latency", ms=1)
+        st, _, body = http_request("GET", url + "?chaos=1")
+        assert st == 200 and body.startswith(b"chaos-write")
+
+        # filer.chunk.read — the wdclient relay seam
+        faults.arm("filer.chunk.read", "latency", ms=1)
+        wc = WeedClient(master.url)
+        assert wc.fetch(ar["fid"]).startswith(b"chaos-write")
+
+        # volume.heartbeat.send
+        faults.arm("volume.heartbeat.send", "latency", ms=1)
+        vols[0].heartbeat_once()
+
+        # volume.ec.parity.write — online-EC ingest encode
+        ah = assign(master, collection="hot")
+        hvid = int(ah["fid"].split(",")[0])
+        hv = next(
+            vs for vs in vols if vs.store.get_volume(hvid) is not None
+        )
+        st, _, _ = http_request(
+            "POST", f"http://{ah['publicUrl']}/{ah['fid']}",
+            os.urandom(BLOCK * 10 * 2),
+        )
+        assert st == 201
+        if hv.fastlane:
+            hv.fastlane.drain()
+        faults.arm("volume.ec.parity.write", "latency", ms=1)
+        hv.store.get_volume(hvid).online_ec.pump(force=True)
+
+        # volume.ec.shard.read — seal a volume to EC, read from shards
+        v_ec = assign(master)
+        ecvid = int(v_ec["fid"].split(",")[0])
+        http_request(
+            "POST", f"http://{v_ec['publicUrl']}/{v_ec['fid']}",
+            b"sealed-ec-needle " * 64,
+        )
+        src = next(
+            vs for vs in vols if vs.store.get_volume(ecvid) is not None
+        )
+        post_json(f"{src.url}/admin/ec/generate", {"volume": ecvid},
+                  timeout=60)
+        post_json(f"{src.url}/admin/ec/delete_volume", {"volume": ecvid})
+        post_json(f"{src.url}/admin/ec/mount", {"volume": ecvid})
+        faults.arm("volume.ec.shard.read", "latency", ms=1)
+        key, _ = parse_key_hash_with_delta(v_ec["fid"].split(",")[1])
+        assert src.store.get_ec_volume(ecvid).read_needle(key).data \
+            .startswith(b"sealed-ec-needle")
+
+        # volume.fastlane.drain — the engine event drain (Python seam;
+        # the engine-side ABI hook degrades to it on a stale .so)
+        faults.arm("volume.fastlane.drain", "latency", ms=1)
+        if vols[0].fastlane is not None:
+            vols[0].fastlane.drain()
+        else:  # no native engine in this build: exercise the seam direct
+            faults.point("volume.fastlane.drain").hit()
+
+        faults.disarm_all()
+        for p in faults.ALL_POINTS:
+            assert fired(p) > before[p], f"fault point {p} never fired"
+
+        # ...and the injections are observable: the metric family counts
+        st, _, body = http_request("GET", f"{master.url}/metrics", timeout=10)
+        assert b"SeaweedFS_faults_injected_total" in body
+
+    def test_debug_faults_endpoint_on_every_role(self, cluster):
+        master, vols, env = cluster
+        for url in [master.url] + [vs.service.url for vs in vols]:
+            out = get_json(f"{url}/debug/faults")
+            assert set(out["declared"]) == set(faults.ALL_POINTS)
+        out = post_json(f"{master.url}/debug/faults", {
+            "action": "arm", "point": "master.lookup", "mode": "latency",
+            "ms": 1,
+        })
+        assert out["ok"]
+        assert "master.lookup" in faults.armed()
+        out = post_json(f"{master.url}/debug/faults",
+                        {"action": "disarm_all"})
+        assert out["disarmed"] == 1
+
+    def test_cluster_faults_verb(self, cluster):
+        master, vols, env = cluster
+        out = run_command(
+            env, "cluster.faults -arm master.assign -mode latency -ms 1"
+        )
+        assert "armed master.assign" in out
+        assert faults.armed()["master.assign"].ms == 1.0
+        listing = run_command(env, "cluster.faults -list")
+        assert "master.assign" in listing and "mode=latency" in listing
+        out = run_command(env, "cluster.faults -disarmAll")
+        assert "disarmed all" in out
+        assert faults.armed() == {}
+
+
+class TestHolderKilledMidReadStorm:
+    def test_reads_survive_holder_loss_and_daemon_heals(self, cluster):
+        """The acceptance scenario: kill a volume holder under a
+        concurrent read storm — >= 99% of reads succeed (retried via the
+        unified RetryPolicy, no client-visible failures), and the
+        maintenance daemon re-replicates within its budget."""
+        master, vols, env = cluster
+        blobs = {}
+        for i in range(12):
+            a = assign(master, replication="010", collection="storm")
+            url = f"http://{a['publicUrl']}/{a['fid']}"
+            data = f"storm-{i}-".encode() * 60
+            st, _, _ = http_request("POST", url, data)
+            assert st == 201
+            blobs[a["fid"]] = data
+        post_json(f"{master.url}/maintenance/enable")
+
+        wc = WeedClient(master.url, cache_ttl=2.0)
+        results = {"ok": 0, "bad": 0, "wrong": 0}
+        res_lock = threading.Lock()
+        stop_at = time.time() + 4.0
+        fids = list(blobs)
+
+        def reader(seed: int) -> None:
+            i = seed
+            while time.time() < stop_at:
+                fid = fids[i % len(fids)]
+                i += 1
+                try:
+                    data = wc.fetch(fid)
+                except Exception:
+                    with res_lock:
+                        results["bad"] += 1
+                    continue
+                with res_lock:
+                    if data == blobs[fid]:
+                        results["ok"] += 1
+                    else:
+                        results["wrong"] += 1
+
+        threads = [
+            threading.Thread(target=reader, args=(s,), daemon=True)
+            for s in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # storm running against a healthy cluster...
+        victim = next(
+            vs for vs in vols
+            if any(vs.store.has_volume(int(f.split(",")[0])) for f in fids)
+        )
+        victim_vids = {
+            int(f.split(",")[0]) for f in fids
+            if victim.store.has_volume(int(f.split(",")[0]))
+        }
+        victim.stop()  # ...then a holder dies mid-storm
+        for t in threads:
+            t.join(timeout=30)
+        total = results["ok"] + results["bad"] + results["wrong"]
+        assert total > 50, f"storm too small to mean anything: {results}"
+        assert results["wrong"] == 0, results
+        assert results["ok"] / total >= 0.99, results
+
+        # the daemon heals: every storm volume back to 2 live holders
+        def healed() -> bool:
+            live = {}
+            for sv in env.servers():
+                for vid in sv.volumes:
+                    live[vid] = live.get(vid, 0) + 1
+            return all(live.get(vid, 0) >= 2 for vid in victim_vids)
+
+        wait_until(healed, timeout=40, msg="re-replication after holder loss")
+        # steady state restored: reads serve clean with zero faults armed
+        assert faults.armed() == {}
+        for fid, data in list(blobs.items())[:3]:
+            assert wc.fetch(fid) == data
+
+
+class TestTornParityWrite:
+    def test_torn_parity_healed_by_daemon(self, cluster):
+        """Arm a torn parity write on a live online-EC volume: reads keep
+        serving off the intact .dat, the holder's heartbeat reports the
+        damage, and the daemon's online ec_rebuild re-arms the striper +
+        re-encodes from the durable .dat within its budget."""
+        master, vols, env = cluster
+        a = assign(master, collection="hot")
+        vid = int(a["fid"].split(",")[0])
+        hv = next(vs for vs in vols if vs.store.get_volume(vid) is not None)
+        url = f"http://{a['publicUrl']}/{a['fid']}"
+        payload = os.urandom(BLOCK * 10 * 3)
+        assert http_request("POST", url, payload)[0] == 201
+        if hv.fastlane:
+            hv.fastlane.drain()
+        v = hv.store.get_volume(vid)
+        v.online_ec.pump(force=True)
+        assert v.online_ec.parity_health() == 0
+
+        faults.arm("volume.ec.parity.write", "torn", frac=1.0, count=1)
+        from seaweedfs_tpu.storage.needle import Needle
+
+        # feed the next stripe, then pump: the encode lands, THEN the
+        # injected tear chops the durable parity tail (crash mid-append)
+        v.write_needle(
+            Needle(cookie=0x99, id=999991, data=os.urandom(BLOCK * 10))
+        )
+        v.online_ec.pump(force=True)
+        faults.disarm_all()
+        assert v.online_ec.parity_health() >= 1
+
+        # reads never noticed: the .dat is intact
+        st, _, body = http_request("GET", url)
+        assert st == 200 and body == payload
+
+        post_json(f"{master.url}/maintenance/enable")
+        hv.heartbeat_once()  # deliver the damage audit
+        wait_until(
+            lambda: v.online_ec.parity_health() == 0
+            and v.online_ec.active,
+            timeout=30, msg="online parity rearm+re-encode",
+        )
+        st_hist = get_json(f"{master.url}/debug/maintenance")
+        applied = [
+            line
+            for e in st_hist.get("history", [])
+            if e["task"]["type"] == "ec_rebuild"
+            for line in e.get("applied", [])
+        ]
+        assert any("parity re-encoded" in a for a in applied), st_hist
+        # and the parity is REAL: a .dat corruption now degrades cleanly
+        # (query-string GET rides the Python path, whose CRC check trips
+        # the reconstruction; counted in degraded_reads_total)
+        key, _ = parse_key_hash_with_delta(a["fid"].split(",")[1])
+        nv = v.nm.get(key)
+        with open(v.base_name + ".dat", "r+b") as f:
+            f.seek(nv[0] + 30)
+            f.write(b"\xff" * 16)
+        st, _, body = http_request("GET", url + "?degraded=1")
+        assert st == 200 and body == payload
+
+
+class TestPartitionedHeartbeat:
+    def test_partition_evacuates_ec_shards_then_rejoins(self, tmp_path):
+        """Partition ONE node's heartbeats (key-scoped fault): the master
+        sees staleness, the evacuate executor pre-copies the node's EC
+        shards from the still-serving node (the PR-5 gap: no more
+        waiting for expiry + ec_rebuild), and disarming lets the node
+        rejoin."""
+        master = MasterServer(port=0, pulse_seconds=2,
+                              volume_size_limit_mb=64,
+                              maintenance_interval=0.3)
+        master.start()
+        vols = []
+        try:
+            for i, rack in enumerate(["r1", "r2", "r3"]):
+                vs = VolumeServer(
+                    [str(tmp_path / f"v{i}")], master.url, port=0, rack=rack,
+                    pulse_seconds=1, max_volume_count=30,
+                )
+                vs.start()
+                vols.append(vs)
+            env = CommandEnv(master.url)
+            a = assign(master)
+            vid = int(a["fid"].split(",")[0])
+            http_request(
+                "POST", f"http://{a['publicUrl']}/{a['fid']}",
+                b"evac-me " * 200,
+            )
+            run_command(env, "lock")
+            run_command(env, f"ec.encode -volumeId {vid}")
+            run_command(env, "unlock")
+            victim = max(
+                vols, key=lambda vs: len(
+                    vs.store.get_ec_volume(vid).shard_ids()
+                    if vs.store.get_ec_volume(vid) else []
+                ),
+            )
+            victim_id = f"{victim._host}:{victim.data_port}"
+            victim_shards = set(
+                victim.store.get_ec_volume(vid).shard_ids()
+            )
+            assert victim_shards
+            post_json(f"{master.url}/maintenance/enable")
+            # partition exactly the victim's heartbeats
+            faults.arm("volume.heartbeat.send", "partition", key=victim_id)
+
+            def shards_covered_elsewhere() -> bool:
+                have = set()
+                for sv in env.servers():
+                    if sv.id == victim_id:
+                        continue
+                    have.update(sv.ec_shards.get(vid, []))
+                return victim_shards <= have
+
+            wait_until(shards_covered_elsewhere, timeout=40,
+                       msg="EC shard pre-copy off the partitioned node")
+            st = get_json(f"{master.url}/debug/maintenance")
+            evac = [
+                line
+                for e in st.get("history", [])
+                if e["task"]["type"] == "evacuate"
+                for line in e.get("applied", [])
+            ]
+            assert any("ec volume" in a for a in evac), st
+
+            # heal the partition: the node heartbeats again and rejoins
+            faults.disarm_all()
+            victim.heartbeat_once()
+            wait_until(
+                lambda: any(
+                    sv.id == victim_id for sv in env.servers()
+                ),
+                timeout=15, msg="partitioned node rejoining",
+            )
+        finally:
+            faults.disarm_all()
+            for vs in vols:
+                vs.stop()
+            master.stop()
+
+
+class TestDisarmAllSteadyState:
+    def test_disarm_all_restores_zero_injection(self, cluster):
+        master, vols, env = cluster
+        faults.arm("volume.read.dat", "latency", ms=1)
+        faults.arm("master.assign", "latency", ms=1)
+        a = assign(master)  # fires
+        assert faults.disarm_all() == 2
+        counts = {p: fired(p) for p in faults.ALL_POINTS}
+        # a post-disarm workload injects NOTHING
+        for i in range(5):
+            a = assign(master)
+            url = f"http://{a['publicUrl']}/{a['fid']}"
+            assert http_request("POST", url, b"steady " * 50)[0] == 201
+            st, _, body = http_request("GET", url + "?steady=1")
+            assert st == 200 and body == b"steady " * 50
+        assert {p: fired(p) for p in faults.ALL_POINTS} == counts
+        assert faults.armed() == {}

@@ -358,6 +358,21 @@ class TestMetricNameLint:
         assert kinds["SeaweedFS_volume_ec_online_fallbacks_total"] \
             == "counter"
         assert tool.ec_online_reason_violations() == []
+        # PR-9: fault-injection + degraded-read families and the
+        # fault-point/reason registries (every declared point registered
+        # by a seam AND exercised by tests/test_chaos.py)
+        assert kinds["SeaweedFS_faults_injected_total"] == "counter"
+        assert kinds["SeaweedFS_volume_degraded_reads_total"] == "counter"
+        assert tool.fault_point_violations() == []
+        assert tool.degraded_reason_violations() == []
+
+    def test_fault_point_name_convention(self):
+        tool = self._tool()
+        assert tool.FAULT_POINT_RE.match("volume.read.dat")
+        assert tool.FAULT_POINT_RE.match("master.assign")
+        for bad in ("volume", "Volume.read", "volume..read", "volume.Read",
+                    "volume.read-", ".read", "volume.5x"):
+            assert not tool.FAULT_POINT_RE.match(bad), bad
 
     def test_task_type_lint_catches_violations(self, monkeypatch):
         from seaweedfs_tpu import maintenance

@@ -36,8 +36,13 @@ import sys
 NAME_RE = re.compile(
     r"^SeaweedFS_"
     r"(master|volume|filer|s3|http|stats|mount|mq|iam|alerts|process"
-    r"|maintenance)_"
+    r"|maintenance|faults)_"
     r"[a-z][a-z0-9]*(_[a-z0-9]+)*$"
+)
+
+# fault-point names: dotted lowercase, at least two segments
+FAULT_POINT_RE = re.compile(
+    r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$"
 )
 
 # Prometheus build-info convention: no subsystem segment
@@ -70,6 +75,11 @@ def collect() -> tuple[dict[str, str], list[str]]:
 
     ec_online.ensure_metrics()  # SeaweedFS_volume_ec_online_* families
     maintenance.ensure_metrics()  # SeaweedFS_maintenance_* families
+    from seaweedfs_tpu.storage.volume import degraded_reads_counter
+    from seaweedfs_tpu.util import faults as faults_mod
+
+    faults_mod._injected_counter()  # SeaweedFS_faults_injected_total
+    degraded_reads_counter()  # SeaweedFS_volume_degraded_reads_total
     svc = HTTPService(port=0)  # never started: registration side effect only
     svc.enable_metrics("lint", serve_route=False)
     reg = default_registry()
@@ -194,6 +204,72 @@ def ec_online_reason_violations() -> list[str]:
     return bad
 
 
+def fault_point_violations() -> list[str]:
+    """Fault-point names become the `point` label of
+    SeaweedFS_faults_injected_total AND the chaos suite's coverage
+    contract — lint them: unique dotted lowercase, every DECLARED point
+    registered by a real seam (importing the seam modules), and every
+    point exercised by tests/test_chaos.py (a fault nobody injects in
+    the suite is a fault nobody proved survivable)."""
+    from seaweedfs_tpu.util import faults
+
+    bad: list[str] = []
+    seen: set[str] = set()
+    for name in faults.ALL_POINTS:
+        if not FAULT_POINT_RE.match(name):
+            bad.append(f"fault point {name!r}: not dotted lowercase")
+        if name in seen:
+            bad.append(f"fault point {name!r}: duplicate")
+        seen.add(name)
+    # importing the seam modules registers their points; collect()
+    # already pulled in the servers, but run standalone-safe here
+    import seaweedfs_tpu.filer.wdclient  # noqa: F401
+    import seaweedfs_tpu.server.master  # noqa: F401
+    import seaweedfs_tpu.server.volume  # noqa: F401
+    import seaweedfs_tpu.storage.erasure_coding.ec_volume  # noqa: F401
+    import seaweedfs_tpu.storage.erasure_coding.online  # noqa: F401
+    import seaweedfs_tpu.storage.fastlane  # noqa: F401
+    import seaweedfs_tpu.storage.volume  # noqa: F401
+
+    registered = set(faults.registered_points())
+    for name in sorted(set(faults.ALL_POINTS) - registered):
+        bad.append(f"fault point {name!r}: declared but no seam registers it")
+    for name in sorted(registered - set(faults.ALL_POINTS)):
+        bad.append(f"fault point {name!r}: registered but not declared")
+    chaos = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "test_chaos.py",
+    )
+    try:
+        with open(chaos) as f:
+            chaos_src = f.read()
+    except OSError:
+        return bad + ["tests/test_chaos.py missing: every fault point must"
+                      " be exercised by the chaos suite"]
+    for name in faults.ALL_POINTS:
+        if name not in chaos_src:
+            bad.append(f"fault point {name!r}: not exercised by"
+                       f" tests/test_chaos.py")
+    return bad
+
+
+def degraded_reason_violations() -> list[str]:
+    """Degraded-read reasons ride into the `reason` label of
+    SeaweedFS_volume_degraded_reads_total (and the degraded_reads alert
+    sums over them) — lint them like the other reason sets."""
+    from seaweedfs_tpu.storage.volume import DEGRADED_READ_REASONS
+
+    bad: list[str] = []
+    seen: set[str] = set()
+    for name in DEGRADED_READ_REASONS:
+        if not ALERT_RULE_RE.match(name):
+            bad.append(f"degraded-read reason {name!r}: not snake_case")
+        if name in seen:
+            bad.append(f"degraded-read reason {name!r}: duplicate")
+        seen.add(name)
+    return bad
+
+
 def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
     bad: list[str] = []
     for name in sorted(set(kinds) | set(collector_names)):
@@ -217,7 +293,8 @@ def main() -> int:
     kinds, collector_names = collect()
     bad = violations(kinds, collector_names) + alert_rule_violations() \
         + task_type_violations() + front_reason_violations() \
-        + ec_online_reason_violations()
+        + ec_online_reason_violations() + fault_point_violations() \
+        + degraded_reason_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
         print(f"{len(bad)} metric-name violation(s) in {total} families:")
