@@ -1040,6 +1040,121 @@ def maintenance_summary(trials: int = 2, blobs: int = 8) -> dict:
     return out
 
 
+def _repair_wire_bytes() -> dict:
+    """Current SeaweedFS_volume_ec_repair_bytes_on_wire_total{mode} values
+    off the shared in-process registry (every server in a bench cluster
+    shares it, so the counters sum cluster-wide traffic)."""
+    from seaweedfs_tpu.stats import default_registry
+
+    out = {"classic": 0.0, "pipelined": 0.0}
+    for line in default_registry().render().splitlines():
+        if line.startswith("SeaweedFS_volume_ec_repair_bytes_on_wire_total{"):
+            for mode in out:
+                if f'mode="{mode}"' in line:
+                    out[mode] = float(line.rsplit(" ", 1)[1])
+    return out
+
+
+def rebuild_bandwidth_summary(blobs: int = 8) -> dict:
+    """PR-11: repair bandwidth per shard rebuild, classic vs pipelined.
+    A 4-node cluster EC-encodes a volume (4 nodes so the partial-sum
+    chain has >= 3 hops and headroom for a restart), then per mode the
+    maintenance daemon (rebuildMode forced) heals one injected shard
+    loss under its own scheduler/token-bucket pacing — the PR-9 chaos
+    harness's heal path. Records bytes-on-wire moved per mode (the
+    counter the volume servers increment at every repair payload
+    receipt) and the daemon's time-to-heal per mode: the regenerating-
+    code claim (arXiv:1412.3022) measured, not assumed."""
+    import tempfile
+
+    from seaweedfs_tpu.server.httpd import get_json, http_request, post_json
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+
+    d = os.path.join(BENCH_DIR, "rebuild_bandwidth")
+    os.makedirs(d, exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=d)
+    master = MasterServer(port=0, pulse_seconds=1, volume_size_limit_mb=64,
+                          maintenance_interval=0.25)
+    master.start()
+    vols = []
+    out: dict = {}
+    try:
+        for i in range(4):
+            vs = VolumeServer(
+                [os.path.join(tmp, f"v{i}")], master.url, port=0,
+                rack=f"r{i}", pulse_seconds=1, max_volume_count=30,
+            )
+            vs.start()
+            vols.append(vs)
+        env = CommandEnv(master.url)
+        fids = []
+        for i in range(blobs):
+            a = get_json(f"{master.url}/dir/assign")
+            http_request("POST", f"http://{a['publicUrl']}/{a['fid']}",
+                         b"b" * 40000)
+            fids.append(a["fid"])
+        vid = int(fids[0].split(",")[0])
+        run_command(env, "lock")
+        run_command(env, f"ec.encode -volumeId {vid}")
+        run_command(env, "unlock")
+
+        def shard_count() -> int:
+            return len({
+                s for sv in env.servers() for s in sv.ec_shards.get(vid, [])
+            })
+
+        shard_sizes = [
+            os.path.getsize(os.path.join(root, name))
+            for root, _, names in os.walk(tmp) for name in names
+            if name.endswith(".ec00")
+        ]
+        if shard_sizes:
+            out["shard_size"] = shard_sizes[0]
+        for mode in ("classic", "pipelined"):
+            post_json(f"{master.url}/maintenance/enable",
+                      {"rebuildMode": mode})
+            holders = [sv for sv in env.servers() if sv.ec_shards.get(vid)]
+            victim = min(holders, key=lambda sv: len(sv.ec_shards[vid]))
+            lost = list(victim.ec_shards[vid])[:1]
+            before = _repair_wire_bytes()
+            t0 = time.time()
+            env.post(
+                f"{victim.http}/admin/ec/delete_shards",
+                {"volume": vid, "shards": lost, "delete_index": False},
+            )
+            # the loss must surface in topology before the heal is timed
+            # (same guard as maintenance_summary: no phantom heals)
+            seen_loss = False
+            while time.time() < t0 + 10:
+                if shard_count() < 14:
+                    seen_loss = True
+                    break
+                time.sleep(0.05)
+            if not seen_loss:
+                out[f"rebuild_{mode}"] = {"error": "loss never surfaced"}
+                continue
+            while time.time() < t0 + 90 and shard_count() < 14:
+                time.sleep(0.1)
+            healed = shard_count() == 14
+            delta = _repair_wire_bytes()
+            out[f"rebuild_bytes_on_wire_{mode}"] = int(
+                delta[mode] - before[mode])
+            if healed:
+                out[f"time_to_heal_{mode}_s"] = round(time.time() - t0, 3)
+            post_json(f"{master.url}/maintenance/disable")
+        cw = out.get("rebuild_bytes_on_wire_classic", 0)
+        pw = out.get("rebuild_bytes_on_wire_pipelined", 0)
+        if cw and pw:
+            out["wire_cut_ratio"] = round(cw / pw, 2)
+    finally:
+        for vs in vols:
+            vs.stop()
+        master.stop()
+    return out
+
+
 def availability_summary(
     outage_s: float = 10.0, blobs: int = 60, readers: int = 4,
 ) -> dict:
@@ -1405,6 +1520,13 @@ def main() -> None:
         detail["availability_under_fault"] = availability_summary()
     except Exception as e:
         detail["availability_under_fault"] = {"error": str(e)[:120]}
+    # PR-11: repair bandwidth — bytes-on-wire per shard rebuild, classic
+    # whole-shard pulls vs pipelined partial-sum chains, with the
+    # maintenance daemon's per-mode time-to-heal
+    try:
+        detail["rebuild_bandwidth"] = rebuild_bandwidth_summary()
+    except Exception as e:
+        detail["rebuild_bandwidth"] = {"error": str(e)[:120]}
     # end-of-run per-kernel attribution over EVERYTHING this process ran
     # (verb trials + rebuild + hash benches), from the shared registry
     try:

@@ -86,6 +86,7 @@ class MaintenanceDaemon:
         scheduler: RepairScheduler | None = None,
         history_size: int = 128,
         registry=None,
+        rebuild_mode: str = "auto",
     ) -> None:
         self.master = master
         self.interval = (
@@ -93,6 +94,10 @@ class MaintenanceDaemon:
             else float(max(master.topo.pulse_seconds, 1))
         )
         self.dry_run = bool(dry_run)
+        # ec_rebuild default mode: auto (per-task choice off holder count
+        # + scheduler pressure) | pipelined | classic. Runtime-settable
+        # via POST /maintenance/enable {"rebuildMode": ...}.
+        self.rebuild_mode = rebuild_mode
         self.enabled = True
         self.scheduler = scheduler or RepairScheduler()
         self.registry = registry if registry is not None else default_registry()
@@ -299,7 +304,9 @@ class MaintenanceDaemon:
                 self._acquire_lease(env)
             try:
                 detail = executors_mod.execute(
-                    task, env, dry_run=self.dry_run
+                    task, env, dry_run=self.dry_run,
+                    scheduler=self.scheduler,
+                    rebuild_mode=self.rebuild_mode,
                 )
             finally:
                 if not self.dry_run:

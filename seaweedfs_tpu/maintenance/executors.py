@@ -11,11 +11,7 @@ the task failed (the scheduler arms backoff).
 
 from __future__ import annotations
 
-from seaweedfs_tpu.shell.commands_ec import (
-    apply_rebuild,
-    describe_rebuild,
-    plan_rebuild,
-)
+from seaweedfs_tpu.shell.commands_ec import run_rebuild
 from seaweedfs_tpu.shell.commands_volume import (
     apply_balance,
     apply_fix_replication,
@@ -44,18 +40,51 @@ def _exec_fix_replication(task: RepairTask, env, dry_run: bool) -> dict:
             "applied": apply_fix_replication(env, actions)}
 
 
-def _exec_ec_rebuild(task: RepairTask, env, dry_run: bool) -> dict:
+def _exec_ec_rebuild(task: RepairTask, env, dry_run: bool,
+                     scheduler=None, rebuild_mode: str = "auto") -> dict:
+    """Rebuild missing shards, choosing pipelined partial-sum chains vs
+    classic whole-shard pulls per task: an explicit task/daemon mode
+    wins, else `auto` decides from the surviving-holder count and the
+    scheduler's live pressure (token bucket + in-flight caps). The whole
+    choose + apply + typed-fallback path is run_rebuild — shared with
+    the ec.rebuild verb so both entry points repair identically and
+    feed the same fallbacks/restarts metric series."""
     if task.params.get("online"):
         return _exec_ec_rebuild_online(task, env, dry_run)
-    plan = plan_rebuild(env, task.volume_id, task.collection)
-    if plan is None:  # healed between detection and dispatch
-        return {"planned": [], "applied": []}
-    planned = describe_rebuild(plan)
-    if dry_run:
-        return {"planned": planned}
-    rebuilt = apply_rebuild(env, plan)
-    return {"planned": planned,
-            "applied": [f"rebuilt shards {rebuilt} on {plan['rebuilder']}"]}
+    pressure = None
+    if scheduler is not None:
+        pressure = scheduler.pressure()
+        # discount THIS task: the scheduler already counted it in flight
+        # (and against its node's limit) when it dispatched us, so the
+        # raw reading would report a busy node/cluster even when this
+        # repair is the only thing running — making the 2-hop
+        # idle-cluster -> classic branch unreachable
+        pressure["in_flight"] = max(0, pressure["in_flight"] - 1)
+        if task.node and pressure["node_inflight"].get(task.node, 0) > 0:
+            pressure["node_inflight"][task.node] -= 1
+    mode = task.params.get("mode") or rebuild_mode or "auto"
+    out = run_rebuild(
+        env, task.volume_id, task.collection, mode=mode,
+        pressure=pressure, dry_run=dry_run,
+    )
+    if out.get("healed"):  # healed between detection and dispatch
+        return {"planned": out["planned"], "applied": []}
+    if out.get("dry_run"):
+        return {"planned": out["planned"]}
+    stats = out.get("stats")
+    if stats is not None:
+        applied = (
+            f"rebuilt shards {out['rebuilt']} on {out['rebuilder']}"
+            f" (pipelined, {stats['hops']} hops,"
+            f" {stats['bytes_on_wire_rebuilder']} B at rebuilder,"
+            f" {stats['restarts']} chain restart(s))"
+        )
+    else:
+        applied = (
+            f"rebuilt shards {out['rebuilt']} on {out['rebuilder']}"
+            f" (classic)"
+        )
+    return {"planned": out["planned"], "applied": [applied]}
 
 
 def _exec_ec_rebuild_online(task: RepairTask, env, dry_run: bool) -> dict:
@@ -119,7 +148,19 @@ def _plan_evacuate(env, node_id: str) -> list[dict]:
     actions = []
     for vid in sorted(stale.volumes):
         others = [sv for sv in healthy if vid in sv.volumes]
-        if not others:
+        if others:
+            src = others[0]
+        elif stale.volumes[vid].get("ec_online"):
+            # a LIVE online-EC volume is single-holder BY DESIGN (its
+            # redundancy is the streamed parity, which cannot be copied
+            # usefully) — pull the .dat/.idx/.vif from the draining node
+            # itself, exactly like the EC-shard pre-copy below: stale
+            # nodes are often alive-but-slow, and the receiver's
+            # /admin/volume/copy re-arms the striper + re-encodes parity
+            # from byte 0 on arrival. A truly dead source fails the
+            # copy into backoff; nothing is lost by trying.
+            src = stale
+        else:
             actions.append({"volume": vid, "source": None, "target": None})
             continue
         ranked = sorted(
@@ -128,12 +169,12 @@ def _plan_evacuate(env, node_id: str) -> list[dict]:
             key=lambda sv: -sv.free_slots(),
         )
         if not ranked:
-            actions.append({"volume": vid, "source": others[0].id,
+            actions.append({"volume": vid, "source": src.id,
                             "target": None})
             continue
         dst = ranked[0]
-        actions.append({"volume": vid, "source": others[0].id,
-                        "source_url": others[0].http,
+        actions.append({"volume": vid, "source": src.id,
+                        "source_url": src.http,
                         "target": dst.id, "target_url": dst.http})
         dst.volumes[vid] = stale.volumes[vid]  # keep the local view fresh
     for vid in sorted(stale.ec_shards):
@@ -207,16 +248,21 @@ def _exec_evacuate(task: RepairTask, env, dry_run: bool) -> dict:
     for a in actions:
         if a.get("target") is None or a.get("source") is None:
             continue
+        # explicit deadline budgets (the bare-call-site audit): shard and
+        # volume pulls can be multi-GB (the receiver's ranged GETs retry
+        # under the unified RetryPolicy), mounts are quick metadata ops
         if a.get("ec_volume") is not None:
             vid = a["ec_volume"]
             env.post(
                 f"{a['target_url']}/admin/ec/copy",
                 {"volume": vid, "collection": a.get("collection", ""),
                  "shards": a["shards"], "source": a["source_url"]},
+                timeout=3600,
             )
             env.post(
                 f"{a['target_url']}/admin/ec/mount",
                 {"volume": vid, "collection": a.get("collection", "")},
+                timeout=60,
             )
             applied.append(
                 f"ec volume {vid}: copied shards {a['shards']}"
@@ -226,6 +272,7 @@ def _exec_evacuate(task: RepairTask, env, dry_run: bool) -> dict:
         env.post(
             f"{a['target_url']}/admin/volume/copy",
             {"volume": a["volume"], "source": a["source_url"]},
+            timeout=3600,
         )
         applied.append(
             f"volume {a['volume']}: copied {a['source']} -> {a['target']}"
@@ -242,15 +289,21 @@ EXECUTORS = {
 }
 
 
-def execute(task: RepairTask, env, dry_run: bool = False) -> dict:
+def execute(task: RepairTask, env, dry_run: bool = False,
+            scheduler=None, rebuild_mode: str = "auto") -> dict:
     """Run one task's executor; every repair is traced as a
     `maintenance.<type>` span so /debug/traces and cluster.trace show
-    healing next to the foreground traffic it must not starve."""
+    healing next to the foreground traffic it must not starve.
+    `scheduler`/`rebuild_mode` feed the ec_rebuild mode choice (live
+    dispatch pressure + the daemon's configured default)."""
     from seaweedfs_tpu.stats import trace
 
     fn = EXECUTORS[task.type]
+    kwargs = {}
+    if task.type == "ec_rebuild":
+        kwargs = {"scheduler": scheduler, "rebuild_mode": rebuild_mode}
     with trace.span(
         f"maintenance.{task.type}", role="master",
         volume=task.volume_id, node=task.node, dry_run=dry_run,
     ):
-        return fn(task, env, dry_run)
+        return fn(task, env, dry_run, **kwargs)

@@ -187,6 +187,23 @@ class RepairScheduler:
             return delay
 
     # --- views ----------------------------------------------------------------
+    def pressure(self, now: float | None = None) -> dict:
+        """Live dispatch pressure for per-task policy decisions — the
+        ec_rebuild executor picks pipelined vs classic partly off this
+        (a drained token bucket / saturated in-flight caps mean repairs
+        are contending, so spreading one rebuild's GF math and wire load
+        across the chain beats concentrating it on one node)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._refill(now)
+            return {
+                "tokens": self._tokens,
+                "in_flight": len(self._in_flight),
+                "global_limit": self.global_limit,
+                "per_node_limit": self.per_node_limit,
+                "node_inflight": dict(self._node_inflight),
+            }
+
     def queue_depths(self) -> dict[str, dict[str, int]]:
         """{task_type: {queued, in_flight}} for the metrics collector."""
         with self._lock:

@@ -404,6 +404,7 @@ struct BackendConn {
     size_t chunk_pos = 0;     // chunked scan cursor
     bool backend_close = false;
     bool retried = false;
+    bool from_pool = false;   // current fd came from the idle keep-alive pool
     time_t started = 0;
     uint64_t start_ns = 0;    // mono_ns at proxy launch (latency metrics)
     uint32_t target_ip = 0;   // 0 = engine's default Python backend
@@ -1422,6 +1423,7 @@ bool backend_launch(Engine* E, Worker* w, BackendConn* b) {
         }
         b->fd = fd;
         b->ssl = ssl;
+        b->from_pool = pooled;
         b->req_off = 0;
         b->resp.clear();
         b->hdr_end = 0;
@@ -1463,6 +1465,28 @@ bool backend_launch(Engine* E, Worker* w, BackendConn* b) {
     }
 }
 
+// Connection is hop-by-hop (RFC 7230 §6.1): forwarding a client's
+// "Connection: close" verbatim makes the Python backend close its side
+// AFTER responding — without advertising close in the response — so the
+// engine pools a socket that is already dying. Enough close-mode clients
+// (urllib sends it on every request) turn the whole idle pool into
+// corpses, and a proxied request that pops two in a row 502s. Rewrite
+// the header to keep-alive on the backend hop; the client-side close is
+// the engine's own business.
+void rewrite_hop_connection(std::string& req) {
+    size_t he = req.find("\r\n\r\n");
+    if (he == std::string::npos) return;
+    for (size_t pos = req.find("\r\n"); pos < he;
+         pos = req.find("\r\n", pos + 2)) {
+        size_t ls = pos + 2;
+        if (ls + 11 > he) break;
+        if (strncasecmp(req.data() + ls, "connection:", 11) != 0) continue;
+        size_t le = req.find("\r\n", ls);
+        req.replace(ls, le - ls, "Connection: keep-alive");
+        return;
+    }
+}
+
 // bypass_cap: long-poll endpoints (meta subscriptions) park cheaply in a
 // Python thread for up to 30s — counting them against the backend cap
 // would let a couple of subscribers starve every other request
@@ -1471,6 +1495,7 @@ void proxy_request(Engine* E, Worker* w, Conn* c, const char* req, size_t len,
     auto* b = new BackendConn();
     b->client = c;
     b->req.assign(req, len);
+    rewrite_hop_connection(b->req);
     b->started = time(nullptr);
     b->start_ns = mono_ns();
     b->counted = !bypass_cap;
@@ -1650,10 +1675,15 @@ void on_backend_event(Engine* E, Worker* w, BackendConn* b, uint32_t events) {
         return;
     }
     if (err || eof) {
-        // nothing usable arrived — retry once on a fresh conn (a pooled
-        // keep-alive socket may have died between requests)
-        if (b->resp.empty() && !b->retried) {
-            b->retried = true;
+        // nothing usable arrived — relaunch. A POOLED keep-alive socket
+        // dying between requests is routine (the peer may close after
+        // responding without having advertised Connection: close), and
+        // the pool can hold SEVERAL such corpses at once, so pooled
+        // deaths retry for as long as the launch keeps drawing from the
+        // pool; only a FRESH connection gets exactly one retry before
+        // the 502 — that one really means the backend is unavailable.
+        if (b->resp.empty() && (b->from_pool || !b->retried)) {
+            if (!b->from_pool) b->retried = true;
             epoll_ctl(w->epfd, EPOLL_CTL_DEL, b->fd, nullptr);
             back_free_ssl(b);
             close(b->fd);

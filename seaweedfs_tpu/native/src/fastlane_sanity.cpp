@@ -228,7 +228,11 @@ int main() {
     }
     // Python-side style interleaving: drains, flag flips, lock/tail hooks
     std::thread admin([&] {
-        uint8_t evbuf[40 * 256];
+        // 48 = sizeof(Event) in fastlane.cpp (grew from 40 when trace_id
+        // was added): a 40B/event buffer overflows whenever >= 214 events
+        // back up between drains — which the hammering workers on a slow
+        // box absolutely produce (ASan caught exactly that)
+        uint8_t evbuf[48 * 256];
         for (int i = 0; i < 300; i++) {
             sw_fl_drain_events(h, evbuf, 256);
             sw_fl_set_flags(h, 7, 0, 0);

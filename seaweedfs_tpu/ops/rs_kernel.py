@@ -149,6 +149,16 @@ class RSCodec:
         return "numpy"
 
     # --- core ---------------------------------------------------------------
+    def apply_matrix(self, matrix: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        """Public arbitrary-matrix transform: out[r] = XOR_c matrix[r,c] x
+        shards[c] on this codec's backend. The partial-sum repair path
+        (erasure_coding/decoder.py) scales a holder's local shards with
+        exactly this call — the same kernel encode/reconstruct use."""
+        return self._apply(
+            np.ascontiguousarray(matrix, dtype=np.uint8),
+            np.ascontiguousarray(shards, dtype=np.uint8),
+        )
+
     def _apply(self, matrix: np.ndarray, shards: np.ndarray) -> np.ndarray:
         if self.backend == "jax":
             return np.asarray(gf_matmul_jax(matrix, shards))

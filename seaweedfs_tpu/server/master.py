@@ -127,14 +127,16 @@ class MasterServer:
         if self._maintenance_flag:
             self._ensure_maintenance(dry_run=self._maintenance_dry_run)
 
-    def _ensure_maintenance(self, dry_run: bool | None = False):
+    def _ensure_maintenance(self, dry_run: bool | None = False,
+                            rebuild_mode: str | None = None):
         """Create (or reconfigure) and start the maintenance daemon — the
         `-maintenance` flag at boot, or `cluster.maintenance -enable` at
         runtime. dry_run=None preserves the daemon's current mode: a bare
         re-enable must not silently flip a dry-run daemon into mutating
-        mode. Locked: two racing /maintenance/enable requests must not
-        each start (and one leak) a daemon, and an enable racing stop()
-        must not start a daemon that outlives the master."""
+        mode (rebuild_mode=None likewise). Locked: two racing
+        /maintenance/enable requests must not each start (and one leak) a
+        daemon, and an enable racing stop() must not start a daemon that
+        outlives the master."""
         with self._maintenance_lock:
             if self._stop.is_set():
                 raise RuntimeError("master is stopping")
@@ -144,12 +146,15 @@ class MasterServer:
                 daemon = MaintenanceDaemon(
                     self, interval=self._maintenance_interval,
                     dry_run=bool(dry_run),
+                    rebuild_mode=rebuild_mode or "auto",
                 )
                 daemon.start()
                 self.maintenance = daemon
             else:
                 if dry_run is not None:
                     self.maintenance.dry_run = bool(dry_run)
+                if rebuild_mode is not None:
+                    self.maintenance.rebuild_mode = rebuild_mode
                 self.maintenance.enabled = True
             return self.maintenance
 
@@ -888,12 +893,19 @@ class MasterServer:
             # only an explicit true/false flips it (a bare re-enable must
             # not silently turn a plan-only daemon into a mutating one)
             dry = p.get("dryRun")
+            mode = p.get("rebuildMode")
+            if mode is not None and mode not in ("auto", "classic",
+                                                 "pipelined"):
+                return Response(
+                    {"error": f"rebuildMode {mode!r} not"
+                     f" auto|classic|pipelined"}, 400)
             d = self._ensure_maintenance(
-                dry_run=None if dry is None else bool(dry)
+                dry_run=None if dry is None else bool(dry),
+                rebuild_mode=mode,
             )
             return Response({
                 "ok": True, "enabled": True, "dry_run": d.dry_run,
-                "interval": d.interval,
+                "interval": d.interval, "rebuild_mode": d.rebuild_mode,
             })
 
         @svc.route("POST", r"/maintenance/disable")

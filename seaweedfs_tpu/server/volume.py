@@ -13,9 +13,12 @@ import re
 import threading
 import urllib.parse
 
+import numpy as np
+
 from seaweedfs_tpu.security import Guard, SecurityConfig
 from seaweedfs_tpu.security.jwt import token_from_request, verify_file_jwt
 from seaweedfs_tpu.storage import crc as crc_mod
+from seaweedfs_tpu.storage.erasure_coding import decoder as ec_decoder
 from seaweedfs_tpu.storage.erasure_coding import encoder as ec_encoder
 from seaweedfs_tpu.storage.erasure_coding import geometry
 from seaweedfs_tpu.storage.file_id import parse_key_hash_with_delta
@@ -36,6 +39,10 @@ _SAFE_EXT_RE = re.compile(r"\.(dat|idx|vif|ecx|ecj|ec\d\d)")
 # identity as the scope key so in-process test clusters can fault ONE node.
 _FP_HEARTBEAT = faults.register("volume.heartbeat.send")
 _FP_REPLICATE = faults.register("volume.replicate.fanout")
+# pipelined-rebuild hop seam: an `error` here kills one node's partial-sum
+# stage mid-chain — the orchestrator's retry ladder must restart the chain
+# minus this hop or fall back to classic whole-shard pulls
+_FP_PARTIAL = faults.register("repair.partial_fetch")
 
 
 class VolumeServer:
@@ -84,6 +91,12 @@ class VolumeServer:
         self.fastlane = None  # native data-plane front door when available
         self.local_socket = local_socket  # same-host unix listener
         self._metrics_collector = None  # registry handle (start/stop)
+        # in-flight pipelined rebuilds: vid -> {writers, targets, ...}.
+        # The orchestrator drives start -> partial chunks -> commit; a
+        # replaced/aborted state discards its tmp files (never a
+        # half-written file under a valid shard name).
+        self._partial_rebuilds: dict[int, dict] = {}
+        self._partial_lock = threading.Lock()
         self._routes()
 
     def _start_fastlane(self) -> None:
@@ -155,6 +168,10 @@ class VolumeServer:
             self.fastlane.stop()
             self.fastlane = None
         self.service.stop()
+        with self._partial_lock:  # orphaned rebuild tmp files die with us
+            for state in self._partial_rebuilds.values():
+                state["writers"].abort()
+            self._partial_rebuilds.clear()
         if self.store:
             self.store.close()
             self.store = None
@@ -474,11 +491,13 @@ class VolumeServer:
     def _attach_shard_fetcher(self, ev) -> None:
         """Give an EcVolume remote shard sourcing: master ec_lookup for
         locations, then /admin/ec/shard range reads off sibling servers
-        (`store_ec.go:281` readRemoteEcShardInterval)."""
+        (`store_ec.go:281` readRemoteEcShardInterval) — plus the
+        repair-bandwidth-optimal partial fan-in: one coefficient-scaled
+        range per HOLDER (not per shard) for interval reconstruction."""
         me = f"{self._host}:{self.data_port}"
         state = {"expires": 0.0, "shards": {}}
 
-        def fetch(shard_id: int, off: int, size: int) -> bytes | None:
+        def shard_map() -> dict:
             import time as _time
 
             now = _time.time()
@@ -489,7 +508,10 @@ class VolumeServer:
                 )
                 state["shards"] = info.get("shards", {})
                 state["expires"] = now + 10
-            for target in state["shards"].get(str(shard_id), []):
+            return state["shards"]
+
+        def fetch(shard_id: int, off: int, size: int) -> bytes | None:
+            for target in shard_map().get(str(shard_id), []):
                 if target == me:
                     continue
                 status, _, body = http_request(
@@ -502,7 +524,70 @@ class VolumeServer:
                     return body
             return None
 
+        def fetch_partials(missing: int, off: int, size: int) -> bytes | None:
+            """Reconstruct shard `missing`'s [off, off+size) range moving
+            one partial per remote holder over the wire instead of one
+            full range per shard (the ranged half of the pipelined-rebuild
+            plane; EcVolume._recover_interval falls back to the classic
+            fan-in ladder when any holder can't serve its partial)."""
+            smap = shard_map()
+            local = set(ev.shards)
+            present = sorted(
+                ({int(s) for s, holders in smap.items() if holders} | local)
+                - {missing}
+            )
+            if len(present) < geometry.DATA_SHARDS_COUNT:
+                return None
+            use, matrix = ec_decoder.repair_coefficients(present, [missing])
+            groups: dict[str, list[int]] = {}
+            local_use: list[int] = []
+            for sid in use:
+                if sid in local:
+                    local_use.append(sid)
+                    continue
+                holders = [t for t in smap.get(str(sid), []) if t != me]
+                if not holders:
+                    return None  # a use shard with no live holder
+                groups.setdefault(holders[0], []).append(sid)
+            acc = None
+            if local_use:
+                rows = []
+                for sid in local_use:
+                    data = ev._pread_shard(sid, off, size)
+                    if data is None:
+                        return None
+                    rows.append(np.frombuffer(data, dtype=np.uint8))
+                cols = [use.index(s) for s in local_use]
+                acc = ec_decoder.xor_partials(acc, ec_decoder.partial_contribution(
+                    matrix[:, cols], np.stack(rows), ev.codec
+                ))
+            for target, sids in groups.items():
+                coefs = {
+                    str(s): [int(matrix[0, use.index(s)])] for s in sids
+                }
+                url = (
+                    peer_url(target) + f"/admin/ec/partial"
+                    f"?volume={ev.volume_id}"
+                    f"&collection={urllib.parse.quote(ev.collection)}"
+                    f"&offset={off}&size={size}&targets={missing}"
+                    f"&coefs={urllib.parse.quote(json.dumps(coefs))}"
+                )
+                status, hdrs, body = http_request(
+                    "POST", url, b"", timeout=READ_POLICY.deadline)
+                if status != 200 or len(body) != size:
+                    return None
+                want = hdrs.get("X-Repair-Crc")
+                if want is not None and int(want) != crc_mod.crc32c(body):
+                    return None
+                acc = ec_decoder.xor_partials(
+                    acc, np.frombuffer(body, dtype=np.uint8).reshape(1, size)
+                )
+            if acc is None:
+                return None
+            return np.ascontiguousarray(acc[0]).tobytes()
+
         ev.shard_fetcher = fetch
+        ev.partial_fetcher = fetch_partials
 
     # --- replication --------------------------------------------------------------
     def _replicate(
@@ -804,9 +889,12 @@ class VolumeServer:
         def ec_mount(req: Request) -> Response:
             p = req.json()
             vid = int(p["volume"])
-            if self.store.get_ec_volume(vid) is not None:  # idempotent remount
-                self.store.unmount_ec_volume(vid)
-            ev = self.store.mount_ec_volume(vid, p.get("collection", ""))
+            # atomic: the old instance (if any) serves until the new one
+            # is swapped in — concurrent reads never see a mount gap
+            ev = self.store.remount_ec_volume(vid, p.get("collection", ""))
+            if ev is None:
+                return Response(
+                    {"error": f"no local .ecx for ec volume {vid}"}, 404)
             self._attach_shard_fetcher(ev)
             self.heartbeat_once()
             return Response({"ok": True, "shards": ev.shard_ids()})
@@ -961,6 +1049,204 @@ class VolumeServer:
             data = os.pread(fd, size, offset)
             return Response(data, content_type="application/octet-stream")
 
+        # --- pipelined partial-sum rebuild plane --------------------------
+        # (repair-bandwidth-optimal rebuilds: arXiv:1412.3022 regenerating
+        # codes for the per-repair traffic cut, arXiv:1207.6744 RapidRAID
+        # for the hop-chained partial coding that kills the rebuilder's
+        # 10x fan-in hotspot)
+
+        @svc.route("POST", r"/admin/ec/partial/start")
+        def ec_partial_start(req: Request) -> Response:
+            """Open a pipelined rebuild on this node (the chain's terminal
+            writer): pre-sized tmp shard files for `targets`, renamed into
+            place only at commit — a dead orchestrator leaves ignorable
+            .tmp litter, never a half-written shard under a valid name."""
+            p = req.json()
+            vid = int(p["volume"])
+            targets = [int(s) for s in p.get("targets", [])]
+            ev = self.store.get_ec_volume(vid)
+            if ev is None:
+                return Response({"error": "ec volume not mounted"}, 404)
+            if not targets or any(
+                t < 0 or t >= geometry.TOTAL_SHARDS_COUNT for t in targets
+            ):
+                return Response({"error": f"bad targets {targets}"}, 400)
+            with self._partial_lock:
+                old = self._partial_rebuilds.pop(vid, None)
+                if old is not None:  # stale orchestrator: replace its state
+                    old["writers"].abort()
+                writers = ec_encoder._ShardWriters(
+                    ev.data_base, ev.shard_size, shard_ids=targets
+                )
+                self._partial_rebuilds[vid] = {
+                    "writers": writers, "targets": targets,
+                    "shard_size": ev.shard_size,
+                    "collection": p.get("collection", ""),
+                }
+            return Response({
+                "ok": True, "shard_size": ev.shard_size, "targets": targets,
+            })
+
+        @svc.route("POST", r"/admin/ec/partial/commit")
+        def ec_partial_commit(req: Request) -> Response:
+            vid = int(req.json()["volume"])
+            with self._partial_lock:
+                state = self._partial_rebuilds.pop(vid, None)
+            if state is None:
+                return Response({"error": "no rebuild state"}, 404)
+            state["writers"].close()
+            # atomic swap: reads keep serving off the old instance until
+            # the one that sees the rebuilt shards replaces it
+            ev = self.store.remount_ec_volume(vid, state["collection"])
+            if ev is None:
+                return Response({"error": "ec volume vanished"}, 409)
+            self._attach_shard_fetcher(ev)
+            self.heartbeat_once()
+            return Response({
+                "ok": True, "rebuilt": state["targets"],
+                "shards": ev.shard_ids(),
+            })
+
+        @svc.route("POST", r"/admin/ec/partial/abort")
+        def ec_partial_abort(req: Request) -> Response:
+            vid = int(req.json()["volume"])
+            with self._partial_lock:
+                state = self._partial_rebuilds.pop(vid, None)
+            if state is not None:
+                state["writers"].abort()
+            return Response({"ok": True, "aborted": state is not None})
+
+        @svc.route("POST", r"/admin/ec/partial")
+        def ec_partial(req: Request) -> Response:
+            """One partial-sum hop. Body: the accumulated partial so far
+            (empty for the chain head), CRC-guarded. Query: volume /
+            collection / offset / size / targets, plus either `chain`
+            (JSON hop list, chain[0] == this node; forward the XOR to
+            chain[1], the last hop writes into the /admin/ec/partial/start
+            state) or bare `coefs` (range-limited partial served straight
+            back — degraded reads fan in ONE scaled range per holder
+            instead of one per shard). Every received/served payload
+            counts into ec_repair_bytes_on_wire{mode="pipelined"}."""
+            me = f"{self._host}:{self.data_port}"
+            _FP_PARTIAL.hit(key=me)
+            q = req.query
+            vid = int(q["volume"])
+            collection = q.get("collection", "")
+            offset = int(q["offset"])
+            size = int(q["size"])
+            targets = [int(s) for s in q.get("targets", "").split(",") if s]
+            if size <= 0 or offset < 0 or not targets:
+                return Response({"error": "bad offset/size/targets"}, 400)
+            chain = json.loads(q["chain"]) if "chain" in q else []
+            if chain:
+                hop, rest = chain[0], chain[1:]
+                coefs = {int(k): v for k, v in hop.get("coefs", {}).items()}
+                write = bool(hop.get("write"))
+            else:
+                hop, rest, write = None, [], False
+                coefs = {int(k): v for k, v in
+                         json.loads(q.get("coefs", "{}")).items()}
+            mbytes, _, _, _ = ec_decoder.repair_metrics()
+            body = req.body
+            if body:
+                if len(body) != len(targets) * size:
+                    return Response(
+                        {"error": "partial size mismatch",
+                         "failed_hop_server": me}, 409)
+                want = req.headers.get("X-Repair-Crc")
+                if want is not None and int(want) != crc_mod.crc32c(body):
+                    return Response(
+                        {"error": "crc_mismatch", "failed_hop_server": me},
+                        409)
+                mbytes.labels("pipelined").inc(len(body))
+                partial = np.frombuffer(body, dtype=np.uint8) \
+                    .reshape(len(targets), size).copy()
+            else:
+                partial = None
+            if coefs:
+                ev = self.store.get_ec_volume(vid)
+                if ev is None:
+                    return Response({"error": "ec volume not mounted",
+                                     "failed_hop_server": me}, 409)
+                sids = sorted(coefs)
+                rows = []
+                for sid in sids:
+                    if len(coefs[sid]) != len(targets):
+                        return Response(
+                            {"error": f"coefs for shard {sid} != targets",
+                             "failed_hop_server": me}, 400)
+                    data = ev._pread_shard(sid, offset, size)
+                    if data is None:
+                        return Response(
+                            {"error": "shard_unavailable", "shard": sid,
+                             "failed_hop_server": me}, 409)
+                    rows.append(np.frombuffer(data, dtype=np.uint8))
+                m = np.array([coefs[s] for s in sids], dtype=np.uint8).T
+                contrib = ec_decoder.partial_contribution(
+                    m, np.stack(rows), ev.codec
+                )
+                partial = ec_decoder.xor_partials(partial, contrib) \
+                    if partial is not None else contrib
+            if partial is None:
+                partial = np.zeros((len(targets), size), dtype=np.uint8)
+            if rest:  # forward the accumulated sum to the next hop
+                nxt = rest[0]
+                payload = np.ascontiguousarray(partial).tobytes()
+                url = (
+                    nxt["url"] + f"/admin/ec/partial?volume={vid}"
+                    f"&collection={urllib.parse.quote(collection)}"
+                    f"&offset={offset}&size={size}"
+                    f"&targets={','.join(str(t) for t in targets)}"
+                    f"&chain={urllib.parse.quote(json.dumps(rest))}"
+                )
+
+                def fwd():
+                    return http_request(
+                        "POST", url, payload,
+                        headers={"X-Repair-Crc":
+                                 str(crc_mod.crc32c(payload))},
+                        timeout=READ_POLICY.deadline,
+                    )
+
+                try:  # transport failures retry under the shared policy
+                    status, _, out = READ_POLICY.call(fwd)
+                except (IOError, OSError) as e:
+                    return Response(
+                        {"error": "hop_unreachable",
+                         "failed_hop_server": nxt.get("server", ""),
+                         "failed_hop": nxt["url"],
+                         "detail": str(e)[:200]}, 502)
+                try:
+                    downstream = json.loads(out) if out else {}
+                except ValueError:
+                    downstream = {}
+                if status != 200:
+                    downstream.setdefault("error", f"hop -> {status}")
+                    downstream.setdefault(
+                        "failed_hop_server", nxt.get("server", ""))
+                    return Response(downstream, 502)
+                downstream["received"] = (
+                    [len(body)] + downstream.get("received", []))
+                return Response(downstream)
+            if write:  # chain terminal: land the sum in the rebuild state
+                with self._partial_lock:
+                    state = self._partial_rebuilds.get(vid)
+                    if state is None or state["targets"] != targets:
+                        return Response(
+                            {"error": "start_failed",
+                             "detail": "no matching rebuild state",
+                             "failed_hop_server": me}, 409)
+                    for i, sid in enumerate(targets):
+                        state["writers"].pwrite(sid, partial[i], offset)
+                return Response({"ok": True, "received": [len(body)]})
+            # bare ranged partial: serve the scaled range back (option (b))
+            payload = np.ascontiguousarray(partial).tobytes()
+            mbytes.labels("pipelined").inc(len(payload))
+            return Response(
+                payload, content_type="application/octet-stream",
+                headers={"X-Repair-Crc": str(crc_mod.crc32c(payload))},
+            )
+
         # --- volume copy / move plane (volume_grpc_copy.go) ---
         @svc.route("GET", r"/admin/volume/files")
         def volume_files(req: Request) -> Response:
@@ -1023,7 +1309,14 @@ class VolumeServer:
         @svc.route("POST", r"/admin/volume/copy")
         def volume_copy(req: Request) -> Response:
             """Pull a volume's .dat/.idx from another volume server and mount
-            it locally (`volume_grpc_copy.go VolumeCopy` — receiver-driven)."""
+            it locally (`volume_grpc_copy.go VolumeCopy` — receiver-driven).
+            A live online-EC volume arrives as .dat/.idx/.vif only — the
+            source's streamed parity and journal stay (and die) with it —
+            so the pulled .vif's unsealed ec_online policy RE-ARMS the
+            striper here: re-encode parity from byte 0 of the durable
+            .dat, the same path as /admin/ec/online/rebuild. That is what
+            makes live online volumes movable by balance/evacuate instead
+            of pinned forever."""
             p = req.json()
             vid = int(p["volume"])
             source = p["source"].rstrip("/")
@@ -1036,11 +1329,29 @@ class VolumeServer:
             for ext in meta["files"]:
                 self._pull_file(source, vid, collection, ext, base + ext)
             v = self.store.mount_volume(vid, collection)
+            rearmed_rows = None
+            try:
+                from seaweedfs_tpu.storage.store import _attach_online_ec
+
+                _attach_online_ec(v)  # no-op unless the .vif demands it
+                if v.online_ec is not None:
+                    rearmed_rows = v.online_ec.rearm()
+                    if self.fastlane and vid in self.fastlane._volumes:
+                        self.fastlane.ec_online_advance(
+                            vid, v.online_ec.watermark)
+            except Exception:
+                # parity re-arm failed: the volume still serves off the
+                # .dat and heartbeats without ec_online, so the layout
+                # re-demands its real replica count and repair owns it
+                if v.online_ec is not None:
+                    v.online_ec.close()
+                    v.online_ec = None
             self.heartbeat_once()
-            return Response(
-                {"ok": True, "volume": vid, "size": v.size(),
-                 "last_append_at_ns": v.last_append_at_ns}
-            )
+            out = {"ok": True, "volume": vid, "size": v.size(),
+                   "last_append_at_ns": v.last_append_at_ns}
+            if rearmed_rows is not None:
+                out["ec_online_rearmed_rows"] = rearmed_rows
+            return Response(out)
 
         @svc.route("POST", r"/admin/volume/mount")
         def volume_mount(req: Request) -> Response:
@@ -1080,9 +1391,11 @@ class VolumeServer:
             if p.get("copy_vif", True) and not os.path.exists(base + ".vif"):
                 exts.append(".vif")
             copied = []
+            pulled = 0
             for ext in exts:
                 try:
-                    self._pull_file(source, vid, collection, ext, base + ext)
+                    pulled += self._pull_file(
+                        source, vid, collection, ext, base + ext)
                     copied.append(ext)
                 except IOError:
                     if ext == ".ecj":  # deletion journal may not exist
@@ -1091,7 +1404,12 @@ class VolumeServer:
                         ec_encoder.save_volume_info(base + ".vif")
                         continue
                     raise
-            return Response({"ok": True, "copied": copied})
+            if p.get("repair") and pulled:
+                # whole-shard pulls feeding a classic rebuild: the traffic
+                # the pipelined mode exists to cut — counted at the
+                # receiving rebuilder, same convention as the partial hops
+                ec_decoder.repair_metrics()[0].labels("classic").inc(pulled)
+            return Response({"ok": True, "copied": copied, "bytes": pulled})
 
         @svc.route("POST", r"/admin/ec/delete_shards")
         def ec_delete_shards(req: Request) -> Response:
@@ -1109,8 +1427,6 @@ class VolumeServer:
 
             removed = []
             was_mounted = self.store.get_ec_volume(vid) is not None
-            if was_mounted:
-                self.store.unmount_ec_volume(vid)
             for loc in self.store.locations:
                 base = ec_shard_file_name(collection, loc.directory, vid)
                 for s in shards:
@@ -1123,10 +1439,15 @@ class VolumeServer:
                         if os.path.exists(base + ext):
                             os.remove(base + ext)
             if was_mounted:
-                try:
-                    self.store.mount_ec_volume(vid, collection)
-                except VolumeError:
-                    pass  # index gone or no shards left
+                # atomic swap: the old instance (whose open fds still
+                # serve the just-unlinked shards) covers concurrent reads
+                # until the refreshed one is in place, and the refresh
+                # re-attaches the remote shard/partial fetchers (the old
+                # unmount+mount dance silently dropped them — every later
+                # degraded read on this node 500'd local-only)
+                ev = self.store.remount_ec_volume(vid, collection)
+                if ev is not None:
+                    self._attach_shard_fetcher(ev)
             self.heartbeat_once()
             return Response({"ok": True, "removed": removed})
 
@@ -1262,10 +1583,13 @@ class VolumeServer:
     def _pull_file(
         self, source: str, vid: int, collection: str, ext: str, dest: str,
         chunk: int = 16 * 1024 * 1024,
-    ) -> None:
+    ) -> int:
         """Ranged GETs of /admin/volume/raw until EOF -> dest file.
         Downloads into a temp sibling and renames, so a failed pull never
-        clobbers an existing good file."""
+        clobbers an existing good file. Each ranged GET is idempotent and
+        rides the unified RetryPolicy (a transient 5xx/socket error must
+        not sink a multi-GB evacuate/rebuild copy at 99%). Returns the
+        bytes pulled (classic-repair bytes-on-wire accounting)."""
         import os
 
         tmp = dest + ".pull"
@@ -1278,7 +1602,16 @@ class VolumeServer:
                         f"&collection={urllib.parse.quote(collection)}"
                         f"&offset={offset}&size={chunk}"
                     )
-                    status, headers, body = http_request("GET", url, timeout=120)
+
+                    def pull_range():
+                        status, hdrs, data = http_request(
+                            "GET", url, timeout=120)
+                        if status >= 500:  # transient: worth a retry
+                            raise IOError(
+                                f"pull {ext} from {source}: {status}")
+                        return status, hdrs, data
+
+                    status, headers, body = READ_POLICY.call(pull_range)
                     if status != 200:
                         raise IOError(f"pull {ext} from {source}: {status}")
                     f.write(body)
@@ -1287,6 +1620,7 @@ class VolumeServer:
                     if offset >= total or not body:
                         break
             os.replace(tmp, dest)
+            return offset
         finally:
             if os.path.exists(tmp):
                 os.remove(tmp)

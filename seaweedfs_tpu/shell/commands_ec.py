@@ -1,14 +1,35 @@
 """ec.* commands — the north-star workload's operational surface
 (reference `weed/shell/command_ec_encode.go:58-300`, `command_ec_rebuild.go:99`,
-`command_ec_decode.go:77`, `command_ec_balance.go`)."""
+`command_ec_decode.go:77`, `command_ec_balance.go`).
+
+`ec.rebuild` runs in two modes. **classic** pulls every needed shard to
+one rebuilder (10x shard-size of fan-in at that node) and decodes
+locally. **pipelined** (repair-bandwidth-optimal: arXiv:1412.3022
+regenerating codes, arXiv:1207.6744 RapidRAID) has each surviving
+holder scale its OWN shards by the decode coefficients on its local
+GFNI kernel and XOR-forward one partial sum hop to hop, the rebuilder
+(last hop) writing the accumulated sum — no node moves more than
+~targets x shard-size, and the GF math spreads across the cluster.
+**auto** picks per repair from the surviving-holder count and the
+maintenance scheduler's live pressure."""
 
 from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+
+from seaweedfs_tpu.storage.erasure_coding import decoder as ec_decoder
 
 from .env import CommandEnv, ServerView, ShellError
 from .registry import command, dry_run_flag, parse_flags, render_plan
 
 TOTAL_SHARDS = 14
 DATA_SHARDS = 10
+
+# partial chunk: ranges per chain pass. Big enough to amortize the hop
+# HTTP overhead, small enough that a mid-chain death retries cheaply.
+PARTIAL_CHUNK = 4 * 1024 * 1024
 
 
 def _spread_plan(
@@ -199,20 +220,29 @@ def describe_rebuild(plan: dict) -> list[str]:
 
 def apply_rebuild(env: CommandEnv, plan: dict) -> list[int]:
     """Execute a plan_rebuild plan: pull inputs, rebuild on the Pallas
-    RS(10,4) path, drop pulled-only inputs, re-mount."""
+    RS(10,4) path, drop pulled-only inputs, re-mount. The whole-shard
+    pulls are flagged `repair` so the rebuilder counts them into
+    ec_repair_bytes_on_wire{mode="classic"} — the baseline the pipelined
+    mode is measured against."""
+    _, mseconds, _, _ = ec_decoder.repair_metrics()
     vid, collection = plan["volume"], plan["collection"]
     rb = plan["rebuilder_url"]
+    t0 = time.perf_counter()
     for p in plan["pulls"]:
         env.post(
             f"{rb}/admin/ec/copy",
             {"volume": vid, "collection": collection,
-             "shards": p["shards"], "source": p["source_url"]},
+             "shards": p["shards"], "source": p["source_url"],
+             "repair": True},
             timeout=3600,
         )
+    mseconds.labels("classic", "pull").observe(time.perf_counter() - t0)
+    t1 = time.perf_counter()
     out = env.post(
         f"{rb}/admin/ec/rebuild",
         {"volume": vid, "collection": collection}, timeout=3600,
     )
+    mseconds.labels("classic", "decode").observe(time.perf_counter() - t1)
     # drop shards the rebuilder only pulled as rebuild inputs, keep its own +
     # the rebuilt ones, then re-mount to refresh its shard list
     pulled = [s for p in plan["pulls"] for s in p["shards"]]
@@ -228,20 +258,341 @@ def apply_rebuild(env: CommandEnv, plan: dict) -> list[int]:
     return out.get("rebuilt", plan["missing"])
 
 
-@command("ec.rebuild", "-volumeId <n> [-collection name] [-dryRun|-apply] —"
-         " rebuild missing shards (ref command_ec_rebuild.go:99)",
+class PipelinedRebuildError(ShellError):
+    """A pipelined rebuild could not complete; `reason` is one of
+    decoder.REPAIR_FALLBACK_REASONS and the caller falls back to classic."""
+
+    def __init__(self, reason: str, detail: str = "") -> None:
+        super().__init__(f"pipelined rebuild failed ({reason}): {detail}")
+        self.reason = reason
+
+
+def plan_rebuild_pipelined(
+    env: CommandEnv, vid: int, collection: str = "",
+    exclude: tuple[str, ...] = (),
+) -> dict | None:
+    """The partial-sum chain plan: decode coefficients per holder, hops
+    ordered with the rebuilder LAST (it lands the accumulated sum in its
+    /admin/ec/partial/start state). `exclude` drops dead hops on a chain
+    restart. None when nothing is missing; ShellError when the surviving
+    (non-excluded) shards drop below 10."""
+    servers = env.servers()
+    all_holders = [sv for sv in servers if vid in sv.ec_shards]
+    holders = [sv for sv in all_holders if sv.id not in exclude]
+    # targets = shards missing from the WHOLE cluster; a dead hop's
+    # shards are unavailable as chain inputs but not lost, so excluding
+    # it shrinks the contributor set without inflating the rebuild
+    present_all = sorted(
+        {s for sv in all_holders for s in sv.ec_shards[vid]})
+    missing = [s for s in range(TOTAL_SHARDS) if s not in present_all]
+    if not missing:
+        return None
+    usable = sorted({s for sv in holders for s in sv.ec_shards[vid]})
+    if len(usable) < DATA_SHARDS:
+        raise ShellError(
+            f"volume {vid}: only {len(usable)} usable shards"
+            f" (excluding {list(exclude)}), cannot rebuild"
+        )
+    use, matrix = ec_decoder.repair_coefficients(usable, missing)
+    rebuilder = max(
+        holders, key=lambda sv: (len(sv.ec_shards[vid]), sv.free_slots())
+    )
+    # each `use` shard contributes from exactly one hop; hops ordered
+    # non-rebuilders first (stable by id), rebuilder last as the writer
+    assigned: set[int] = set()
+    chain: list[dict] = []
+    others = sorted(
+        (sv for sv in holders if sv.id != rebuilder.id),
+        key=lambda sv: sv.id,
+    )
+    for sv in others + [rebuilder]:
+        own = [
+            s for s in sorted(sv.ec_shards[vid])
+            if s in use and s not in assigned
+        ]
+        assigned.update(own)
+        if not own and sv.id != rebuilder.id:
+            continue  # nothing to contribute, not the writer: skip the hop
+        chain.append({
+            "server": sv.id, "url": sv.http, "shards": own,
+            "coefs": {
+                str(s): [int(matrix[t, use.index(s)])
+                         for t in range(len(missing))]
+                for s in own
+            },
+            "write": sv.id == rebuilder.id,
+        })
+    return {
+        "volume": vid, "collection": collection, "mode": "pipelined",
+        "rebuilder": rebuilder.id, "rebuilder_url": rebuilder.http,
+        "missing": missing, "present": present_all, "use": use,
+        "chain": chain,
+    }
+
+
+def describe_rebuild_pipelined(plan: dict) -> list[str]:
+    steps = []
+    for hop in plan["chain"]:
+        if hop["write"]:
+            steps.append(
+                f"{hop['server']}: add shards {hop['shards']}, write"
+                f" rebuilt {plan['missing']} (chain terminal)"
+            )
+        else:
+            steps.append(
+                f"{hop['server']}: scale shards {hop['shards']},"
+                f" XOR-forward one partial"
+            )
+    steps.append(
+        f"bytes-on-wire at rebuilder ~{len(plan['missing'])}x shard-size"
+        f" (classic: {DATA_SHARDS}x)"
+    )
+    return steps
+
+
+def choose_rebuild_mode(pplan: dict | None, pressure: dict | None = None
+                        ) -> tuple[str, str]:
+    """auto-mode policy, per the repair-bandwidth trade: a chain needs
+    >= 3 contributing nodes before hop-forwarding beats one pull burst; a
+    2-node chain is still worth it when the maintenance scheduler is
+    under pressure (token bucket drained / in-flight near the global or
+    per-node cap — spreading the GF math and halving the rebuilder's
+    fan-in matters exactly when repairs contend); single-holder volumes
+    rebuild locally either way, so classic's simpler ladder wins."""
+    if pplan is None:
+        return "classic", "no pipelined plan"
+    hops = len(pplan["chain"])
+    if hops >= 3:
+        return "pipelined", f"{hops}-hop chain cuts rebuilder fan-in" \
+            f" {DATA_SHARDS}x -> {len(pplan['missing'])}x"
+    if hops == 2 and pressure is not None:
+        node_hot = any(
+            n >= pressure.get("per_node_limit", 1)
+            for n in pressure.get("node_inflight", {}).values()
+        )
+        if (
+            pressure.get("tokens", 2.0) < 1.0
+            or pressure.get("in_flight", 0)
+            >= max(1, pressure.get("global_limit", 4) - 1)
+            or node_hot
+        ):
+            return "pipelined", "2-hop chain under repair-scheduler pressure"
+    return "classic", "too_few_holders"
+
+
+def apply_rebuild_pipelined(
+    env: CommandEnv, plan: dict, chunk: int = PARTIAL_CHUNK,
+) -> tuple[list[int], dict]:
+    """Execute a pipelined plan with the retry ladder: a dead hop
+    restarts the chain minus that hop (re-planned coefficients) while
+    the survivors still cover 10 shards; a CRC mismatch restarts the
+    SAME chain once (the server that reported it is the detector, not
+    the corruptor — excluding it would punish a healthy holder) and
+    escalates to the typed crc_mismatch fallback on a repeat; exhausted
+    restarts raise PipelinedRebuildError so the caller falls back to
+    classic. Returns (rebuilt shard ids, wire stats)."""
+    _, mseconds, _, mrestarts = ec_decoder.repair_metrics()
+    excluded: list[str] = []
+    restarts = 0
+    crc_failures = 0
+    while True:
+        try:
+            return _run_chain(env, plan, chunk, mseconds, restarts)
+        except PipelinedRebuildError:
+            raise
+        except _HopFailed as e:
+            reason = e.reason if e.reason in ec_decoder.REPAIR_RESTART_REASONS \
+                else "hop_failed"
+            mrestarts.labels(reason).inc()
+            restarts += 1
+            if reason == "crc_mismatch":
+                crc_failures += 1
+                if crc_failures >= 2:  # corrupt twice: stop pretending
+                    raise PipelinedRebuildError("crc_mismatch", e.detail)
+            elif e.server:
+                excluded.append(e.server)
+            elif restarts > 1:
+                # a hop failed twice without ever being attributable
+                # (pure transport noise): classic is the honest fallback
+                raise PipelinedRebuildError("hop_failed", e.detail)
+            try:
+                plan = plan_rebuild_pipelined(
+                    env, plan["volume"], plan["collection"],
+                    exclude=tuple(excluded),
+                )
+            except ShellError as err:
+                raise PipelinedRebuildError("insufficient_shards", str(err))
+            if plan is None:  # healed underneath us (another repair won)
+                return [], {"bytes_on_wire_total": 0,
+                            "bytes_on_wire_rebuilder": 0,
+                            "hops": 0, "restarts": restarts}
+
+
+class _HopFailed(Exception):
+    def __init__(self, server: str, reason: str, detail: str = "") -> None:
+        super().__init__(f"chain hop {server or '?'} failed: {reason}")
+        self.server = server
+        self.reason = reason
+        self.detail = detail
+
+
+def _run_chain(env, plan, chunk, mseconds, restarts) -> tuple[list[int], dict]:
+    from seaweedfs_tpu.server.httpd import http_request
+
+    vid, collection = plan["volume"], plan["collection"]
+    rb = plan["rebuilder_url"]
+    chain = plan["chain"]
+    targets = plan["missing"]
+    targets_q = ",".join(str(t) for t in targets)
+    t0 = time.perf_counter()
+    try:
+        start = env.post(
+            f"{rb}/admin/ec/partial/start",
+            {"volume": vid, "collection": collection, "targets": targets},
+            timeout=60,
+        )
+    except Exception as e:
+        raise PipelinedRebuildError("start_failed", str(e)[:200])
+    shard_size = int(start["shard_size"])
+    mseconds.labels("pipelined", "start").observe(time.perf_counter() - t0)
+    received = [0] * len(chain)
+    try:
+        t1 = time.perf_counter()
+        for off in range(0, max(shard_size, 1), chunk):
+            size = min(chunk, shard_size - off)
+            if size <= 0:
+                break
+            url = (
+                chain[0]["url"] + f"/admin/ec/partial?volume={vid}"
+                f"&collection={urllib.parse.quote(collection)}"
+                f"&offset={off}&size={size}&targets={targets_q}"
+                f"&chain={urllib.parse.quote(json.dumps(chain))}"
+            )
+            try:
+                status, _, out = http_request("POST", url, b"", timeout=120)
+            except (IOError, OSError) as e:
+                raise _HopFailed(chain[0]["server"], "hop_failed",
+                                 str(e)[:200])
+            try:
+                resp = json.loads(out) if out else {}
+            except ValueError:
+                resp = {}
+            if status != 200:
+                reason = "crc_mismatch" \
+                    if resp.get("error") == "crc_mismatch" else "hop_failed"
+                raise _HopFailed(
+                    resp.get("failed_hop_server") or chain[0]["server"],
+                    reason, str(resp)[:200],
+                )
+            got = resp.get("received", [])
+            for i, n in enumerate(got[-len(chain):]):
+                received[i] += int(n)
+        mseconds.labels("pipelined", "chain").observe(
+            time.perf_counter() - t1)
+        t2 = time.perf_counter()
+        out = env.post(
+            f"{rb}/admin/ec/partial/commit",
+            {"volume": vid, "collection": collection}, timeout=60,
+        )
+        mseconds.labels("pipelined", "commit").observe(
+            time.perf_counter() - t2)
+    except BaseException:
+        try:
+            env.post(f"{rb}/admin/ec/partial/abort", {"volume": vid},
+                     timeout=30)
+        except Exception:
+            pass
+        raise
+    stats = {
+        "bytes_on_wire_total": sum(received),
+        "bytes_on_wire_rebuilder": received[-1] if received else 0,
+        "shard_size": shard_size,
+        "hops": len(chain),
+        "restarts": restarts,
+        "per_hop_received": received,
+    }
+    return out.get("rebuilt", targets), stats
+
+
+def run_rebuild(
+    env: CommandEnv, vid: int, collection: str = "", mode: str = "auto",
+    pressure: dict | None = None, dry_run: bool = False,
+) -> dict:
+    """The ONE choose-mode + apply + typed-fallback path, shared by the
+    `ec.rebuild` verb and the maintenance ec_rebuild executor — so both
+    entry points produce identical repair behavior AND identical
+    fallbacks/restarts metric series. Returns a dict:
+    {healed} | {dry_run, mode, planned} |
+    {mode, planned, rebuilt, rebuilder, stats?}."""
+    if mode not in ("auto",) + ec_decoder.REPAIR_MODES:
+        raise ShellError(f"mode must be auto|classic|pipelined, got {mode}")
+    plan = plan_rebuild(env, vid, collection)
+    if plan is None:
+        return {"healed": True, "planned": [], "mode": mode}
+    pplan = None
+    if mode != "classic":
+        try:
+            pplan = plan_rebuild_pipelined(env, vid, collection)
+        except (ShellError, IOError, OSError):
+            pplan = None  # no usable chain (or a transient topology
+            #               fetch failure): classic still repairs
+    if mode == "auto":
+        mode, _why = choose_rebuild_mode(pplan, pressure)
+        if mode == "classic" and pplan is not None:
+            ec_decoder.repair_metrics()[2].labels("too_few_holders").inc()
+    if mode == "pipelined" and pplan is None:
+        ec_decoder.repair_metrics()[2].labels("insufficient_shards").inc()
+        mode = "classic"
+    if dry_run:
+        planned = describe_rebuild_pipelined(pplan) if mode == "pipelined" \
+            else describe_rebuild(plan)
+        return {"dry_run": True, "mode": mode, "planned": planned}
+    if mode == "pipelined":
+        planned = describe_rebuild_pipelined(pplan)
+        try:
+            rebuilt, stats = apply_rebuild_pipelined(env, pplan)
+            return {"mode": "pipelined", "planned": planned,
+                    "rebuilt": rebuilt, "rebuilder": pplan["rebuilder"],
+                    "stats": stats}
+        except PipelinedRebuildError as e:
+            ec_decoder.repair_metrics()[2].labels(e.reason).inc()
+            # classic stays the fallback: re-plan (the chain attempts may
+            # have changed nothing — partial state aborted server-side)
+            plan = plan_rebuild(env, vid, collection)
+            if plan is None:
+                return {"healed": True, "planned": planned, "mode": mode}
+    planned = describe_rebuild(plan)
+    rebuilt = apply_rebuild(env, plan)
+    return {"mode": "classic", "planned": planned, "rebuilt": rebuilt,
+            "rebuilder": plan["rebuilder"]}
+
+
+@command("ec.rebuild", "-volumeId <n> [-collection name]"
+         " [-mode pipelined|classic|auto] [-dryRun|-apply] — rebuild"
+         " missing shards; pipelined streams GF partial sums hop to hop"
+         " (~1x shard-size at the rebuilder vs 10x classic)",
          needs_lock=True)
 def cmd_ec_rebuild(env: CommandEnv, args: list[str]) -> str:
     flags = parse_flags(args)
     vid = int(flags["volumeId"])
-    collection = flags.get("collection", "")
-    plan = plan_rebuild(env, vid, collection)
-    if plan is None:
+    out = run_rebuild(
+        env, vid, flags.get("collection", ""),
+        mode=flags.get("mode", "auto"), dry_run=dry_run_flag(flags),
+    )
+    if out.get("healed"):
         return f"volume {vid}: all {TOTAL_SHARDS} shards present"
-    if dry_run_flag(flags):
-        return render_plan("ec.rebuild", describe_rebuild(plan))
-    rebuilt = apply_rebuild(env, plan)
-    return f"volume {vid}: rebuilt shards {rebuilt} on {plan['rebuilder']}"
+    if out.get("dry_run"):
+        return render_plan(f"ec.rebuild [{out['mode']}]", out["planned"])
+    stats = out.get("stats")
+    if stats is not None:
+        return (
+            f"volume {vid}: rebuilt shards {out['rebuilt']} on"
+            f" {out['rebuilder']} (pipelined, {stats['hops']} hops,"
+            f" {stats['bytes_on_wire_rebuilder']} B at rebuilder,"
+            f" {stats['bytes_on_wire_total']} B total on wire)"
+        )
+    return f"volume {vid}: rebuilt shards {out['rebuilt']} on" \
+        f" {out['rebuilder']} (classic)"
 
 
 @command("ec.balance", "spread EC shards evenly across servers "

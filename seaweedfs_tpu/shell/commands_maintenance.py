@@ -80,10 +80,12 @@ def _render_status(st: dict) -> str:
 
 
 @command("cluster.maintenance",
-         "[-status] [-enable [-dryRun|-apply]] [-disable] [-now <task|all>]"
+         "[-status] [-enable [-dryRun|-apply]"
+         " [-rebuildMode auto|pipelined|classic]] [-disable]"
+         " [-now <task|all>]"
          " — inspect/steer the master's autonomous maintenance daemon"
          " (detect -> plan -> heal; /debug/maintenance). -enable alone"
-         " preserves the daemon's current dry-run mode")
+         " preserves the daemon's current dry-run/rebuild modes")
 def cmd_cluster_maintenance(env: CommandEnv, args: list[str]) -> str:
     flags = parse_flags(args)
     actions = [f for f in ("enable", "disable", "now") if f in flags]
@@ -99,13 +101,16 @@ def cmd_cluster_maintenance(env: CommandEnv, args: list[str]) -> str:
                 payload["dryRun"] = True
             elif "apply" in flags:
                 payload["dryRun"] = False
+            if "rebuildMode" in flags:
+                payload["rebuildMode"] = flags["rebuildMode"]
             out = env.post(
                 f"{env.master_url}/maintenance/enable", payload,
             )
             return (
                 "maintenance enabled"
                 + (" (dry-run)" if out.get("dry_run") else "")
-                + f" — scan interval {out.get('interval', 0):g}s"
+                + f" — scan interval {out.get('interval', 0):g}s,"
+                + f" rebuild mode {out.get('rebuild_mode', 'auto')}"
             )
         if "disable" in flags:
             env.post(f"{env.master_url}/maintenance/disable")

@@ -23,9 +23,15 @@ def _move_volume(env: CommandEnv, vid: int, src: ServerView, dst: ServerView) ->
     moves tail writes; we mark readonly during the copy like evacuate does)."""
     env.post(f"{src.http}/admin/volume/readonly", {"volume": vid, "readonly": True})
     try:
+        # a live online-EC volume's copy also re-encodes full parity on
+        # the receiver (rearm) before responding — budget like the other
+        # whole-volume pulls, not the 300s default (a client timeout here
+        # while the server-side copy completes would leave the volume
+        # mounted on BOTH nodes)
         env.post(
             f"{dst.http}/admin/volume/copy",
             {"volume": vid, "source": src.http},
+            timeout=3600,
         )
     except Exception:
         env.post(
@@ -295,24 +301,23 @@ def plan_balance(
         return []
     # simulated state: per-node eligible volumes + full membership (a move
     # must not land a volume on a node already holding a replica of it).
-    # LIVE online-EC volumes never move: a volume copy transfers only
-    # .dat/.idx — the streamed parity and its journal would be destroyed
-    # with the source, leaving a single unprotected copy (they become
-    # movable once sealed to EC shards or fallen back to replication)
+    # LIVE online-EC volumes are movable too: the receiver's
+    # /admin/volume/copy re-arms the striper off the pulled .vif policy
+    # and re-encodes parity from the durable .dat (the PR-8/PR-9
+    # follow-up) — the source's parity/journal dying with it no longer
+    # strands the volume unprotected.
     vols = {
         sv.id: {
             vid: v for vid, v in sv.volumes.items()
             if (collection is None or v.get("collection", "") == collection)
-            and not v.get("ec_online")
         }
         for sv in servers
     }
     membership = {sv.id: set(sv.volumes) for sv in servers}
     urls = {sv.id: sv.http for sv in servers}
     # live per-node collection counts for the affinity rank, over the
-    # FULL volume set (pinned online-EC volumes and filtered collections
-    # still anchor their collection to a node) and tracking the
-    # simulated moves
+    # FULL volume set (filtered collections still anchor their
+    # collection to a node) and tracking the simulated moves
     from collections import Counter
 
     colls = {

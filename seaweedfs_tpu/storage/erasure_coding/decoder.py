@@ -4,6 +4,21 @@ Behavioral port of `weed/storage/erasure_coding/ec_decoder.go`: the .dat is
 re-assembled by de-striping the 10 data shards (large rows then small rows up
 to the computed dat size); the .idx is the .ecx plus tombstones for every id
 in the .ecj journal.
+
+Also home of the **partial-sum repair math** (repair-bandwidth-optimal
+rebuilds, after product-matrix regenerating codes arXiv:1412.3022 and
+RapidRAID arXiv:1207.6744): reconstructing shard t from survivors is
+
+    out[t] = XOR_i  m[t,i] x use[i]          (GF(2^8))
+
+which is GF-linear, so any PARTITION of the `use` shards can be scaled
+and summed locally wherever those shards live, and only the partial sums
+— one shard-size each, regardless of how many shards a holder owns —
+cross the network. `repair_coefficients` builds the matrix,
+`partial_contribution` runs one holder's share on the same GFNI/numpy
+kernel full decode uses, and `xor_partials` folds contributions in any
+order. Byte-identity with `RSCodec.reconstruct` is property-tested
+(tests/test_ec_repair.py).
 """
 
 from __future__ import annotations
@@ -11,6 +26,10 @@ from __future__ import annotations
 import os
 from typing import Callable, Iterator
 
+import numpy as np
+
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.rs_kernel import RSCodec
 from seaweedfs_tpu.stats import trace
 from seaweedfs_tpu.storage import idx as idx_mod
 from seaweedfs_tpu.storage.needle import get_actual_size
@@ -23,7 +42,13 @@ from seaweedfs_tpu.storage.types import (
     size_is_deleted,
 )
 
-from .geometry import DATA_SHARDS_COUNT, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, to_ext
+from .geometry import (
+    DATA_SHARDS_COUNT,
+    LARGE_BLOCK_SIZE,
+    PARITY_SHARDS_COUNT,
+    SMALL_BLOCK_SIZE,
+    to_ext,
+)
 
 
 def iterate_ecx_file(
@@ -122,3 +147,112 @@ def _copy_n(src, dst, n: int) -> None:
             raise IOError(f"short shard read: {left} bytes missing")
         dst.write(chunk)
         left -= len(chunk)
+
+
+# --- partial-sum repair (repair-bandwidth-optimal rebuilds) -----------------
+#
+# The modes / typed fallback reasons / chain-restart reasons below ride into
+# metric labels and are linted by tools/check_metric_names.py like the other
+# reason sets. A "fallback" is a pipelined repair degrading to classic
+# whole-shard pulls; a "restart" is the chain re-planned minus a dead hop
+# (the retry ladder's cheaper rung — the repair stays pipelined).
+REPAIR_MODES = ("classic", "pipelined")
+REPAIR_FALLBACK_REASONS = (
+    "too_few_holders",     # auto mode: a <=2-node chain spreads nothing
+    "hop_failed",          # chain restarts exhausted the surviving holders
+    "crc_mismatch",        # a partial arrived corrupt twice in a row
+    "start_failed",        # the rebuilder refused the partial-write state
+    "insufficient_shards", # survivors minus dead hops dropped below 10
+)
+REPAIR_RESTART_REASONS = ("hop_failed", "crc_mismatch")
+
+REPAIR_BYTES_ON_WIRE = "SeaweedFS_volume_ec_repair_bytes_on_wire_total"
+REPAIR_SECONDS = "SeaweedFS_volume_ec_repair_seconds"
+REPAIR_FALLBACKS = "SeaweedFS_volume_ec_repair_fallbacks_total"
+REPAIR_RESTARTS = "SeaweedFS_volume_ec_repair_chain_restarts_total"
+
+_repair_metrics_cache = None
+
+
+def repair_metrics():
+    """Idempotently register the ec_repair families; returns the tuple
+    (bytes_on_wire{mode}, seconds{mode,stage}, fallbacks{reason},
+    chain_restarts{reason}). bytes_on_wire counts every repair payload
+    once, at the node that RECEIVES it (chain hops, the rebuilder's
+    partial writes, classic shard pulls) or serves a ranged partial —
+    so `rate(...{mode="classic"}) / rate(...{mode="pipelined"})` is the
+    bandwidth cut, straight off /metrics."""
+    global _repair_metrics_cache
+    if _repair_metrics_cache is None:
+        from seaweedfs_tpu.stats.metrics import default_registry
+
+        reg = default_registry()
+        _repair_metrics_cache = (
+            reg.counter(
+                REPAIR_BYTES_ON_WIRE,
+                "EC repair bytes moved over the network, by rebuild mode",
+                ("mode",),
+            ),
+            reg.histogram(
+                REPAIR_SECONDS,
+                "EC repair wall time per stage and mode",
+                ("mode", "stage"),
+            ),
+            reg.counter(
+                REPAIR_FALLBACKS,
+                "pipelined repairs degraded to classic, by typed reason",
+                ("reason",),
+            ),
+            reg.counter(
+                REPAIR_RESTARTS,
+                "repair chains re-planned minus a dead hop, by reason",
+                ("reason",),
+            ),
+        )
+    return _repair_metrics_cache
+
+
+def repair_coefficients(
+    present, targets, data_shards: int = DATA_SHARDS_COUNT,
+    parity_shards: int = PARITY_SHARDS_COUNT,
+) -> tuple[list[int], np.ndarray]:
+    """-> (use, matrix): `use` is the canonical 10-shard subset of
+    `present` full decode would read (sorted, first 10 — the SAME choice
+    gf256.decode_matrix makes, which is what keeps the partial sum
+    byte-identical to `RSCodec.reconstruct`), and matrix[t][i] is the
+    GF(2^8) coefficient applied to use[i] when rebuilding targets[t]."""
+    present_t = tuple(sorted(present))
+    if len(present_t) < data_shards:
+        raise ValueError(
+            f"need {data_shards} surviving shards, have {len(present_t)}"
+        )
+    m = gf256.decode_matrix(
+        data_shards, parity_shards, present_t, tuple(targets)
+    )
+    return list(present_t[:data_shards]), m
+
+
+def partial_contribution(
+    coefs: np.ndarray, shards: np.ndarray, codec: RSCodec | None = None
+) -> np.ndarray:
+    """One holder's locally-computed share of the repair sum:
+    coefs (targets, k) over its k local `use` shards, shards (k, n) the
+    corresponding byte ranges -> (targets, n). Runs on the same
+    sw_gf256_matmul GFNI / numpy kernel as full decode."""
+    coefs = np.ascontiguousarray(coefs, dtype=np.uint8)
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    if coefs.ndim != 2 or shards.ndim != 2 or coefs.shape[1] != shards.shape[0]:
+        raise ValueError(
+            f"coefs {coefs.shape} does not apply to shards {shards.shape}"
+        )
+    codec = codec or RSCodec()
+    return codec.apply_matrix(coefs, shards)
+
+
+def xor_partials(acc: np.ndarray | None, part: np.ndarray) -> np.ndarray:
+    """Fold one partial into the accumulator (associative + commutative,
+    so chain hops may run in any order). acc=None starts the sum."""
+    if acc is None:
+        return np.array(part, dtype=np.uint8, copy=True)
+    np.bitwise_xor(acc, part, out=acc)
+    return acc

@@ -82,11 +82,17 @@ class EcVolume:
         self.small_block_size = small_block_size
         self._ecj_lock = threading.Lock()
 
-        # optional remote sourcing hook, set by the server layer:
-        # (shard_id, offset, size) -> bytes | None. Mirrors the remote half
-        # of `store_ec.go` (readRemoteEcShardInterval).
+        # optional remote sourcing hooks, set by the server layer:
+        # shard_fetcher(shard_id, offset, size) -> bytes | None mirrors the
+        # remote half of `store_ec.go` (readRemoteEcShardInterval);
+        # partial_fetcher(missing_shard, offset, size) -> bytes | None
+        # reconstructs an interval moving ONE coefficient-scaled partial
+        # per remote holder (repair-bandwidth-optimal fan-in) instead of
+        # one full range per shard.
         self.shard_fetcher = None
+        self.partial_fetcher = None
 
+        self._closed = False
         self.data_base = ec_shard_file_name(collection, self.dir, volume_id)
         self.index_base = ec_shard_file_name(collection, self.dir_idx, volume_id)
         if not os.path.exists(self.index_base + ".ecx"):
@@ -120,6 +126,11 @@ class EcVolume:
                 self.shard_size = max(self.shard_size, os.path.getsize(p))
 
     def close(self) -> None:
+        # idempotent: an atomic remount defers the old instance's close
+        # on a timer, which can race the store's shutdown close
+        if self._closed:
+            return
+        self._closed = True
         os.close(self._ecx_fd)
         for fd in self.shards.values():
             os.close(fd)
@@ -204,7 +215,19 @@ class EcVolume:
     def _recover_interval(self, missing_shard: int, off: int, size: int) -> bytes:
         """Reconstruct one interval from >= 10 surviving shards, local first
         then remote fan-in (`store_ec.go:339-395`
-        recoverOneRemoteEcShardInterval)."""
+        recoverOneRemoteEcShardInterval). When the server layer attached a
+        partial_fetcher, the remote fan-in moves one GF-scaled partial per
+        holder (~1x the interval per holder) instead of a full range per
+        shard (up to 10x) — byte-identical, any holder failing drops to
+        the classic ladder below."""
+        if self.partial_fetcher is not None:
+            try:
+                data = self.partial_fetcher(missing_shard, off, size)
+            except Exception:
+                data = None
+            if data is not None and len(data) == size:
+                degraded_reads_counter().labels("ec_reconstruct").inc()
+                return data
         present: dict[int, np.ndarray] = {}
         for shard_id in self.shards:
             if shard_id == missing_shard:

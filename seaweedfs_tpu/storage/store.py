@@ -255,6 +255,45 @@ class Store:
                 ev.close()
                 return
 
+    def remount_ec_volume(
+        self, vid: int, collection: str = "", grace: float = 2.0
+    ) -> EcVolume | None:
+        """Atomic shard-set refresh (rebuild commit, shard delete/copy):
+        the NEW EcVolume is built while the old keeps serving, swapped in
+        under the lock, and the old instance closed only after `grace`
+        seconds — an in-flight positional read on the old fds finishes
+        instead of 500ing on EBADF (the commit_compact seqlock lesson,
+        applied to shard remounts; close() is idempotent so shutdown can
+        race the timer). Returns None (and unmounts) when no .ecx
+        remains."""
+        import threading as _threading
+
+        with self._lock:
+            old_loc, old = None, None
+            for loc in self.locations:
+                if vid in loc.ec_volumes:
+                    old_loc, old = loc, loc.ec_volumes[vid]
+                    break
+            new = None
+            for loc in self.locations:
+                base = ec_shard_file_name(collection, loc.directory, vid)
+                if os.path.exists(base + ".ecx"):
+                    new = EcVolume(loc.directory, collection, vid)
+                    if old_loc is not None and loc is not old_loc:
+                        old_loc.ec_volumes.pop(vid, None)
+                    loc.ec_volumes[vid] = new
+                    break
+            if new is None and old_loc is not None:
+                old_loc.ec_volumes.pop(vid, None)
+        if old is not None:
+            if grace > 0:
+                t = _threading.Timer(grace, old.close)
+                t.daemon = True
+                t.start()
+            else:
+                old.close()
+        return new
+
     # --- heartbeat ------------------------------------------------------------
     def collect_heartbeat(self) -> dict:
         """Message shape mirrors master_pb.Heartbeat (`store.go:249`)."""

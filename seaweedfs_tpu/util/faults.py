@@ -59,6 +59,8 @@ ALL_POINTS = (
     "filer.chunk.read",       # filer -> volume chunk relay (wdclient.fetch)
     "volume.replicate.fanout",# synchronous replica fan-out
     "volume.fastlane.drain",  # engine event drain (ABI hook when present)
+    "repair.partial_fetch",   # pipelined-rebuild partial-sum hop (/admin/ec/
+                              # partial): error = a chain hop dies mid-rebuild
 )
 
 MODES = ("error", "latency", "torn", "disk_full", "partition")

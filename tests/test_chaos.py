@@ -15,7 +15,7 @@ firing counts at runtime:
     volume.read.dat volume.read.idx volume.write.dat
     volume.ec.shard.read volume.ec.parity.write volume.heartbeat.send
     master.assign master.lookup filer.chunk.read
-    volume.replicate.fanout volume.fastlane.drain
+    volume.replicate.fanout volume.fastlane.drain repair.partial_fetch
 """
 
 import os
@@ -155,6 +155,21 @@ class TestEveryPointFires:
         key, _ = parse_key_hash_with_delta(v_ec["fid"].split(",")[1])
         assert src.store.get_ec_volume(ecvid).read_needle(key).data \
             .startswith(b"sealed-ec-needle")
+
+        # repair.partial_fetch — a ranged partial-sum request (the
+        # pipelined-rebuild hop seam) against the sealed EC volume
+        import json as _json
+        import urllib.parse as _up
+
+        faults.arm("repair.partial_fetch", "latency", ms=1)
+        sid = src.store.get_ec_volume(ecvid).shard_ids()[0]
+        st, _, body = http_request(
+            "POST",
+            f"{src.url}/admin/ec/partial?volume={ecvid}&offset=0&size=64"
+            f"&targets=0&coefs={_up.quote(_json.dumps({str(sid): [1]}))}",
+            b"",
+        )
+        assert st == 200 and len(body) == 64
 
         # volume.fastlane.drain — the engine event drain (Python seam;
         # the engine-side ABI hook degrades to it on a stale .so)
@@ -419,6 +434,123 @@ class TestPartitionedHeartbeat:
             for vs in vols:
                 vs.stop()
             master.stop()
+
+
+class TestPipelineHopKilledMidRebuild:
+    def test_rebuild_survives_dead_hop_under_read_storm(self, cluster):
+        """PR-11 acceptance: a pipelined-rebuild chain hop dies
+        (repair.partial_fetch error, key-scoped to one node) while
+        clients hammer the EC volume with reads. The maintenance daemon
+        (rebuildMode=pipelined) must still heal the lost shard — via a
+        chain restart minus the dead hop or the typed classic fallback —
+        with ZERO client-visible read errors, and the fallback/restart
+        must be visible in the ec_repair counters."""
+        master, vols, env = cluster
+        # build a spread EC volume with real needles (assigns rotate over
+        # the collection's volumes: group by vid, take the fullest)
+        by_vid: dict[int, dict] = {}
+        for i in range(8):
+            a = assign(master, collection="pipe")
+            data = f"pipe-{i}-".encode() * 400
+            st, _, _ = http_request(
+                "POST", f"http://{a['publicUrl']}/{a['fid']}", data)
+            assert st == 201
+            by_vid.setdefault(
+                int(a["fid"].split(",")[0]), {})[a["fid"]] = data
+        vid, blobs = max(by_vid.items(), key=lambda kv: len(kv[1]))
+        assert blobs
+        run_command(env, "lock")
+        run_command(env, f"ec.encode -volumeId {vid}")
+        run_command(env, "unlock")
+
+        def counter(name: str, label: str) -> float:
+            from seaweedfs_tpu.stats import default_registry
+
+            total = 0.0
+            for line in default_registry().render().splitlines():
+                if line.startswith(name + "{") and label in line:
+                    total += float(line.rsplit(" ", 1)[1])
+            return total
+
+        from seaweedfs_tpu.storage.erasure_coding import decoder as ec_dec
+
+        restarts0 = counter(ec_dec.REPAIR_RESTARTS, "reason=")
+        fallbacks0 = counter(ec_dec.REPAIR_FALLBACKS, "reason=")
+
+        # kill one holder's partial-sum stage (NOT the whole node: its
+        # shards still serve reads and classic copies)
+        holders = [sv for sv in env.servers() if sv.ec_shards.get(vid)]
+        victim = holders[0]
+        faults.arm("repair.partial_fetch", "error", key=victim.id)
+
+        post_json(f"{master.url}/maintenance/enable",
+                  {"rebuildMode": "pipelined"})
+
+        # client-visible = through the real retrying client (the unified
+        # RetryPolicy + holder failover wdclient carries — the same bar
+        # the PR-9 killed-holder storm holds reads to)
+        wc = WeedClient(master.url, cache_ttl=1.0)
+        results = {"ok": 0, "bad": 0}
+        res_lock = threading.Lock()
+        stop_at = time.time() + 6.0
+        fids = list(blobs)
+
+        def reader(seed: int) -> None:
+            i = seed
+            while time.time() < stop_at:
+                fid = fids[i % len(fids)]
+                i += 1
+                try:
+                    body = wc.fetch(fid)
+                    with res_lock:
+                        if body == blobs[fid]:
+                            results["ok"] += 1
+                        else:
+                            results["bad"] += 1
+                except Exception:
+                    with res_lock:
+                        results["bad"] += 1
+
+        threads = [
+            threading.Thread(target=reader, args=(s,), daemon=True)
+            for s in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        # lose a shard mid-storm; the daemon detects + repairs through
+        # the dead hop
+        fired_before = fired("repair.partial_fetch")
+        lost = victim.ec_shards[vid][0]
+        post_json(f"{victim.http}/admin/ec/delete_shards",
+                  {"volume": vid, "shards": [lost], "collection": "pipe"})
+
+        def healed() -> bool:
+            have = {
+                s for sv in env.servers()
+                for s in sv.ec_shards.get(vid, [])
+            }
+            return len(have) == 14
+
+        wait_until(healed, timeout=40,
+                   msg="shard heal through a dead pipeline hop")
+        for t in threads:
+            t.join(timeout=30)
+        assert results["bad"] == 0, results
+        assert results["ok"] > 30, results
+        # the dead hop was really in the repair's path...
+        assert fired("repair.partial_fetch") > fired_before
+        # ...and the ladder engaged: a chain restart or typed fallback
+        restarts = counter(ec_dec.REPAIR_RESTARTS, "reason=") - restarts0
+        fallbacks = counter(ec_dec.REPAIR_FALLBACKS, "reason=") - fallbacks0
+        assert restarts + fallbacks >= 1, (restarts, fallbacks)
+        faults.disarm_all()
+        # steady state: reads still clean, shard still present
+        for fid, data in list(blobs.items())[:2]:
+            st, _, body = http_request(
+                "GET", f"{holders[0].http}/{fid}")
+            assert st == 200 and body == data
+        assert healed()
 
 
 class TestDisarmAllSteadyState:

@@ -408,7 +408,15 @@ class TestServerFlow:
         body = os.urandom(BLOCK * geometry.DATA_SHARDS_COUNT * 2)
         assert http_request("POST", url, body)[0] == 201  # native append
         pending, tail = vs.fastlane.ec_online_pending(vid)
-        assert pending >= 1 and tail > v.online_ec.watermark
+        if pending >= 1:
+            assert tail > v.online_ec.watermark
+        else:
+            # the BACKGROUND drain loop (every 20ms) won the race and
+            # already pumped these rows: the accumulator must then be
+            # re-armed at a watermark covering the appended tail — the
+            # same invariant, observed post-encode
+            assert v.online_ec.stripes >= 2
+            assert tail <= v.online_ec.watermark
         vs._pump_online_ec()  # what the drain loop runs every tick
         assert v.online_ec.stripes >= 2
         # pump re-armed the accumulator at the new watermark
@@ -512,10 +520,13 @@ class TestBalanceAffinity:
         first = actions[0]["volume"]
         assert first in (1, 2), f"moved volume {first}, scattering 'b'"
 
-    def test_live_online_volumes_never_move(self):
-        """A balance move copies only .dat/.idx — the streamed parity and
-        its journal would die with the source. Live online-EC volumes
-        are pinned until sealed or fallen back."""
+    def test_live_online_volumes_are_movable(self):
+        """Live online-EC volumes used to be PINNED (a move copies only
+        .dat/.idx, so the streamed parity died with the source). The
+        receiver's /admin/volume/copy now re-arms the striper off the
+        pulled .vif policy and re-encodes parity from the durable .dat,
+        so the planner treats them like any other volume (the PR-8/PR-9
+        online-EC-aware-evacuate follow-up)."""
         from seaweedfs_tpu.shell.commands_volume import plan_balance
 
         high = self._sv("h1", [
@@ -527,7 +538,66 @@ class TestBalanceAffinity:
         low = self._sv("h2", [])
         actions = plan_balance(None, servers=[high, low])
         moved = [a["volume"] for a in actions]
-        assert moved and set(moved) <= {3, 4}, moved
+        assert len(moved) == 2, moved
+        # no affinity signal on the empty target: smallest-size wins,
+        # and the smallest volumes here are the (now movable) online pair
+        assert set(moved) == {1, 2}, moved
+
+    def test_move_rearms_striper_on_target(self, tmp_path):
+        """Moving a LIVE online-EC volume re-encodes its parity from
+        byte 0 on the target (same path as /admin/ec/online/rebuild) —
+        the volume arrives protected, not silently parity-less."""
+        import os as _os
+
+        from seaweedfs_tpu.server.httpd import get_json, http_request
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume import VolumeServer
+        from seaweedfs_tpu.shell import CommandEnv, run_command
+
+        master = MasterServer(port=0, pulse_seconds=1, ec_online="hot",
+                              ec_online_block=BLOCK)
+        master.start()
+        vols = []
+        try:
+            for i in range(2):
+                vs = VolumeServer(
+                    [str(tmp_path / f"mv{i}")], master.url, port=0,
+                    pulse_seconds=1, max_volume_count=20, rack=f"r{i}",
+                )
+                vs.start()
+                vols.append(vs)
+            env = CommandEnv(master.url)
+            a = get_json(f"{master.url}/dir/assign?collection=hot")
+            vid = int(a["fid"].split(",")[0])
+            payload = _os.urandom(BLOCK * 10 * 3)
+            st, _, _ = http_request(
+                "POST", f"http://{a['publicUrl']}/{a['fid']}", payload)
+            assert st == 201
+            src = next(
+                v for v in vols if v.store.get_volume(vid) is not None)
+            if src.fastlane:
+                src.fastlane.drain()
+            src.store.get_volume(vid).online_ec.pump(force=True)
+            dst = next(v for v in vols if v is not src)
+            src_id = f"{src._host}:{src.data_port}"
+            dst_id = f"{dst._host}:{dst.data_port}"
+            run_command(env, "lock")
+            run_command(
+                env,
+                f"volume.move -volumeId {vid} -source {src_id}"
+                f" -target {dst_id}",
+            )
+            nv = dst.store.get_volume(vid)
+            assert nv is not None and nv.online_ec is not None
+            assert nv.online_ec.active
+            assert nv.online_ec.parity_health() == 0
+            assert nv.online_ec.watermark == 3 * BLOCK * 10
+            st, _, body = http_request("GET", f"http://{dst_id}/{a['fid']}")
+            assert st == 200 and body == payload
+        finally:
+            for vs in vols:
+                vs.stop()
+            master.stop()
 
     def test_smallest_wins_without_affinity_signal(self):
         from seaweedfs_tpu.shell.commands_volume import plan_balance

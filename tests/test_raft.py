@@ -295,7 +295,25 @@ class TestMasterHA:
             vol.heartbeat_once()  # re-register volumes with the new leader
 
             client2 = WeedClient(",".join(m.url for m in survivors))
-            for _ in range(3):
+            # the volume server's re-registration races the failover: a
+            # heartbeat that went to the dead leader leaves the new one
+            # with zero capacity ("cannot grow") for a beat — re-send and
+            # retry briefly instead of failing the first assign
+            import time as _time
+
+            deadline = _time.time() + 10
+            while True:
+                try:
+                    fid = client2.assign()["fid"]
+                    break
+                except OSError:
+                    if _time.time() > deadline:
+                        raise
+                    vol.heartbeat_once()
+                    _time.sleep(0.2)
+            assert fid not in fids
+            fids.add(fid)
+            for _ in range(2):
                 fid = client2.assign()["fid"]
                 assert fid not in fids  # never reuse a file id
                 fids.add(fid)

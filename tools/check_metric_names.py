@@ -74,6 +74,9 @@ def collect() -> tuple[dict[str, str], list[str]]:
     from seaweedfs_tpu.storage.erasure_coding import online as ec_online
 
     ec_online.ensure_metrics()  # SeaweedFS_volume_ec_online_* families
+    from seaweedfs_tpu.storage.erasure_coding import decoder as ec_decoder
+
+    ec_decoder.repair_metrics()  # SeaweedFS_volume_ec_repair_* families
     maintenance.ensure_metrics()  # SeaweedFS_maintenance_* families
     from seaweedfs_tpu.storage.volume import degraded_reads_counter
     from seaweedfs_tpu.util import faults as faults_mod
@@ -253,6 +256,33 @@ def fault_point_violations() -> list[str]:
     return bad
 
 
+def repair_reason_violations() -> list[str]:
+    """Repair modes / fallback reasons / chain-restart reasons ride into
+    the labels of the SeaweedFS_volume_ec_repair_* families (and the
+    shell verb's -mode flag) — lint them like the other reason sets:
+    unique snake_case, the restart reasons a real subset of the fallback
+    reasons (a restart that exhausts becomes that fallback), and the
+    mode set exactly the classic/pipelined pair bench compares."""
+    from seaweedfs_tpu.storage.erasure_coding import decoder
+
+    bad: list[str] = []
+    if tuple(sorted(decoder.REPAIR_MODES)) != ("classic", "pipelined"):
+        bad.append(f"repair modes {decoder.REPAIR_MODES!r}: expected"
+                   f" exactly classic+pipelined")
+    seen: set[str] = set()
+    for name in decoder.REPAIR_FALLBACK_REASONS:
+        if not ALERT_RULE_RE.match(name):
+            bad.append(f"repair fallback reason {name!r}: not snake_case")
+        if name in seen:
+            bad.append(f"repair fallback reason {name!r}: duplicate")
+        seen.add(name)
+    for name in decoder.REPAIR_RESTART_REASONS:
+        if name not in seen:
+            bad.append(f"repair restart reason {name!r}: not a declared"
+                       f" fallback reason")
+    return bad
+
+
 def degraded_reason_violations() -> list[str]:
     """Degraded-read reasons ride into the `reason` label of
     SeaweedFS_volume_degraded_reads_total (and the degraded_reads alert
@@ -294,7 +324,7 @@ def main() -> int:
     bad = violations(kinds, collector_names) + alert_rule_violations() \
         + task_type_violations() + front_reason_violations() \
         + ec_online_reason_violations() + fault_point_violations() \
-        + degraded_reason_violations()
+        + degraded_reason_violations() + repair_reason_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
         print(f"{len(bad)} metric-name violation(s) in {total} families:")
