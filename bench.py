@@ -1163,7 +1163,16 @@ def availability_summary(
     one volume holder is killed for real; reports the client-visible
     error rate, the degraded/retried share, read p99 inside the outage
     window, and time-to-heal — the service-through-repair coexistence
-    RapidRAID (arXiv:1207.6744) argues for, measured instead of assumed."""
+    RapidRAID (arXiv:1207.6744) argues for, measured instead of assumed.
+
+    PR-13 extends the phase with the flight-recorder/SLO acceptance: a
+    fault injected at the needle-read seam makes an online-EC
+    collection's reads DEGRADE (reconstructed, journaled with trace
+    ids) and the replicated collection's reads 500-then-retry, so the
+    fast-burn SLO alert must fire during the outage and clear after
+    heal (`slo_summary`), and the fraction of degraded reads whose
+    causal chain fully resolves (trace -> request span + a journaled
+    fault cause) is recorded as `why_coverage`."""
     import tempfile
     import threading
 
@@ -1173,12 +1182,18 @@ def availability_summary(
     from seaweedfs_tpu.server.volume import VolumeServer
     from seaweedfs_tpu.shell import CommandEnv
     from seaweedfs_tpu.stats import default_registry, parse_exposition
+    from seaweedfs_tpu.stats import alerts as alerts_mod
+    from seaweedfs_tpu.stats import events as events_mod
+    from seaweedfs_tpu.stats import trace as trace_mod
+    from seaweedfs_tpu.util import faults
 
+    EC_BLOCK = 4096
     d = os.path.join(BENCH_DIR, "availability")
     os.makedirs(d, exist_ok=True)
     tmp = tempfile.mkdtemp(dir=d)
     master = MasterServer(port=0, pulse_seconds=1, volume_size_limit_mb=64,
-                          maintenance_interval=0.25)
+                          maintenance_interval=0.25,
+                          ec_online="availec", ec_online_block=EC_BLOCK)
     master.start()
     vols = []
     out: dict = {"outage_s": outage_s, "readers": readers, "blobs": blobs}
@@ -1198,7 +1213,30 @@ def availability_summary(
                          "&collection=avail")
             http_request("POST", f"http://{a['publicUrl']}/{a['fid']}", data)
             fids.append(a["fid"])
+        # online-EC blobs whose reads will DEGRADE (reconstruct from the
+        # streamed parity) when the .dat read fault fires mid-outage
+        ec_urls = []
+        ec_vids: set = set()
+        for _ in range(4):
+            a = get_json(f"{master.url}/dir/assign?collection=availec")
+            url = f"http://{a['publicUrl']}/{a['fid']}"
+            http_request("POST", url, os.urandom(EC_BLOCK * 10))
+            ec_urls.append(url)
+            ec_vids.add(int(a["fid"].split(",")[0]))
+        for vs in vols:
+            if vs.fastlane:
+                vs.fastlane.drain()
+            for vid_ in list(vs.store.volume_ids()):
+                v_ = vs.store.get_volume(vid_)
+                if v_ is not None and v_.online_ec is not None:
+                    v_.online_ec.pump(force=True)
         post_json(f"{master.url}/maintenance/enable")
+        # tighten the SLO windows to the phase's timescale (the 5s
+        # history interval still gives each window >= 2 samples) and let
+        # the degraded_reads alert fire on the phase's modest read rate
+        eng = alerts_mod.engine()
+        eng.configure(slo_fast_window=15.0, slo_slow_window=45.0,
+                      degraded_read_rate=0.05)
 
         def degraded_total() -> float:
             return sum(
@@ -1277,6 +1315,116 @@ def availability_summary(
                     return
                 time.sleep(0.2)
 
+        # --- PR-13: degraded reads + SLO burn through the outage -------
+        ev_t0 = time.time()
+        faults.enable()
+        # fires inside each Python-path read's request span, so every
+        # injection and every degraded read journals with its trace id:
+        # online-EC reads reconstruct (200, degraded), replicated reads
+        # 500 at the faulted holder and fail over (genuine 5xx burn)
+        faults.arm("volume.read.idx", "error", rate=0.3)
+        stop_aux = threading.Event()
+        deg_stats = {"ok": 0, "err": 0}
+        py_stats = {"ok": 0, "err": 0}
+
+        def ec_reader() -> None:
+            i = 0
+            while not stop_aux.is_set():
+                url = ec_urls[i % len(ec_urls)]
+                i += 1
+                try:
+                    st, _, _ = http_request(
+                        "GET", url + "?availdeg=1", timeout=10)
+                    ok = st == 200
+                except Exception:
+                    ok = False
+                deg_stats["ok" if ok else "err"] += 1
+                time.sleep(0.05)
+
+        loc_map = {
+            fid: [l["url"] for l in get_json(
+                f"{master.url}/dir/lookup?volumeId={fid.split(',')[0]}",
+                timeout=5).get("locations", [])]
+            for fid in fids
+        }
+
+        def py_reader() -> None:
+            # query-string GETs ride the Python path (the metered one the
+            # SLO availability objective watches); a 500 fails over to
+            # the other replica like the real client would
+            i = 0
+            while not stop_aux.is_set():
+                fid = fids[i % len(fids)]
+                i += 1
+                ok = False
+                for loc in loc_map[fid]:
+                    try:
+                        st, _, _ = http_request(
+                            "GET", f"http://{loc}/{fid}?bench=1",
+                            timeout=10)
+                    except Exception:
+                        continue
+                    if st == 200:
+                        ok = True
+                        break
+                py_stats["ok" if ok else "err"] += 1
+                time.sleep(0.02)
+
+        # continuous cause-chain resolution: each journaled degraded read
+        # is resolved while its trace is FRESH (an operator runs
+        # cluster.why near the incident; post-hoc resolution after a
+        # minute of storm would measure ring retention, not correlation)
+        rec = events_mod.recorder()
+        col = trace_mod.collector()
+        why_cov = {"seen": set(), "total": 0, "resolved": 0}
+
+        def why_resolver() -> None:
+            while True:
+                done = stop_aux.is_set()  # final pass after stop
+                fault_evs = rec.events(type="fault_injected", limit=0)
+                fault_traces = {f.get("trace_id") for f in fault_evs
+                                if f.get("trace_id")}
+                fault_vols = {f.get("volume") for f in fault_evs
+                              if f.get("volume") is not None}
+                for e in rec.events(type="degraded_read", limit=0):
+                    if e["ts"] < ev_t0 or e.get("volume") not in ec_vids \
+                            or e["seq"] in why_cov["seen"]:
+                        continue
+                    why_cov["seen"].add(e["seq"])
+                    why_cov["total"] += 1
+                    tid = e.get("trace_id")
+                    if tid and col.trace_spans(tid) and (
+                            tid in fault_traces
+                            or e.get("volume") in fault_vols):
+                        why_cov["resolved"] += 1
+                if done:
+                    return
+                time.sleep(0.3)
+
+        slo_state = {"fired": False, "max_burn": 0.0, "alerts": set()}
+
+        def slo_watch() -> None:
+            while not stop_aux.is_set():
+                try:
+                    eng.history.ensure_fresh(2.0)
+                    snap = eng.snapshot()
+                    slo_state["alerts"] |= set(snap["firing"])
+                    if "slo_burn_fast" in snap["firing"]:
+                        slo_state["fired"] = True
+                    for s in eng.slo_status().values():
+                        b = s.get("burn_fast")
+                        if b:
+                            slo_state["max_burn"] = max(
+                                slo_state["max_burn"], b)
+                except Exception:
+                    pass
+                time.sleep(0.5)
+
+        aux = [threading.Thread(target=fn, daemon=True)
+               for fn in (ec_reader, py_reader, slo_watch, why_resolver)]
+        for t in aux:
+            t.start()
+
         window["t0"] = time.perf_counter()
         heal_t0 = time.time()
         healer = threading.Thread(target=heal_poll, args=(heal_t0,),
@@ -1285,11 +1433,52 @@ def availability_summary(
         victim.stop()
         time.sleep(outage_s)
         window["t1"] = time.perf_counter()
+        faults.disarm_all()  # the injected outage ends with the window
         healer.join(timeout=max(0.0, heal_t0 + 60 - time.time()))
         healed_at = heal["at"]
         stop.set()
-        for t in threads:
+        stop_aux.set()
+        for t in threads + aux:
             t.join(timeout=10)
+
+        # the fast-burn alert must CLEAR once the burst ages out of the
+        # (tightened) fast window — the "fires during the outage, clears
+        # after heal" acceptance, measured
+        cleared = False
+        clear_deadline = time.time() + 60
+        while time.time() < clear_deadline:
+            try:
+                eng.history.ensure_fresh(1.0)
+                if "slo_burn_fast" not in eng.snapshot()["firing"]:
+                    cleared = True
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        out["slo_summary"] = {
+            "fast_burn_fired_during_outage": slo_state["fired"],
+            "fast_burn_cleared_after_heal": cleared,
+            "max_burn_fast": round(slo_state["max_burn"], 2),
+            "alerts_during_outage": sorted(slo_state["alerts"]),
+            # python-path reads driven through the fault (each 500
+            # fails over to the other replica); errors = reads where NO
+            # replica served
+            "python_path_reads": py_stats["err"] + py_stats["ok"],
+            "python_path_errors": py_stats["err"],
+            "degraded_collection_reads": deg_stats["ok"],
+            "degraded_collection_errors": deg_stats["err"],
+        }
+
+        # why coverage: fraction of journaled degraded reads whose cause
+        # chain fully resolved — a trace id resolving to the request
+        # span AND a journaled fault injection tied to the same trace or
+        # volume (the cluster.why acceptance, computed not eyeballed)
+        out["why_coverage"] = {
+            "degraded_reads_journaled": why_cov["total"],
+            "cause_chain_resolved": why_cov["resolved"],
+            "ratio": (round(why_cov["resolved"] / why_cov["total"], 4)
+                      if why_cov["total"] else None),
+        }
         total = stats["ok"] + stats["err"]
         out["reads_total"] = total
         out["reads_failed"] = stats["err"]
@@ -1312,6 +1501,16 @@ def availability_summary(
             round(healed_at - heal_t0, 3) if healed_at else None
         )
     finally:
+        faults.disarm_all()
+        try:  # restore the process-wide engine's default thresholds
+            eng.configure(
+                slo_fast_window=alerts_mod.DEFAULT_PARAMS["slo_fast_window"],
+                slo_slow_window=alerts_mod.DEFAULT_PARAMS["slo_slow_window"],
+                degraded_read_rate=alerts_mod.DEFAULT_PARAMS[
+                    "degraded_read_rate"],
+            )
+        except Exception:
+            pass
         for vs in vols:
             vs.stop()
         master.stop()

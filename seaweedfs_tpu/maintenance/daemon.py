@@ -318,6 +318,16 @@ class MaintenanceDaemon:
         duration = time.time() - started
         ok = state != "failed"
         retry_in = self.scheduler.complete(task, ok=ok)
+        from seaweedfs_tpu.stats import events as events_mod
+        from .scheduler import task_key_str
+
+        events_mod.emit(
+            "task_done" if ok else "task_failed",
+            task=task_key_str(task), volume=task.volume_id,
+            node=task.node, type=task.type, state=state,
+            duration_ms=round(duration * 1000.0, 2),
+            **({"error": error} if error is not None else {}),
+        )
         # a finished task frees a cap/throttle slot: wake the loop so the
         # next queued task dispatches now, not a full scan interval later
         if not self._stopping:

@@ -29,7 +29,16 @@ import random
 import threading
 import time
 
+from seaweedfs_tpu.stats import events as events_mod
+
 from .detectors import TASK_TYPES, RepairTask
+
+
+def task_key_str(task: RepairTask) -> str:
+    """The flight recorder's `task` correlation key: the scheduler's
+    dedup identity, flattened ("ec_rebuild:7", "evacuate:127.0.0.1:81")
+    so cluster.why can follow one repair queued→dispatched→done."""
+    return ":".join(str(p) for p in task.key)
 
 
 class RepairScheduler:
@@ -96,7 +105,10 @@ class RepairScheduler:
             self._seq += 1
             heapq.heappush(self._heap, (task.priority, self._seq, task))
             self._queued[key] = task
-            return True
+        events_mod.emit("task_queued", task=task_key_str(task),
+                        volume=task.volume_id, node=task.node,
+                        type=task.type, reason=task.reason)
+        return True
 
     # --- dispatch -------------------------------------------------------------
     def _refill(self, now: float) -> None:
@@ -154,7 +166,10 @@ class RepairScheduler:
             self.stats["max_inflight"] = max(
                 self.stats["max_inflight"], len(self._in_flight)
             )
-            return picked
+        events_mod.emit("task_dispatched", task=task_key_str(picked),
+                        volume=picked.volume_id, node=picked.node,
+                        type=picked.type)
+        return picked
 
     def complete(
         self, task: RepairTask, ok: bool, now: float | None = None
@@ -184,7 +199,12 @@ class RepairScheduler:
                 self.backoff_base * 2 ** (bo["failures"] - 1),
             ) * (0.5 + self._rng.random())  # +-50% jitter
             bo["not_before"] = now + delay
-            return delay
+            failures = bo["failures"]
+        events_mod.emit("task_backoff", task=task_key_str(task),
+                        volume=task.volume_id, node=task.node,
+                        type=task.type, retry_in=round(delay, 2),
+                        failures=failures)
+        return delay
 
     # --- views ----------------------------------------------------------------
     def pressure(self, now: float | None = None) -> dict:

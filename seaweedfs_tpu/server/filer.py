@@ -453,6 +453,14 @@ class FilerServer:
                 cookie, key, key + count, upload_auth.encode(),
                 read_auth.encode(),
             ))
+            from seaweedfs_tpu.stats import events as events_mod
+
+            events_mod.emit(
+                "lease_churn", volume=int(vid_s), node=loc,
+                action=("leased" if rc == 0
+                        else "kept" if rc == 1 else "rejected"),
+                rc=rc, count=count,
+            )
             if rc == 1:
                 # the master granted a vid the engine already holds with a
                 # healthy unspent range (the engine kept the range,
@@ -469,6 +477,14 @@ class FilerServer:
                 # backoff the 20ms loop would burn a count=20000 master
                 # assignment per tick forever.
                 self._fl_lease_backoff_until = time.monotonic() + 30.0
+                # this rejection IS the cause of pathological no_lease /
+                # lease_spent front-door fallbacks — journal it so
+                # cluster.why can name the root of a fallback regime
+                events_mod.emit(
+                    "fallback_fastlane", volume=int(vid_s), node=loc,
+                    reason="lease_rejected",
+                    detail=fl_mod.error_str(fl._lib, rc),
+                )
                 glog.warning(
                     "filer native lease rejected by engine (volume %s): %s;"
                     " chunk writes stay on the Python path", loc,

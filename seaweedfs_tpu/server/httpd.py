@@ -153,8 +153,12 @@ class HTTPService:
         self._m_total = reg.counter(
             "SeaweedFS_http_request_total", "requests", ("role", "method", "code")
         )
+        # exemplars: each latency sample carries the active trace id, so
+        # a cluster.top p99 row links straight to the trace that landed
+        # in that bucket (/debug/traces?id= point lookup)
         self._m_seconds = reg.histogram(
-            "SeaweedFS_http_request_seconds", "request latency", ("role", "method")
+            "SeaweedFS_http_request_seconds", "request latency",
+            ("role", "method"), exemplars=True,
         )
         if serve_route:
             @self.route("GET", r"/metrics")
@@ -181,10 +185,14 @@ class HTTPService:
             "constant 1, labeled with the build version and server role",
             ("version", "role"),
         ).labels(seaweedfs_tpu.__version__, role).set(1)
-        # the self-scraping history ring + alert engine start with the
-        # first metered server in the process (library imports pay nothing)
+        # the self-scraping history ring + alert engine + flight recorder
+        # start with the first metered server in the process (library
+        # imports pay nothing)
+        from seaweedfs_tpu.stats import events as events_mod
+
         history_mod.default_history().start()
         alerts_mod.engine()
+        events_mod.enable()
         self.enable_tracing(role)
 
     def enable_tracing(self, role: str) -> None:
@@ -453,6 +461,22 @@ def _register_debug_routes(service: "HTTPService") -> None:
     def debug_traces(req: Request) -> Response:
         import math
 
+        trace_id = req.query.get("id")
+        if trace_id is not None:
+            # exact-lookup (?id=): exemplar links and cluster.why resolve
+            # one trace without paging the whole ring. Malformed ids are
+            # a 400 with a JSON error, consistent with the other routes.
+            if not re.fullmatch(r"[0-9a-f]{1,32}", trace_id):
+                return Response(
+                    {"error": f"malformed trace id {trace_id!r}"
+                              " (lowercase hex)"}, 400
+                )
+            spans = col.trace_spans(trace_id)
+            return Response({
+                "trace_id": trace_id,
+                "found": bool(spans),
+                "spans": spans,
+            })
         try:
             limit = int(req.query.get("limit", 20))
             min_ms = float(req.query.get("min_ms", 0))
@@ -525,6 +549,8 @@ def _register_debug_routes(service: "HTTPService") -> None:
                 400,
             )
         hist.ensure_fresh()
+        from seaweedfs_tpu.stats import default_registry as _dr
+
         return Response({
             "interval": hist.interval,
             "slots": hist.slots,
@@ -535,6 +561,12 @@ def _register_debug_routes(service: "HTTPService") -> None:
                 family=req.query.get("family") or None,
                 window=window,
                 max_samples=max(0, max_samples),
+            ),
+            # histogram exemplars ride here, not in the 0.0.4 text format
+            # (which has no exemplar syntax): per (labels, upper bucket),
+            # the freshest sample's trace id — the p99 -> trace join
+            "exemplars": _dr().exemplars(
+                family=req.query.get("family") or None
             ),
         })
 
@@ -558,6 +590,47 @@ def _register_debug_routes(service: "HTTPService") -> None:
         out = alerts_mod.engine().status(window=window)
         out["proc"] = prof_mod.PROCESS_TOKEN
         return Response(out)
+
+    @service.route("GET", r"/debug/events")
+    def debug_events(req: Request) -> Response:
+        """The flight-recorder journal (stats/events.py): typed events
+        with correlation keys, filterable by ?type= / ?volume= /
+        ?trace= / ?since= (+ ?limit=). cluster.why fans this out across
+        every node and assembles the causal timeline."""
+        import math
+
+        from seaweedfs_tpu.stats import events as events_mod
+        from seaweedfs_tpu.stats import profiler as prof_mod
+
+        q = req.query
+        try:
+            limit = int(q.get("limit", 256))
+            volume = int(q["volume"]) if "volume" in q else None
+            since = float(q["since"]) if "since" in q else None
+            if since is not None and not math.isfinite(since):
+                raise ValueError(since)
+        except ValueError:
+            return Response(
+                {"error": "limit/volume/since must be finite numbers"}, 400
+            )
+        type_ = q.get("type") or None
+        if type_ is not None and type_ not in events_mod.EVENT_TYPES:
+            return Response(
+                {"error": f"unknown event type {type_!r}",
+                 "types": sorted(events_mod.EVENT_TYPES)}, 400
+            )
+        rec = events_mod.recorder()
+        return Response({
+            "proc": prof_mod.PROCESS_TOKEN,  # cluster.why dedup key
+            "role": service.trace_role or service.metrics_role,
+            "enabled": rec.enabled,
+            "capacity": rec.capacity,
+            "recorded": rec.recorded_total,
+            "dropped": rec.dropped_total,
+            "events": rec.events(type=type_, volume=volume,
+                                 trace=q.get("trace") or None,
+                                 since=since, limit=limit),
+        })
 
     @service.route("GET", r"/debug/faults")
     def debug_faults_get(req: Request) -> Response:

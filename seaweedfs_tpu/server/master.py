@@ -218,14 +218,37 @@ class MasterServer:
         # not flap the gauge, early enough to flag well before the 5x-pulse
         # node expiry removes the node (and its gauges) entirely
         stale_after = 3 * max(self.topo.pulse_seconds, 1)
+        # flight-recorder edges: staleness is computed right here, so the
+        # journal events ride the same scrape that flips the gauge (a
+        # racing double-render could at worst duplicate an edge — the
+        # journal tolerates that; missing one it would not)
+        prev_stale = getattr(self, "_stale_nodes", None)
+        if prev_stale is None:
+            prev_stale = self._stale_nodes = set()
+        from seaweedfs_tpu.stats import events as events_mod
+
+        # a stale node that EXPIRED out of the topology never rejoined —
+        # drop it without an edge, so the set can't leak and a later
+        # fresh re-registration can't fabricate a spurious rejoin
+        live_ids = {n.id for n in self.topo.all_nodes()}
+        prev_stale &= live_ids
         for node in self.topo.all_nodes():
             where = {"dc": node.dc_name(), "rack": node.rack_name(),
                      "node": node.id}
             sample("SeaweedFS_master_free_slots", where, node.free_slots())
             age = max(0.0, now - node.last_seen)
+            stale = age > stale_after
+            if stale and node.id not in prev_stale:
+                prev_stale.add(node.id)
+                events_mod.emit("heartbeat_stale", node=node.id,
+                                age_s=round(age, 2))
+            elif not stale and node.id in prev_stale:
+                prev_stale.discard(node.id)
+                events_mod.emit("heartbeat_rejoin", node=node.id,
+                                age_s=round(age, 2))
             sample("SeaweedFS_master_heartbeat_age_seconds", where, age)
             sample("SeaweedFS_master_stale_heartbeats", where,
-                   1 if age > stale_after else 0)
+                   1 if stale else 0)
             sample("SeaweedFS_master_ec_shard_count", where,
                    sum(len(s.shard_ids()) for s in node.ec_shards.values()))
             for vid, v in sorted(node.volumes.items()):

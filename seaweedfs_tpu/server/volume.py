@@ -607,7 +607,7 @@ class VolumeServer:
         PUT/DELETEs are fid-addressed, so a re-send cannot duplicate) before
         the all-or-nothing verdict."""
         me = f"{self._host}:{self.data_port}"
-        _FP_REPLICATE.hit(key=me)
+        _FP_REPLICATE.hit(key=me, volume=vid)
         try:
             info = get_json(f"{self.master_url}/dir/lookup?volumeId={vid}", timeout=5)
         except Exception as e:
@@ -1128,9 +1128,9 @@ class VolumeServer:
             instead of one per shard). Every received/served payload
             counts into ec_repair_bytes_on_wire{mode="pipelined"}."""
             me = f"{self._host}:{self.data_port}"
-            _FP_PARTIAL.hit(key=me)
             q = req.query
             vid = int(q["volume"])
+            _FP_PARTIAL.hit(key=me, volume=vid)
             collection = q.get("collection", "")
             offset = int(q["offset"])
             size = int(q["size"])
@@ -1138,6 +1138,13 @@ class VolumeServer:
             if size <= 0 or offset < 0 or not targets:
                 return Response({"error": "bad offset/size/targets"}, 400)
             chain = json.loads(q["chain"]) if "chain" in q else []
+            # hop identity onto the request's server span: a pipelined
+            # rebuild renders in cluster.trace as one cross-node chain of
+            # `POST /admin/ec/partial` spans — the attrs say which hop
+            from seaweedfs_tpu.stats import trace as _trace
+
+            _trace.annotate(volume=vid, targets=targets, hop=me,
+                            hops_left=len(chain))
             if chain:
                 hop, rest = chain[0], chain[1:]
                 coefs = {int(k): v for k, v in hop.get("coefs", {}).items()}

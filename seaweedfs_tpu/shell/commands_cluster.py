@@ -649,6 +649,7 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
                     r["version"] = version
 
         firing: dict[str, dict] = {}
+        slo_rows: dict[str, dict] = {}
         seen_procs: set[str] = set()
         for ep in sorted(alert_res):
             token = alert_res[ep].get("proc") or ep
@@ -658,12 +659,39 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
             for a in alert_res[ep].get("alerts", []):
                 if a.get("firing"):
                     firing.setdefault(a["name"], a)
+            # per-slo burn: the worst process's reading wins (one slow
+            # filer is the story, not the fleet average)
+            for name, s in (alert_res[ep].get("slos") or {}).items():
+                cur = slo_rows.setdefault(name, dict(s))
+                for k in ("burn_fast", "burn_slow"):
+                    v = s.get(k)
+                    if v is not None and (cur.get(k) is None
+                                          or v > cur[k]):
+                        cur[k] = v
+
+        # p99 exemplars (histogram bucket -> trace id): per role, the
+        # slowest sample's trace INSIDE the window — the p99 row's "go
+        # look" link. Exemplars never expire server-side (freshest per
+        # bucket), so without the ts filter one old multi-second request
+        # would pin the column to a long-evicted trace forever.
+        exemplar: dict[str, dict] = {}
+        cutoff = _time.time() - window
+        for token in sorted(by_proc):
+            ex = hist_res[by_proc[token]].get("exemplars") or {}
+            for e in ex.get("SeaweedFS_http_request_seconds", []):
+                if e.get("ts", 0) < cutoff:
+                    continue
+                role = e.get("labels", {}).get("role", "?")
+                cur = exemplar.get(role)
+                if cur is None or e.get("value", 0) > cur.get("value", 0):
+                    exemplar[role] = e
 
         lines = [
             f"cluster.top @ {env.master_url}  window={window:g}s  "
             f"{len(by_proc)} process(es), {len(hist_res)} endpoint(s)",
             f"{'role':<10} {'req/s':>9} {'5xx%':>7} {'p99 ms':>9}"
-            f" {'bytes/s':>10} {'front%':>7} {'uptime':>8}  version",
+            f" {'bytes/s':>10} {'front%':>7} {'uptime':>8}  version"
+            f"  p99-trace",
         ]
         for role in sorted(roles):
             r = roles[role]
@@ -677,16 +705,30 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
             front = (
                 f"{100.0 * r['fr_native'] / fr_total:.1f}" if fr_total else "-"
             )
+            ex = exemplar.get(role)
             lines.append(
                 f"{role:<10} {r['req_s']:>9.1f} {err_pct:>7}"
                 f" {('n/a' if p99 is None else f'{p99 * 1e3:.2f}'):>9}"
                 f" {_fmt_bytes_rate(r['bytes_s']):>10}"
                 f" {front:>7}"
                 f" {_fmt_uptime(r['uptime']):>8}  {r['version'] or '-'}"
+                f"  {ex['trace_id'] if ex else '-'}"
             )
         if not roles:
             lines.append("(no rates yet — the history ring needs two"
                          " scrapes inside the window)")
+        if slo_rows:
+            lines.append("slo error-budget burn (x sustainable;"
+                         " fast/slow window):")
+            for name in sorted(slo_rows):
+                s = slo_rows[name]
+                fast, slow = s.get("burn_fast"), s.get("burn_slow")
+                obj = s.get("objective", 0.0)
+                lines.append(
+                    f"  {name:<24} obj={obj:.3%}"
+                    f"  fast={'-' if fast is None else f'{fast:.2f}x'}"
+                    f"  slow={'-' if slow is None else f'{slow:.2f}x'}"
+                )
         if firing:
             lines.append(f"{len(firing)} alert(s) firing:")
             for name in sorted(firing):
@@ -720,6 +762,186 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
     except KeyboardInterrupt:
         pass
     return f"cluster.top stopped after {shown} frame(s)"
+
+
+def _why_describe(ev: dict) -> str:
+    """One flight-recorder event as a timeline row body."""
+    parts = [ev["type"]]
+    for k in ("task", "volume", "node"):
+        if ev.get(k) is not None:
+            parts.append(f"{k}={ev[k]}")
+    for k, v in sorted((ev.get("attrs") or {}).items()):
+        parts.append(f"{k}={v}")
+    if ev.get("trace_id"):
+        parts.append(f"trace={ev['trace_id']}")
+    return " ".join(str(p) for p in parts)
+
+
+@command("cluster.why",
+         "<trace-id|volume-id> [-window 600] [-limit 2048]"
+         " [-include url,url] — assemble one causally-ordered cross-node"
+         " timeline from every node's flight recorder (/debug/events) +"
+         " trace ring: request span, degraded read, injected fault, alert"
+         " edges, repair task lifecycle, heal")
+def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
+    """The question the disconnected counters never answered: WHY was
+    this read degraded / WHAT healed this volume. Given a trace id, the
+    verb pulls the trace's spans and trace-keyed events from every node,
+    widens to the volumes those events name, and folds in each volume's
+    fault/alert/task/heal events inside the window; given a volume id it
+    renders that volume's whole incident timeline. Events are deduped by
+    (process token, seq) — single-process test clusters expose one ring
+    at every port."""
+    import math
+    import re as _re
+
+    flags = parse_flags(args)
+    target = flags.get("", "").strip()
+    if not target:
+        raise ShellError(
+            "usage: cluster.why <trace-id|volume-id> [-window n]"
+            " [-include url,url]")
+    try:
+        window = float(flags.get("window", 600.0))
+        limit = int(flags.get("limit", 2048))
+        if not math.isfinite(window) or window <= 0:
+            raise ValueError(window)
+    except ValueError:
+        raise ShellError("bad -window/-limit")
+    volume_id: int | None = None
+    trace_id: str | None = None
+    if target.isdigit():
+        volume_id = int(target)
+    elif _re.fullmatch(r"[0-9a-f]{1,32}", target):
+        trace_id = target
+    else:
+        raise ShellError(
+            f"{target!r} is neither a volume id nor a (lowercase hex)"
+            f" trace id")
+
+    endpoints = _discover_endpoints(env, flags.get("include", ""))
+    ev_res: dict[str, dict] = {}
+    tr_res: dict[str, dict] = {}
+
+    def fetch(ep: str) -> None:
+        try:
+            ev_res[ep] = env.get(
+                f"{ep}/debug/events?limit={limit}", timeout=10)
+        except Exception:
+            return  # an unreachable node must not sink the timeline
+        if trace_id is not None:
+            try:
+                tr_res[ep] = env.get(
+                    f"{ep}/debug/traces?id={trace_id}", timeout=10)
+            except Exception:
+                pass
+
+    _fetch_concurrently(endpoints, fetch)
+    if not ev_res:
+        raise ShellError("no /debug/events endpoint reachable")
+
+    # dedup: one ring per process, exposed at every one of its ports
+    events: list[dict] = []
+    seen: set[tuple] = set()
+    procs: set[str] = set()
+    for ep in sorted(ev_res):
+        out = ev_res[ep]
+        token = out.get("proc") or ep
+        for ev in out.get("events", []):
+            key = (token, ev.get("seq"))
+            if key in seen:
+                continue
+            seen.add(key)
+            procs.add(token)
+            events.append(ev)
+
+    spans: dict[str, dict] = {}
+    for ep in sorted(tr_res):
+        for sp in tr_res[ep].get("spans", []):
+            spans.setdefault(sp["span_id"], sp)
+
+    if trace_id is not None:
+        direct = [ev for ev in events if ev.get("trace_id") == trace_id]
+        anchor_ts = [sp["start"] for sp in spans.values()] \
+            + [ev["ts"] for ev in direct]
+        if not anchor_ts and not direct:
+            raise ShellError(
+                f"trace {trace_id}: no spans or events found on"
+                f" {len(ev_res)} endpoint(s) (evicted, or wrong id?)")
+        t0 = min(anchor_ts)
+        # widen to the volumes the trace touched: their fault/alert/task
+        # events ARE the causal context (a repair task has no trace id —
+        # it is correlated by volume + time)
+        vols = {ev["volume"] for ev in direct if ev.get("volume") is not None}
+        for sp in spans.values():
+            v = (sp.get("attrs") or {}).get("volume")
+            if v is not None:
+                try:
+                    vols.add(int(v))
+                except (TypeError, ValueError):
+                    pass
+        related = [
+            ev for ev in events
+            if ev.get("trace_id") != trace_id
+            and ev.get("volume") in vols
+            and t0 - 1.0 <= ev["ts"] <= t0 + window
+        ]
+        picked = direct + related
+        head = (f"cluster.why trace {trace_id}: {len(spans)} span(s),"
+                f" {len(direct)} direct + {len(related)} related event(s)"
+                f" from {len(procs)} process(es)"
+                + (f", volumes {sorted(vols)}" if vols else ""))
+    else:
+        picked = [ev for ev in events if ev.get("volume") == volume_id]
+        if picked:
+            t1 = max(ev["ts"] for ev in picked)
+            picked = [ev for ev in picked if ev["ts"] >= t1 - window]
+        if not picked:
+            raise ShellError(
+                f"volume {volume_id}: no events found on"
+                f" {len(ev_res)} endpoint(s)")
+        # pull the request traces the volume's events name (the span side
+        # of the story: which reads were degraded, how slow they were) —
+        # ONE fan-out with all lookups batched per endpoint, so a single
+        # unreachable node costs one timeout, not one per trace id
+        tids = sorted({ev["trace_id"] for ev in picked
+                       if ev.get("trace_id")})[:8]
+        found: dict[str, list] = {}
+        found_lock = __import__("threading").Lock()
+
+        def fetch_traces(ep: str) -> None:
+            for tid in tids:
+                try:
+                    out = env.get(f"{ep}/debug/traces?id={tid}", timeout=10)
+                except Exception:
+                    return  # unreachable: skip its remaining lookups too
+                with found_lock:
+                    found.setdefault(ep, []).extend(out.get("spans", []))
+
+        if tids:
+            _fetch_concurrently(ev_res, fetch_traces)
+        for sps in found.values():
+            for sp in sps:
+                spans.setdefault(sp["span_id"], sp)
+        head = (f"cluster.why volume {volume_id}: {len(picked)} event(s),"
+                f" {len(spans)} span(s) from {len(procs)} process(es)")
+
+    # one causally-ordered timeline: spans (at their start time) + events
+    rows: list[tuple[float, str]] = []
+    for sp in spans.values():
+        rows.append((
+            sp["start"],
+            f"span [{sp.get('role') or '-'}] {sp['name']}"
+            f" {sp['duration_ms']}ms {sp['status']}"
+            f" trace={sp['trace_id']}",
+        ))
+    for ev in picked:
+        rows.append((ev["ts"], _why_describe(ev)))
+    rows.sort(key=lambda r: r[0])
+    t0 = rows[0][0] if rows else 0.0
+    lines = [head]
+    lines.extend(f"  +{ts - t0:8.3f}s  {body}" for ts, body in rows)
+    return "\n".join(lines)
 
 
 @command("cluster.faults",

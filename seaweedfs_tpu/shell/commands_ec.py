@@ -265,6 +265,7 @@ class PipelinedRebuildError(ShellError):
     def __init__(self, reason: str, detail: str = "") -> None:
         super().__init__(f"pipelined rebuild failed ({reason}): {detail}")
         self.reason = reason
+        self.detail = detail
 
 
 def plan_rebuild_pipelined(
@@ -404,6 +405,11 @@ def apply_rebuild_pipelined(
             reason = e.reason if e.reason in ec_decoder.REPAIR_RESTART_REASONS \
                 else "hop_failed"
             mrestarts.labels(reason).inc()
+            from seaweedfs_tpu.stats import events as events_mod
+
+            events_mod.emit("chain_restart", volume=plan["volume"],
+                            node=e.server, reason=reason,
+                            detail=e.detail[:200])
             restarts += 1
             if reason == "crc_mismatch":
                 crc_failures += 1
@@ -523,7 +529,23 @@ def run_rebuild(
     entry points produce identical repair behavior AND identical
     fallbacks/restarts metric series. Returns a dict:
     {healed} | {dry_run, mode, planned} |
-    {mode, planned, rebuilt, rebuilder, stats?}."""
+    {mode, planned, rebuilt, rebuilder, stats?}.
+
+    The whole repair runs inside an `ec.rebuild` trace span: every hop
+    POST inherits its X-Sw-Trace-Id (httpd's automatic propagation), so
+    `cluster.trace` shows the start -> partial hops -> commit chain as
+    ONE cross-node trace — from the daemon it nests under the
+    maintenance.ec_rebuild root, from the shell it IS the root."""
+    from seaweedfs_tpu.stats import trace as trace_mod
+
+    with trace_mod.span("ec.rebuild", volume=vid, mode=mode):
+        return _run_rebuild(env, vid, collection, mode, pressure, dry_run)
+
+
+def _run_rebuild(
+    env: CommandEnv, vid: int, collection: str, mode: str,
+    pressure: dict | None, dry_run: bool,
+) -> dict:
     if mode not in ("auto",) + ec_decoder.REPAIR_MODES:
         raise ShellError(f"mode must be auto|classic|pipelined, got {mode}")
     plan = plan_rebuild(env, vid, collection)
@@ -536,12 +558,18 @@ def run_rebuild(
         except (ShellError, IOError, OSError):
             pplan = None  # no usable chain (or a transient topology
             #               fetch failure): classic still repairs
+    from seaweedfs_tpu.stats import events as events_mod
+
     if mode == "auto":
         mode, _why = choose_rebuild_mode(pplan, pressure)
         if mode == "classic" and pplan is not None:
             ec_decoder.repair_metrics()[2].labels("too_few_holders").inc()
+            events_mod.emit("fallback_repair", volume=vid,
+                            reason="too_few_holders")
     if mode == "pipelined" and pplan is None:
         ec_decoder.repair_metrics()[2].labels("insufficient_shards").inc()
+        events_mod.emit("fallback_repair", volume=vid,
+                        reason="insufficient_shards")
         mode = "classic"
     if dry_run:
         planned = describe_rebuild_pipelined(pplan) if mode == "pipelined" \
@@ -556,6 +584,8 @@ def run_rebuild(
                     "stats": stats}
         except PipelinedRebuildError as e:
             ec_decoder.repair_metrics()[2].labels(e.reason).inc()
+            events_mod.emit("fallback_repair", volume=vid, reason=e.reason,
+                            detail=e.detail[:200])
             # classic stays the fallback: re-plan (the chain attempts may
             # have changed nothing — partial state aborted server-side)
             plan = plan_rebuild(env, vid, collection)

@@ -31,6 +31,94 @@ from seaweedfs_tpu.stats import history as history_mod
 from seaweedfs_tpu.stats.metrics import _fmt_labels, default_registry
 
 ALERT_FAMILIES = ("SeaweedFS_alerts_firing",)
+SLO_FAMILIES = ("SeaweedFS_slo_burn_rate",)
+
+
+class Slo:
+    """One declarative service-level objective, evaluated off the history
+    ring into an error-budget burn rate per window:
+
+      * kind="availability": objective = success ratio (0.999 -> 0.1%
+        error budget); burn = (5xx share of the role's requests) /
+        (1 - objective).
+      * kind="latency": objective = the quantile (0.99) that must land
+        within `threshold_s`; burn = (share of requests slower than the
+        threshold) / (1 - objective). The threshold snaps to a histogram
+        bucket bound, so the share is exact, not interpolated.
+
+    A burn rate of 1.0 spends the budget exactly at the sustainable
+    rate; 14x over the fast window pages (the multi-window burn-rate
+    discipline from the SRE workbook, scaled to the ring's retention)."""
+
+    __slots__ = ("name", "role", "kind", "objective", "threshold_s",
+                 "description")
+
+    def __init__(self, name: str, role: str, kind: str, objective: float,
+                 threshold_s: float = 0.0, description: str = ""):
+        self.name = name
+        self.role = role
+        self.kind = kind
+        self.objective = float(objective)
+        self.threshold_s = float(threshold_s)
+        self.description = description
+
+
+DEFAULT_SLOS = (
+    Slo("master_availability", "master", "availability", 0.999,
+        description="99.9% of master control-plane requests succeed"),
+    Slo("volume_availability", "volume", "availability", 0.999,
+        description="99.9% of volume data-plane requests succeed"),
+    Slo("filer_availability", "filer", "availability", 0.999,
+        description="99.9% of filer requests succeed"),
+    Slo("s3_availability", "s3", "availability", 0.999,
+        description="99.9% of s3 gateway requests succeed"),
+    Slo("volume_read_p99", "volume", "latency", 0.99, threshold_s=0.25,
+        description="99% of volume requests complete within 250ms"),
+    Slo("filer_p99", "filer", "latency", 0.99, threshold_s=0.5,
+        description="99% of filer requests complete within 500ms"),
+)
+
+
+def slo_burn(hist, slo: Slo, window: float, now: float):
+    """Error-budget burn rate for one SLO over one window -> float | None
+    (None = not enough traffic/samples to judge, distinct from 0.0)."""
+    budget = 1.0 - slo.objective
+    if budget <= 0:
+        return None
+    if slo.kind == "availability":
+        total = _sum_rates(
+            hist, "SeaweedFS_http_request_total", window, now,
+            match=lambda l: l.get("role") == slo.role,
+        )
+        if not total:
+            return None
+        errs = _sum_rates(
+            hist, "SeaweedFS_http_request_total", window, now,
+            match=lambda l: (l.get("role") == slo.role
+                             and l.get("code", "").startswith("5")),
+        ) or 0.0
+        return (errs / total) / budget
+    # latency: cumulative bucket rates keep the cumulative shape (rate of
+    # cumulative is cumulative of rates), so the share of requests slower
+    # than the threshold bound is (total - cum_at_bound) / total
+    per_bound: dict[float, float] = {}
+    for labels, rate in hist.rates(
+        "SeaweedFS_http_request_seconds_bucket", window, now
+    ):
+        if rate is None or labels.get("role") != slo.role:
+            continue
+        le = labels.get("le", "")
+        bound = float("inf") if le == "+Inf" else float(le)
+        per_bound[bound] = per_bound.get(bound, 0.0) + rate
+    total = per_bound.get(float("inf"))
+    if not total:
+        return None
+    candidates = [b for b in per_bound
+                  if b != float("inf") and b >= slo.threshold_s - 1e-12]
+    good = per_bound[min(candidates)] if candidates else total
+    slow_share = max(0.0, total - good) / total
+    return slow_share / budget
+
 
 DEFAULT_PARAMS = {
     # evaluation window (seconds) for every rate-based rule
@@ -59,6 +147,18 @@ DEFAULT_PARAMS = {
     # fault is in flight (torn .dat, lost shard/holder) — the reads
     # succeed, which is exactly why nothing else pages
     "degraded_read_rate": 0.5,
+    # SLO multi-window burn-rate alerting: the fast window pages on an
+    # incident spending the error budget 14x faster than sustainable
+    # (critical, self-clears once the burst ages out of the window); the
+    # slow window warns on a 3x sustained burn, gated on the fast window
+    # still showing burn >= 1 so a long-resolved incident stops warning.
+    "slo_fast_window": 60.0,
+    "slo_slow_window": 300.0,
+    "slo_fast_burn": 14.0,
+    "slo_slow_burn": 3.0,
+    # the SLO set itself is a param so deployments (and tests/bench) can
+    # swap objectives without subclassing the engine
+    "slos": DEFAULT_SLOS,
 }
 
 
@@ -247,6 +347,45 @@ def _check_ec_starved(hist, now, p):
     return worst, "EC pipeline stage starving: " + ", ".join(starved)
 
 
+def _check_slo_fast_burn(hist, now, p):
+    """An incident is spending the error budget an order of magnitude
+    faster than sustainable RIGHT NOW — the paging signal."""
+    worst, details = None, []
+    for slo in p.get("slos") or ():
+        burn = slo_burn(hist, slo, p["slo_fast_window"], now)
+        if burn is not None and burn > p["slo_fast_burn"]:
+            details.append(
+                f"{slo.name} burning {burn:.0f}x its error budget"
+                f" over {p['slo_fast_window']:g}s"
+            )
+            worst = max(worst or 0.0, burn)
+    if not details:
+        return None
+    return worst, "; ".join(details)
+
+
+def _check_slo_slow_burn(hist, now, p):
+    """A sustained slow leak of the error budget; the fast-window gate
+    (burn >= 1) keeps a long-resolved incident from warning forever
+    while its errors age out of the slow window."""
+    worst, details = None, []
+    for slo in p.get("slos") or ():
+        slow = slo_burn(hist, slo, p["slo_slow_window"], now)
+        if slow is None or slow <= p["slo_slow_burn"]:
+            continue
+        fast = slo_burn(hist, slo, p["slo_fast_window"], now)
+        if fast is None or fast < 1.0:
+            continue
+        details.append(
+            f"{slo.name} burning {slow:.1f}x its error budget"
+            f" over {p['slo_slow_window']:g}s (still burning)"
+        )
+        worst = max(worst or 0.0, slow)
+    if not details:
+        return None
+    return worst, "; ".join(details)
+
+
 def default_rules() -> list[Rule]:
     return [
         Rule("http_error_ratio", "critical",
@@ -275,6 +414,14 @@ def default_rules() -> list[Rule]:
              "needle reads are being served through EC reconstruction"
              " at a sustained rate (a fault is in flight)",
              _check_degraded_reads),
+        Rule("slo_burn_fast", "critical",
+             "an SLO's error budget is burning faster than the fast-"
+             "window threshold (incident in progress)",
+             _check_slo_fast_burn),
+        Rule("slo_burn_slow", "warning",
+             "an SLO's error budget is burning at a sustained multiple"
+             " over the slow window (and still burning now)",
+             _check_slo_slow_burn),
     ]
 
 
@@ -313,11 +460,19 @@ class AlertEngine:
         self._collector = self.registry.register_collector(
             self._lines, names=ALERT_FAMILIES
         )
+        # SLO error-budget burn gauges, refreshed on every evaluation —
+        # the history ring self-scrapes these right back, so cluster.top
+        # sees cluster-wide burn with zero extra plumbing
+        self._slo_burns: dict[str, dict] = {}
+        self._slo_collector = self.registry.register_collector(
+            self._slo_lines, names=SLO_FAMILIES
+        )
         self.history.add_listener(self._on_scrape)
 
     def close(self) -> None:
         self.history.remove_listener(self._on_scrape)
         self.registry.unregister_collector(self._collector)
+        self.registry.unregister_collector(self._slo_collector)
 
     def configure(self, **params) -> None:
         """Tune thresholds (keys of DEFAULT_PARAMS)."""
@@ -344,6 +499,58 @@ class AlertEngine:
     def _on_scrape(self, hist, now) -> None:
         self.evaluate(now=now)
 
+    def _slo_update(self, now: float) -> None:
+        """Recompute every SLO's fast/slow burn rate into the cache the
+        collector and /debug/alerts serve (computed once per evaluation,
+        not per scrape-time render)."""
+        p = self.params
+        burns: dict[str, dict] = {}
+        for slo in p.get("slos") or ():
+            try:
+                fast = slo_burn(self.history, slo, p["slo_fast_window"], now)
+                slow = slo_burn(self.history, slo, p["slo_slow_window"], now)
+            except Exception:
+                continue  # a broken SLO must not take down the scrape
+            burns[slo.name] = {
+                "role": slo.role, "kind": slo.kind,
+                "objective": slo.objective,
+                "threshold_s": slo.threshold_s,
+                "burn_fast": None if fast is None else round(fast, 4),
+                "burn_slow": None if slow is None else round(slow, 4),
+            }
+        with self._lock:
+            self._slo_burns = burns
+
+    def slo_status(self) -> dict:
+        """{slo_name: {role, kind, objective, burn_fast, burn_slow}} —
+        the /debug/alerts `slos` block cluster.top renders."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._slo_burns.items()}
+
+    def _slo_lines(self) -> list[str]:
+        with self._lock:
+            burns = {k: dict(v) for k, v in self._slo_burns.items()}
+        lines = [
+            "# HELP SeaweedFS_slo_burn_rate error-budget burn rate per"
+            " SLO and window (1.0 = spending the budget exactly at the"
+            " sustainable rate)",
+            "# TYPE SeaweedFS_slo_burn_rate gauge",
+        ]
+        from seaweedfs_tpu.stats.metrics import _fmt_value
+
+        for name in sorted(burns):
+            b = burns[name]
+            for win, key in (("fast", "burn_fast"), ("slow", "burn_slow")):
+                v = b.get(key)
+                if v is None:
+                    continue
+                lines.append(
+                    "SeaweedFS_slo_burn_rate"
+                    + _fmt_labels(("slo", "window"), (name, win))
+                    + f" {_fmt_value(v)}"
+                )
+        return lines
+
     def _run_checks(self, now: float, params: dict) -> dict:
         results = {}
         for rule in self.rules:
@@ -360,14 +567,17 @@ class AlertEngine:
         return a snapshot {name: {severity, since, value, detail}}."""
         now = time.time() if now is None else now
         results = self._run_checks(now, self.params)
+        self._slo_update(now)
         self._last_eval = time.time()
         rising: list[tuple[str, dict]] = []
+        cleared: list[tuple[str, dict]] = []
         with self._lock:
             for rule in self.rules:
                 res = results.get(rule.name)
                 cur = self.firing.get(rule.name)
                 if res is None:
                     if cur is not None:
+                        cleared.append((rule.name, dict(cur)))
                         del self.firing[rule.name]
                     continue
                 value, detail = res
@@ -385,13 +595,24 @@ class AlertEngine:
                     cur["detail"] = detail
             snapshot = {k: dict(v) for k, v in self.firing.items()}
             listeners = list(self._on_fire)
-        # outside the lock: a listener may call back into the engine
+        # outside the lock: a listener may call back into the engine.
+        # Rising AND clearing edges land in the flight recorder so
+        # cluster.why can bracket an incident (alert_raised ... cleared).
+        from seaweedfs_tpu.stats import events as events_mod
+
         for name, info in rising:
+            events_mod.emit("alert_raised", alert=name,
+                            severity=info.get("severity", "?"),
+                            detail=str(info.get("detail", ""))[:200])
             for fn in listeners:
                 try:
                     fn(name, info)
                 except Exception:
                     pass  # a broken listener must not sink the scrape
+        for name, info in cleared:
+            events_mod.emit("alert_cleared", alert=name,
+                            severity=info.get("severity", "?"),
+                            after_s=round(now - info.get("since", now), 2))
         return snapshot
 
     def status(self, window: float | None = None,
@@ -443,6 +664,9 @@ class AlertEngine:
                             else self.params["window"]),
             "firing": sum(1 for a in alerts if a["firing"]),
             "alerts": alerts,
+            "slos": self.slo_status(),
+            "slo_windows": {"fast": self.params["slo_fast_window"],
+                            "slow": self.params["slo_slow_window"]},
         }
 
     def snapshot(self) -> dict:

@@ -164,15 +164,34 @@ class _GaugeChild:
         self._parent._add(self._key, -amount)
 
 
+# Exemplar source hook: () -> (trace_id, span_id) | None. Installed by
+# stats.trace at import (this module must not import trace — trace
+# imports it), so histograms can stamp the active trace id onto their
+# latency samples without a dependency cycle.
+_exemplar_source = None
+
+
+def set_exemplar_source(fn) -> None:
+    global _exemplar_source
+    _exemplar_source = fn
+
+
 class Histogram(_Metric):
     kind = "histogram"
 
-    def __init__(self, name, help_text="", label_names=(), buckets=DEFAULT_BUCKETS):
+    def __init__(self, name, help_text="", label_names=(),
+                 buckets=DEFAULT_BUCKETS, exemplars=False):
         super().__init__(name, help_text, label_names)
         self.buckets = tuple(sorted(buckets))
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
+        # exemplars: most recent (trace_id, value, ts) per upper bucket —
+        # the join from a p99 row straight to the trace that landed there
+        # (opt-in: only request-latency histograms pay the per-observe
+        # source call; kernel histograms on the data plane do not)
+        self.exemplars_enabled = bool(exemplars)
+        self._exemplars: dict[tuple, dict[float, tuple]] = {}
 
     def labels(self, *values) -> "_HistogramChild":
         return _HistogramChild(self, tuple(str(v) for v in values))
@@ -181,6 +200,11 @@ class Histogram(_Metric):
         self.labels().observe(value)
 
     def _observe(self, key: tuple, value: float) -> None:
+        ex = None
+        if self.exemplars_enabled and _exemplar_source is not None:
+            ctx = _exemplar_source()
+            if ctx is not None:
+                ex = (ctx[0], value, time.time())
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
             for i, ub in enumerate(self.buckets):
@@ -188,6 +212,35 @@ class Histogram(_Metric):
                     counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+            if ex is not None:
+                for ub in self.buckets:
+                    if value <= ub:
+                        bound = ub
+                        break
+                else:
+                    bound = float("inf")
+                self._exemplars.setdefault(key, {})[bound] = ex
+
+    def exemplars(self) -> list[dict]:
+        """JSON-ready exemplar view: the freshest trace per (labels,
+        upper bucket). `le` renders "+Inf" for the overflow bucket to
+        stay JSON-safe."""
+        with self._lock:
+            items = [
+                (key, sorted(per.items()))
+                for key, per in self._exemplars.items()
+            ]
+        out = []
+        for key, per in items:
+            for bound, (tid, value, ts) in per:
+                out.append({
+                    "labels": dict(zip(self.label_names, key)),
+                    "le": "+Inf" if bound == float("inf") else bound,
+                    "trace_id": tid,
+                    "value": round(value, 6),
+                    "ts": round(ts, 3),
+                })
+        return out
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
@@ -270,12 +323,14 @@ class Registry:
         return self._get_or_create(Gauge, name, help_text, label_names)
 
     def histogram(
-        self, name, help_text="", label_names=(), buckets=DEFAULT_BUCKETS
+        self, name, help_text="", label_names=(), buckets=DEFAULT_BUCKETS,
+        exemplars=False,
     ) -> Histogram:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = Histogram(name, help_text, label_names, buckets)
+                m = Histogram(name, help_text, label_names, buckets,
+                              exemplars=exemplars)
                 self._metrics[name] = m
             if not isinstance(m, Histogram):
                 raise TypeError(f"{name} already registered as {type(m).__name__}")
@@ -284,6 +339,8 @@ class Registry:
                     f"{name} already registered with buckets {m.buckets}, "
                     f"not {tuple(sorted(buckets))}"
                 )
+            if exemplars:  # any registrant opting in turns them on
+                m.exemplars_enabled = True
             return m
 
     def _get_or_create(self, cls, name, help_text, label_names):
@@ -309,6 +366,24 @@ class Registry:
         with self._lock:
             if col in self._collectors:
                 self._collectors.remove(col)
+
+    def exemplars(self, family: str | None = None) -> dict[str, list[dict]]:
+        """{family: [exemplar, ...]} for every exemplar-bearing histogram
+        (served inside /debug/metrics/history — the Prometheus 0.0.4 text
+        format /metrics serves has no exemplar syntax, and smuggling one
+        in would break every parse_exposition consumer)."""
+        with self._lock:
+            hists = [
+                m for m in self._metrics.values()
+                if isinstance(m, Histogram) and m.exemplars_enabled
+                and (family is None or m.name == family)
+            ]
+        out: dict[str, list[dict]] = {}
+        for h in hists:
+            ex = h.exemplars()
+            if ex:
+                out[h.name] = ex
+        return out
 
     def metric_names(self) -> list[str]:
         """Every family name this registry can expose: registered metrics

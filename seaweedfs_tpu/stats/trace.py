@@ -138,6 +138,10 @@ class TraceCollector:
         self.max_spans = max_spans
         self._ring: collections.deque[Span] = collections.deque(maxlen=max_spans)
         self._inflight: dict[str, Span] = {}
+        # finished spans indexed by trace id (exemplar links and
+        # /debug/traces?id= need point lookups, not a ring scan);
+        # in-flight spans are found by scanning the small _inflight set
+        self._by_trace: dict[str, list[Span]] = {}
         self._lock = threading.Lock()
         # self-observability (SeaweedFS_stats_trace_*): how many spans this
         # ring recorded and how many it LOST (eviction under churn, unkept
@@ -147,8 +151,20 @@ class TraceCollector:
 
     def _append_locked(self, span: Span) -> None:
         if len(self._ring) == self.max_spans:
-            self.dropped_total += 1  # deque eviction is silent; count it
+            # evict explicitly (not via deque maxlen) so the trace-id
+            # index never holds a span the ring already lost
+            old = self._ring.popleft()
+            self.dropped_total += 1
+            lst = self._by_trace.get(old.trace_id)
+            if lst is not None:
+                try:
+                    lst.remove(old)
+                except ValueError:
+                    pass
+                if not lst:
+                    del self._by_trace[old.trace_id]
         self._ring.append(span)
+        self._by_trace.setdefault(span.trace_id, []).append(span)
         self.spans_total += 1
 
     # --- span lifecycle -------------------------------------------------------
@@ -232,10 +248,25 @@ class TraceCollector:
         spans.sort(key=lambda s: s.start)
         return [s.to_dict() for s in spans]
 
+    def trace_spans(self, trace_id: str) -> list[dict]:
+        """Point lookup by trace id: finished spans via the index plus
+        any still-in-flight spans of the same trace — so an exemplar
+        link or `cluster.why` resolves a trace while its request is
+        still running."""
+        with self._lock:
+            spans = list(self._by_trace.get(trace_id, ()))
+            spans += [
+                s for s in self._inflight.values()
+                if s.trace_id == trace_id
+            ]
+        spans.sort(key=lambda s: s.start)
+        return [s.to_dict() for s in spans]
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
             self._inflight.clear()
+            self._by_trace.clear()
 
 
 _collector = TraceCollector()
@@ -421,3 +452,26 @@ def _self_metrics_lines() -> list[str]:
 default_registry().register_collector(
     _self_metrics_lines, names=TRACE_SELF_FAMILIES
 )
+
+# Exemplar wiring: request-latency histograms stamp the active trace id
+# onto their samples through this hook. metrics.py cannot import this
+# module (it is imported BY it), so the hookup runs here at import time.
+from seaweedfs_tpu.stats.metrics import set_exemplar_source  # noqa: E402
+
+
+def _exemplar_ctx() -> tuple[str, str] | None:
+    """The active trace context, UNLESS the span will be dropped as
+    unkept noise (finish_span's rule: noise with no parent never enters
+    the ring) — an exemplar must not link to a trace that cannot
+    resolve (heartbeat/registration chatter would otherwise dangle)."""
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None:
+        return None
+    with _collector._lock:
+        sp = _collector._inflight.get(ctx[1])
+    if sp is not None and sp.attrs.get("noise") and sp.parent_id is None:
+        return None
+    return ctx
+
+
+set_exemplar_source(_exemplar_ctx)

@@ -51,6 +51,15 @@ class NeedleNotFound(NotFound):
     pass
 
 
+def _emit_degraded(volume_id: int, missing_shard: int, via: str) -> None:
+    """Journal a sealed-EC reconstruction into the flight recorder
+    (cold path — only runs when a shard read already failed)."""
+    from seaweedfs_tpu.stats import events as events_mod
+
+    events_mod.emit("degraded_read", volume=volume_id,
+                    reason="ec_reconstruct", shard=missing_shard, via=via)
+
+
 # sealed-shard pread seam: error/latency here exercises the local ->
 # remote -> reconstruct ladder below (an injected local-read failure
 # must degrade into reconstruction, not a 500)
@@ -176,7 +185,7 @@ class EcVolume:
         """Full-length positional read, or None if the shard can't serve it
         (absent or truncated — both are 'missing' to the erasure code)."""
         try:
-            _FP_SHARD_READ.hit()
+            _FP_SHARD_READ.hit(volume=self.volume_id)
         except (faults.FaultInjected, OSError):
             return None  # an injected local failure = a missing shard
         fd = self.shards.get(shard_id)
@@ -227,6 +236,7 @@ class EcVolume:
                 data = None
             if data is not None and len(data) == size:
                 degraded_reads_counter().labels("ec_reconstruct").inc()
+                _emit_degraded(self.volume_id, missing_shard, "partial_fanin")
                 return data
         present: dict[int, np.ndarray] = {}
         for shard_id in self.shards:
@@ -254,6 +264,7 @@ class EcVolume:
             )
         out = self.codec.reconstruct(present, targets=[missing_shard])
         degraded_reads_counter().labels("ec_reconstruct").inc()
+        _emit_degraded(self.volume_id, missing_shard, "full_decode")
         return out[missing_shard].tobytes()
 
     def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:

@@ -484,6 +484,10 @@ class OnlineEcWriter:
     def _count_fallback(self, reason: str) -> None:
         self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
         self._m_fallbacks.labels(self._vol_label, reason).inc()
+        from seaweedfs_tpu.stats import events as events_mod
+
+        events_mod.emit("fallback_ec_online", volume=int(self._vol_label),
+                        reason=reason)
 
     def _degrade(self, reason: str) -> None:
         """Leave online mode: the volume reverts to classic
@@ -620,7 +624,8 @@ class OnlineEcWriter:
         rows_done = 0
         nrows = behind // self.stripe
         try:
-            _FP_PARITY.hit()  # error/disk_full degrade like a real emit
+            _FP_PARITY.hit(volume=int(self._vol_label))  # error/
+            # disk_full degrade like a real emit failure would
             batch_rows = max(1, encoder_mod.DEFAULT_BATCH_HOST // self.block)
             if nrows > max(16, 2 * batch_rows):
                 # deep backlog (journal replay, seal catch-up): overlap
@@ -667,7 +672,7 @@ class OnlineEcWriter:
         if rows_done:
             spec = _FP_PARITY.spec
             if spec is not None and spec.mode == "torn":
-                spec = _FP_PARITY.draw()
+                spec = _FP_PARITY.draw(volume=int(self._vol_label))
                 if spec is not None:
                     self._tear_parity(spec.frac)
         self._m_buffered.labels(self._vol_label).set(

@@ -12,6 +12,8 @@ import os
 import threading
 from dataclasses import dataclass, field
 
+from seaweedfs_tpu.stats import events as events_mod
+
 from .erasure_coding.ec_volume import EcVolume, ec_shard_file_name
 from .needle import Needle
 from .types import TTL, ReplicaPlacement
@@ -164,7 +166,9 @@ class Store:
             if ec_online:
                 _attach_online_ec(v, block_size=ec_online_block, create=True)
             loc.volumes[vid] = v
-            return v
+        events_mod.emit("volume_state", volume=vid, state="created",
+                        collection=collection, ec_online=bool(ec_online))
+        return v
 
     def _pick_location(self) -> DiskLocation:
         candidates = [l for l in self.locations if not l.is_disk_space_low()]
@@ -178,6 +182,8 @@ class Store:
                 v = loc.volumes.pop(vid, None)
                 if v is not None:
                     v.destroy()
+                    events_mod.emit("volume_state", volume=vid,
+                                    state="deleted")
                     return
         raise VolumeError(f"volume {vid} not found")
 
@@ -186,6 +192,8 @@ class Store:
         if v is None:
             raise VolumeError(f"volume {vid} not found")
         v.readonly = readonly
+        events_mod.emit("volume_state", volume=vid,
+                        state="readonly" if readonly else "writable")
 
     # --- data ops -------------------------------------------------------------
     def write(self, vid: int, n: Needle, check_cookie: bool = False) -> tuple[int, int]:
@@ -225,6 +233,8 @@ class Store:
                 ):
                     v = Volume(loc.directory, collection, vid)
                     loc.volumes[vid] = v
+                    events_mod.emit("volume_state", volume=vid,
+                                    state="mounted", collection=collection)
                     return v
         raise VolumeError(f"no local .dat for volume {vid}")
 
@@ -235,6 +245,8 @@ class Store:
                 v = loc.volumes.pop(vid, None)
                 if v is not None:
                     v.close()
+                    events_mod.emit("volume_state", volume=vid,
+                                    state="unmounted")
                     return
         raise VolumeError(f"volume {vid} not found")
 
@@ -245,6 +257,8 @@ class Store:
             if os.path.exists(base + ".ecx"):
                 ev = EcVolume(loc.directory, collection, vid)
                 loc.ec_volumes[vid] = ev
+                events_mod.emit("volume_state", volume=vid,
+                                state="ec_mounted", shards=ev.shard_ids())
                 return ev
         raise VolumeError(f"no local .ecx for ec volume {vid}")
 
@@ -253,6 +267,8 @@ class Store:
             ev = loc.ec_volumes.pop(vid, None)
             if ev is not None:
                 ev.close()
+                events_mod.emit("volume_state", volume=vid,
+                                state="ec_unmounted")
                 return
 
     def remount_ec_volume(
@@ -285,6 +301,9 @@ class Store:
                     break
             if new is None and old_loc is not None:
                 old_loc.ec_volumes.pop(vid, None)
+        events_mod.emit("remount_swap", volume=vid,
+                        shards=new.shard_ids() if new is not None else [],
+                        had_old=old is not None)
         if old is not None:
             if grace > 0:
                 t = _threading.Timer(grace, old.close)

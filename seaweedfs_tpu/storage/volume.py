@@ -299,7 +299,7 @@ class Volume:
             return offset, n.size
 
     def _append(self, n: Needle) -> int:
-        _FP_WRITE_DAT.hit()  # error / disk_full / latency injection
+        _FP_WRITE_DAT.hit(volume=self.id)  # error / disk_full / latency
         offset = self._size
         if offset % NEEDLE_PADDING_SIZE != 0:
             offset += NEEDLE_PADDING_SIZE - offset % NEEDLE_PADDING_SIZE
@@ -307,7 +307,9 @@ class Volume:
         # torn-write injection: part of the record never reaches disk,
         # but the in-memory tail advances as if it did — the exact state
         # a crash mid-pwrite leaves, which degraded reads must survive
-        self._dat.write_at(_FP_WRITE_DAT.mangle(blob), offset)
+        self._dat.write_at(
+            _FP_WRITE_DAT.mangle(blob, volume=self.id), offset
+        )
         self._size = offset + len(blob)
         return offset
 
@@ -331,8 +333,8 @@ class Volume:
 
     # --- read path -----------------------------------------------------------
     def _read_at(self, offset: int, size: int) -> Needle:
-        _FP_READ_DAT.hit()  # needle-level seam: reconstruction reads
-        # (block-level, via online_ec/_dat directly) bypass it, so a
+        _FP_READ_DAT.hit(volume=self.id)  # needle-level seam: recon-
+        # struction reads (block-level, via online_ec/_dat) bypass it, so a
         # rate=1.0 error here still leaves the degraded path a way out
         total = get_actual_size(size, self.version())
         blob = self._dat.read_at(total, offset)
@@ -413,6 +415,14 @@ class Volume:
             else "dat_read"
         )
         degraded_reads_counter().labels(reason).inc()
+        # flight recorder: the event auto-captures the request's trace id
+        # (this runs inside the server span), so `cluster.why <trace>`
+        # can answer "why was this read degraded"
+        from seaweedfs_tpu.stats import events as events_mod
+
+        events_mod.emit("degraded_read", volume=self.id, reason=reason,
+                        needle=f"{needle_id:x}",
+                        cause=str(cause)[:120])
         return n
 
     def _reconstruct_from_sealed(self, offset: int, size: int) -> bytes | None:
@@ -451,7 +461,7 @@ class Volume:
                 raise NotFound("needle expired")
 
     def _read_needle_once(self, needle_id: int, cookie: int | None) -> Needle:
-        _FP_READ_IDX.hit()
+        _FP_READ_IDX.hit(volume=self.id)
         nv = self.nm.get(needle_id)
         if nv is None or not size_is_valid(nv[1]):
             raise NotFound(f"needle {needle_id:x} not found")

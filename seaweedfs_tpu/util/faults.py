@@ -123,16 +123,21 @@ class FaultPoint:
         self.fired = 0
 
     # --- hot path -----------------------------------------------------------
-    def draw(self, key: str | None = None) -> FaultSpec | None:
+    def draw(self, key: str | None = None,
+             volume: int | None = None) -> FaultSpec | None:
         """Decide whether the armed fault fires for this invocation and
         count it; returns the spec (caller acts) or None. Seams with
-        custom damage (torn parity) use this directly."""
+        custom damage (torn parity) use this directly. `volume` is a
+        pure correlation key for the flight-recorder journal — seams
+        that know which volume they are damaging pass it so
+        `cluster.why <volume>` can show the injection in the timeline."""
         spec = self.spec
         if spec is None:
             return None
-        return self._draw_slow(spec, key)
+        return self._draw_slow(spec, key, volume)
 
-    def _draw_slow(self, spec: FaultSpec, key: str | None) -> FaultSpec | None:
+    def _draw_slow(self, spec: FaultSpec, key: str | None,
+                   volume: int | None = None) -> FaultSpec | None:
         if spec.key and key is not None and key != spec.key:
             return None
         if spec.rate < 1.0 and random.random() >= spec.rate:
@@ -148,9 +153,16 @@ class FaultPoint:
                     self.spec = None
             self.fired += 1
         _injected_counter().labels(self.name, spec.mode).inc()
+        # flight-recorder journal (cold path: only a FIRING fault pays) —
+        # emitted inside the request span when one is active, so
+        # cluster.why joins the injection to the read it degraded
+        from seaweedfs_tpu.stats import events as _events
+
+        _events.emit("fault_injected", point=self.name, mode=spec.mode,
+                     key=key or "", volume=volume)
         return spec
 
-    def hit(self, key: str | None = None) -> None:
+    def hit(self, key: str | None = None, volume: int | None = None) -> None:
         """The standard seam check: no-op disarmed; armed, acts per mode
         (error/partition/disk_full raise, latency sleeps; torn is a
         no-op here — use mangle() at the byte seam, so a seam calling
@@ -158,17 +170,18 @@ class FaultPoint:
         spec = self.spec
         if spec is None or spec.mode == "torn":
             return
-        spec = self.draw(key)
+        spec = self.draw(key, volume)
         if spec is not None:
             act(self.name, spec)
 
-    def mangle(self, data: bytes, key: str | None = None) -> bytes:
+    def mangle(self, data: bytes, key: str | None = None,
+               volume: int | None = None) -> bytes:
         """Torn-write seams: return the payload truncated by `frac` when
         a torn fault fires; every other mode is handled by hit()."""
         spec = self.spec
         if spec is None or spec.mode != "torn":
             return data
-        spec = self.draw(key)
+        spec = self.draw(key, volume)
         if spec is None:
             return data
         keep = max(0, int(len(data) * (1.0 - spec.frac)))

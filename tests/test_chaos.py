@@ -29,6 +29,7 @@ from seaweedfs_tpu.server.httpd import get_json, http_request, post_json
 from seaweedfs_tpu.server.master import MasterServer
 from seaweedfs_tpu.server.volume import VolumeServer
 from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.stats import events as events_mod
 from seaweedfs_tpu.storage.file_id import parse_key_hash_with_delta
 from seaweedfs_tpu.util import faults
 
@@ -41,6 +42,12 @@ def _clean_faults():
     faults.disarm_all()
     yield
     faults.disarm_all()
+    # neutralize this scenario's metric fallout (5xx bursts, degraded
+    # reads) so rate-based alerts — the SLO fast burn especially — don't
+    # keep firing into whatever suite runs inside the next window
+    from seaweedfs_tpu.stats import history as history_mod
+
+    history_mod.default_history().clear()
 
 
 @pytest.fixture()
@@ -271,6 +278,7 @@ class TestHolderKilledMidReadStorm:
             int(f.split(",")[0]) for f in fids
             if victim.store.has_volume(int(f.split(",")[0]))
         }
+        victim_id = f"{victim._host}:{victim.data_port}"
         victim.stop()  # ...then a holder dies mid-storm
         for t in threads:
             t.join(timeout=30)
@@ -292,6 +300,30 @@ class TestHolderKilledMidReadStorm:
         assert faults.armed() == {}
         for fid, data in list(blobs.items())[:3]:
             assert wc.fetch(fid) == data
+        # the flight recorder tells the heal story: the repair runs its
+        # full journaled lifecycle — either per-volume fix_replication
+        # tasks or the stale-heartbeat evacuate (whichever wins the
+        # race; healed() can pass early off the pre-expiry topology, so
+        # wait for the journal, not just the holder counts)
+        rec = events_mod.recorder()
+
+        def repair_events() -> list[dict]:
+            return [
+                e for e in rec.events(limit=0)
+                if (e.get("volume") in victim_vids
+                    and (e.get("task") or "").startswith("fix_replication:"))
+                or e.get("task") == f"evacuate:{victim_id}"
+            ]
+
+        wait_until(
+            lambda: {"task_queued", "task_dispatched", "task_done"}
+            <= {e["type"] for e in repair_events()},
+            timeout=40, msg="repair task lifecycle in the flight recorder",
+        )
+        # and cluster.why renders a healed volume's timeline
+        healed_vid = sorted(victim_vids)[0]
+        why = run_command(env, f"cluster.why {healed_vid}")
+        assert f"cluster.why volume {healed_vid}" in why
 
 
 class TestTornParityWrite:
@@ -352,8 +384,22 @@ class TestTornParityWrite:
         with open(v.base_name + ".dat", "r+b") as f:
             f.seek(nv[0] + 30)
             f.write(b"\xff" * 16)
-        st, _, body = http_request("GET", url + "?degraded=1")
+        st, hdrs, body = http_request("GET", url + "?degraded=1")
         assert st == 200 and body == payload
+        # the degraded read's full causal chain reconstructs from the
+        # flight recorder: request span -> degraded_read under ONE trace,
+        # and the volume timeline shows the torn-parity fault, the
+        # daemon's rearm heal (task_done + parity_rearm fallback) — the
+        # acceptance chain, assembled by cluster.why
+        tid = hdrs["X-Sw-Trace-Id"]
+        why = run_command(env, f"cluster.why {tid}")
+        assert "span [volume] GET" in why, why
+        assert "degraded_read" in why and f"volume={vid}" in why, why
+        whyv = run_command(env, f"cluster.why {vid}")
+        assert "fault_injected" in whyv, whyv  # the torn parity write
+        assert "fallback_ec_online" in whyv \
+            and "parity_rearm" in whyv, whyv  # the rearm heal
+        assert "task_done" in whyv and "ec_rebuild" in whyv, whyv
 
 
 class TestPartitionedHeartbeat:
@@ -411,6 +457,14 @@ class TestPartitionedHeartbeat:
 
             wait_until(shards_covered_elsewhere, timeout=40,
                        msg="EC shard pre-copy off the partitioned node")
+            # force a collector render: the heartbeat_stale edge lands in
+            # the flight recorder the moment staleness is computed
+            http_request("GET", f"{master.url}/metrics")
+            rec = events_mod.recorder()
+            assert any(
+                e["node"] == victim_id
+                for e in rec.events(type="heartbeat_stale")
+            ), rec.events(limit=64)
             st = get_json(f"{master.url}/debug/maintenance")
             evac = [
                 line
@@ -429,6 +483,18 @@ class TestPartitionedHeartbeat:
                 ),
                 timeout=15, msg="partitioned node rejoining",
             )
+            # ...and the rejoin edge is journaled on the next render
+            http_request("GET", f"{master.url}/metrics")
+            assert any(
+                e["node"] == victim_id
+                for e in rec.events(type="heartbeat_rejoin")
+            ), rec.events(limit=64)
+            # the evacuate repair's lifecycle is journaled under its
+            # node-scoped task key (queued -> done on the stale node)
+            evac = [e for e in rec.events(limit=0)
+                    if e.get("task") == f"evacuate:{victim_id}"]
+            assert {"task_queued", "task_done"} <= {
+                e["type"] for e in evac}, evac
         finally:
             faults.disarm_all()
             for vs in vols:
@@ -518,11 +584,20 @@ class TestPipelineHopKilledMidRebuild:
         for t in threads:
             t.start()
         time.sleep(0.5)
-        # lose a shard mid-storm; the daemon detects + repairs through
-        # the dead hop
+        # lose the DATA shard backing blobs[0] mid-storm (not an
+        # arbitrary — possibly parity — shard): its reads must now
+        # RECONSTRUCT (degraded, journaled with their trace ids), and
+        # the daemon detects + repairs through the dead hop
         fired_before = fired("repair.partial_fetch")
-        lost = victim.ec_shards[vid][0]
-        post_json(f"{victim.http}/admin/ec/delete_shards",
+        key0, _ = parse_key_hash_with_delta(fids[0].split(",")[1])
+        ev0 = next(v.store.get_ec_volume(vid) for v in vols
+                   if v.store.get_ec_volume(vid) is not None)
+        off0, size0 = ev0.find_needle_from_ecx(key0)
+        lost = ev0.locate_intervals(off0, size0)[0].to_shard_id_and_offset(
+            ev0.large_block_size, ev0.small_block_size)[0]
+        shard_holder = next(sv for sv in env.servers()
+                            if lost in sv.ec_shards.get(vid, []))
+        post_json(f"{shard_holder.http}/admin/ec/delete_shards",
                   {"volume": vid, "shards": [lost], "collection": "pipe"})
 
         def healed() -> bool:
@@ -551,6 +626,22 @@ class TestPipelineHopKilledMidRebuild:
                 "GET", f"{holders[0].http}/{fid}")
             assert st == 200 and body == data
         assert healed()
+        # the flight recorder reconstructs the incident: at least one
+        # degraded (reconstructed) read is journaled with its trace id,
+        # and cluster.why resolves request -> degraded_read, while the
+        # volume timeline shows the remount swap, the repair lifecycle
+        # and the ladder's restart/fallback through the dead hop
+        rec = events_mod.recorder()
+        deg = [e for e in rec.events(type="degraded_read", limit=0)
+               if e["volume"] == vid and e.get("trace_id")]
+        assert deg, rec.events(limit=64)
+        why = run_command(env, f"cluster.why {deg[-1]['trace_id']}")
+        assert "degraded_read" in why, why
+        assert "ec_reconstruct" in why, why
+        whyv = run_command(env, f"cluster.why {vid}")
+        assert "remount_swap" in whyv, whyv
+        assert "task_queued" in whyv and "task_done" in whyv, whyv
+        assert "chain_restart" in whyv or "fallback_repair" in whyv, whyv
 
 
 class TestDisarmAllSteadyState:

@@ -365,6 +365,45 @@ class TestMetricNameLint:
         assert kinds["SeaweedFS_volume_degraded_reads_total"] == "counter"
         assert tool.fault_point_violations() == []
         assert tool.degraded_reason_violations() == []
+        # PR-13: flight-recorder event registry (every declared type
+        # emitted by a seam AND exercised by the tests) + SLO layer
+        assert "SeaweedFS_events_recorded_total" in collector_names
+        assert "SeaweedFS_events_dropped_total" in collector_names
+        assert "SeaweedFS_slo_burn_rate" in collector_names
+        assert tool.event_type_violations() == []
+        assert tool.slo_violations() == []
+
+    def test_event_type_lint_catches_violations(self, monkeypatch):
+        from seaweedfs_tpu.stats import events
+
+        tool = self._tool()
+        monkeypatch.setattr(
+            events, "EVENT_TYPES",
+            {**events.EVENT_TYPES, "BadName": "x", "never_emitted": "x"},
+        )
+        bad = tool.event_type_violations()
+        assert any("not snake_case" in b for b in bad)
+        assert any("no seam emits it" in b
+                   and "never_emitted" in b for b in bad)
+
+    def test_slo_lint_catches_violations(self, monkeypatch):
+        from seaweedfs_tpu.stats import alerts
+
+        tool = self._tool()
+        monkeypatch.setattr(
+            alerts, "DEFAULT_SLOS",
+            alerts.DEFAULT_SLOS + (
+                alerts.Slo("BadSlo", "volume", "availability", 0.999),
+                alerts.Slo("too_greedy", "volume", "availability", 1.5),
+                alerts.Slo("no_thresh", "volume", "latency", 0.99),
+                alerts.Slo("who", "toaster", "availability", 0.9),
+            ),
+        )
+        bad = tool.slo_violations()
+        assert any("not snake_case" in b for b in bad)
+        assert any("not in (0, 1)" in b for b in bad)
+        assert any("positive" in b and "threshold_s" in b for b in bad)
+        assert any("unknown role" in b for b in bad)
 
     def test_fault_point_name_convention(self):
         tool = self._tool()

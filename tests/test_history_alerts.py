@@ -229,17 +229,22 @@ class TestAlertRules:
         assert "http_error_ratio" in eng.firing
         st = eng.firing["http_error_ratio"]
         assert st["severity"] == "critical" and "5xx" in st["detail"]
-        assert eng.fired_events == 1
+        # the same burst also trips the SLO burn rules (by design) —
+        # edge accounting is asserted per rule via the counter metric
+        edges = eng.fired_events
+        assert edges >= 1
         text = reg.render()
         assert ('SeaweedFS_alerts_firing{alert="http_error_ratio",'
                 'severity="critical"} 1') in text
-        assert 'SeaweedFS_alerts_fired_total{alert="http_error_ratio"' \
-            in text
-        # burst ages out of the window -> clears, edge counter stays
+        assert ('SeaweedFS_alerts_fired_total{alert="http_error_ratio",'
+                'severity="critical"} 1') in text
+        # burst ages out of the window -> clears, edge counters stay
         h.scrape_once(now=2000.0)
         h.scrape_once(now=2010.0)
         assert "http_error_ratio" not in eng.firing
-        assert eng.fired_events == 1
+        assert eng.fired_events == edges
+        assert ('SeaweedFS_alerts_fired_total{alert="http_error_ratio",'
+                'severity="critical"} 1') in reg.render()
         assert ('SeaweedFS_alerts_firing{alert="http_error_ratio",'
                 'severity="critical"} 0') in reg.render()
 

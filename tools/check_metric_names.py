@@ -36,7 +36,7 @@ import sys
 NAME_RE = re.compile(
     r"^SeaweedFS_"
     r"(master|volume|filer|s3|http|stats|mount|mq|iam|alerts|process"
-    r"|maintenance|faults)_"
+    r"|maintenance|faults|events|slo)_"
     r"[a-z][a-z0-9]*(_[a-z0-9]+)*$"
 )
 
@@ -95,6 +95,8 @@ def collect() -> tuple[dict[str, str], list[str]]:
     from seaweedfs_tpu.s3api.s3_server import S3Server
     from seaweedfs_tpu.server.filer import FilerServer
 
+    from seaweedfs_tpu.stats import events as events_mod
+
     collector_names = sorted(
         set(MasterServer.MASTER_METRIC_FAMILIES)
         | set(VolumeServer.FL_FAMILIES)
@@ -104,6 +106,8 @@ def collect() -> tuple[dict[str, str], list[str]]:
         | set(profiler.PROFILER_FAMILIES)
         | set(history.HISTORY_FAMILIES)
         | set(alerts.ALERT_FAMILIES)
+        | set(alerts.SLO_FAMILIES)
+        | set(events_mod.EVENT_FAMILIES)
         | set(maintenance.MAINTENANCE_FAMILIES)
     )
     return kinds, collector_names
@@ -256,6 +260,95 @@ def fault_point_violations() -> list[str]:
     return bad
 
 
+def event_type_violations() -> list[str]:
+    """Flight-recorder event types (stats/events.py) become the `type`
+    label of SeaweedFS_events_recorded_total, /debug/events' filter
+    vocabulary, and cluster.why's timeline rows — lint them like the
+    fault-point registry: unique snake_case, every DECLARED type emitted
+    by a real seam somewhere in the package (an event nobody journals is
+    a lie in the registry), and every type exercised by the tests
+    (tests/test_events.py or tests/test_chaos.py)."""
+    from seaweedfs_tpu.stats import events as events_mod
+
+    bad: list[str] = []
+    for name in events_mod.EVENT_TYPES:
+        # (no duplicate check: EVENT_TYPES is a dict — the data type
+        # already guarantees uniqueness)
+        if not ALERT_RULE_RE.match(name):
+            bad.append(f"event type {name!r}: not snake_case")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(root, "seaweedfs_tpu")
+    events_src = os.path.join("stats", "events.py")
+    emitted: set[str] = set()
+    for dirpath, _, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if path.endswith(events_src):
+                continue  # the registry itself does not count as a seam
+            try:
+                with open(path) as f:
+                    src = f.read()
+            except OSError:
+                continue
+            for name in events_mod.EVENT_TYPES:
+                if name in emitted:
+                    continue
+                if f'"{name}"' in src or f"'{name}'" in src:
+                    emitted.add(name)
+    for name in sorted(set(events_mod.EVENT_TYPES) - emitted):
+        bad.append(f"event type {name!r}: declared but no seam emits it")
+    test_src = ""
+    for tf in ("test_events.py", "test_chaos.py"):
+        try:
+            with open(os.path.join(root, "tests", tf)) as f:
+                test_src += f.read()
+        except OSError:
+            bad.append(f"tests/{tf} missing: the event registry must be"
+                       f" exercised by the suite")
+    for name in events_mod.EVENT_TYPES:
+        if name not in test_src:
+            bad.append(f"event type {name!r}: not exercised by"
+                       f" tests/test_events.py or tests/test_chaos.py")
+    return bad
+
+
+def slo_violations() -> list[str]:
+    """SLO names ride into the `slo` label of SeaweedFS_slo_burn_rate
+    and the burn alerts' details — lint them like alert-rule names
+    (unique snake_case, sane objectives, known kinds/roles), and require
+    the two multi-window burn rules to exist with the right severities
+    so a renamed rule can't silently un-page the fast burn."""
+    from seaweedfs_tpu.stats import alerts
+
+    bad: list[str] = []
+    seen: set[str] = set()
+    known_roles = {"master", "volume", "filer", "s3", "webdav"}
+    for slo in alerts.DEFAULT_SLOS:
+        if not ALERT_RULE_RE.match(slo.name):
+            bad.append(f"slo {slo.name!r}: not snake_case")
+        if slo.name in seen:
+            bad.append(f"slo {slo.name!r}: duplicate name")
+        seen.add(slo.name)
+        if slo.kind not in ("availability", "latency"):
+            bad.append(f"slo {slo.name!r}: unknown kind {slo.kind!r}")
+        if not (0.0 < slo.objective < 1.0):
+            bad.append(f"slo {slo.name!r}: objective {slo.objective}"
+                       f" not in (0, 1)")
+        if slo.kind == "latency" and slo.threshold_s <= 0:
+            bad.append(f"slo {slo.name!r}: latency slo needs a positive"
+                       f" threshold_s")
+        if slo.role not in known_roles:
+            bad.append(f"slo {slo.name!r}: unknown role {slo.role!r}")
+    severities = {r.name: r.severity for r in alerts.default_rules()}
+    if severities.get("slo_burn_fast") != "critical":
+        bad.append("alert rule slo_burn_fast: missing or not critical")
+    if severities.get("slo_burn_slow") != "warning":
+        bad.append("alert rule slo_burn_slow: missing or not warning")
+    return bad
+
+
 def repair_reason_violations() -> list[str]:
     """Repair modes / fallback reasons / chain-restart reasons ride into
     the labels of the SeaweedFS_volume_ec_repair_* families (and the
@@ -324,7 +417,8 @@ def main() -> int:
     bad = violations(kinds, collector_names) + alert_rule_violations() \
         + task_type_violations() + front_reason_violations() \
         + ec_online_reason_violations() + fault_point_violations() \
-        + degraded_reason_violations() + repair_reason_violations()
+        + degraded_reason_violations() + repair_reason_violations() \
+        + event_type_violations() + slo_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
         print(f"{len(bad)} metric-name violation(s) in {total} families:")
