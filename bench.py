@@ -1517,6 +1517,112 @@ def availability_summary(
     return out
 
 
+def bench_scrub(staging: str, needles: int = 49152,
+                needle_bytes: int = 1024) -> dict:
+    """PR-14: integrity-scrub throughput + time-to-detect. Builds a
+    volume of uniform 1KB needles (the small-files bench's blob size —
+    the regime where bulk hashing pays, arXiv:1202.3669), scrubs it
+    unthrottled through the batched CRC32C kernel and again with the
+    scalar table path, then flips one bit and measures how long a pass
+    takes to FIND it (detection latency per volume, not per cluster —
+    the scan interval governs the rest)."""
+    import shutil
+
+    from seaweedfs_tpu.maintenance.scrub import VolumeScrubber
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    d = os.path.join(staging, "scrub")
+    shutil.rmtree(d, ignore_errors=True)
+    os.makedirs(d, exist_ok=True)
+    st = Store([d])
+    v = st.add_volume(1, "")
+    rng = np.random.RandomState(14)
+    payload = rng.randint(
+        0, 256, size=(64, needle_bytes), dtype=np.uint8)
+    for i in range(needles):
+        v.write_needle(Needle(
+            cookie=0x14, id=i + 1,
+            data=payload[i % 64].tobytes(),
+        ))
+    out: dict = {"needles": needles, "needle_bytes": needle_bytes}
+
+    def one_pass(use_batch: bool) -> tuple[float, float]:
+        sc = VolumeScrubber(st, rate_mb=1e9, use_batch=use_batch)
+        t0 = time.perf_counter()
+        found = sc.scrub_pass()
+        wall = time.perf_counter() - t0
+        assert found == [], "clean volume must scrub clean"
+        gbps = sc.stats["bytes_scanned"] / max(sc.stats["seconds"], 1e-9) / 1e9
+        return gbps, wall
+
+    # best of 3 per kernel: this box's granted CPU swings
+    batched = max(one_pass(True)[0] for _ in range(3))
+    scalar = max(one_pass(False)[0] for _ in range(3))
+    out["scrub_gbps"] = {
+        "batched": round(batched, 3), "scalar": round(scalar, 3),
+        "speedup": round(batched / max(scalar, 1e-9), 2),
+    }
+    # flip one bit mid-volume; a pass must find exactly that needle
+    victim = needles // 2
+    nv = v.nm.get(victim)
+    with open(v.base_name + ".dat", "r+b") as f:
+        f.seek(nv[0] + 40)
+        b = f.read(1)
+        f.seek(nv[0] + 40)
+        f.write(bytes([b[0] ^ 0x10]))
+    sc = VolumeScrubber(st, rate_mb=1e9)
+    t0 = time.perf_counter()
+    found = sc.scrub_pass()
+    out["scrub_time_to_detect_s"] = round(time.perf_counter() - t0, 4)
+    out["detected"] = (
+        [f.kind for f in found] == ["corrupt_needle"]
+        and found[0].needle == victim
+    )
+    # repair the flip with the victim's ORIGINAL payload (needle id n
+    # carries payload[(n-1) % 64]) — a clean volume for the p99 phase
+    v.write_needle(Needle(cookie=0x14, id=victim,
+                          data=payload[(victim - 1) % 64].tobytes()))
+
+    # foreground impact: read p99 with no scrub vs during a continuous
+    # DEFAULT-throttled (8 MB/s) scrub — the token bucket's promise
+    import threading
+
+    def read_p99(seconds: float) -> float:
+        lat = []
+        stop_at = time.perf_counter() + seconds
+        i = 0
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            v.read_needle(i % needles + 1)
+            lat.append(time.perf_counter() - t0)
+            i += 1
+        lat.sort()
+        return lat[int(len(lat) * 0.99)]
+
+    p99_idle = read_p99(1.0)
+    throttled = VolumeScrubber(st, rate_mb=8.0)
+    stop = threading.Event()
+
+    def bg():
+        while not stop.is_set():
+            throttled.scrub_pass()
+
+    t = threading.Thread(target=bg, daemon=True)
+    t.start()
+    p99_during = read_p99(1.5)
+    stop.set()
+    t.join(timeout=10)
+    out["foreground_read_p99_ms"] = {
+        "idle": round(p99_idle * 1000, 4),
+        "during_scrub": round(p99_during * 1000, 4),
+        "inflation": round(p99_during / max(p99_idle, 1e-9), 2),
+    }
+    v.close()
+    shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def bench_hash_1m_4k(
     total_blobs: int = 1_000_000, slab: int = 65536, device: bool = True
 ) -> dict:
@@ -1726,6 +1832,12 @@ def main() -> None:
         detail["rebuild_bandwidth"] = rebuild_bandwidth_summary()
     except Exception as e:
         detail["rebuild_bandwidth"] = {"error": str(e)[:120]}
+    # PR-14: integrity scrub — batched vs scalar CRC verification rate
+    # and the per-volume detection latency for an injected bit flip
+    try:
+        detail["scrub"] = bench_scrub(BENCH_DIR)
+    except Exception as e:
+        detail["scrub"] = {"error": str(e)[:120]}
     # end-of-run per-kernel attribution over EVERYTHING this process ran
     # (verb trials + rebuild + hash benches), from the shared registry
     try:
@@ -1843,6 +1955,12 @@ def summary_line(
             "filer_native_ratio": fsf.get("filer_native_ratio"),
             "s3_write_req_s": s3f.get("write_req_s"),
             "s3_read_req_s": s3f.get("read_req_s"),
+            "scrub_gbps_batched": (detail.get("scrub", {})
+                                   .get("scrub_gbps", {})).get("batched"),
+            "scrub_gbps_scalar": (detail.get("scrub", {})
+                                  .get("scrub_gbps", {})).get("scalar"),
+            "scrub_ttd_s": detail.get("scrub", {})
+            .get("scrub_time_to_detect_s"),
             "note": "host GFNI engine carries the verb (DRAM-bound ~4GB/s;"
             " chip link dead — see device_status); detail in"
             " BENCH_full.json",

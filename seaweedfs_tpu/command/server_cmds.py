@@ -135,6 +135,16 @@ def run_volume(args: list[str]) -> int:
     p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
                    help="log requests slower than this many ms for this "
                         "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
+    p.add_argument("-scrub.interval", dest="scrub_interval", type=float,
+                   default=0.0,
+                   help="seconds between background integrity-scrub passes"
+                        " (CRC every needle, parity-check EC stripes, sweep"
+                        " rebuild tmp litter); 0 disables the loop —"
+                        " /admin/scrub/run and volume.scrub still work")
+    p.add_argument("-scrub.rate", dest="scrub_rate", type=float,
+                   default=8.0,
+                   help="scrub read-budget in MB/s (token bucket; scrubbing"
+                        " never starves foreground traffic)")
     _add_faults_flag(p)
     opts = p.parse_args(args)
     _arm_faults(opts)
@@ -154,6 +164,8 @@ def run_volume(args: list[str]) -> int:
         max_volume_count=opts.max,
         local_socket=opts.localSocket,
         slow_ms=opts.slow_ms,
+        scrub_interval=opts.scrub_interval,
+        scrub_rate_mb=opts.scrub_rate,
     )
     vs.start()
     print(f"volume server listening at {vs.url}")
@@ -270,6 +282,13 @@ def run_server(args: list[str]) -> int:
                    default=None,
                    help="online-EC stripe block bytes per shard "
                         "(default 1MB)")
+    p.add_argument("-scrub.interval", dest="scrub_interval", type=float,
+                   default=0.0,
+                   help="seconds between background integrity-scrub passes"
+                        " on the volume server; 0 disables the loop")
+    p.add_argument("-scrub.rate", dest="scrub_rate", type=float,
+                   default=8.0,
+                   help="scrub read-budget in MB/s (token bucket)")
     _add_faults_flag(p)
     opts = p.parse_args(args)
     _arm_faults(opts)
@@ -295,6 +314,8 @@ def run_server(args: list[str]) -> int:
     vs = VolumeServer(
         opts.dir.split(","), m.url, host=opts.ip, port=opts.volume_port,
         security=sec,
+        scrub_interval=opts.scrub_interval,
+        scrub_rate_mb=opts.scrub_rate,
     )
     vs.start()
     print(f"volume server listening at {vs.url}")

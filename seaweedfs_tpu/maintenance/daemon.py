@@ -50,6 +50,8 @@ ALERT_SCANS = {
     # reads surviving only through reconstruction: something is lost or
     # torn RIGHT NOW — race the repair scan instead of waiting a tick
     "degraded_reads": ("ec_rebuild", "fix_replication"),
+    # a scrub pass proved silent damage: route the findings immediately
+    "scrub_findings": ("scrub",),
 }
 
 

@@ -43,6 +43,9 @@ TASK_TYPES: dict[str, TaskSpec] = {
                  "rebuild missing RS(10,4) shards on the Pallas path"),
         TaskSpec("evacuate", 2, 1,
                  "pre-copy replicas off a stale-heartbeat node"),
+        TaskSpec("scrub", 2, 1,
+                 "repair silent damage a scrub pass or digest"
+                 " divergence proved (route per finding kind)"),
         TaskSpec("vacuum", 3, 1,
                  "compact a volume whose deleted-bytes crossed the"
                  " threshold"),
@@ -216,10 +219,20 @@ def detect_stale_nodes(master) -> list[RepairTask]:
     return tasks
 
 
+def detect_scrub_findings(master) -> list[RepairTask]:
+    """Heartbeat-reported scrub findings + anti-entropy digest
+    divergence -> scrub tasks (the integrity loop's detect leg; the
+    scanning itself runs on the volume servers — see scrub.py)."""
+    from . import scrub as scrub_mod
+
+    return scrub_mod.detect(master)
+
+
 # task type -> detector; the daemon iterates this to scan
 DETECTORS = {
     "fix_replication": detect_under_replicated,
     "ec_rebuild": detect_ec_missing_shards,
+    "scrub": detect_scrub_findings,
     "vacuum": detect_vacuum_candidates,
     "balance": detect_imbalance,
     "evacuate": detect_stale_nodes,

@@ -112,6 +112,25 @@ def _exec_ec_rebuild_online(task: RepairTask, env, dry_run: bool) -> dict:
                         f" {out.get('watermark')} on {holder.id}"]}
 
 
+def _exec_scrub(task: RepairTask, env, dry_run: bool) -> dict:
+    """Route a volume's scrub findings to their heals through the shared
+    plan/apply helpers (scrub.py): corrupt needle -> re-copy from a
+    verified-good holder, corrupt shard -> delete (the missing-shard
+    detector's ec_rebuild re-derives it, pipelined per PR 11), online
+    parity mismatch -> striper re-arm, replica divergence -> needle-level
+    re-sync from the digest-majority holder."""
+    from . import scrub as scrub_mod
+
+    actions = scrub_mod.plan_scrub_repairs(
+        env, task.params.get("findings", [])
+    )
+    planned = scrub_mod.describe_scrub_repairs(actions)
+    if dry_run:
+        return {"planned": planned}
+    return {"planned": planned,
+            "applied": scrub_mod.apply_scrub_repairs(env, actions)}
+
+
 def _exec_vacuum(task: RepairTask, env, dry_run: bool) -> dict:
     actions = plan_vacuum(env, volume_id=task.volume_id)
     planned = describe_vacuum(actions)
@@ -283,6 +302,7 @@ def _exec_evacuate(task: RepairTask, env, dry_run: bool) -> dict:
 EXECUTORS = {
     "fix_replication": _exec_fix_replication,
     "ec_rebuild": _exec_ec_rebuild,
+    "scrub": _exec_scrub,
     "vacuum": _exec_vacuum,
     "balance": _exec_balance,
     "evacuate": _exec_evacuate,

@@ -60,6 +60,8 @@ class VolumeServer:
         security: SecurityConfig | None = None,
         local_socket: str | None = None,
         slow_ms: float | None = None,
+        scrub_interval: float = 0.0,
+        scrub_rate_mb: float = 8.0,
     ) -> None:
         # -mserver may list several masters; heartbeats follow the raft
         # leader hint (`volume_grpc_client_to_master.go` re-dial on redirect)
@@ -97,6 +99,12 @@ class VolumeServer:
         # half-written file under a valid shard name).
         self._partial_rebuilds: dict[int, dict] = {}
         self._partial_lock = threading.Lock()
+        # background integrity scrubber (maintenance/scrub.py): walks
+        # volumes/EC shards in token-bucket-throttled passes. -scrub.
+        # interval 0 disables the loop; /admin/scrub/run still works.
+        self.scrubber = None
+        self.scrub_interval = float(scrub_interval)
+        self.scrub_rate_mb = float(scrub_rate_mb)
         self._routes()
 
     def _start_fastlane(self) -> None:
@@ -140,6 +148,15 @@ class VolumeServer:
         for loc in self.store.locations:
             for ev in loc.ec_volumes.values():
                 self._attach_shard_fetcher(ev)
+        from seaweedfs_tpu.maintenance.scrub import VolumeScrubber
+
+        self.scrubber = VolumeScrubber(
+            self.store, node_id=f"{self._host}:{self.data_port}",
+            rate_mb=self.scrub_rate_mb,
+            active_tmp_paths=self._active_rebuild_tmps,
+        )
+        if self.scrub_interval > 0:
+            threading.Thread(target=self._scrub_loop, daemon=True).start()
         self.heartbeat_once()
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         # Calibrate the EC pipeline backend (host GFNI vs TPU, measured
@@ -444,6 +461,13 @@ class VolumeServer:
         hb["data_center"] = self.data_center
         hb["rack"] = self.rack
         hb["max_volume_count"] = self.max_volume_count
+        if self.scrubber is not None:
+            # unresolved scrub findings ride the beat: the master's
+            # scrub detector routes each kind to its heal. Capped — a
+            # massively rotted volume (thousands of corrupt needles)
+            # must not bloat every heartbeat; repairs resolve findings
+            # as they land, so the rest ride later beats
+            hb["scrub_findings"] = self.scrubber.unresolved()[:64]
         body = _json.dumps(hb).encode()
         tried = 0
         rotation = [u for u in self.master_urls if u != self.master_url]
@@ -473,6 +497,29 @@ class VolumeServer:
                 self.master_url = rotation.pop(0)
                 continue
             return
+
+    def _active_rebuild_tmps(self) -> set[str]:
+        """Tmp shard paths belonging to IN-FLIGHT pipelined rebuilds —
+        the scrubber's tmp-litter GC must never sweep these, any age."""
+        with self._partial_lock:
+            return {
+                p
+                for state in self._partial_rebuilds.values()
+                for p in state["writers"].tmp_paths.values()
+            }
+
+    def _scrub_loop(self) -> None:  # pragma: no cover - timing loop
+        while not self._stop.wait(self.scrub_interval):
+            try:
+                if self.fastlane:  # scrub the engine's appends too
+                    self.fastlane.drain()
+                found = self.scrubber.scrub_pass()
+                if found:
+                    # the master learns about fresh damage on the next
+                    # beat anyway; beating now shortens time-to-heal
+                    self.heartbeat_once()
+            except Exception:
+                pass
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.pulse_seconds):
@@ -1353,6 +1400,10 @@ class VolumeServer:
                 if v.online_ec is not None:
                     v.online_ec.close()
                     v.online_ec = None
+            # hand the received volume to the engine like ec_to_volume
+            # does — without this a balanced/evacuated volume silently
+            # lost its native data plane on the new holder until restart
+            self._fl_register(vid)
             self.heartbeat_once()
             out = {"ok": True, "volume": vid, "size": v.size(),
                    "last_append_at_ns": v.last_append_at_ns}
@@ -1364,6 +1415,7 @@ class VolumeServer:
         def volume_mount(req: Request) -> Response:
             p = req.json()
             v = self.store.mount_volume(int(p["volume"]), p.get("collection", ""))
+            self._fl_register(int(p["volume"]))  # native plane resumes
             self.heartbeat_once()
             return Response({"ok": True, "size": v.size()})
 
@@ -1518,6 +1570,186 @@ class VolumeServer:
                 {"volume": vid, "checked": checked, "errors": errors,
                  "ok": not errors}
             )
+
+        # --- integrity scrub plane (maintenance/scrub.py) -----------------
+        @svc.route("GET", r"/admin/scrub/status")
+        def scrub_status(req: Request) -> Response:
+            if self.scrubber is None:
+                return Response({"error": "scrubber not started"}, 503)
+            out = self.scrubber.status()
+            out["interval"] = self.scrub_interval
+            return Response(out)
+
+        @svc.route("POST", r"/admin/scrub/run")
+        def scrub_run(req: Request) -> Response:
+            """One synchronous, throttled scrub pass (whole store, or one
+            volume) — the volume.scrub verb's and the chaos suite's
+            entry. Detection only: repairs route through the master's
+            scrub task (or volume.scrub -apply)."""
+            if self.scrubber is None:
+                return Response({"error": "scrubber not started"}, 503)
+            try:
+                p = req.json()
+            except ValueError:
+                p = {}
+            vid = int(p["volume"]) if p.get("volume") is not None else None
+            if self.fastlane:  # scrub must see the engine's appends
+                self.fastlane.drain()
+            found = self.scrubber.scrub_pass(volume_id=vid)
+            if found:
+                self.heartbeat_once()  # the master learns immediately
+            return Response({
+                "ok": True,
+                "findings": [f.to_dict() for f in found],
+                "stats": dict(self.scrubber.stats),
+            })
+
+        @svc.route("POST", r"/admin/scrub/resolve")
+        def scrub_resolve(req: Request) -> Response:
+            """Drop findings a just-applied repair addressed, so the
+            heartbeat stops re-advertising healed damage (and the
+            master's scrub detector stops re-queueing it). The next
+            scheduled pass re-verifies — resolve is an optimization,
+            re-detection is the ground truth."""
+            if self.scrubber is None:
+                return Response({"error": "scrubber not started"}, 503)
+            p = req.json()
+            dropped = self.scrubber.resolve(
+                kind=p.get("kind"),
+                volume=int(p["volume"]) if p.get("volume") is not None
+                else None,
+                needle=int(p["needle"]) if p.get("needle") is not None
+                else None,
+            )
+            if dropped:
+                self.heartbeat_once()
+            return Response({"ok": True, "resolved": dropped})
+
+        @svc.route("GET", r"/admin/scrub/needle")
+        def scrub_needle(req: Request) -> Response:
+            """One needle's record, read through the full verifying path
+            (CRC + degraded-read ladder) and re-serialized canonically —
+            the verified-good source side of a corrupt-needle repair."""
+            vid = int(req.query["volume"])
+            needle_id = int(req.query["needle"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            try:
+                n = v.read_needle(needle_id)
+            except NotFound:
+                return Response({"error": "needle not found"}, 404)
+            except Exception as e:
+                # this holder can't prove the needle either: not a source
+                return Response({"error": f"unverifiable: {e}"}, 409)
+            return Response(
+                n.to_bytes(v.version()),
+                content_type="application/octet-stream",
+            )
+
+        @svc.route("POST", r"/admin/scrub/repair_needle")
+        def scrub_repair_needle(req: Request) -> Response:
+            """Heal one corrupt needle in place: re-append a verified
+            copy (from `source`'s /admin/scrub/needle, or reconstructed
+            locally through the degraded-read ladder when this volume
+            has EC redundancy). The needle map then points at the clean
+            record; the corrupt bytes become vacuumable garbage."""
+            p = req.json()
+            vid = int(p["volume"])
+            needle_id = int(p["needle"])
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            source = (p.get("source") or "").rstrip("/")
+            try:
+                if source:
+                    status, _, blob = http_request(
+                        "GET",
+                        f"{source}/admin/scrub/needle?volume={vid}"
+                        f"&needle={needle_id}",
+                        timeout=60,
+                    )
+                    if status != 200:
+                        return Response(
+                            {"error": f"source -> {status}"}, 502)
+                    n = Needle.from_bytes(blob, version=v.version())
+                else:
+                    # local redundancy: read_needle's degraded ladder
+                    # reconstructs from online/sealed EC parity
+                    n = v.read_needle(needle_id)
+            except Exception as e:
+                return Response(
+                    {"error": f"no verified copy: {e}"}, 409)
+            if n.id != needle_id:
+                return Response({"error": "source returned wrong needle"},
+                                409)
+            v.write_needle(n)
+            if self.scrubber is not None:
+                self.scrubber.resolve(kind="corrupt_needle", volume=vid,
+                                      needle=needle_id)
+            self.heartbeat_once()  # digest/finding state changed
+            return Response({"ok": True, "needle": f"{needle_id:x}",
+                             "source": source or "local-reconstruction"})
+
+        @svc.route("POST", r"/admin/scrub/sync")
+        def scrub_sync(req: Request) -> Response:
+            """Anti-entropy re-sync of THIS holder's replica from a
+            digest-majority source: pull the source's live needle list,
+            append verified copies of needles we miss, tombstone needles
+            the majority deleted. Needle-level — no whole-volume copy."""
+            p = req.json()
+            vid = int(p["volume"])
+            source = p["source"].rstrip("/")
+            v = self.store.get_volume(vid)
+            if v is None:
+                return Response({"error": f"volume {vid} not found"}, 404)
+            if self.fastlane:
+                self.fastlane.drain()
+            listing = get_json(
+                f"{source}/admin/volume/needles?volume={vid}", timeout=300)
+            theirs = {int(n["id"]): n for n in listing.get("needles", [])}
+            mine = {key for key, _off, _sz in v.nm.ascending_visit()}
+            if not theirs and mine:
+                # the detector never SELECTS an empty-digest holder as
+                # the sync source (empty replicas are always the
+                # divergent targets) — so an empty source here means a
+                # stale task or an operator mistake, and a bare sync
+                # against it would tombstone the whole replica. Refuse:
+                # that heal is fix_replication/human territory.
+                return Response(
+                    {"error": "source reports no live needles; refusing"
+                              " to mass-delete this replica"}, 409)
+            copied, deleted, failed = 0, 0, 0
+            for nid, meta in theirs.items():
+                if nid in mine:
+                    continue
+                status, _, blob = http_request(
+                    "GET",
+                    f"{source}/admin/volume/needle_blob?volume={vid}"
+                    f"&offset={meta['offset']}&size={meta['size']}",
+                    timeout=60,
+                )
+                if status != 200:
+                    failed += 1
+                    continue
+                try:  # from_bytes CRC-verifies: never sync damage in
+                    n = Needle.from_bytes(
+                        blob, size=meta["size"], version=v.version())
+                    v.write_needle(n)
+                    copied += 1
+                except Exception:
+                    failed += 1
+            for nid in mine - set(theirs):
+                # the majority tombstoned it; a diverged replica that
+                # missed the delete must not resurrect it on failover
+                v.delete_needle(Needle(id=nid))
+                deleted += 1
+            if self.scrubber is not None:
+                self.scrubber.resolve(kind="replica_divergence",
+                                      volume=vid)
+            self.heartbeat_once()  # fresh digest -> divergence clears
+            return Response({"ok": True, "copied": copied,
+                             "deleted": deleted, "failed": failed})
 
         @svc.route("GET", r"/admin/tail")
         def tail(req: Request) -> Response:

@@ -944,9 +944,65 @@ def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(lines)
 
 
+@command("cluster.scrub",
+         "— integrity-scrub status across every volume server"
+         " (/admin/scrub/status): bytes verified, scrub GB/s per kernel,"
+         " unresolved findings, throttle budget")
+def cmd_cluster_scrub(env: CommandEnv, args: list[str]) -> str:
+    import time as _time
+
+    statuses: dict[str, dict] = {}
+    for sv in env.servers():
+        try:
+            statuses[sv.id] = env.get(
+                f"{sv.http}/admin/scrub/status", timeout=10)
+        except Exception as e:
+            statuses[sv.id] = {"error": str(e)}
+    if not statuses:
+        raise ShellError("no volume servers in the topology")
+    lines = [f"integrity scrub across {len(statuses)} volume server(s):"]
+    total_findings = 0
+    now = _time.time()
+    for node, st in sorted(statuses.items()):
+        if "error" in st:
+            lines.append(f"  {node}: UNREACHABLE ({st['error']})")
+            continue
+        s = st.get("stats", {})
+        gbps = (s.get("bytes_scanned", 0) / max(s.get("seconds", 0.0), 1e-9)
+                / 1e9) if s.get("bytes_scanned") else 0.0
+        last = s.get("last_pass_at", 0.0)
+        age = f"{now - last:.0f}s ago" if last else "never"
+        interval = st.get("interval", 0)
+        lines.append(
+            f"  {node}: {s.get('passes', 0)} pass(es) (last {age},"
+            + (f" every {interval:g}s" if interval else " loop off")
+            + f"), {s.get('needles_checked', 0)} needles +"
+            f" {s.get('stripes_checked', 0)} stripe samples,"
+            f" {_fmt_gb(s.get('bytes_scanned', 0))} verified"
+            f" @ {gbps:.2f} GB/s,"
+            f" budget {st.get('rate_bytes_per_sec', 0) / 1e6:.0f} MB/s"
+            f" ({s.get('throttle_waits', 0)} throttle waits),"
+            f" {s.get('tmp_removed', 0)} tmp swept"
+        )
+        unresolved = st.get("unresolved", [])
+        total_findings += len(unresolved)
+        for f in unresolved:
+            lines.append(
+                f"    finding: volume {f.get('volume_id')}"
+                f" [{f.get('kind')}] {f.get('detail', '')}"
+            )
+    lines.append(
+        "no unresolved findings — cluster integrity clean"
+        if total_findings == 0
+        else f"{total_findings} unresolved finding(s) — the maintenance"
+             f" scrub task routes each to its heal"
+    )
+    return "\n".join(lines)
+
+
 @command("cluster.faults",
          "[-list] | -arm <point> -mode <error|latency|torn|disk_full|"
-         "partition> [-rate r] [-ms n] [-frac f] [-count n] [-key id]"
+         "partition|corrupt> [-rate r] [-ms n] [-frac f] [-count n] [-key id]"
          " | -disarm <point> | -disarmAll  [-node url] [-include url,url]"
          " — arm/disarm/list fault injection across discovered nodes")
 def cmd_cluster_faults(env: CommandEnv, args: list[str]) -> str:

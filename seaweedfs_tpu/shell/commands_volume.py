@@ -141,6 +141,70 @@ def cmd_volume_vacuum(env: CommandEnv, args: list[str]) -> str:
     return "vacuumed: " + (", ".join(done) if done else "nothing to do")
 
 
+@command("volume.scrub", "[-volumeId n] [-node host:port] [-dryRun|-apply]"
+         " — run a throttled integrity-scrub pass (bulk-CRC needles,"
+         " parity-check EC stripes, sweep rebuild tmp litter) and route"
+         " each finding to its heal (re-copy needle / delete corrupt"
+         " shard -> ec_rebuild / parity re-arm / replica re-sync)",
+         needs_lock=True)
+def cmd_volume_scrub(env: CommandEnv, args: list[str]) -> str:
+    from seaweedfs_tpu.maintenance.scrub import (
+        apply_scrub_repairs,
+        describe_scrub_repairs,
+        plan_scrub_repairs,
+    )
+
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"]) if "volumeId" in flags else None
+    node = flags.get("node")
+    dry = dry_run_flag(flags)
+    findings: list[dict] = []
+    lines: list[str] = []
+    scanned = 0
+    for sv in env.servers():
+        if node and sv.id != node and sv.url != node:
+            continue
+        if vid is not None and vid not in sv.volumes \
+                and vid not in sv.ec_shards:
+            continue
+        try:
+            out = env.post(
+                f"{sv.http}/admin/scrub/run",
+                {} if vid is None else {"volume": vid}, timeout=3600,
+            )
+        except IOError as e:
+            lines.append(f"{sv.id}: scrub pass failed ({e})")
+            continue
+        scanned += 1
+        fs = out.get("findings", [])
+        st = out.get("stats", {})
+        lines.append(
+            f"{sv.id}: {st.get('needles_checked', 0)} needles,"
+            f" {st.get('stripes_checked', 0)} stripe samples checked,"
+            f" {len(fs)} finding(s)"
+        )
+        findings.extend(fs)
+    if not scanned:
+        raise ShellError("no volume server matched the scrub scope")
+    if not findings:
+        lines.append("scrub: clean — no silent damage found")
+        return "\n".join(lines)
+    actions = plan_scrub_repairs(env, findings)
+    if dry:
+        lines.append(render_plan("volume.scrub",
+                                 describe_scrub_repairs(actions)))
+        return "\n".join(lines)
+    applied = apply_scrub_repairs(env, actions)
+    lines.append(f"repaired {len(applied)} finding(s):")
+    lines.extend(f"  {a}" for a in applied)
+    skipped = [a for a in actions if a.get("skip")]
+    lines.extend(
+        f"  skipped volume {a['volume']} [{a['kind']}]: {a['skip']}"
+        for a in skipped
+    )
+    return "\n".join(lines)
+
+
 @command("volume.fsck", "[-volumeId n] — CRC-verify every needle on every volume")
 def cmd_volume_fsck(env: CommandEnv, args: list[str]) -> str:
     flags = parse_flags(args)

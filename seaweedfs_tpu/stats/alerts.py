@@ -147,6 +147,9 @@ DEFAULT_PARAMS = {
     # fault is in flight (torn .dat, lost shard/holder) — the reads
     # succeed, which is exactly why nothing else pages
     "degraded_read_rate": 0.5,
+    # scrub_findings: ANY sustained rate of proved silent damage warns —
+    # reads still succeed, so nothing else would page for bitrot
+    "scrub_finding_rate": 0.0,
     # SLO multi-window burn-rate alerting: the fast window pages on an
     # incident spending the error budget 14x faster than sustainable
     # (critical, self-clears once the burst ages out of the window); the
@@ -324,6 +327,29 @@ def _check_degraded_reads(hist, now, p):
     )
 
 
+def _check_scrub_findings(hist, now, p):
+    """An integrity scrub pass proved SILENT damage (bitrot, torn shard,
+    diverged replica) — nothing else will page for it, because reads are
+    still succeeding. The maintenance daemon's on_fire hook races a
+    scrub repair scan off this edge."""
+    per_kind: dict[str, float] = {}
+    for labels, rate in hist.rates(
+        "SeaweedFS_volume_scrub_findings_total", p["window"], now
+    ):
+        if rate is None or rate <= 0:
+            continue
+        k = labels.get("kind", "?")
+        per_kind[k] = per_kind.get(k, 0.0) + rate
+    total = sum(per_kind.values())
+    if total <= p["scrub_finding_rate"]:
+        return None
+    top = max(per_kind.items(), key=lambda kv: kv[1])
+    return total, (
+        f"scrub detecting silent damage at {total:.2f} finding(s)/s"
+        f" (mostly '{top[0]}')"
+    )
+
+
 def _check_ec_starved(hist, now, p):
     per_stage: dict[str, dict] = {}
     for labels, rate in hist.rates(
@@ -414,6 +440,10 @@ def default_rules() -> list[Rule]:
              "needle reads are being served through EC reconstruction"
              " at a sustained rate (a fault is in flight)",
              _check_degraded_reads),
+        Rule("scrub_findings", "warning",
+             "integrity scrub passes are detecting silent damage"
+             " (bitrot, torn shards, diverged replicas)",
+             _check_scrub_findings),
         Rule("slo_burn_fast", "critical",
              "an SLO's error budget is burning faster than the fast-"
              "window threshold (incident in progress)",

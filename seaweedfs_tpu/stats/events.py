@@ -63,6 +63,9 @@ EVENT_TYPES = {
                    " (leased|kept|rejected)",
     "alert_raised": "an alert rule transitioned to firing",
     "alert_cleared": "a firing alert rule stopped firing",
+    "scrub_finding": "an integrity scrub pass proved silent damage"
+                     " (corrupt needle/shard, parity mismatch, replica"
+                     " divergence, tmp litter)",
     "heartbeat_stale": "a node's heartbeat crossed the 3x-pulse"
                        " staleness threshold",
     "heartbeat_rejoin": "a stale node's heartbeat recovered",

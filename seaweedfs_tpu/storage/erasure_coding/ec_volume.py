@@ -194,7 +194,9 @@ class EcVolume:
         data = os.pread(fd, size, off)
         if len(data) != size:
             return None
-        return data
+        # `corrupt` mode: silent bit flip on the shard-read seam — the
+        # needle CRC (or the scrubber's parity recompute) must catch it
+        return _FP_SHARD_READ.mangle(data, volume=self.volume_id)
 
     def _fetch_remote(self, shard_id: int, off: int, size: int) -> bytes | None:
         if self.shard_fetcher is None:

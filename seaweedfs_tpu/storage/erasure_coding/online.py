@@ -734,6 +734,65 @@ class OnlineEcWriter:
         finally:
             self._lock.release()
 
+    def scrub_sample(self, max_rows: int = 4,
+                     sample_bytes: int = 4096) -> tuple[int, list[int]]:
+        """Integrity scrub: recompute-and-compare a sampled column slice
+        of up to `max_rows` durable stripe rows (GF is byte-wise, so a
+        slice verifies independently of the rest of the row); a slice
+        mismatch escalates to the full-width row before it is reported.
+        Returns (bytes_verified, mismatching row indices); the CALLER
+        pays its throttle afterwards — this runs under the writer lock,
+        and sleeping here would stall the append path. Short parity
+        reads are skipped — parity_health() already reports loss/tears;
+        this pass is for silent CONTENT damage."""
+        with self._lock:
+            if not self._parity_fds or self.sealed:
+                return 0, []
+            rows = self.watermark // self.stripe
+            if rows <= 0:
+                return 0, []
+            picks = sorted({
+                int(i) for i in
+                np.linspace(0, rows - 1, num=min(max_rows, rows))
+            })
+            width = min(sample_bytes, self.block)
+            checked = 0
+            mismatches: list[int] = []
+            for row in picks:
+                for off, w in ((0, width), (None, None)):
+                    if off is None:  # escalation: full width
+                        off, w = 0, self.block
+                    cost = w * (DATA_SHARDS_COUNT + PARITY_SHARDS_COUNT)
+                    data = []
+                    for c in range(DATA_SHARDS_COUNT):
+                        col_start = row * self.stripe + c * self.block + off
+                        data.append(np.frombuffer(
+                            self._read_dat(col_start, w), dtype=np.uint8
+                        ))
+                    parity = {}
+                    for p in range(PARITY_SHARDS_COUNT):
+                        blk = os.pread(
+                            self._parity_fds[p], w, row * self.block + off
+                        )
+                        if len(blk) == w:
+                            parity[p] = np.frombuffer(blk, dtype=np.uint8)
+                    checked += cost
+                    if not parity:
+                        break  # torn/short: parity_health's finding
+                    expect = self.codec.encode(np.stack(data))
+                    ok = all(
+                        np.array_equal(expect[p], blk)
+                        for p, blk in parity.items()
+                    )
+                    if ok:
+                        break  # slice verified: next row
+                    if w == self.block:  # full width still disagrees
+                        mismatches.append(row)
+                        break  # recorded: when the sample already spans
+                        # the block, the escalation iteration would
+                        # re-verify and re-report this same row
+            return checked, mismatches
+
     def reconstruct_range(self, offset: int, size: int) -> bytes | None:
         """Rebuild .dat bytes [offset, offset+size) from parity + the
         other data columns — the degraded-read path for a torn/unreadable

@@ -339,6 +339,14 @@ class CompactNeedleMap:
         for key, off_u, size in zip(keys, offs, sizes):
             yield int(key), int(off_u) * 8, int(size)
 
+    def live_keys_sizes(self):
+        """Live (keys, sizes) as numpy columns — the needle_set_digest
+        fast path (no per-entry Python objects on the heartbeat)."""
+        with self._mu:
+            self._merge()
+            live = self._sizes != self._HOLE
+            return self._keys[live].copy(), self._sizes[live].copy()
+
     def __len__(self) -> int:
         return self._live
 
@@ -518,3 +526,49 @@ class SortedFileNeedleMap:
             self._mm.close()
             self._mm = None
         self._f.close()
+
+
+# the empty set's fold: a REAL digest (so an empty replica still
+# diverges from populated peers) but one the detector recognizes — an
+# append-only replica with no history can never be the source of truth
+EMPTY_NEEDLE_DIGEST = "0" * 16
+
+
+def needle_set_digest(entries) -> str:
+    """Order-independent digest over live (needle_id, size) pairs — the
+    anti-entropy fingerprint riding heartbeats (maintenance/scrub.py).
+
+    Two replicas holding the same logical content — regardless of append
+    order, vacuum history, or on-disk offsets — produce the same digest;
+    a missed write or missed delete changes it. XOR- and ADD-folds of a
+    mixed 64-bit hash per entry (both folds together so swapped pairs
+    can't cancel). Returns 16 hex chars; the empty set folds to all
+    zeros — a REAL digest, not "", so a replica that silently missed
+    every write still diverges from its populated peers ("" is reserved
+    for "digest not reported"). `entries` may be a (key, offset, size)
+    iterable OR a nm instance exposing live_keys_sizes() — the
+    CompactNeedleMap fast path hands over its numpy columns directly,
+    so a million-needle volume's heartbeat never pays a Python loop."""
+    if hasattr(entries, "live_keys_sizes"):
+        k, s = entries.live_keys_sizes()
+        k = k.astype(np.uint64, copy=False)
+        s = s.astype(np.uint64, copy=False)
+    else:
+        keys, sizes = [], []
+        for key, _off, size in entries:
+            keys.append(key)
+            sizes.append(size)
+        k = np.asarray(keys, dtype=np.uint64)
+        s = np.asarray(sizes, dtype=np.uint64)
+    if k.size == 0:
+        return EMPTY_NEEDLE_DIGEST
+    with np.errstate(over="ignore"):
+        h = (k + np.uint64(1)) * np.uint64(0x9E3779B97F4A7C15)
+        h ^= (s + np.uint64(1)) * np.uint64(0xC2B2AE3D27D4EB4F)
+        h ^= h >> np.uint64(29)
+        h *= np.uint64(0xBF58476D1CE4E5B9)
+        h ^= h >> np.uint64(32)
+        xor_fold = np.bitwise_xor.reduce(h)
+        add_fold = np.add.reduce(h)
+    return (f"{int(xor_fold) & 0xFFFFFFFF:08x}"
+            f"{int(add_fold) & 0xFFFFFFFF:08x}")

@@ -348,6 +348,12 @@ class Store:
                             v.online_ec.parity_health()
                             if v.online_ec is not None else 0
                         ),
+                        # anti-entropy fingerprint: the master compares
+                        # replica digests to detect silent divergence
+                        # without moving data (maintenance/scrub.py;
+                        # cached per (size, counts) so idle beats are
+                        # free)
+                        "needle_digest": v.needle_map_digest(),
                     }
                 )
         ec_shards = []

@@ -237,6 +237,30 @@ class Volume:
     def content_size(self) -> int:
         return self.nm.content_size()
 
+    def needle_map_digest(self) -> str:
+        """Order-independent digest of the live (needle_id, size) set —
+        the anti-entropy fingerprint riding every heartbeat so the
+        master can detect replica divergence without moving data
+        (maintenance/scrub.py needle_set_digest). Cached against the
+        (size, file_count, deleted_count) triple: an idle volume's beat
+        never re-walks its map."""
+        key = (
+            self._size,
+            self.nm.metrics.file_count,
+            self.nm.metrics.deleted_count,
+        )
+        cached = getattr(self, "_digest_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from .needle_map import needle_set_digest
+
+        digest = needle_set_digest(
+            self.nm if hasattr(self.nm, "live_keys_sizes")
+            else self.nm.ascending_visit()
+        )
+        self._digest_cache = (key, digest)
+        return digest
+
     # --- write path ----------------------------------------------------------
     def _record_size(self, size: int) -> int:
         return get_actual_size(size, self.version())
@@ -268,7 +292,10 @@ class Volume:
             return False
         try:
             old = self._read_at(nv[0], nv[1])
-        except VolumeError:
+        except Exception:
+            # an unreadable/corrupt old record (short read, CRC error,
+            # torn parse) is by definition NOT unchanged — overwriting it
+            # with the incoming clean copy is exactly the scrub repair
             return False
         return (
             old.cookie == n.cookie
@@ -338,6 +365,9 @@ class Volume:
         # rate=1.0 error here still leaves the degraded path a way out
         total = get_actual_size(size, self.version())
         blob = self._dat.read_at(total, offset)
+        # `corrupt` mode: a silent bit flip on the read seam — the CRC
+        # check in Needle.from_bytes must trip it into the degraded path
+        blob = _FP_READ_DAT.mangle(blob, volume=self.id)
         if len(blob) < total:
             raise VolumeError(
                 f"volume {self.id}: short read {len(blob)} < {total} at {offset}"

@@ -28,6 +28,10 @@ class VolumeInfo:
     # durable watermark — >0 means this LIVE online volume's redundancy
     # is damaged and an online ec_rebuild (re-arm + re-encode) is due
     ec_online_parity_damaged: int = 0
+    # order-independent live-needle-set digest (anti-entropy): replica
+    # holders reporting different digests for one volume have silently
+    # diverged — the scrub detector re-syncs from the majority holder
+    needle_digest: str = ""
 
     @staticmethod
     def from_dict(d: dict) -> "VolumeInfo":
@@ -46,6 +50,7 @@ class VolumeInfo:
             ec_online_parity_damaged=int(
                 d.get("ec_online_parity_damaged", 0)
             ),
+            needle_digest=str(d.get("needle_digest", "")),
         )
 
 
@@ -70,6 +75,9 @@ class DataNode:
     ec_shards: dict[int, EcShardInfo] = field(default_factory=dict)
     last_seen: float = field(default_factory=time.time)
     max_file_key: int = 0
+    # unresolved scrub findings the node's last heartbeat carried
+    # (maintenance/scrub.py detect() turns them into repair tasks)
+    scrub_findings: list = field(default_factory=list)
 
     @property
     def id(self) -> str:

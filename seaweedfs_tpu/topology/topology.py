@@ -94,6 +94,7 @@ class Topology:
         )
         node.last_seen = time.time()
         node.max_file_key = int(hb.get("max_file_key", 0))
+        node.scrub_findings = list(hb.get("scrub_findings", []))
         self.sequencer.set_max(node.max_file_key)
 
         new_volumes = {int(v["id"]): VolumeInfo.from_dict(v) for v in hb.get("volumes", [])}
@@ -357,6 +358,7 @@ class Topology:
                                             "ec_online": v.ec_online,
                                             "ec_online_parity_damaged":
                                                 v.ec_online_parity_damaged,
+                                            "needle_digest": v.needle_digest,
                                         }
                                         for v in n.volumes.values()
                                     ],

@@ -1,5 +1,6 @@
-"""Named fault-point registry: inject errors, latency, torn writes, full
-disks and network partitions at the cluster's hot seams.
+"""Named fault-point registry: inject errors, latency, torn writes,
+silent bit flips, full disks and network partitions at the cluster's
+hot seams.
 
 Every repair path this repo grew (PR 5's detect->plan->heal, PR 8's
 online EC) was only ever tested by *polite* loss — admin APIs deleting
@@ -63,7 +64,12 @@ ALL_POINTS = (
                               # partial): error = a chain hop dies mid-rebuild
 )
 
-MODES = ("error", "latency", "torn", "disk_full", "partition")
+# `corrupt` is the silent-damage mode the scrub subsystem exists to
+# catch: deterministic in-place bit flips on the payload at the
+# .dat/shard read-write byte seams (mangle()), invisible to the writer —
+# only a CRC/parity check can notice. rate/count/key/volume scoping
+# applies like every other mode.
+MODES = ("error", "latency", "torn", "disk_full", "partition", "corrupt")
 
 
 class FaultInjected(IOError):
@@ -164,11 +170,11 @@ class FaultPoint:
 
     def hit(self, key: str | None = None, volume: int | None = None) -> None:
         """The standard seam check: no-op disarmed; armed, acts per mode
-        (error/partition/disk_full raise, latency sleeps; torn is a
-        no-op here — use mangle() at the byte seam, so a seam calling
-        both never double-counts one torn firing)."""
+        (error/partition/disk_full raise, latency sleeps; torn and
+        corrupt are no-ops here — use mangle() at the byte seam, so a
+        seam calling both never double-counts one firing)."""
         spec = self.spec
-        if spec is None or spec.mode == "torn":
+        if spec is None or spec.mode in ("torn", "corrupt"):
             return
         spec = self.draw(key, volume)
         if spec is not None:
@@ -176,14 +182,23 @@ class FaultPoint:
 
     def mangle(self, data: bytes, key: str | None = None,
                volume: int | None = None) -> bytes:
-        """Torn-write seams: return the payload truncated by `frac` when
-        a torn fault fires; every other mode is handled by hit()."""
+        """Byte seams: `torn` truncates the payload by `frac`; `corrupt`
+        flips every bit of ONE byte at position frac*len — deterministic
+        silent damage a CRC must catch (the writer never notices). Every
+        other mode is handled by hit()."""
         spec = self.spec
-        if spec is None or spec.mode != "torn":
+        if spec is None or spec.mode not in ("torn", "corrupt"):
             return data
         spec = self.draw(key, volume)
         if spec is None:
             return data
+        if spec.mode == "corrupt":
+            if not data:
+                return data
+            pos = min(len(data) - 1, int(len(data) * spec.frac))
+            out = bytearray(data)
+            out[pos] ^= 0xFF
+            return bytes(out)
         keep = max(0, int(len(data) * (1.0 - spec.frac)))
         return data[:keep]
 

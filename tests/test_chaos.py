@@ -16,6 +16,9 @@ firing counts at runtime:
     volume.ec.shard.read volume.ec.parity.write volume.heartbeat.send
     master.assign master.lookup filer.chunk.read
     volume.replicate.fanout volume.fastlane.drain repair.partial_fetch
+
+The `corrupt` fault mode (silent bit flips) is exercised by the PR-14
+scrub scenario (TestSilentCorruptionScrubHeal), also lint-enforced.
 """
 
 import os
@@ -642,6 +645,190 @@ class TestPipelineHopKilledMidRebuild:
         assert "remount_swap" in whyv, whyv
         assert "task_queued" in whyv and "task_done" in whyv, whyv
         assert "chain_restart" in whyv or "fallback_repair" in whyv, whyv
+
+
+class TestSilentCorruptionScrubHeal:
+    def test_bitrot_detected_and_healed_with_zero_client_errors(
+        self, cluster
+    ):
+        """The PR-14 acceptance scenario: silent corruption — a bit flip
+        in a cold replicated needle (injected via the `corrupt` fault
+        mode on the write seam: the client got its 201, nobody noticed)
+        and a flipped byte in a sealed EC shard — is found by a scrub
+        pass, routed by the maintenance daemon to the existing heals
+        (needle re-copy from the good replica; shard delete ->
+        ec_rebuild re-derivation), `cluster.why <vid>` resolves the
+        scrub_finding -> task_done chain, and a concurrent client read
+        storm sees ZERO errors throughout."""
+        master, vols, env = cluster
+
+        # --- a replicated collection with one silently-corrupt needle
+        blobs = {}
+        for i in range(6):
+            a = assign(master, replication="010", collection="cold")
+            data = f"cold-{i}-".encode() * 120
+            st, _, _ = http_request(
+                "POST", f"http://{a['publicUrl']}/{a['fid']}", data)
+            assert st == 201
+            blobs[a["fid"]] = data
+        # the silent write-path bit flip: ONE append draws the fault —
+        # the write still acks 201 and the flip is invisible until a
+        # CRC looks at it (the scrub thesis)
+        faults.arm("volume.write.dat", "corrupt", frac=0.5, count=1)
+        a = assign(master, replication="010", collection="cold")
+        vid_n = int(a["fid"].split(",")[0])
+        key_n, _ = parse_key_hash_with_delta(a["fid"].split(",")[1])
+        data_n = b"rot-me " * 150
+        st, _, _ = http_request(
+            "POST", f"http://{a['publicUrl']}/{a['fid']}", data_n)
+        assert st == 201, "silent corruption must not fail the write"
+        faults.disarm_all()
+        blobs[a["fid"]] = data_n
+
+        # --- a sealed EC volume with a flipped shard byte (all 14
+        # shards stay on the sealing node: the locate-via-parity regime)
+        e = assign(master)
+        vid_e = int(e["fid"].split(",")[0])
+        key_e, _ = parse_key_hash_with_delta(e["fid"].split(",")[1])
+        data_e = b"sealed-rot " * 300
+        assert http_request(
+            "POST", f"http://{e['publicUrl']}/{e['fid']}", data_e,
+        )[0] == 201
+        src = next(
+            vs for vs in vols if vs.store.get_volume(vid_e) is not None
+        )
+        post_json(f"{src.url}/admin/ec/generate", {"volume": vid_e},
+                  timeout=60)
+        post_json(f"{src.url}/admin/ec/delete_volume", {"volume": vid_e})
+        post_json(f"{src.url}/admin/ec/mount", {"volume": vid_e})
+        ev = src.store.get_ec_volume(vid_e)
+        assert len(ev.shard_ids()) == 14
+        flipped_shard = 4
+        shard_path = ev.data_base + f".ec{flipped_shard:02d}"
+        with open(shard_path, "r+b") as f:
+            f.seek(11)
+            b = f.read(1)
+            f.seek(11)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+        # --- client read storm through the whole detect->heal window
+        wc = WeedClient(master.url, cache_ttl=1.0)
+        results = {"ok": 0, "bad": 0}
+        res_lock = threading.Lock()
+        storm_stop = threading.Event()
+        fids = list(blobs)
+
+        def reader(seed: int) -> None:
+            i = seed
+            while not storm_stop.is_set():
+                fid = fids[i % len(fids)]
+                i += 1
+                try:
+                    body = wc.fetch(fid)
+                    with res_lock:
+                        results["ok" if body == blobs[fid] else "bad"] += 1
+                except Exception:
+                    with res_lock:
+                        results["bad"] += 1
+        threads = [
+            threading.Thread(target=reader, args=(s,), daemon=True)
+            for s in range(3)
+        ]
+        for t in threads:
+            t.start()
+
+        try:
+            # --- scrub passes find BOTH pieces of silent damage
+            findings = []
+            for vs in vols:
+                out = post_json(f"{vs.url}/admin/scrub/run", {},
+                                timeout=120)
+                findings.extend(out["findings"])
+            kinds = {(f["kind"], f["volume_id"]) for f in findings}
+            assert ("corrupt_needle", vid_n) in kinds, findings
+            assert ("corrupt_shard", vid_e) in kinds, findings
+            shard_finding = next(
+                f for f in findings if f["kind"] == "corrupt_shard"
+            )
+            assert shard_finding["shard"] == flipped_shard, \
+                "parity recompute must LOCATE the flipped shard"
+
+            # the operator surface sees the same truth: volume.scrub
+            # -dryRun renders the routed repair plan without mutating
+            run_command(env, "lock")
+            plan = run_command(env, "volume.scrub -dryRun")
+            run_command(env, "unlock")
+            assert "corrupt_needle" in plan and "re-copy needle" in plan
+            assert "corrupt_shard" in plan and "ec_rebuild" in plan
+            top = run_command(env, "cluster.scrub")
+            assert "unresolved finding(s)" in top, top
+
+            # --- the daemon routes both findings to their heals
+            post_json(f"{master.url}/maintenance/enable")
+            corrupt_holder = next(
+                vs for vs in vols
+                if vs.scrubber is not None and any(
+                    f["kind"] == "corrupt_needle"
+                    for f in vs.scrubber.unresolved()
+                )
+            )
+            cv = corrupt_holder.store.get_volume(vid_n)
+
+            def needle_healed() -> bool:
+                try:  # a DIRECT local read must verify (no failover)
+                    return cv._read_needle_once(key_n, None).data == data_n
+                except Exception:
+                    return False
+
+            wait_until(needle_healed, timeout=40,
+                       msg="corrupt needle re-copied from good replica")
+
+            def shard_healed() -> bool:
+                evx = src.store.get_ec_volume(vid_e)
+                return evx is not None \
+                    and len(evx.shard_ids()) == 14 \
+                    and not [
+                        f for f in src.scrubber.unresolved()
+                        if f["kind"] == "corrupt_shard"
+                    ]
+
+            wait_until(shard_healed, timeout=40,
+                       msg="corrupt shard deleted + ec_rebuild re-derived")
+            # the re-derived shard is REAL: re-scrub is clean and the
+            # needle reads back through the shards byte-identical
+            out = post_json(f"{src.url}/admin/scrub/run",
+                            {"volume": vid_e}, timeout=120)
+            assert out["findings"] == [], out
+            evx = src.store.get_ec_volume(vid_e)
+            assert evx.read_needle(key_e).data == data_e
+        finally:
+            storm_stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        # --- zero client-visible errors through detect + heal
+        total = results["ok"] + results["bad"]
+        assert total > 30, f"storm too small to mean anything: {results}"
+        assert results["bad"] == 0, results
+
+        # --- the flight recorder resolves detect -> repair for both:
+        # scrub_finding -> task_queued/task_done (scrub), and the shard's
+        # delete -> ec_rebuild chain
+        whyn = run_command(env, f"cluster.why {vid_n}")
+        assert "scrub_finding" in whyn, whyn
+        assert "corrupt_needle" in whyn, whyn
+        assert "task_done" in whyn and "scrub" in whyn, whyn
+        whye = run_command(env, f"cluster.why {vid_e}")
+        assert "scrub_finding" in whye, whye
+        assert "corrupt_shard" in whye, whye
+        assert "ec_rebuild" in whye, whye
+
+        # --- steady state: re-scrub everywhere finds nothing
+        for vs in vols:
+            out = post_json(f"{vs.url}/admin/scrub/run", {}, timeout=120)
+            assert out["findings"] == [], out
+        top = run_command(env, "cluster.scrub")
+        assert "integrity clean" in top, top
 
 
 class TestDisarmAllSteadyState:

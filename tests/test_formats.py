@@ -372,6 +372,26 @@ class TestMetricNameLint:
         assert "SeaweedFS_slo_burn_rate" in collector_names
         assert tool.event_type_violations() == []
         assert tool.slo_violations() == []
+        # PR-14: integrity-scrub families + finding-kind registry
+        # (unique snake_case, corrupt fault mode exercised in chaos,
+        # scrub task type registered with detector + executor)
+        assert kinds["SeaweedFS_volume_scrub_bytes_total"] == "counter"
+        assert kinds["SeaweedFS_volume_scrub_seconds"] == "histogram"
+        assert kinds["SeaweedFS_volume_scrub_findings_total"] == "counter"
+        assert kinds["SeaweedFS_volume_scrub_repairs_total"] == "counter"
+        assert tool.scrub_violations() == []
+
+    def test_scrub_lint_catches_violations(self, monkeypatch):
+        from seaweedfs_tpu.maintenance import scrub
+
+        tool = self._tool()
+        monkeypatch.setattr(
+            scrub, "SCRUB_FINDING_KINDS",
+            scrub.SCRUB_FINDING_KINDS + ("BadKind", "corrupt_needle"),
+        )
+        bad = tool.scrub_violations()
+        assert any("not snake_case" in b for b in bad)
+        assert any("duplicate" in b for b in bad)
 
     def test_event_type_lint_catches_violations(self, monkeypatch):
         from seaweedfs_tpu.stats import events

@@ -475,8 +475,17 @@ class TestSelfHealing:
         st = get_json(f"{master.url}/debug/maintenance")
         assert any(h["task"]["type"] == "vacuum"
                    and h["state"] == "completed" for h in st["history"])
-        # the surviving blob is intact post-compaction
-        status, _, body = http_request("GET", in_vol[-1])
+        # the surviving blob is intact post-compaction. Read through a
+        # location lookup like a real client: the daemon owns EVERY
+        # repair class while enabled, and its balance task may have
+        # legitimately MOVED this volume to the other node — the pinned
+        # assign-time URL then 404s on the old holder (the pre-existing
+        # ~1/8-runs flake this line used to be)
+        fid = in_vol[-1].rsplit("/", 1)[-1]
+        locs = get_json(f"{master.url}/dir/lookup?volumeId={vid}")
+        assert locs.get("locations"), locs
+        status, _, body = http_request(
+            "GET", f"http://{locs['locations'][0]['url']}/{fid}")
         assert status == 200 and body == blobs[in_vol[-1]]
 
     def test_dry_run_plans_same_tasks_with_zero_mutations(self, cluster):

@@ -78,6 +78,9 @@ def collect() -> tuple[dict[str, str], list[str]]:
 
     ec_decoder.repair_metrics()  # SeaweedFS_volume_ec_repair_* families
     maintenance.ensure_metrics()  # SeaweedFS_maintenance_* families
+    from seaweedfs_tpu.maintenance import scrub as scrub_mod
+
+    scrub_mod.ensure_metrics()  # SeaweedFS_volume_scrub_* families
     from seaweedfs_tpu.storage.volume import degraded_reads_counter
     from seaweedfs_tpu.util import faults as faults_mod
 
@@ -376,6 +379,54 @@ def repair_reason_violations() -> list[str]:
     return bad
 
 
+def scrub_violations() -> list[str]:
+    """Scrub finding kinds ride into the `kind` label of
+    SeaweedFS_volume_scrub_{findings,repairs}_total, the scrub_finding
+    event's attrs and the scrub repair routing table — lint them like
+    the other reason sets (unique snake_case), require the `corrupt`
+    fault mode to exist AND be exercised by the chaos suite (silent
+    damage nobody injects is silent damage nobody proved detectable),
+    and require the `scrub` maintenance task type to be registered."""
+    from seaweedfs_tpu import maintenance
+    from seaweedfs_tpu.maintenance import scrub as scrub_mod
+    from seaweedfs_tpu.util import faults
+
+    bad: list[str] = []
+    seen: set[str] = set()
+    for name in scrub_mod.SCRUB_FINDING_KINDS:
+        if not ALERT_RULE_RE.match(name):
+            bad.append(f"scrub finding kind {name!r}: not snake_case")
+        if name in seen:
+            bad.append(f"scrub finding kind {name!r}: duplicate")
+        seen.add(name)
+    if "corrupt" not in faults.MODES:
+        bad.append("fault mode 'corrupt' missing from faults.MODES"
+                   " (scrub detection is untestable end to end)")
+    if "scrub" not in maintenance.TASK_TYPES:
+        bad.append("maintenance task type 'scrub' not registered")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    chaos_src, test_src = "", ""
+    for tf, into in (("test_chaos.py", "chaos"), ("test_scrub.py", "unit")):
+        try:
+            with open(os.path.join(root, "tests", tf)) as f:
+                src = f.read()
+        except OSError:
+            bad.append(f"tests/{tf} missing: the scrub subsystem must be"
+                       f" exercised by the suite")
+            continue
+        test_src += src
+        if into == "chaos":
+            chaos_src = src
+    if '"corrupt"' not in chaos_src and "'corrupt'" not in chaos_src:
+        bad.append("fault mode 'corrupt': not exercised by"
+                   " tests/test_chaos.py")
+    for name in scrub_mod.SCRUB_FINDING_KINDS:
+        if name not in test_src:
+            bad.append(f"scrub finding kind {name!r}: not exercised by"
+                       f" tests/test_scrub.py or tests/test_chaos.py")
+    return bad
+
+
 def degraded_reason_violations() -> list[str]:
     """Degraded-read reasons ride into the `reason` label of
     SeaweedFS_volume_degraded_reads_total (and the degraded_reads alert
@@ -418,7 +469,7 @@ def main() -> int:
         + task_type_violations() + front_reason_violations() \
         + ec_online_reason_violations() + fault_point_violations() \
         + degraded_reason_violations() + repair_reason_violations() \
-        + event_type_violations() + slo_violations()
+        + event_type_violations() + slo_violations() + scrub_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
         print(f"{len(bad)} metric-name violation(s) in {total} families:")
