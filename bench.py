@@ -1081,7 +1081,9 @@ def rebuild_bandwidth_summary(blobs: int = 8) -> dict:
     vols = []
     out: dict = {}
     try:
-        for i in range(4):
+        # 5 nodes: the partial-sum chain keeps >= 4 contributing hops
+        # even when `use` (10 of 13 survivors) skips one holder entirely
+        for i in range(5):
             vs = VolumeServer(
                 [os.path.join(tmp, f"v{i}")], master.url, port=0,
                 rack=f"r{i}", pulse_seconds=1, max_volume_count=30,
@@ -1148,6 +1150,193 @@ def rebuild_bandwidth_summary(blobs: int = 8) -> dict:
         pw = out.get("rebuild_bytes_on_wire_pipelined", 0)
         if cw and pw:
             out["wire_cut_ratio"] = round(cw / pw, 2)
+
+        # --- PR-15 phase: hop-parallel streaming vs the serial chain ---
+        # Same chain (>= 4 hops), same chunking (>= 8 chunks), daemon
+        # off, direct ladder: wall-clock is the only variable. The
+        # streaming claim is ~(H + N) chunk-times vs H x N — a claim
+        # about per-hop TIME, which an in-process localhost cluster
+        # doesn't have; the faults switchboard injects the same fixed
+        # per-hop latency into BOTH modes (repair.partial_fetch fires
+        # once per hop per chunk in each dataflow), so the measured
+        # ratio is the protocol's dataflow shape, not socket noise.
+        from seaweedfs_tpu.shell.commands_ec import (
+            apply_rebuild_pipelined,
+            plan_rebuild_pipelined,
+        )
+        from seaweedfs_tpu.util import faults as faults_mod
+
+        HOP_MS = 4.0
+
+        def wait_shards(n: int, timeout: float = 30.0) -> bool:
+            t = time.time()
+            while time.time() < t + timeout:
+                if shard_count() == n:
+                    return True
+                time.sleep(0.05)
+            return False
+
+        def lose(shards: list[int]) -> None:
+            for s in shards:
+                sv = next(v for v in env.servers()
+                          if s in v.ec_shards.get(vid, []))
+                env.post(f"{sv.http}/admin/ec/delete_shards",
+                         {"volume": vid, "shards": [s],
+                          "delete_index": False})
+
+        try:
+            # the daemon must not race the direct ladder (phase A's error
+            # paths can leave it enabled)
+            post_json(f"{master.url}/maintenance/disable")
+            wait_shards(14)
+            stream_res: dict = {}
+            faults_mod.enable()
+            faults_mod.arm("repair.partial_fetch", "latency", ms=HOP_MS)
+            try:
+                for label, use_stream in (("serial", False),
+                                          ("stream", True)):
+                    lose([0])
+                    if not wait_shards(13):
+                        raise RuntimeError("loss never surfaced")
+                    pplan = plan_rebuild_pipelined(env, vid, "")
+                    hops = len(pplan["chain"])
+                    shard_size = int(out.get("shard_size") or 0)
+                    chunk = max(1024, -(-max(shard_size, 1) // 12))
+                    t0 = time.time()
+                    _, stats = apply_rebuild_pipelined(
+                        env, pplan, chunk=chunk, stream=use_stream)
+                    stream_res[label] = {
+                        "wallclock_s": round(time.time() - t0, 4),
+                        "hops": hops,
+                        "chunks": -(-stats["shard_size"] // chunk),
+                        "bytes_on_wire": stats["bytes_on_wire_total"],
+                        "survivor_bytes_read":
+                            stats["survivor_bytes_read"],
+                    }
+                    if not wait_shards(14):
+                        raise RuntimeError(f"{label} heal never surfaced")
+            finally:
+                faults_mod.disarm_all()
+            out["stream_vs_serial"] = stream_res
+            out["hop_latency_ms"] = HOP_MS
+            out["serial_wallclock_s"] = stream_res["serial"]["wallclock_s"]
+            out["stream_wallclock_s"] = stream_res["stream"]["wallclock_s"]
+            if stream_res["serial"]["wallclock_s"] > 0:
+                out["stream_vs_serial_ratio"] = round(
+                    stream_res["stream"]["wallclock_s"]
+                    / stream_res["serial"]["wallclock_s"], 3)
+            out["stream_equal_wire"] = (
+                stream_res["serial"]["bytes_on_wire"]
+                == stream_res["stream"]["bytes_on_wire"])
+        except Exception as e:
+            out["stream_vs_serial"] = {"error": str(e)[:120]}
+
+        # --- PR-15 phase: 2 lost shards of one stripe, ONE chain pass ---
+        # The hops scale (2 x k) coefficient blocks and forward stacked
+        # partials: each survivor range is read ONCE (not once per
+        # target) and wire bytes per recovered shard stay flat.
+        try:
+            wait_shards(14)
+            lose([0, 1])
+            if not wait_shards(12):
+                raise RuntimeError("double loss never surfaced")
+            pplan = plan_rebuild_pipelined(env, vid, "")
+            links = max(len(pplan["chain"]) - 1, 1)
+            shard_size = int(out.get("shard_size") or 0)
+            chunk = max(1024, -(-max(shard_size, 1) // 12))
+            t0 = time.time()
+            rebuilt, stats = apply_rebuild_pipelined(
+                env, pplan, chunk=chunk, stream=True)
+            multi = {
+                "targets": sorted(rebuilt),
+                "hops": len(pplan["chain"]),
+                "wallclock_s": round(time.time() - t0, 4),
+                "chain_passes": 1 + stats["restarts"],
+                "bytes_on_wire": stats["bytes_on_wire_total"],
+                "survivor_bytes_read": stats["survivor_bytes_read"],
+                # == 1.0: each survivor range read once for BOTH targets
+                # (two separate passes would read them twice)
+                "survivor_reads_per_pass": round(
+                    stats["survivor_bytes_read"]
+                    / (10.0 * stats["shard_size"]), 3),
+                # == 1.0: wire per recovered shard equals a one-target
+                # pass over the same chain — stacking targets onto one
+                # traversal does not double what crosses the wire
+                "wire_per_target_per_link": round(
+                    stats["bytes_on_wire_total"]
+                    / (2.0 * links * stats["shard_size"]), 3),
+            }
+            out["multi_target"] = multi
+            if not wait_shards(14):
+                raise RuntimeError("multi-target heal never surfaced")
+        except Exception as e:
+            out["multi_target"] = {"error": str(e)[:120]}
+
+        # --- PR-15 phase: lazy-batching window through the daemon ---
+        # Two co-stripe losses a scan apart: with -repair.lazyWindow the
+        # first single-shard task defers, the second loss FOLDS into it,
+        # and one multi-target dispatch heals both.
+        def lazy_counts() -> dict:
+            from seaweedfs_tpu.stats import default_registry
+
+            c: dict = {}
+            for line in default_registry().render().splitlines():
+                if line.startswith(
+                        "SeaweedFS_maintenance_lazy_batch_total{"):
+                    k = line.split('outcome="', 1)[1].split('"', 1)[0]
+                    c[k] = c.get(k, 0) + float(line.rsplit(" ", 1)[1])
+            return c
+
+        try:
+            wait_shards(14)
+            before_lazy = lazy_counts()
+            post_json(f"{master.url}/maintenance/enable",
+                      {"rebuildMode": "pipelined", "lazyWindow": 1.5})
+            t0 = time.time()
+            lose([2])
+            time.sleep(0.4)  # a detector scan apart, inside the window
+            lose([3])
+            if not wait_shards(12, timeout=10):
+                pass  # losses may heal before both surface; counters tell
+            healed = wait_shards(14, timeout=60)
+            delta = {
+                k: round(v - before_lazy.get(k, 0), 1)
+                for k, v in lazy_counts().items()
+                if v - before_lazy.get(k, 0) > 0
+            }
+            out["lazy_batching"] = {
+                "window_s": 1.5,
+                "healed": healed,
+                "time_to_heal_s": round(time.time() - t0, 3)
+                if healed else None,
+                "outcomes": delta,
+            }
+            post_json(f"{master.url}/maintenance/disable")
+        except Exception as e:
+            out["lazy_batching"] = {"error": str(e)[:120]}
+
+        # --- regression guard (cluster.check -fail-style) ---
+        # vs the recorded prior round: a >25% streaming wall-clock
+        # regression marks the record, and `bench.py -fail` exits 2 on it
+        try:
+            prior = None
+            prior_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_full.json")
+            if os.path.exists(prior_path):
+                with open(prior_path) as f:
+                    prior = (json.load(f).get("rebuild_bandwidth") or {}) \
+                        .get("stream_wallclock_s")
+            cur = out.get("stream_wallclock_s")
+            out["wallclock_guard"] = {
+                "prior_stream_wallclock_s": prior,
+                "stream_wallclock_s": cur,
+                "max_regression": 1.25,
+                "regressed": bool(
+                    prior and cur and cur > 1.25 * float(prior)),
+            }
+        except Exception as e:
+            out["wallclock_guard"] = {"error": str(e)[:120]}
     finally:
         for vs in vols:
             vs.stop()
@@ -1898,6 +2087,17 @@ def main() -> None:
         json.dump(_drop_nonfinite(detail), f, indent=1, allow_nan=False)
 
     print(summary_line(verb_gbps, seq_gfni, backend, verb_info, dev, detail))
+    # `bench.py -fail`: cluster.check -fail-style scripting hook — a >25%
+    # streaming-rebuild wall-clock regression vs the recorded prior round
+    # exits nonzero (the record above still carries the full numbers)
+    guard = (detail.get("rebuild_bandwidth") or {}).get(
+        "wallclock_guard") or {}
+    if guard.get("regressed") and "-fail" in sys.argv[1:]:
+        print(f"FAIL rebuild_bandwidth wall-clock regression: "
+              f"{guard.get('stream_wallclock_s')}s vs prior "
+              f"{guard.get('prior_stream_wallclock_s')}s (>1.25x)",
+              file=sys.stderr)
+        sys.exit(2)
 
 
 def summary_line(
@@ -1961,6 +2161,13 @@ def summary_line(
                                   .get("scrub_gbps", {})).get("scalar"),
             "scrub_ttd_s": detail.get("scrub", {})
             .get("scrub_time_to_detect_s"),
+            "rebuild_stream_ratio": detail.get("rebuild_bandwidth", {})
+            .get("stream_vs_serial_ratio"),
+            "rebuild_wire_cut": detail.get("rebuild_bandwidth", {})
+            .get("wire_cut_ratio"),
+            "rebuild_wallclock_regressed": (
+                detail.get("rebuild_bandwidth", {})
+                .get("wallclock_guard") or {}).get("regressed"),
             "note": "host GFNI engine carries the verb (DRAM-bound ~4GB/s;"
             " chip link dead — see device_status); detail in"
             " BENCH_full.json",

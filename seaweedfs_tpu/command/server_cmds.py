@@ -81,6 +81,11 @@ def run_master(args: list[str]) -> int:
                    type=float, default=None,
                    help="maintenance scan interval seconds "
                         "(default: pulseSeconds)")
+    p.add_argument("-repair.lazyWindow", dest="repair_lazy_window",
+                   type=float, default=0.0,
+                   help="defer single-shard ec_rebuild dispatch up to this "
+                        "many seconds so co-stripe losses coalesce into "
+                        "one multi-target chain pass (0 = immediate)")
     p.add_argument("-ec.online", dest="ec_online", default="",
                    help="comma-separated collections whose volumes stream-"
                         "encode RS(10,4) parity on ingest ('*' = all); "
@@ -111,6 +116,7 @@ def run_master(args: list[str]) -> int:
         maintenance=opts.maintenance or opts.maintenance_dry_run,
         maintenance_dry_run=opts.maintenance_dry_run,
         maintenance_interval=opts.maintenance_interval,
+        repair_lazy_window=opts.repair_lazy_window,
         ec_online=opts.ec_online,
         ec_online_block=opts.ec_online_block,
     )
@@ -275,6 +281,11 @@ def run_server(args: list[str]) -> int:
                    type=float, default=None,
                    help="maintenance scan interval seconds "
                         "(default: pulseSeconds)")
+    p.add_argument("-repair.lazyWindow", dest="repair_lazy_window",
+                   type=float, default=0.0,
+                   help="defer single-shard ec_rebuild dispatch up to this "
+                        "many seconds so co-stripe losses coalesce into "
+                        "one multi-target chain pass (0 = immediate)")
     p.add_argument("-ec.online", dest="ec_online", default="",
                    help="comma-separated collections whose volumes stream-"
                         "encode RS(10,4) parity on ingest ('*' = all)")
@@ -306,6 +317,7 @@ def run_server(args: list[str]) -> int:
         maintenance=opts.maintenance or opts.maintenance_dry_run,
         maintenance_dry_run=opts.maintenance_dry_run,
         maintenance_interval=opts.maintenance_interval,
+        repair_lazy_window=opts.repair_lazy_window,
         ec_online=opts.ec_online,
         ec_online_block=opts.ec_online_block,
     )

@@ -89,6 +89,7 @@ class MaintenanceDaemon:
         history_size: int = 128,
         registry=None,
         rebuild_mode: str = "auto",
+        lazy_window: float = 0.0,
     ) -> None:
         self.master = master
         self.interval = (
@@ -101,7 +102,12 @@ class MaintenanceDaemon:
         # via POST /maintenance/enable {"rebuildMode": ...}.
         self.rebuild_mode = rebuild_mode
         self.enabled = True
-        self.scheduler = scheduler or RepairScheduler()
+        # -repair.lazyWindow: single-shard ec_rebuild tasks may sit
+        # queued up to this many seconds so co-stripe losses coalesce
+        # into one multi-target chain pass (0 = dispatch immediately).
+        # Runtime-settable via POST /maintenance/enable {"lazyWindow"}.
+        self.scheduler = scheduler or RepairScheduler(
+            lazy_window=lazy_window)
         self.registry = registry if registry is not None else default_registry()
         self._m_tasks, self._m_seconds, self._m_failures = ensure_metrics(
             self.registry
@@ -231,17 +237,24 @@ class MaintenanceDaemon:
 
     def scan_now(self, types=None) -> list[dict]:
         """Synchronous scan + enqueue (the `cluster.maintenance -now` verb);
-        returns what was offered. Dispatch still rides the loop/caps."""
-        offered = self._scan_and_enqueue(types)
+        returns what was offered. Dispatch still rides the loop/caps. An
+        operator-forced scan is urgent: it bypasses the lazy window."""
+        offered = self._scan_and_enqueue(types, urgent=True)
         self._wake.set()
         return [t.to_dict() for t in offered]
 
-    def _scan_and_enqueue(self, types=None) -> list[RepairTask]:
+    def _scan_and_enqueue(self, types=None,
+                          urgent: bool | None = None) -> list[RepairTask]:
+        # subset scans are reactions (a firing alert — degraded reads are
+        # paying for the fault right now — or an operator's -now): they
+        # bypass the lazy-batching window; periodic full scans do not
+        if urgent is None:
+            urgent = types is not None
         self.scans += 1
         now = time.time()
         offered = []
         for task in detectors_mod.scan(self.master, types):
-            if self.scheduler.offer(task, now):
+            if self.scheduler.offer(task, now, urgent=urgent):
                 offered.append(task)
         return offered
 
@@ -249,7 +262,14 @@ class MaintenanceDaemon:
     def _loop(self) -> None:
         next_scan = 0.0  # monotonic deadline for the periodic full scan
         while True:
-            woke = self._wake.wait(timeout=self.interval)
+            timeout = self.interval
+            # a lazy-held task must dispatch the moment its window
+            # expires, not a full interval later: shorten the wait to
+            # the soonest lazy deadline
+            lazy_in = self.scheduler.next_lazy_deadline()
+            if lazy_in is not None:
+                timeout = max(0.05, min(timeout, lazy_in))
+            woke = self._wake.wait(timeout=timeout)
             if self._stopping:
                 return
             with self._lock:
@@ -384,6 +404,10 @@ class MaintenanceDaemon:
             "interval": self.interval,
             "scans": self.scans,
             "started_at": self.started_at,
+            # the live dispatch view cluster.maintenance renders: why a
+            # repair is running (or deferred) RIGHT NOW — token-bucket
+            # level, in-flight vs caps, and the lazy-batching hold
+            "pressure": self.scheduler.pressure(),
             "task_types": {
                 name: {"priority": spec.priority,
                        "concurrency": spec.concurrency,

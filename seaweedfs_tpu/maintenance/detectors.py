@@ -153,12 +153,20 @@ def detect_ec_missing_shards(master) -> list[RepairTask]:
         holders = sorted({n.id for nodes in shard_map.values() for n in nodes})
         if not holders:
             continue
+        # the concrete missing shard ids: the scheduler's lazy-batching
+        # fold widens a queued task's target set with these, so co-stripe
+        # losses detected across scans coalesce into ONE chain pass
+        present_ids = {
+            int(s) for s, nodes in shard_map.items() if nodes
+        }
+        targets = sorted(set(range(total)) - present_ids)
         tasks.append(_task(
             "ec_rebuild", volume_id=vid,
             collection=master.topo.ec_collections.get(vid, ""),
             node=holders[0],
             reason=f"{missing} shard(s) without a live holder",
-            params={"missing": missing, "present": present},
+            params={"missing": missing, "present": present,
+                    "targets": targets},
         ))
     return tasks
 

@@ -327,6 +327,13 @@ class VolumeScrubber:
         self._sleep = sleep or time.sleep
         self._lock = threading.Lock()
         self._findings: dict[tuple, ScrubFinding] = {}
+        # the volumes scrub passes are scanning RIGHT NOW (refcounted —
+        # the periodic loop and an operator/repair-driven targeted pass
+        # can overlap). Rides heartbeats as `scrub_active` so the
+        # master's vacuum detector skips them: a compaction swapping
+        # (nm, dat) mid-scrub wastes the pass at best and fabricates
+        # suspects at worst.
+        self._scrub_holds: dict[int, int] = {}
         (self._m_bytes, self._m_seconds, self._m_findings,
          self._m_repairs) = ensure_metrics()
         self.stats = {
@@ -391,6 +398,28 @@ class VolumeScrubber:
         with self._lock:
             return [f.to_dict() for f in self._findings.values()]
 
+    def active_volumes(self) -> list[int]:
+        """Volume ids scrub passes hold RIGHT NOW (one per concurrent
+        pass). Rides heartbeats so `vacuum_candidates` skips them until
+        the pass moves on."""
+        with self._lock:
+            return sorted(self._scrub_holds)
+
+    def _hold(self, vid: int | None, prev: int | None) -> int | None:
+        """Move one pass's hold from `prev` to `vid` (refcounted: an
+        overlapping pass on the same volume keeps it held). Returns
+        `vid` so callers can thread the current hold through."""
+        with self._lock:
+            if prev is not None:
+                n = self._scrub_holds.get(prev, 0) - 1
+                if n <= 0:
+                    self._scrub_holds.pop(prev, None)
+                else:
+                    self._scrub_holds[prev] = n
+            if vid is not None:
+                self._scrub_holds[vid] = self._scrub_holds.get(vid, 0) + 1
+        return vid
+
     # --- the pass -------------------------------------------------------------
     def scrub_pass(self, volume_id: int | None = None) -> list[ScrubFinding]:
         """One bounded, throttled pass. Returns the findings of THIS
@@ -404,35 +433,41 @@ class VolumeScrubber:
             "corrupt_needle": set(), "corrupt_shard": set(),
             "parity_mismatch": set(),
         }
-        for loc in self.store.locations:
-            for v in list(loc.volumes.values()):
-                if volume_id is not None and v.id != volume_id:
-                    continue
-                try:
-                    found.extend(self._scrub_needles(v))
-                    scanned["corrupt_needle"].add(v.id)
-                except Exception:
-                    pass  # an unloadable volume must not sink the pass
-                w = getattr(v, "online_ec", None)
-                if w is not None and w.active and not w.sealed:
+        held: int | None = None
+        try:
+            for loc in self.store.locations:
+                for v in list(loc.volumes.values()):
+                    if volume_id is not None and v.id != volume_id:
+                        continue
+                    held = self._hold(v.id, held)
                     try:
-                        found.extend(self._scrub_online_parity(v, w))
-                        scanned["parity_mismatch"].add(v.id)
+                        found.extend(self._scrub_needles(v))
+                        scanned["corrupt_needle"].add(v.id)
+                    except Exception:
+                        pass  # an unloadable volume must not sink the pass
+                    w = getattr(v, "online_ec", None)
+                    if w is not None and w.active and not w.sealed:
+                        try:
+                            found.extend(self._scrub_online_parity(v, w))
+                            scanned["parity_mismatch"].add(v.id)
+                        except Exception:
+                            pass
+                for ev in list(loc.ec_volumes.values()):
+                    if volume_id is not None and ev.volume_id != volume_id:
+                        continue
+                    held = self._hold(ev.volume_id, held)
+                    try:
+                        found.extend(self._scrub_sealed_ec(ev))
+                        scanned["corrupt_shard"].add(ev.volume_id)
                     except Exception:
                         pass
-            for ev in list(loc.ec_volumes.values()):
-                if volume_id is not None and ev.volume_id != volume_id:
-                    continue
-                try:
-                    found.extend(self._scrub_sealed_ec(ev))
-                    scanned["corrupt_shard"].add(ev.volume_id)
-                except Exception:
-                    pass
-            if volume_id is None:
-                try:
-                    found.extend(self._gc_tmp_litter(loc.directory))
-                except Exception:
-                    pass
+                if volume_id is None:
+                    try:
+                        found.extend(self._gc_tmp_litter(loc.directory))
+                    except Exception:
+                        pass
+        finally:
+            held = self._hold(None, held)
         # reconcile: a prior finding whose scope COMPLETED this pass
         # without reproducing it was healed (or was transient)
         fresh_keys = {f.key for f in found}

@@ -674,7 +674,7 @@ def _register_debug_routes(service: "HTTPService") -> None:
                     p["point"], p["mode"],
                     rate=p.get("rate", 1.0), ms=p.get("ms", 0.0),
                     frac=p.get("frac", 0.5), count=p.get("count", -1),
-                    key=p.get("key", ""),
+                    key=p.get("key", ""), after=p.get("after", 0),
                 )
                 return Response({"ok": True, "point": p["point"],
                                  "armed": spec.to_dict()})

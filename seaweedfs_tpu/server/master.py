@@ -44,6 +44,7 @@ class MasterServer:
         maintenance: bool = False,
         maintenance_dry_run: bool = False,
         maintenance_interval: float | None = None,
+        repair_lazy_window: float = 0.0,
         ec_online: str = "",
         ec_online_block: int | None = None,
     ) -> None:
@@ -95,6 +96,10 @@ class MasterServer:
         self._maintenance_flag = maintenance
         self._maintenance_dry_run = maintenance_dry_run
         self._maintenance_interval = maintenance_interval
+        # -repair.lazyWindow: defer single-shard ec_rebuild dispatch up
+        # to this many seconds so co-stripe losses fold into one
+        # multi-target chain pass (0 = dispatch immediately)
+        self._repair_lazy_window = float(repair_lazy_window)
         self._maintenance_lock = threading.Lock()
         self._routes()
 
@@ -128,15 +133,16 @@ class MasterServer:
             self._ensure_maintenance(dry_run=self._maintenance_dry_run)
 
     def _ensure_maintenance(self, dry_run: bool | None = False,
-                            rebuild_mode: str | None = None):
+                            rebuild_mode: str | None = None,
+                            lazy_window: float | None = None):
         """Create (or reconfigure) and start the maintenance daemon — the
         `-maintenance` flag at boot, or `cluster.maintenance -enable` at
         runtime. dry_run=None preserves the daemon's current mode: a bare
         re-enable must not silently flip a dry-run daemon into mutating
-        mode (rebuild_mode=None likewise). Locked: two racing
-        /maintenance/enable requests must not each start (and one leak) a
-        daemon, and an enable racing stop() must not start a daemon that
-        outlives the master."""
+        mode (rebuild_mode=None and lazy_window=None likewise). Locked:
+        two racing /maintenance/enable requests must not each start (and
+        one leak) a daemon, and an enable racing stop() must not start a
+        daemon that outlives the master."""
         with self._maintenance_lock:
             if self._stop.is_set():
                 raise RuntimeError("master is stopping")
@@ -147,6 +153,10 @@ class MasterServer:
                     self, interval=self._maintenance_interval,
                     dry_run=bool(dry_run),
                     rebuild_mode=rebuild_mode or "auto",
+                    lazy_window=(
+                        self._repair_lazy_window if lazy_window is None
+                        else float(lazy_window)
+                    ),
                 )
                 daemon.start()
                 self.maintenance = daemon
@@ -155,6 +165,9 @@ class MasterServer:
                     self.maintenance.dry_run = bool(dry_run)
                 if rebuild_mode is not None:
                     self.maintenance.rebuild_mode = rebuild_mode
+                if lazy_window is not None:
+                    self.maintenance.scheduler.lazy_window = \
+                        float(lazy_window)
                 self.maintenance.enabled = True
             return self.maintenance
 
@@ -922,13 +935,26 @@ class MasterServer:
                 return Response(
                     {"error": f"rebuildMode {mode!r} not"
                      f" auto|classic|pipelined"}, 400)
+            lazy = p.get("lazyWindow")
+            if lazy is not None:
+                try:
+                    lazy = float(lazy)
+                except (TypeError, ValueError):
+                    return Response(
+                        {"error": f"lazyWindow {lazy!r} not a number"},
+                        400)
+                if not (0.0 <= lazy < 3600.0) or lazy != lazy:
+                    return Response(
+                        {"error": f"lazyWindow {lazy} not in [0, 3600)"},
+                        400)
             d = self._ensure_maintenance(
                 dry_run=None if dry is None else bool(dry),
-                rebuild_mode=mode,
+                rebuild_mode=mode, lazy_window=lazy,
             )
             return Response({
                 "ok": True, "enabled": True, "dry_run": d.dry_run,
                 "interval": d.interval, "rebuild_mode": d.rebuild_mode,
+                "lazy_window": d.scheduler.lazy_window,
             })
 
         @svc.route("POST", r"/maintenance/disable")

@@ -9,8 +9,10 @@ JSON admin endpoints here), `volume_grpc_client_to_master.go:50` (heartbeat).
 from __future__ import annotations
 
 import json
+import queue
 import re
 import threading
+import time
 import urllib.parse
 
 import numpy as np
@@ -43,6 +45,23 @@ _FP_REPLICATE = faults.register("volume.replicate.fanout")
 # stage mid-chain — the orchestrator's retry ladder must restart the chain
 # minus this hop or fall back to classic whole-shard pulls
 _FP_PARTIAL = faults.register("repair.partial_fetch")
+
+# streaming rebuild sessions: bounded in-flight window per hop (chunks
+# parked on the forward queue) and the stall budget after which a hop
+# declares its downstream wedged (the orchestrator's ladder restarts)
+STREAM_WINDOW = 4
+STREAM_STALL_TIMEOUT = 30.0
+STREAM_SESSION_MAX_AGE = 600.0
+
+
+class _PartialError(Exception):
+    """A partial-sum hop step failed; `payload` is the attribution dict
+    the orchestrator's retry ladder reads (error, failed_hop_server)."""
+
+    def __init__(self, payload: dict, status: int) -> None:
+        super().__init__(payload.get("error", "partial step failed"))
+        self.payload = payload
+        self.status = status
 
 
 class VolumeServer:
@@ -99,6 +118,14 @@ class VolumeServer:
         # half-written file under a valid shard name).
         self._partial_rebuilds: dict[int, dict] = {}
         self._partial_lock = threading.Lock()
+        # streaming rebuild sessions (the hop-parallel half of the
+        # pipelined plane): session id -> per-hop state. Each hop ACKs a
+        # chunk after scaling its local shards and parking the XOR'd sum
+        # on a bounded forward queue; a forwarder thread ships it
+        # downstream while the hop computes the NEXT chunk — an H-hop,
+        # N-chunk rebuild costs ~(H + N) chunk-times instead of H x N.
+        self._partial_streams: dict[str, dict] = {}
+        self._stream_lock = threading.Lock()
         # background integrity scrubber (maintenance/scrub.py): walks
         # volumes/EC shards in token-bucket-throttled passes. -scrub.
         # interval 0 disables the loop; /admin/scrub/run still works.
@@ -189,6 +216,11 @@ class VolumeServer:
             for state in self._partial_rebuilds.values():
                 state["writers"].abort()
             self._partial_rebuilds.clear()
+        with self._stream_lock:  # wake forwarder threads so they exit
+            streams, self._partial_streams = (
+                list(self._partial_streams.values()), {})
+        for st in streams:
+            self._teardown_stream(st)
         if self.store:
             self.store.close()
             self.store = None
@@ -468,6 +500,9 @@ class VolumeServer:
             # must not bloat every heartbeat; repairs resolve findings
             # as they land, so the rest ride later beats
             hb["scrub_findings"] = self.scrubber.unresolved()[:64]
+            # volumes a scrub pass holds right now: the master's vacuum
+            # detector defers their compaction until the pass moves on
+            hb["scrub_active"] = self.scrubber.active_volumes()
         body = _json.dumps(hb).encode()
         tried = 0
         rotation = [u for u in self.master_urls if u != self.master_url]
@@ -508,6 +543,133 @@ class VolumeServer:
                 for p in state["writers"].tmp_paths.values()
             }
 
+    # --- streaming rebuild sessions ------------------------------------------
+    def _scale_local_shards(
+        self, vid: int, coefs: dict[int, list[int]], targets: list[int],
+        offset: int, size: int, me: str,
+    ) -> tuple[np.ndarray | None, int]:
+        """One hop's locally-computed share of the repair sum for
+        [offset, offset+size): scale this node's `use` shards by their
+        coefficient columns on the GF kernel. Returns (contribution or
+        None when the hop owns nothing, bytes read from local shards);
+        raises _PartialError with orchestrator-readable attribution."""
+        if not coefs:
+            return None, 0
+        ev = self.store.get_ec_volume(vid)
+        if ev is None:
+            raise _PartialError(
+                {"error": "ec volume not mounted", "failed_hop_server": me},
+                409)
+        sids = sorted(coefs)
+        rows = []
+        read = 0
+        for sid in sids:
+            if len(coefs[sid]) != len(targets):
+                raise _PartialError(
+                    {"error": f"coefs for shard {sid} != targets",
+                     "failed_hop_server": me}, 400)
+            data = ev._pread_shard(sid, offset, size)
+            if data is None:
+                raise _PartialError(
+                    {"error": "shard_unavailable", "shard": sid,
+                     "failed_hop_server": me}, 409)
+            read += len(data)
+            rows.append(np.frombuffer(data, dtype=np.uint8))
+        m = np.array([coefs[s] for s in sids], dtype=np.uint8).T
+        contrib = ec_decoder.partial_contribution(m, np.stack(rows), ev.codec)
+        return contrib, read
+
+    def _stream_forwarder(self, state: dict) -> None:
+        """Per-session forwarder thread on a mid-chain hop: ship queued
+        chunks downstream IN ORDER while the HTTP handler computes the
+        next one — the overlap the (H + N) wall-clock comes from. A
+        downstream failure is recorded on the session (attributed, with
+        the chunk index) and the queue keeps draining so upstream
+        enqueues never block behind a dead hop."""
+        nxt = state["downstream"][0]
+        mchunks, _ = ec_decoder.stream_metrics()
+        url_base = (
+            nxt["url"] + "/admin/ec/partial/stream/chunk"
+            f"?session={state['session']}"
+        )
+        while True:
+            item = state["queue"].get()
+            if item is None:
+                return
+            seq, offset, size, payload = item
+            if state["error"] is not None:
+                continue  # drain-and-discard: the session already failed
+            url = url_base + f"&seq={seq}&offset={offset}&size={size}"
+
+            def fwd():
+                return http_request(
+                    "POST", url, payload,
+                    headers={"X-Repair-Crc": str(crc_mod.crc32c(payload))},
+                    timeout=READ_POLICY.deadline,
+                )
+
+            try:
+                status, _, out = READ_POLICY.call(fwd)
+            except (IOError, OSError) as e:
+                state["error"] = {
+                    "error": "hop_unreachable",
+                    "failed_hop_server": nxt.get("server", ""),
+                    "chunk": seq, "detail": str(e)[:200],
+                }
+                continue
+            except Exception as e:  # never die with chunks enqueued
+                state["error"] = {
+                    "error": "hop_failed",
+                    "failed_hop_server": nxt.get("server", ""),
+                    "chunk": seq, "detail": str(e)[:200],
+                }
+                continue
+            if status != 200:
+                try:
+                    downstream = json.loads(out) if out else {}
+                except ValueError:
+                    downstream = {}
+                downstream.setdefault("error", f"hop -> {status}")
+                downstream.setdefault(
+                    "failed_hop_server", nxt.get("server", ""))
+                downstream.setdefault("chunk", seq)
+                state["error"] = downstream
+                continue
+            state["forwarded"] += 1
+            mchunks.labels("forwarded").inc()
+
+    def _teardown_stream(self, state: dict) -> None:
+        """Stop a session's forwarder (sentinel + join). Caller already
+        removed it from _partial_streams."""
+        q, t = state.get("queue"), state.get("thread")
+        if q is not None:
+            try:
+                q.put(None, timeout=state.get("stall_timeout", 1.0))
+            except queue.Full:
+                # forwarder wedged mid-send: mark failed so it discards
+                # the backlog, then the sentinel fits
+                state["error"] = state["error"] or {
+                    "error": "stream_stall",
+                    "failed_hop_server": "", "chunk": -1}
+                try:
+                    q.put(None, timeout=5.0)
+                except queue.Full:
+                    pass
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def _sweep_streams_locked(self) -> list[dict]:
+        """Drop sessions past the idle age (a dead orchestrator never
+        closed them). Caller holds _stream_lock; returns the swept
+        states for teardown OUTSIDE the lock."""
+        now = time.time()
+        swept = []
+        for sid in list(self._partial_streams):
+            st = self._partial_streams[sid]
+            if now - st["touched"] > STREAM_SESSION_MAX_AGE:
+                swept.append(self._partial_streams.pop(sid))
+        return swept
+
     def _scrub_loop(self) -> None:  # pragma: no cover - timing loop
         while not self._stop.wait(self.scrub_interval):
             try:
@@ -531,6 +693,17 @@ class VolumeServer:
                     self._pump_online_ec()
                 except Exception:
                     pass
+            # age out streaming sessions a dead orchestrator never
+            # closed — each holds a forwarder thread + up to a window
+            # of chunk payloads, and stream/open (the only other sweep
+            # driver) may never arrive on this node again
+            try:
+                with self._stream_lock:
+                    swept = self._sweep_streams_locked()
+                for st in swept:
+                    self._teardown_stream(st)
+            except Exception:
+                pass
             if getattr(self, "_leaving", False):
                 continue  # volume.server.leave: stay up, stop heartbeating
             self.heartbeat_once()
@@ -1107,7 +1280,10 @@ class VolumeServer:
             """Open a pipelined rebuild on this node (the chain's terminal
             writer): pre-sized tmp shard files for `targets`, renamed into
             place only at commit — a dead orchestrator leaves ignorable
-            .tmp litter, never a half-written shard under a valid name."""
+            .tmp litter, never a half-written shard under a valid name.
+            `resume: true` keeps an existing same-target state and returns
+            its committed frontier, so a restarted chain re-sends only the
+            uncommitted suffix instead of every chunk from byte 0."""
             p = req.json()
             vid = int(p["volume"])
             targets = [int(s) for s in p.get("targets", [])]
@@ -1119,6 +1295,16 @@ class VolumeServer:
             ):
                 return Response({"error": f"bad targets {targets}"}, 400)
             with self._partial_lock:
+                old = self._partial_rebuilds.get(vid)
+                if (
+                    p.get("resume") and old is not None
+                    and old["targets"] == targets
+                ):
+                    return Response({
+                        "ok": True, "shard_size": old["shard_size"],
+                        "targets": targets, "resumed": True,
+                        "committed": old.get("committed", 0),
+                    })
                 old = self._partial_rebuilds.pop(vid, None)
                 if old is not None:  # stale orchestrator: replace its state
                     old["writers"].abort()
@@ -1129,15 +1315,28 @@ class VolumeServer:
                     "writers": writers, "targets": targets,
                     "shard_size": ev.shard_size,
                     "collection": p.get("collection", ""),
+                    # contiguous per-shard byte frontier the chain has
+                    # landed (chunks arrive in order): restarts resume here
+                    "committed": 0,
                 }
             return Response({
                 "ok": True, "shard_size": ev.shard_size, "targets": targets,
+                "committed": 0,
             })
 
         @svc.route("POST", r"/admin/ec/partial/commit")
         def ec_partial_commit(req: Request) -> Response:
             vid = int(req.json()["volume"])
             with self._partial_lock:
+                state = self._partial_rebuilds.get(vid)
+                if state is not None and \
+                        state.get("committed", 0) < state["shard_size"]:
+                    # committing a half-landed rebuild would rename a
+                    # partially-written file under a valid shard name
+                    return Response(
+                        {"error": "rebuild incomplete",
+                         "committed": state.get("committed", 0),
+                         "shard_size": state["shard_size"]}, 409)
                 state = self._partial_rebuilds.pop(vid, None)
             if state is None:
                 return Response({"error": "no rebuild state"}, 404)
@@ -1217,28 +1416,12 @@ class VolumeServer:
                     .reshape(len(targets), size).copy()
             else:
                 partial = None
-            if coefs:
-                ev = self.store.get_ec_volume(vid)
-                if ev is None:
-                    return Response({"error": "ec volume not mounted",
-                                     "failed_hop_server": me}, 409)
-                sids = sorted(coefs)
-                rows = []
-                for sid in sids:
-                    if len(coefs[sid]) != len(targets):
-                        return Response(
-                            {"error": f"coefs for shard {sid} != targets",
-                             "failed_hop_server": me}, 400)
-                    data = ev._pread_shard(sid, offset, size)
-                    if data is None:
-                        return Response(
-                            {"error": "shard_unavailable", "shard": sid,
-                             "failed_hop_server": me}, 409)
-                    rows.append(np.frombuffer(data, dtype=np.uint8))
-                m = np.array([coefs[s] for s in sids], dtype=np.uint8).T
-                contrib = ec_decoder.partial_contribution(
-                    m, np.stack(rows), ev.codec
-                )
+            try:
+                contrib, local_read = self._scale_local_shards(
+                    vid, coefs, targets, offset, size, me)
+            except _PartialError as e:
+                return Response(e.payload, e.status)
+            if contrib is not None:
                 partial = ec_decoder.xor_partials(partial, contrib) \
                     if partial is not None else contrib
             if partial is None:
@@ -1281,6 +1464,8 @@ class VolumeServer:
                     return Response(downstream, 502)
                 downstream["received"] = (
                     [len(body)] + downstream.get("received", []))
+                downstream["read"] = (
+                    [local_read] + downstream.get("read", []))
                 return Response(downstream)
             if write:  # chain terminal: land the sum in the rebuild state
                 with self._partial_lock:
@@ -1292,7 +1477,10 @@ class VolumeServer:
                              "failed_hop_server": me}, 409)
                     for i, sid in enumerate(targets):
                         state["writers"].pwrite(sid, partial[i], offset)
-                return Response({"ok": True, "received": [len(body)]})
+                    if offset == state.get("committed", 0):
+                        state["committed"] = offset + size
+                return Response({"ok": True, "received": [len(body)],
+                                 "read": [local_read]})
             # bare ranged partial: serve the scaled range back (option (b))
             payload = np.ascontiguousarray(partial).tobytes()
             mbytes.labels("pipelined").inc(len(payload))
@@ -1300,6 +1488,261 @@ class VolumeServer:
                 payload, content_type="application/octet-stream",
                 headers={"X-Repair-Crc": str(crc_mod.crc32c(payload))},
             )
+
+        # --- streaming session mode (hop-parallel chunk pipelining) -------
+        # One /admin/ec/partial chain pass per CHUNK costs hops x chunks
+        # sequential hop-steps (each nested POST holds the whole chain).
+        # A stream session arms every hop once (open cascades down the
+        # chain), then each chunk POST is ACKed after local compute +
+        # enqueue — the hop's forwarder thread ships chunk k downstream
+        # while the handler computes chunk k+1. Bounded queue = in-flight
+        # window = backpressure: a stalled downstream fills the queue and
+        # the enqueue timeout surfaces as a typed stream_stall.
+
+        @svc.route("POST", r"/admin/ec/partial/stream/open")
+        def ec_partial_stream_open(req: Request) -> Response:
+            me = f"{self._host}:{self.data_port}"
+            p = req.json()
+            sid = str(p.get("session", ""))
+            vid = int(p["volume"])
+            chain = p.get("chain") or []
+            targets = [int(t) for t in p.get("targets", [])]
+            if not sid or not chain or not targets:
+                return Response(
+                    {"error": "bad session/chain/targets",
+                     "failed_hop_server": me}, 400)
+            _FP_PARTIAL.hit(key=me, volume=vid)
+            from seaweedfs_tpu.stats import trace as _trace
+
+            _trace.annotate(volume=vid, targets=targets, hop=me,
+                            hops_left=len(chain), stream=True)
+            hop, rest = chain[0], chain[1:]
+            state = {
+                "session": sid, "volume": vid,
+                "collection": p.get("collection", ""),
+                "targets": targets,
+                "coefs": {int(k): v
+                          for k, v in hop.get("coefs", {}).items()},
+                "write": bool(hop.get("write")),
+                "downstream": rest,
+                "window": max(1, int(p.get("window", STREAM_WINDOW))),
+                "stall_timeout": float(
+                    p.get("stall_timeout", STREAM_STALL_TIMEOUT)),
+                "received": 0, "read": 0, "forwarded": 0,
+                "error": None, "touched": time.time(),
+                "queue": None, "thread": None,
+            }
+            if rest:
+                # arm the whole chain before any chunk flows: the open
+                # cascades downstream synchronously (chain latency once,
+                # not per chunk)
+                body = dict(p)
+                body["chain"] = rest
+                try:
+                    status, _, out = http_request(
+                        "POST",
+                        rest[0]["url"] + "/admin/ec/partial/stream/open",
+                        json.dumps(body).encode(),
+                        headers={"Content-Type": "application/json"},
+                        timeout=60,
+                    )
+                except (IOError, OSError) as e:
+                    return Response(
+                        {"error": "hop_unreachable",
+                         "failed_hop_server": rest[0].get("server", ""),
+                         "detail": str(e)[:200]}, 502)
+                try:
+                    downstream = json.loads(out) if out else {}
+                except ValueError:
+                    downstream = {}
+                if status != 200:
+                    downstream.setdefault("error", f"open -> {status}")
+                    downstream.setdefault(
+                        "failed_hop_server", rest[0].get("server", ""))
+                    return Response(downstream, 502)
+                state["queue"] = queue.Queue(maxsize=state["window"])
+                t = threading.Thread(
+                    target=self._stream_forwarder, args=(state,),
+                    daemon=True, name="sw-ec-stream",
+                )
+                state["thread"] = t
+                t.start()
+            elif state["write"]:
+                with self._partial_lock:
+                    rb = self._partial_rebuilds.get(vid)
+                    if rb is None or rb["targets"] != targets:
+                        return Response(
+                            {"error": "start_failed",
+                             "detail": "no matching rebuild state",
+                             "failed_hop_server": me}, 409)
+            with self._stream_lock:
+                old = self._partial_streams.pop(sid, None)
+                self._partial_streams[sid] = state
+                swept = self._sweep_streams_locked()
+            if old is not None:
+                self._teardown_stream(old)
+            for st in swept:
+                self._teardown_stream(st)
+            return Response({"ok": True, "session": sid})
+
+        @svc.route("POST", r"/admin/ec/partial/stream/chunk")
+        def ec_partial_stream_chunk(req: Request) -> Response:
+            me = f"{self._host}:{self.data_port}"
+            q = req.query
+            sid = q.get("session", "")
+            with self._stream_lock:
+                state = self._partial_streams.get(sid)
+            if state is None:
+                return Response(
+                    {"error": "unknown stream session",
+                     "failed_hop_server": me}, 404)
+            vid = state["volume"]
+            seq = int(q["seq"])
+            offset = int(q["offset"])
+            size = int(q["size"])
+            if size <= 0 or offset < 0:
+                return Response({"error": "bad offset/size",
+                                 "failed_hop_server": me,
+                                 "chunk": seq}, 400)
+            _FP_PARTIAL.hit(key=me, volume=vid)
+            state["touched"] = time.time()
+            if state["error"] is not None:
+                return Response(dict(state["error"]), 502)
+            targets = state["targets"]
+            mchunks, _ = ec_decoder.stream_metrics()
+            mbytes, _, _, _ = ec_decoder.repair_metrics()
+            body = req.body
+            partial = None
+            if body:
+                if len(body) != len(targets) * size:
+                    return Response(
+                        {"error": "partial size mismatch",
+                         "failed_hop_server": me, "chunk": seq}, 409)
+                want = req.headers.get("X-Repair-Crc")
+                if want is not None and int(want) != crc_mod.crc32c(body):
+                    mchunks.labels("crc_failed").inc()
+                    return Response(
+                        {"error": "chunk_crc", "failed_hop_server": me,
+                         "chunk": seq}, 409)
+                state["received"] += len(body)
+                mbytes.labels("pipelined").inc(len(body))
+                partial = np.frombuffer(body, dtype=np.uint8) \
+                    .reshape(len(targets), size).copy()
+            try:
+                contrib, local_read = self._scale_local_shards(
+                    vid, state["coefs"], targets, offset, size, me)
+            except _PartialError as e:
+                return Response({**e.payload, "chunk": seq}, e.status)
+            state["read"] += local_read
+            if contrib is not None:
+                partial = ec_decoder.xor_partials(partial, contrib) \
+                    if partial is not None else contrib
+            if partial is None:
+                partial = np.zeros((len(targets), size), dtype=np.uint8)
+            if state["queue"] is not None:
+                payload = np.ascontiguousarray(partial).tobytes()
+                try:
+                    state["queue"].put((seq, offset, size, payload),
+                                       timeout=state["stall_timeout"])
+                except queue.Full:
+                    mchunks.labels("stalled").inc()
+                    state["error"] = {
+                        "error": "stream_stall",
+                        "failed_hop_server":
+                            state["downstream"][0].get("server", ""),
+                        "chunk": seq,
+                    }
+                    return Response(dict(state["error"]), 503)
+                return Response({"ok": True, "chunk": seq})
+            # chain terminal: land the chunk at the committed frontier
+            with self._partial_lock:
+                rb = self._partial_rebuilds.get(vid)
+                if rb is None or rb["targets"] != targets:
+                    return Response(
+                        {"error": "start_failed",
+                         "detail": "no matching rebuild state",
+                         "failed_hop_server": me, "chunk": seq}, 409)
+                committed = rb.get("committed", 0)
+                if offset + size <= committed:
+                    # duplicate delivery: the upstream forwarder's retry
+                    # policy re-sends a chunk whose ACK was lost on the
+                    # wire. The write already landed — ACK it again
+                    # instead of failing the session (a 409 here gets
+                    # the healthy REBUILDER excluded by the ladder and
+                    # its whole committed frontier aborted).
+                    return Response({"ok": True, "chunk": seq,
+                                     "committed": committed,
+                                     "duplicate": True})
+                if offset != committed:
+                    return Response(
+                        {"error": f"chunk out of order (offset {offset},"
+                                  f" committed {committed})",
+                         "failed_hop_server": me, "chunk": seq}, 409)
+                for i, t in enumerate(targets):
+                    rb["writers"].pwrite(t, partial[i], offset)
+                rb["committed"] = offset + size
+            mchunks.labels("written").inc()
+            return Response({"ok": True, "chunk": seq,
+                             "committed": offset + size})
+
+        @svc.route("POST", r"/admin/ec/partial/stream/close")
+        def ec_partial_stream_close(req: Request) -> Response:
+            """Flush-and-report: drain this hop's forward queue, cascade
+            the close downstream, and return per-hop received/read byte
+            lists (chain order) plus the terminal's committed frontier.
+            Always 200 — the payload carries `ok` and, on failure, the
+            attributed error so the orchestrator's ladder can resume
+            from `committed` instead of byte 0."""
+            me = f"{self._host}:{self.data_port}"
+            sid = req.query.get("session", "")
+            with self._stream_lock:
+                state = self._partial_streams.pop(sid, None)
+            if state is None:
+                return Response(
+                    {"error": "unknown stream session",
+                     "failed_hop_server": me}, 404)
+            if state["queue"] is not None:
+                self._teardown_stream(state)  # drains in order, then joins
+            out: dict = {
+                "ok": True,
+                "received": [state["received"]],
+                "read": [state["read"]],
+                "committed": None,
+            }
+            if state["downstream"]:
+                nxt = state["downstream"][0]
+                try:
+                    status, _, body = http_request(
+                        "POST",
+                        nxt["url"]
+                        + f"/admin/ec/partial/stream/close?session={sid}",
+                        b"", timeout=120,
+                    )
+                    down = json.loads(body) if body else {}
+                except (IOError, OSError, ValueError) as e:
+                    down = {"error": "hop_unreachable",
+                            "failed_hop_server": nxt.get("server", ""),
+                            "detail": str(e)[:200]}
+                out["received"] += down.get("received", [])
+                out["read"] += down.get("read", [])
+                out["committed"] = down.get("committed")
+                if (down.get("error") or not down.get("ok", True)) \
+                        and state["error"] is None:
+                    state["error"] = {
+                        k: down[k]
+                        for k in ("error", "failed_hop_server", "chunk",
+                                  "detail")
+                        if k in down
+                    }
+            else:
+                with self._partial_lock:
+                    rb = self._partial_rebuilds.get(state["volume"])
+                    out["committed"] = (
+                        None if rb is None else rb.get("committed", 0))
+            if state["error"] is not None:
+                out.update(state["error"])
+                out["ok"] = False
+            return Response(out)
 
         # --- volume copy / move plane (volume_grpc_copy.go) ---
         @svc.route("GET", r"/admin/volume/files")

@@ -27,9 +27,27 @@ from .registry import command, dry_run_flag, parse_flags, render_plan
 TOTAL_SHARDS = 14
 DATA_SHARDS = 10
 
-# partial chunk: ranges per chain pass. Big enough to amortize the hop
-# HTTP overhead, small enough that a mid-chain death retries cheaply.
+# partial chunk ceiling: ranges per chain pass. Big enough to amortize
+# the hop HTTP overhead, small enough that a mid-chain death retries
+# cheaply.
 PARTIAL_CHUNK = 4 * 1024 * 1024
+# auto chunk sizing (chunk=None): aim for ~STREAM_TARGET_CHUNKS chunks
+# per shard so the hop-parallel overlap engages proportionally on ANY
+# shard size — a 32KB test shard pipelines 8+ chunks just like a 4GB
+# production shard, instead of degenerating to one serial pass
+PARTIAL_CHUNK_MIN = 4096
+STREAM_TARGET_CHUNKS = 16
+
+# streaming sessions: per-hop in-flight chunk window (the bounded queue
+# each hop parks computed chunks on while its forwarder ships them)
+STREAM_WINDOW = 4
+
+
+def auto_chunk(shard_size: int) -> int:
+    """The chunk size apply_rebuild_pipelined uses when none is forced:
+    ~1/16th of the shard, clamped to [PARTIAL_CHUNK_MIN, PARTIAL_CHUNK]."""
+    want = -(-max(shard_size, 1) // STREAM_TARGET_CHUNKS)
+    return min(PARTIAL_CHUNK, max(PARTIAL_CHUNK_MIN, want))
 
 
 def _spread_plan(
@@ -382,133 +400,172 @@ def choose_rebuild_mode(pplan: dict | None, pressure: dict | None = None
 
 
 def apply_rebuild_pipelined(
-    env: CommandEnv, plan: dict, chunk: int = PARTIAL_CHUNK,
+    env: CommandEnv, plan: dict, chunk: int | None = None,
+    stream: bool | None = None, window: int = STREAM_WINDOW,
+    stall_timeout: float | None = None,
 ) -> tuple[list[int], dict]:
     """Execute a pipelined plan with the retry ladder: a dead hop
     restarts the chain minus that hop (re-planned coefficients) while
-    the survivors still cover 10 shards; a CRC mismatch restarts the
-    SAME chain once (the server that reported it is the detector, not
-    the corruptor — excluding it would punish a healthy holder) and
-    escalates to the typed crc_mismatch fallback on a repeat; exhausted
-    restarts raise PipelinedRebuildError so the caller falls back to
-    classic. Returns (rebuilt shard ids, wire stats)."""
+    the survivors still cover 10 shards; a CRC mismatch or stream stall
+    restarts the SAME chain once (the server that reported it is the
+    detector, not the corruptor, and a stalled downstream may just have
+    been slow — excluding either would punish a healthy holder) and
+    escalates to the typed fallback on a repeat; exhausted restarts
+    raise PipelinedRebuildError so the caller falls back to classic.
+
+    Restarts RESUME: the rebuilder's partial-write state survives a
+    failed chain (chunks land in order, so its committed frontier is
+    exact) and the re-planned chain re-sends only the uncommitted
+    suffix — the already-committed bytes are counted into
+    ec_repair_resumed_bytes_total instead of crossing the wire again.
+    The state is aborted only on terminal failure.
+
+    `stream=None` auto-picks: multi-hop, multi-chunk repairs use the
+    streaming session mode (hop-parallel, ~(hops + chunks) chunk-times);
+    True/False forces. `chunk=None` sizes chunks via auto_chunk() off
+    the real shard size. Returns (rebuilt shard ids, wire stats)."""
     _, mseconds, _, mrestarts = ec_decoder.repair_metrics()
     excluded: list[str] = []
     restarts = 0
-    crc_failures = 0
-    while True:
-        try:
-            return _run_chain(env, plan, chunk, mseconds, restarts)
-        except PipelinedRebuildError:
-            raise
-        except _HopFailed as e:
-            reason = e.reason if e.reason in ec_decoder.REPAIR_RESTART_REASONS \
-                else "hop_failed"
-            mrestarts.labels(reason).inc()
-            from seaweedfs_tpu.stats import events as events_mod
-
-            events_mod.emit("chain_restart", volume=plan["volume"],
-                            node=e.server, reason=reason,
-                            detail=e.detail[:200])
-            restarts += 1
-            if reason == "crc_mismatch":
-                crc_failures += 1
-                if crc_failures >= 2:  # corrupt twice: stop pretending
-                    raise PipelinedRebuildError("crc_mismatch", e.detail)
-            elif e.server:
-                excluded.append(e.server)
-            elif restarts > 1:
-                # a hop failed twice without ever being attributable
-                # (pure transport noise): classic is the honest fallback
-                raise PipelinedRebuildError("hop_failed", e.detail)
+    strikes = {r: 0 for r in ("crc_mismatch", "chunk_crc", "stream_stall")}
+    rb_url = plan["rebuilder_url"]
+    try:
+        while True:
             try:
-                plan = plan_rebuild_pipelined(
-                    env, plan["volume"], plan["collection"],
-                    exclude=tuple(excluded),
+                return _run_chain(env, plan, chunk, mseconds, restarts,
+                                  stream=stream, window=window,
+                                  stall_timeout=stall_timeout)
+            except PipelinedRebuildError:
+                raise
+            except _HopFailed as e:
+                reason = e.reason \
+                    if e.reason in ec_decoder.REPAIR_RESTART_REASONS \
+                    else "hop_failed"
+                mrestarts.labels(reason).inc()
+                from seaweedfs_tpu.stats import events as events_mod
+
+                events_mod.emit(
+                    "chain_restart", volume=plan["volume"],
+                    node=e.server, reason=reason, detail=e.detail[:200],
+                    **({"chunk": e.chunk} if e.chunk is not None else {}),
                 )
-            except ShellError as err:
-                raise PipelinedRebuildError("insufficient_shards", str(err))
-            if plan is None:  # healed underneath us (another repair won)
-                return [], {"bytes_on_wire_total": 0,
-                            "bytes_on_wire_rebuilder": 0,
-                            "hops": 0, "restarts": restarts}
+                restarts += 1
+                if reason in strikes:
+                    strikes[reason] += 1
+                    if strikes[reason] >= 2:  # twice: stop pretending
+                        raise PipelinedRebuildError(reason, e.detail)
+                elif e.server:
+                    excluded.append(e.server)
+                elif restarts > 1:
+                    # a hop failed twice without ever being attributable
+                    # (pure transport noise): classic is honest fallback
+                    raise PipelinedRebuildError("hop_failed", e.detail)
+                try:
+                    new_plan = plan_rebuild_pipelined(
+                        env, plan["volume"], plan["collection"],
+                        exclude=tuple(excluded),
+                    )
+                except ShellError as err:
+                    raise PipelinedRebuildError(
+                        "insufficient_shards", str(err))
+                if new_plan is None:  # healed underneath us
+                    return [], {"bytes_on_wire_total": 0,
+                                "bytes_on_wire_rebuilder": 0,
+                                "hops": 0, "restarts": restarts}
+                if new_plan["rebuilder_url"] != rb_url:
+                    # the committed frontier lives on the OLD rebuilder:
+                    # drop its state, the new writer starts from byte 0
+                    try:
+                        env.post(f"{rb_url}/admin/ec/partial/abort",
+                                 {"volume": plan["volume"]}, timeout=30)
+                    except Exception:
+                        pass
+                    rb_url = new_plan["rebuilder_url"]
+                plan = new_plan
+    except BaseException as e:
+        # terminal exit (typed fallback or unexpected): the partial
+        # state will not be resumed — abort it so only .tmp litter
+        # (swept by scrub GC) can remain. Success returns above.
+        if not isinstance(e, GeneratorExit):
+            try:
+                env.post(f"{rb_url}/admin/ec/partial/abort",
+                         {"volume": plan["volume"]}, timeout=30)
+            except Exception:
+                pass
+        raise
 
 
 class _HopFailed(Exception):
-    def __init__(self, server: str, reason: str, detail: str = "") -> None:
+    def __init__(self, server: str, reason: str, detail: str = "",
+                 chunk: int | None = None) -> None:
         super().__init__(f"chain hop {server or '?'} failed: {reason}")
         self.server = server
         self.reason = reason
         self.detail = detail
+        self.chunk = chunk
 
 
-def _run_chain(env, plan, chunk, mseconds, restarts) -> tuple[list[int], dict]:
-    from seaweedfs_tpu.server.httpd import http_request
+def _json_or_empty(out: bytes) -> dict:
+    try:
+        return json.loads(out) if out else {}
+    except ValueError:
+        return {}
 
+
+def _reason_of(resp: dict) -> str:
+    err = resp.get("error", "")
+    return err if err in ec_decoder.REPAIR_RESTART_REASONS else "hop_failed"
+
+
+def _run_chain(env, plan, chunk, mseconds, restarts, stream=None,
+               window=STREAM_WINDOW,
+               stall_timeout=None) -> tuple[list[int], dict]:
     vid, collection = plan["volume"], plan["collection"]
     rb = plan["rebuilder_url"]
     chain = plan["chain"]
     targets = plan["missing"]
-    targets_q = ",".join(str(t) for t in targets)
     t0 = time.perf_counter()
     try:
         start = env.post(
             f"{rb}/admin/ec/partial/start",
-            {"volume": vid, "collection": collection, "targets": targets},
+            {"volume": vid, "collection": collection, "targets": targets,
+             "resume": True},
             timeout=60,
         )
     except Exception as e:
         raise PipelinedRebuildError("start_failed", str(e)[:200])
     shard_size = int(start["shard_size"])
+    committed = int(start.get("committed", 0))
+    if chunk is None:
+        chunk = auto_chunk(shard_size)
     mseconds.labels("pipelined", "start").observe(time.perf_counter() - t0)
-    received = [0] * len(chain)
-    try:
-        t1 = time.perf_counter()
-        for off in range(0, max(shard_size, 1), chunk):
-            size = min(chunk, shard_size - off)
-            if size <= 0:
-                break
-            url = (
-                chain[0]["url"] + f"/admin/ec/partial?volume={vid}"
-                f"&collection={urllib.parse.quote(collection)}"
-                f"&offset={off}&size={size}&targets={targets_q}"
-                f"&chain={urllib.parse.quote(json.dumps(chain))}"
-            )
-            try:
-                status, _, out = http_request("POST", url, b"", timeout=120)
-            except (IOError, OSError) as e:
-                raise _HopFailed(chain[0]["server"], "hop_failed",
-                                 str(e)[:200])
-            try:
-                resp = json.loads(out) if out else {}
-            except ValueError:
-                resp = {}
-            if status != 200:
-                reason = "crc_mismatch" \
-                    if resp.get("error") == "crc_mismatch" else "hop_failed"
-                raise _HopFailed(
-                    resp.get("failed_hop_server") or chain[0]["server"],
-                    reason, str(resp)[:200],
-                )
-            got = resp.get("received", [])
-            for i, n in enumerate(got[-len(chain):]):
-                received[i] += int(n)
-        mseconds.labels("pipelined", "chain").observe(
-            time.perf_counter() - t1)
-        t2 = time.perf_counter()
-        out = env.post(
-            f"{rb}/admin/ec/partial/commit",
-            {"volume": vid, "collection": collection}, timeout=60,
-        )
-        mseconds.labels("pipelined", "commit").observe(
-            time.perf_counter() - t2)
-    except BaseException:
-        try:
-            env.post(f"{rb}/admin/ec/partial/abort", {"volume": vid},
-                     timeout=30)
-        except Exception:
-            pass
-        raise
+    saved = 0
+    if committed and len(chain) > 1:
+        # bytes a from-scratch restart would have re-sent: the committed
+        # prefix, stacked per target, over every hop link. A 1-hop chain
+        # moves no partial-sum bytes at all (the writer computes from
+        # its own shards; the chunk POSTs carry empty bodies), so there
+        # are no wire savings to count.
+        saved = committed * len(targets) * (len(chain) - 1)
+        ec_decoder.stream_metrics()[1].inc(saved)
+    use_stream = stream if stream is not None else (
+        len(chain) > 1 and shard_size - committed > chunk)
+    t1 = time.perf_counter()
+    if use_stream:
+        received, read_bytes = _stream_chunks(
+            env, plan, chunk, window, shard_size, committed,
+            stall_timeout=stall_timeout)
+    else:
+        received, read_bytes = _serial_chunks(
+            env, plan, chunk, shard_size, committed)
+    mseconds.labels("pipelined", "chain").observe(time.perf_counter() - t1)
+    t2 = time.perf_counter()
+    out = env.post(
+        f"{rb}/admin/ec/partial/commit",
+        {"volume": vid, "collection": collection}, timeout=60,
+    )
+    mseconds.labels("pipelined", "commit").observe(
+        time.perf_counter() - t2)
     stats = {
         "bytes_on_wire_total": sum(received),
         "bytes_on_wire_rebuilder": received[-1] if received else 0,
@@ -516,13 +573,154 @@ def _run_chain(env, plan, chunk, mseconds, restarts) -> tuple[list[int], dict]:
         "hops": len(chain),
         "restarts": restarts,
         "per_hop_received": received,
+        "survivor_bytes_read": sum(read_bytes),
+        "per_hop_read": read_bytes,
+        "resumed_bytes_saved": saved,
+        "streamed": bool(use_stream),
+        "targets": len(targets),
     }
     return out.get("rebuilt", targets), stats
+
+
+def _chunk_spans(shard_size: int, committed: int, chunk: int):
+    for off in range(committed, max(shard_size, 1), chunk):
+        size = min(chunk, shard_size - off)
+        if size <= 0:
+            return
+        yield off, size
+
+
+def _serial_chunks(env, plan, chunk, shard_size, committed):
+    """One nested chain pass per chunk (the pre-streaming dataflow, kept
+    for single-chunk repairs, 1-hop chains and as the forced-comparison
+    baseline the bench measures the streaming win against)."""
+    from seaweedfs_tpu.server.httpd import http_request
+
+    vid, collection = plan["volume"], plan["collection"]
+    chain = plan["chain"]
+    targets = plan["missing"]
+    targets_q = ",".join(str(t) for t in targets)
+    received = [0] * len(chain)
+    read_bytes = [0] * len(chain)
+    for off, size in _chunk_spans(shard_size, committed, chunk):
+        url = (
+            chain[0]["url"] + f"/admin/ec/partial?volume={vid}"
+            f"&collection={urllib.parse.quote(collection)}"
+            f"&offset={off}&size={size}&targets={targets_q}"
+            f"&chain={urllib.parse.quote(json.dumps(chain))}"
+        )
+        try:
+            status, _, out = http_request("POST", url, b"", timeout=120)
+        except (IOError, OSError) as e:
+            raise _HopFailed(chain[0]["server"], "hop_failed",
+                             str(e)[:200])
+        resp = _json_or_empty(out)
+        if status != 200:
+            raise _HopFailed(
+                resp.get("failed_hop_server") or chain[0]["server"],
+                _reason_of(resp), str(resp)[:200],
+            )
+        for i, n in enumerate(resp.get("received", [])[-len(chain):]):
+            received[i] += int(n)
+        for i, n in enumerate(resp.get("read", [])[-len(chain):]):
+            read_bytes[i] += int(n)
+    return received, read_bytes
+
+
+def _stream_chunks(env, plan, chunk, window, shard_size, committed,
+                   stall_timeout=None):
+    """The hop-parallel dataflow: open a session along the chain once,
+    then fire chunk POSTs that each hop ACKs after local compute +
+    enqueue — chunk k rides the forwarder threads downstream while every
+    hop computes chunk k+1, so the pass costs ~(hops + chunks)
+    chunk-times instead of hops x chunks. close() flushes, cascades, and
+    reports per-hop wire/read accounting + the writer's committed
+    frontier (the resume point when anything failed)."""
+    import uuid
+
+    from seaweedfs_tpu.server.httpd import http_request
+
+    vid, collection = plan["volume"], plan["collection"]
+    chain = plan["chain"]
+    targets = plan["missing"]
+    head = chain[0]
+    session = uuid.uuid4().hex
+    open_payload = {
+        "session": session, "volume": vid, "collection": collection,
+        "targets": targets, "chain": chain, "window": window,
+    }
+    if stall_timeout is not None:
+        open_payload["stall_timeout"] = stall_timeout
+    open_body = json.dumps(open_payload).encode()
+    try:
+        status, _, out = http_request(
+            "POST", head["url"] + "/admin/ec/partial/stream/open",
+            open_body, headers={"Content-Type": "application/json"},
+            timeout=120,
+        )
+    except (IOError, OSError) as e:
+        raise _HopFailed(head["server"], "hop_failed", str(e)[:200])
+    resp = _json_or_empty(out)
+    if status != 200:
+        raise _HopFailed(
+            resp.get("failed_hop_server") or head["server"],
+            _reason_of(resp), str(resp)[:200], chunk=resp.get("chunk"),
+        )
+    close_url = (head["url"]
+                 + f"/admin/ec/partial/stream/close?session={session}")
+    try:
+        for seq, (off, size) in enumerate(
+                _chunk_spans(shard_size, committed, chunk)):
+            url = (
+                head["url"] + "/admin/ec/partial/stream/chunk"
+                f"?session={session}&seq={seq}&offset={off}&size={size}"
+            )
+            try:
+                status, _, out = http_request("POST", url, b"", timeout=120)
+            except (IOError, OSError) as e:
+                raise _HopFailed(head["server"], "hop_failed",
+                                 str(e)[:200], chunk=seq)
+            resp = _json_or_empty(out)
+            if status != 200:
+                raise _HopFailed(
+                    resp.get("failed_hop_server") or head["server"],
+                    _reason_of(resp), str(resp)[:200],
+                    chunk=resp.get("chunk", seq),
+                )
+    except _HopFailed:
+        try:  # tear the session down chain-wide; the ladder resumes
+            http_request("POST", close_url, b"", timeout=60)
+        except Exception:
+            pass
+        raise
+    try:
+        status, _, out = http_request("POST", close_url, b"", timeout=240)
+    except (IOError, OSError) as e:
+        raise _HopFailed(head["server"], "hop_failed", str(e)[:200])
+    close = _json_or_empty(out)
+    if status != 200 or not close.get("ok"):
+        raise _HopFailed(
+            close.get("failed_hop_server") or head["server"],
+            _reason_of(close), str(close)[:300], chunk=close.get("chunk"),
+        )
+    landed = close.get("committed")
+    if landed is not None and int(landed) < shard_size:
+        raise _HopFailed(
+            "", "hop_failed",
+            f"stream closed at {landed}/{shard_size} committed")
+    received = [int(n) for n in close.get("received", [])]
+    read_bytes = [int(n) for n in close.get("read", [])]
+    while len(received) < len(chain):
+        received.append(0)
+    while len(read_bytes) < len(chain):
+        read_bytes.append(0)
+    return received, read_bytes
 
 
 def run_rebuild(
     env: CommandEnv, vid: int, collection: str = "", mode: str = "auto",
     pressure: dict | None = None, dry_run: bool = False,
+    stream: bool | None = None,
 ) -> dict:
     """The ONE choose-mode + apply + typed-fallback path, shared by the
     `ec.rebuild` verb and the maintenance ec_rebuild executor — so both
@@ -539,12 +737,13 @@ def run_rebuild(
     from seaweedfs_tpu.stats import trace as trace_mod
 
     with trace_mod.span("ec.rebuild", volume=vid, mode=mode):
-        return _run_rebuild(env, vid, collection, mode, pressure, dry_run)
+        return _run_rebuild(env, vid, collection, mode, pressure, dry_run,
+                            stream)
 
 
 def _run_rebuild(
     env: CommandEnv, vid: int, collection: str, mode: str,
-    pressure: dict | None, dry_run: bool,
+    pressure: dict | None, dry_run: bool, stream: bool | None = None,
 ) -> dict:
     if mode not in ("auto",) + ec_decoder.REPAIR_MODES:
         raise ShellError(f"mode must be auto|classic|pipelined, got {mode}")
@@ -578,7 +777,8 @@ def _run_rebuild(
     if mode == "pipelined":
         planned = describe_rebuild_pipelined(pplan)
         try:
-            rebuilt, stats = apply_rebuild_pipelined(env, pplan)
+            rebuilt, stats = apply_rebuild_pipelined(env, pplan,
+                                                     stream=stream)
             return {"mode": "pipelined", "planned": planned,
                     "rebuilt": rebuilt, "rebuilder": pplan["rebuilder"],
                     "stats": stats}
@@ -598,16 +798,21 @@ def _run_rebuild(
 
 
 @command("ec.rebuild", "-volumeId <n> [-collection name]"
-         " [-mode pipelined|classic|auto] [-dryRun|-apply] — rebuild"
-         " missing shards; pipelined streams GF partial sums hop to hop"
-         " (~1x shard-size at the rebuilder vs 10x classic)",
+         " [-mode pipelined|classic|auto] [-stream true|false]"
+         " [-dryRun|-apply] — rebuild missing shards; pipelined streams"
+         " GF partial sums hop to hop (~1x shard-size at the rebuilder"
+         " vs 10x classic), chunks pipelined hop-parallel by default",
          needs_lock=True)
 def cmd_ec_rebuild(env: CommandEnv, args: list[str]) -> str:
     flags = parse_flags(args)
     vid = int(flags["volumeId"])
+    stream = None
+    if "stream" in flags:
+        stream = flags["stream"] not in ("false", "0", "no")
     out = run_rebuild(
         env, vid, flags.get("collection", ""),
         mode=flags.get("mode", "auto"), dry_run=dry_run_flag(flags),
+        stream=stream,
     )
     if out.get("healed"):
         return f"volume {vid}: all {TOTAL_SHARDS} shards present"
@@ -617,7 +822,9 @@ def cmd_ec_rebuild(env: CommandEnv, args: list[str]) -> str:
     if stats is not None:
         return (
             f"volume {vid}: rebuilt shards {out['rebuilt']} on"
-            f" {out['rebuilder']} (pipelined, {stats['hops']} hops,"
+            f" {out['rebuilder']} (pipelined"
+            f"{', streamed' if stats.get('streamed') else ''},"
+            f" {stats['hops']} hops,"
             f" {stats['bytes_on_wire_rebuilder']} B at rebuilder,"
             f" {stats['bytes_on_wire_total']} B total on wire)"
         )

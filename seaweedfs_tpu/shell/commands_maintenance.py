@@ -34,6 +34,18 @@ def _render_status(st: dict) -> str:
         f" global {limits.get('global_limit', '?')} in flight,"
         f" per-node {limits.get('per_node_limit', '?')}"
     )
+    pressure = st.get("pressure")
+    if pressure:
+        lazy_w = pressure.get("lazy_window", 0)
+        lines.append(
+            f"pressure: {pressure.get('tokens', 0):.1f} tokens,"
+            f" {pressure.get('in_flight', 0)}"
+            f"/{pressure.get('global_limit', '?')} in flight,"
+            f" {pressure.get('queued', 0)} queued"
+            + (f", lazy window {lazy_w:g}s"
+               f" ({pressure.get('lazy_held', 0)} held for co-stripe"
+               f" batching)" if lazy_w else "")
+        )
     counts = st.get("counts", {})
     stats = sched.get("stats", {})
     lines.append(
@@ -52,9 +64,13 @@ def _render_status(st: dict) -> str:
     if queued:
         lines.append(f"{len(queued)} queued:")
         for t in queued[:10]:
+            lazy = t.get("lazy") or {}
             lines.append(
                 f"  {t['type']} volume={t['volume_id']} node={t['node']}"
                 f" ({t['reason']})"
+                + (f" [lazy: dispatch in {lazy['dispatch_in']}s,"
+                   f" waiting for co-stripe losses]"
+                   if lazy.get("held") else "")
             )
     if in_flight:
         lines.append(f"{len(in_flight)} in flight:")
@@ -81,11 +97,11 @@ def _render_status(st: dict) -> str:
 
 @command("cluster.maintenance",
          "[-status] [-enable [-dryRun|-apply]"
-         " [-rebuildMode auto|pipelined|classic]] [-disable]"
-         " [-now <task|all>]"
+         " [-rebuildMode auto|pipelined|classic] [-lazyWindow <s>]]"
+         " [-disable] [-now <task|all>]"
          " — inspect/steer the master's autonomous maintenance daemon"
          " (detect -> plan -> heal; /debug/maintenance). -enable alone"
-         " preserves the daemon's current dry-run/rebuild modes")
+         " preserves the daemon's current dry-run/rebuild/lazy modes")
 def cmd_cluster_maintenance(env: CommandEnv, args: list[str]) -> str:
     flags = parse_flags(args)
     actions = [f for f in ("enable", "disable", "now") if f in flags]
@@ -103,14 +119,18 @@ def cmd_cluster_maintenance(env: CommandEnv, args: list[str]) -> str:
                 payload["dryRun"] = False
             if "rebuildMode" in flags:
                 payload["rebuildMode"] = flags["rebuildMode"]
+            if "lazyWindow" in flags:
+                payload["lazyWindow"] = float(flags["lazyWindow"])
             out = env.post(
                 f"{env.master_url}/maintenance/enable", payload,
             )
+            lazy_w = out.get("lazy_window", 0)
             return (
                 "maintenance enabled"
                 + (" (dry-run)" if out.get("dry_run") else "")
                 + f" — scan interval {out.get('interval', 0):g}s,"
                 + f" rebuild mode {out.get('rebuild_mode', 'auto')}"
+                + (f", lazy window {lazy_w:g}s" if lazy_w else "")
             )
         if "disable" in flags:
             env.post(f"{env.master_url}/maintenance/disable")

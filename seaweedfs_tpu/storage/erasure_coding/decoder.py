@@ -163,15 +163,30 @@ REPAIR_FALLBACK_REASONS = (
     "crc_mismatch",        # a partial arrived corrupt twice in a row
     "start_failed",        # the rebuilder refused the partial-write state
     "insufficient_shards", # survivors minus dead hops dropped below 10
+    "stream_stall",        # a streaming hop's bounded window backed up past
+                           # the stall budget twice (downstream wedged)
+    "chunk_crc",           # a streamed chunk failed its per-chunk CRC twice
 )
-REPAIR_RESTART_REASONS = ("hop_failed", "crc_mismatch")
+REPAIR_RESTART_REASONS = ("hop_failed", "crc_mismatch", "stream_stall",
+                          "chunk_crc")
+
+# per-chunk lifecycle states of the streaming session plane — the `state`
+# label of SeaweedFS_volume_ec_repair_stream_chunks_total (linted like the
+# reason sets): a chunk is `forwarded` by a mid-chain hop's forwarder
+# thread, `written` by the terminal writer, `stalled` when the bounded
+# in-flight window blocked past the stall budget, `crc_failed` when its
+# CRC32C did not survive the hop transfer.
+STREAM_CHUNK_STATES = ("forwarded", "written", "stalled", "crc_failed")
 
 REPAIR_BYTES_ON_WIRE = "SeaweedFS_volume_ec_repair_bytes_on_wire_total"
 REPAIR_SECONDS = "SeaweedFS_volume_ec_repair_seconds"
 REPAIR_FALLBACKS = "SeaweedFS_volume_ec_repair_fallbacks_total"
 REPAIR_RESTARTS = "SeaweedFS_volume_ec_repair_chain_restarts_total"
+REPAIR_STREAM_CHUNKS = "SeaweedFS_volume_ec_repair_stream_chunks_total"
+REPAIR_RESUMED_BYTES = "SeaweedFS_volume_ec_repair_resumed_bytes_total"
 
 _repair_metrics_cache = None
+_stream_metrics_cache = None
 
 
 def repair_metrics():
@@ -210,6 +225,32 @@ def repair_metrics():
             ),
         )
     return _repair_metrics_cache
+
+
+def stream_metrics():
+    """Idempotently register the streaming-session families; returns
+    (stream_chunks{state}, resumed_bytes). `resumed_bytes` counts bytes a
+    restarted chain did NOT re-send because the writer's committed
+    frontier survived the failure — the wire savings of restarting from
+    the first uncommitted chunk instead of byte 0."""
+    global _stream_metrics_cache
+    if _stream_metrics_cache is None:
+        from seaweedfs_tpu.stats.metrics import default_registry
+
+        reg = default_registry()
+        _stream_metrics_cache = (
+            reg.counter(
+                REPAIR_STREAM_CHUNKS,
+                "streaming-rebuild chunks by per-chunk lifecycle state",
+                ("state",),
+            ),
+            reg.counter(
+                REPAIR_RESUMED_BYTES,
+                "bytes not re-sent because a restarted chain resumed from"
+                " the writer's committed frontier",
+            ),
+        )
+    return _stream_metrics_cache
 
 
 def repair_coefficients(

@@ -571,6 +571,14 @@ class Volume:
                 self._compact_gen += 1
             old_nm.close()
             old_dat.close()
+            # the cached needle-map digest keyed (size, file_count,
+            # deleted_count) — compaction changes the SET members' offsets
+            # but not the set, yet the cache key can collide across the
+            # swap (e.g. a vacuum that reclaimed exactly the bytes a
+            # racing append added back): drop it so the next heartbeat
+            # recomputes instead of advertising a stale digest the master
+            # would read as replica divergence
+            self._digest_cache = None
         # compaction rewrote every .dat offset: any online-EC parity is
         # stale — restart the stripe watermark (counted vacuum_reset)
         if self.online_ec is not None:

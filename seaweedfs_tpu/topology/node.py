@@ -78,6 +78,9 @@ class DataNode:
     # unresolved scrub findings the node's last heartbeat carried
     # (maintenance/scrub.py detect() turns them into repair tasks)
     scrub_findings: list = field(default_factory=list)
+    # volumes a scrub pass on this node holds right now: vacuum defers
+    # their compaction (heartbeat-fed, maintenance/scrub.py)
+    scrub_active: set = field(default_factory=set)
 
     @property
     def id(self) -> str:

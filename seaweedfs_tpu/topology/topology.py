@@ -95,6 +95,7 @@ class Topology:
         node.last_seen = time.time()
         node.max_file_key = int(hb.get("max_file_key", 0))
         node.scrub_findings = list(hb.get("scrub_findings", []))
+        node.scrub_active = {int(v) for v in hb.get("scrub_active", [])}
         self.sequencer.set_max(node.max_file_key)
 
         new_volumes = {int(v["id"]): VolumeInfo.from_dict(v) for v in hb.get("volumes", [])}
@@ -287,8 +288,16 @@ class Topology:
         (`topology_vacuum.go:216` scanning semantics)."""
         out = []
         for node in self.all_nodes():
+            held = getattr(node, "scrub_active", ())
             for vid, info in list(node.volumes.items()):
                 if info.size == 0 or info.read_only:
+                    continue
+                if vid in held:
+                    # a scrub pass holds this volume: compacting now
+                    # would swap (nm, dat) under the scanner — wasting
+                    # the pass at best, fabricating suspects at worst.
+                    # The pass moves on within a beat or two; the
+                    # garbage is still there next scan.
                     continue
                 if info.ec_online:
                     # compaction rewrites every .dat offset and discards

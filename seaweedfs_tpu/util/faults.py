@@ -95,10 +95,13 @@ class FaultSpec:
     frac: float = 0.5     # torn mode: fraction of the payload DROPPED
     count: int = -1       # firings remaining; <0 = unlimited
     key: str = ""         # scope discriminator ("" = every invocation)
+    after: int = 0        # skip the first N would-fire draws (onset
+                          # delay: "die on the 4th chunk, not the 1st")
 
     def to_dict(self) -> dict:
         return {"mode": self.mode, "rate": self.rate, "ms": self.ms,
-                "frac": self.frac, "count": self.count, "key": self.key}
+                "frac": self.frac, "count": self.count, "key": self.key,
+                "after": self.after}
 
 
 _metric = None
@@ -150,6 +153,9 @@ class FaultPoint:
             return None
         with _lock:
             if self.spec is not spec:  # disarmed/re-armed under us
+                return None
+            if spec.after > 0:  # onset delay: let the first N draws pass
+                spec.after -= 1
                 return None
             if spec.count == 0:
                 return None
@@ -264,7 +270,8 @@ def registered_points() -> list[str]:
 
 
 def arm(name: str, mode: str, rate: float = 1.0, ms: float = 0.0,
-        frac: float = 0.5, count: int = -1, key: str = "") -> FaultSpec:
+        frac: float = 0.5, count: int = -1, key: str = "",
+        after: int = 0) -> FaultSpec:
     """Arm one point. Validates the mode and numeric ranges; replaces
     any existing spec on the point."""
     if mode not in MODES:
@@ -273,12 +280,15 @@ def arm(name: str, mode: str, rate: float = 1.0, ms: float = 0.0,
     ms = float(ms)
     frac = float(frac)
     count = int(count)
+    after = int(after)
     if not (0.0 < rate <= 1.0):
         raise ValueError(f"rate {rate} not in (0, 1]")
     if ms < 0 or not (0.0 < frac <= 1.0) or ms != ms:
         raise ValueError(f"bad latency/frac ({ms}, {frac})")
+    if after < 0:
+        raise ValueError(f"after {after} < 0")
     spec = FaultSpec(mode=mode, rate=rate, ms=ms, frac=frac, count=count,
-                     key=key)
+                     key=key, after=after)
     p = point(name)
     with _lock:
         p.spec = spec
@@ -345,11 +355,12 @@ def arm_from_spec(text: str) -> list[str]:
             if not kv:
                 continue
             k, _, v = kv.partition("=")
-            if k not in ("rate", "ms", "frac", "count", "key"):
+            if k not in ("rate", "ms", "frac", "count", "key", "after"):
                 raise ValueError(f"fault spec {entry!r}: unknown option {k!r}")
             opts[k] = v if k == "key" else float(v)
-        if "count" in opts:
-            opts["count"] = int(opts["count"])
+        for k in ("count", "after"):
+            if k in opts:
+                opts[k] = int(opts[k])
         arm(name, mode, **opts)
         out.append(name)
     return out

@@ -647,6 +647,156 @@ class TestPipelineHopKilledMidRebuild:
         assert "chain_restart" in whyv or "fallback_repair" in whyv, whyv
 
 
+class TestStreamHopKilledChunksInFlight:
+    def test_heal_resumes_from_committed_chunk_zero_client_errors(
+        self, tmp_path
+    ):
+        """PR-15 acceptance: a STREAMING rebuild hop dies with chunks in
+        flight. 5-node cluster (excluding any one hop still leaves 10
+        usable shards), one lost PARITY shard — parity so no read ever
+        needs the partial fan-in, which shares the repair.partial_fetch
+        point: the armed onset delay (`after=4`) is then consumed by the
+        stream session alone, deterministically — open, then chunks 0-2
+        pass through the victim and chunk 3 dies while the bounded
+        window (4) keeps later chunks in flight behind it. The daemon's
+        pipelined+streaming heal must restart minus the hop and RESUME
+        from the writer's committed frontier (chunks 0-2 never re-sent,
+        counted into resumed_bytes_total), journal chain_restart with
+        the chunk index, and a concurrent read storm across the volume
+        must see ZERO errors end to end."""
+        from seaweedfs_tpu.shell.commands_ec import plan_rebuild_pipelined
+        from seaweedfs_tpu.storage.erasure_coding import decoder as ec_dec
+
+        def counter(name: str, label: str = "") -> float:
+            from seaweedfs_tpu.stats import default_registry
+
+            total = 0.0
+            for line in default_registry().render().splitlines():
+                if line.startswith(name) and label in line:
+                    total += float(line.rsplit(" ", 1)[1])
+            return total
+
+        master = MasterServer(port=0, pulse_seconds=1,
+                              volume_size_limit_mb=64,
+                              maintenance_interval=0.25)
+        master.start()
+        vols = []
+        try:
+            for i in range(5):
+                vs = VolumeServer(
+                    [str(tmp_path / f"v{i}")], master.url, port=0,
+                    rack=f"r{i}", pulse_seconds=1, max_volume_count=30,
+                )
+                vs.start()
+                vols.append(vs)
+            env = CommandEnv(master.url)
+            by_vid: dict[int, dict] = {}
+            for i in range(8):
+                a = assign(master, collection="stream")
+                data = os.urandom(50000)
+                st, _, _ = http_request(
+                    "POST", f"http://{a['publicUrl']}/{a['fid']}", data)
+                assert st == 201
+                by_vid.setdefault(
+                    int(a["fid"].split(",")[0]), {})[a["fid"]] = data
+            vid, blobs = max(by_vid.items(), key=lambda kv: len(kv[1]))
+            run_command(env, "lock")
+            run_command(env, f"ec.encode -volumeId {vid}")
+            run_command(env, "unlock")
+
+            def shard_count() -> int:
+                return len({
+                    s for sv in env.servers()
+                    for s in sv.ec_shards.get(vid, [])
+                })
+
+            # lose a parity shard: the repair is real, the reads never
+            # degrade (see docstring — keeps the fault onset countdown
+            # owned by the stream)
+            lost = 13
+            holder = next(sv for sv in env.servers()
+                          if lost in sv.ec_shards.get(vid, []))
+            post_json(f"{holder.http}/admin/ec/delete_shards",
+                      {"volume": vid, "shards": [lost],
+                       "collection": "stream"})
+            wait_until(lambda: shard_count() == 13, timeout=15,
+                       msg="shard loss in topology")
+            # the daemon will compute this same deterministic plan; pick
+            # a MID hop (not head, not the terminal writer) as victim
+            pplan = plan_rebuild_pipelined(env, vid, "stream")
+            assert pplan is not None and len(pplan["chain"]) >= 4
+            victim = pplan["chain"][1]["server"]
+            faults.arm("repair.partial_fetch", "error", key=victim,
+                       after=4)
+            resumed0 = counter(ec_dec.REPAIR_RESUMED_BYTES)
+            written0 = counter(ec_dec.REPAIR_STREAM_CHUNKS,
+                               'state="written"')
+
+            wc = WeedClient(master.url, cache_ttl=1.0)
+            results = {"ok": 0, "bad": 0}
+            res_lock = threading.Lock()
+            stop = threading.Event()
+            fids = list(blobs)
+
+            def reader(seed: int) -> None:
+                i = seed
+                while not stop.is_set():
+                    fid = fids[i % len(fids)]
+                    i += 1
+                    try:
+                        body = wc.fetch(fid)
+                        with res_lock:
+                            if body == blobs[fid]:
+                                results["ok"] += 1
+                            else:
+                                results["bad"] += 1
+                    except Exception:
+                        with res_lock:
+                            results["bad"] += 1
+
+            threads = [
+                threading.Thread(target=reader, args=(s,), daemon=True)
+                for s in range(3)
+            ]
+            for t in threads:
+                t.start()
+            post_json(f"{master.url}/maintenance/enable",
+                      {"rebuildMode": "pipelined"})
+            wait_until(lambda: shard_count() == 14, timeout=40,
+                       msg="streamed heal through the dead hop")
+            time.sleep(0.5)  # let the storm read across the remount
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert results["bad"] == 0, results
+            assert results["ok"] > 30, results
+            # the heal streamed, and the restart RESUMED: the committed
+            # chunks (>= 3 by the onset delay) were never re-sent
+            assert counter(ec_dec.REPAIR_STREAM_CHUNKS,
+                           'state="written"') > written0
+            assert counter(ec_dec.REPAIR_RESUMED_BYTES) - resumed0 > 0, \
+                "restart re-sent from byte 0 instead of resuming"
+            restarts = [
+                e for e in events_mod.recorder().events(
+                    type="chain_restart", limit=0)
+                if e["volume"] == vid
+            ]
+            assert restarts, "chain_restart not journaled"
+            chunks = [e.get("attrs", e).get("chunk") for e in restarts]
+            assert any(c is not None and c >= 3 for c in chunks), restarts
+            # the victim was the attributed hop, and steady state is clean
+            assert any(e.get("node") == victim for e in restarts), restarts
+            faults.disarm_all()
+            for fid, data in list(blobs.items())[:2]:
+                body = wc.fetch(fid)
+                assert body == data
+        finally:
+            faults.disarm_all()
+            for vs in vols:
+                vs.stop()
+            master.stop()
+
+
 class TestSilentCorruptionScrubHeal:
     def test_bitrot_detected_and_healed_with_zero_client_errors(
         self, cluster

@@ -342,6 +342,298 @@ class TestPipelinedRebuildLive:
             master.stop()
 
 
+def _stream_counter(state: str) -> float:
+    from seaweedfs_tpu.stats import default_registry
+
+    for line in default_registry().render().splitlines():
+        if line.startswith(decoder.REPAIR_STREAM_CHUNKS + "{") \
+                and f'state="{state}"' in line:
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def _resumed_bytes() -> float:
+    from seaweedfs_tpu.stats import default_registry
+
+    for line in default_registry().render().splitlines():
+        if line.startswith(decoder.REPAIR_RESUMED_BYTES + " "):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+class TestStreamingRebuild:
+    """The hop-parallel session mode: chunks pipeline through the chain
+    (ACK after local compute + enqueue, forwarder threads overlap hops),
+    the writer commits chunks incrementally, and restarts resume from the
+    first uncommitted chunk instead of byte 0."""
+
+    def test_stream_byte_identical_and_survivor_reads_once(self, tmp_path):
+        """Streamed multi-chunk rebuild is byte-identical at equal
+        bytes-on-wire, every hop's local shard reads are accounted, and
+        the forwarded/written chunk counters move."""
+        master, vols = _cluster(tmp_path, 4)
+        try:
+            env = CommandEnv(master.url)
+            vid, _ = _seed_ec_volume(master, env, blobs=8, size=30000)
+            sv, path = _shard_path(vols, env, vid, 0)
+            original = open(path, "rb").read()
+            post_json(f"{sv.http}/admin/ec/delete_shards",
+                      {"volume": vid, "shards": [0]})
+            pplan = plan_rebuild_pipelined(env, vid, "")
+            assert len(pplan["chain"]) >= 3
+            fwd0, wr0 = _stream_counter("forwarded"), _stream_counter(
+                "written")
+            rebuilt, stats = apply_rebuild_pipelined(
+                env, pplan, chunk=4096, stream=True)
+            assert rebuilt == [0]
+            assert stats["streamed"] is True
+            rb = _holder_vs(vols, pplan["rebuilder"])
+            got = open(
+                rb.store.get_ec_volume(vid).data_base + geometry.to_ext(0),
+                "rb",
+            ).read()
+            assert got == original
+            shard_size = stats["shard_size"]
+            # equal bytes-on-wire vs the serial dataflow: one stacked
+            # partial per hop link, nothing extra for the pipelining
+            assert stats["bytes_on_wire_total"] \
+                == (len(pplan["chain"]) - 1) * shard_size
+            # every `use` shard read exactly once across the chain
+            assert stats["survivor_bytes_read"] == 10 * shard_size
+            assert _stream_counter("forwarded") > fwd0
+            assert _stream_counter("written") > wr0
+        finally:
+            for v in vols:
+                v.stop()
+            master.stop()
+
+    def test_multi_target_single_pass_amortizes_survivor_reads(
+            self, tmp_path):
+        """Two lost shards of one stripe repair in ONE chain pass: the
+        hops scale (2 x k) coefficient blocks and forward stacked
+        partials, so each survivor range is read once — survivor read
+        bytes do NOT double vs a single-target pass, and both targets
+        commit from the same traversal."""
+        master, vols = _cluster(tmp_path, 4)
+        try:
+            env = CommandEnv(master.url)
+            vid, _ = _seed_ec_volume(master, env, blobs=8, size=30000)
+            originals = {}
+            for s in (0, 1):
+                sv, path = _shard_path(vols, env, vid, s)
+                originals[s] = open(path, "rb").read()
+                post_json(f"{sv.http}/admin/ec/delete_shards",
+                          {"volume": vid, "shards": [s]})
+            pplan = plan_rebuild_pipelined(env, vid, "")
+            assert pplan["missing"] == [0, 1]
+            rebuilt, stats = apply_rebuild_pipelined(
+                env, pplan, chunk=4096, stream=True)
+            assert sorted(rebuilt) == [0, 1]
+            assert stats["restarts"] == 0  # one pass, no re-traversal
+            shard_size = stats["shard_size"]
+            # the amortization claim: 10 survivor-range reads total, not
+            # 10 per target — the multi-row matrix reuses each read
+            assert stats["survivor_bytes_read"] == 10 * shard_size
+            # stacked partials: wire bytes scale with targets (2 rows
+            # per hop link), not with passes
+            assert stats["bytes_on_wire_total"] \
+                == 2 * (len(pplan["chain"]) - 1) * shard_size
+            rb = _holder_vs(vols, pplan["rebuilder"])
+            for s in (0, 1):
+                got = open(
+                    rb.store.get_ec_volume(vid).data_base
+                    + geometry.to_ext(s), "rb").read()
+                assert got == originals[s], f"shard {s}"
+        finally:
+            for v in vols:
+                v.stop()
+            master.stop()
+
+    def test_dead_hop_resumes_from_committed_chunk(self, tmp_path):
+        """A hop killed with chunks in flight: the ladder re-plans minus
+        the hop and the new chain resumes from the writer's committed
+        frontier — re-sent bytes shrink (counted in resumed_bytes_total),
+        the chain_restart event journals the chunk index, and the result
+        is byte-identical."""
+        from seaweedfs_tpu.stats import events as events_mod
+
+        master, vols = _cluster(tmp_path, 5)
+        try:
+            env = CommandEnv(master.url)
+            vid, _ = _seed_ec_volume(master, env, blobs=8, size=30000)
+            sv, path = _shard_path(vols, env, vid, 2)
+            original = open(path, "rb").read()
+            post_json(f"{sv.http}/admin/ec/delete_shards",
+                      {"volume": vid, "shards": [2]})
+            pplan = plan_rebuild_pipelined(env, vid, "")
+            assert len(pplan["chain"]) >= 4
+            shard_size = len(original)
+            chunk = max(4096, shard_size // 16)
+            # kill a MID hop (not the writer) after a few chunks passed
+            # through it: the writer has committed chunks by then
+            victim = pplan["chain"][1]["server"]
+            faults.arm("repair.partial_fetch", "error", key=victim,
+                       after=6)
+            saved0 = _resumed_bytes()
+            rebuilt, stats = apply_rebuild_pipelined(
+                env, pplan, chunk=chunk, stream=True)
+            faults.disarm_all()
+            assert rebuilt == [2]
+            assert stats["restarts"] >= 1
+            # the restart resumed mid-shard instead of re-sending from 0
+            assert stats["resumed_bytes_saved"] > 0
+            assert _resumed_bytes() - saved0 > 0
+            restarts = [
+                e for e in events_mod.recorder().events(
+                    type="chain_restart", limit=0)
+                if e["volume"] == vid
+            ]
+            assert restarts, "chain_restart not journaled"
+            assert any(
+                "chunk" in e.get("attrs", e) for e in restarts), restarts
+            rb_id = next(
+                s.id for s in env.servers()
+                if 2 in s.ec_shards.get(vid, []))
+            hv = _holder_vs(vols, rb_id)
+            got = open(
+                hv.store.get_ec_volume(vid).data_base + geometry.to_ext(2),
+                "rb",
+            ).read()
+            assert got == original
+        finally:
+            faults.disarm_all()
+            for v in vols:
+                v.stop()
+            master.stop()
+
+    def test_stream_stall_escalates_typed(self, tmp_path):
+        """A wedged downstream hop (latency injection past the stall
+        budget) backs the bounded window up into a typed stream_stall:
+        one same-chain restart, then the PipelinedRebuildError whose
+        reason the classic fallback counts — and the `stalled` chunk
+        counter moves."""
+        master, vols = _cluster(tmp_path, 3)
+        try:
+            env = CommandEnv(master.url)
+            vid, _ = _seed_ec_volume(master, env)
+            sv, path = _shard_path(vols, env, vid, 4)
+            post_json(f"{sv.http}/admin/ec/delete_shards",
+                      {"volume": vid, "shards": [4]})
+            pplan = plan_rebuild_pipelined(env, vid, "")
+            assert len(pplan["chain"]) >= 2
+            wedged = pplan["chain"][1]["server"]
+            faults.arm("repair.partial_fetch", "latency", ms=600.0,
+                       key=wedged)
+            stalled0 = _stream_counter("stalled")
+            with pytest.raises(PipelinedRebuildError) as ei:
+                apply_rebuild_pipelined(
+                    env, pplan, chunk=4096, stream=True, window=1,
+                    stall_timeout=0.05)
+            assert ei.value.reason == "stream_stall"
+            assert _stream_counter("stalled") > stalled0
+        finally:
+            faults.disarm_all()
+            for v in vols:
+                v.stop()
+            master.stop()
+
+    def test_duplicate_chunk_acked_not_rejected(self, tmp_path):
+        """A forwarder retry after a lost ACK re-delivers a chunk the
+        writer already committed: the terminal must ACK it as landed —
+        a 409 would get the healthy REBUILDER excluded by the ladder
+        and its whole committed frontier aborted."""
+        master, vols = _cluster(tmp_path, 3)
+        try:
+            env = CommandEnv(master.url)
+            vid, _ = _seed_ec_volume(master, env)
+            sv, _ = _shard_path(vols, env, vid, 0)
+            post_json(f"{sv.http}/admin/ec/delete_shards",
+                      {"volume": vid, "shards": [0]})
+            pplan = plan_rebuild_pipelined(env, vid, "")
+            rb = pplan["rebuilder_url"]
+            out = post_json(f"{rb}/admin/ec/partial/start",
+                            {"volume": vid, "targets": [0]})
+            assert out["ok"]
+            terminal = pplan["chain"][-1]
+            st, _, body = http_request(
+                "POST", f"{rb}/admin/ec/partial/stream/open",
+                json.dumps({
+                    "session": "duptest", "volume": vid, "targets": [0],
+                    "chain": [terminal],
+                }).encode())
+            assert st == 200, body
+            url = (f"{rb}/admin/ec/partial/stream/chunk"
+                   f"?session=duptest&seq=0&offset=0&size=256")
+            st, _, body = http_request("POST", url, b"")
+            assert st == 200 and json.loads(body)["committed"] == 256
+            # the retry: same chunk again — already landed, ACKed
+            st, _, body = http_request("POST", url, b"")
+            dup = json.loads(body)
+            assert st == 200, body
+            assert dup["ok"] and dup["duplicate"] \
+                and dup["committed"] == 256
+            # a genuinely out-of-order chunk still 409s
+            st, _, body = http_request(
+                "POST",
+                f"{rb}/admin/ec/partial/stream/chunk"
+                f"?session=duptest&seq=3&offset=1024&size=256", b"")
+            assert st == 409, body
+            http_request(
+                "POST",
+                f"{rb}/admin/ec/partial/stream/close?session=duptest",
+                b"")
+            post_json(f"{rb}/admin/ec/partial/abort", {"volume": vid})
+        finally:
+            for v in vols:
+                v.stop()
+            master.stop()
+
+    def test_chunk_crc_rejected_at_hop(self, tmp_path):
+        """A streamed chunk whose CRC does not survive the hop transfer
+        is refused with the typed chunk_crc error (and counted
+        crc_failed) — corrupt partials never fold into the sum."""
+        master, vols = _cluster(tmp_path, 3)
+        try:
+            env = CommandEnv(master.url)
+            vid, _ = _seed_ec_volume(master, env)
+            sv, _ = _shard_path(vols, env, vid, 0)
+            post_json(f"{sv.http}/admin/ec/delete_shards",
+                      {"volume": vid, "shards": [0]})
+            pplan = plan_rebuild_pipelined(env, vid, "")
+            rb = pplan["rebuilder_url"]
+            out = post_json(f"{rb}/admin/ec/partial/start",
+                            {"volume": vid, "targets": [0]})
+            assert out["ok"]
+            # open a 1-hop session on the writer, then feed it a chunk
+            # with a deliberately wrong CRC header
+            terminal = pplan["chain"][-1]
+            st, _, body = http_request(
+                "POST", f"{rb}/admin/ec/partial/stream/open",
+                json.dumps({
+                    "session": "crctest", "volume": vid, "targets": [0],
+                    "chain": [terminal],
+                }).encode())
+            assert st == 200, body
+            crc0 = _stream_counter("crc_failed")
+            st, _, body = http_request(
+                "POST",
+                f"{rb}/admin/ec/partial/stream/chunk"
+                f"?session=crctest&seq=0&offset=0&size=256",
+                b"\x00" * 256, headers={"X-Repair-Crc": "12345"})
+            assert st == 409
+            assert json.loads(body)["error"] == "chunk_crc"
+            assert _stream_counter("crc_failed") > crc0
+            http_request(
+                "POST",
+                f"{rb}/admin/ec/partial/stream/close?session=crctest",
+                b"")
+            post_json(f"{rb}/admin/ec/partial/abort", {"volume": vid})
+        finally:
+            for v in vols:
+                v.stop()
+            master.stop()
+
+
 class TestRetryLadder:
     def test_dead_hop_restarts_chain_minus_hop(self, tmp_path):
         """5 nodes (max 3 shards each): killing one hop always leaves

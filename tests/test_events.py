@@ -712,8 +712,12 @@ class TestPipelinedChainTrace:
             )
             spans = col.trace_spans(root["trace_id"])
             names = [s["name"] for s in spans]
+            # a multi-chunk repair streams (hop-annotated stream/open
+            # cascade spans); a single-chunk one runs the serial chain
+            # (one hop-annotated /admin/ec/partial span per hop)
             hops = [s for s in spans
-                    if s["name"] == "POST /admin/ec/partial"]
+                    if s["name"] in ("POST /admin/ec/partial",
+                                     "POST /admin/ec/partial/stream/open")]
             assert "POST /admin/ec/partial/start" in names
             assert "POST /admin/ec/partial/commit" in names
             # every chain hop joined the SAME trace, hop-annotated —

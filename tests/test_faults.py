@@ -84,6 +84,31 @@ class TestFaultRegistry:
             faults.arm("master.lookup", "error", rate=1.5)
         with pytest.raises(ValueError):
             faults.arm("master.lookup", "wat")
+        with pytest.raises(ValueError):
+            faults.arm("master.lookup", "error", after=-1)
+
+    def test_after_delays_onset(self):
+        """`after=N` lets the first N would-fire draws pass untouched —
+        the onset-delay the chaos suite uses to kill a streaming hop
+        with chunks already in flight ("die on the 4th chunk")."""
+        p = faults.point("volume.read.dat")
+        fired_before = p.fired
+        faults.arm("volume.read.dat", "error", after=2, count=1)
+        p.hit()  # draw 1: passes
+        p.hit()  # draw 2: passes
+        with pytest.raises(faults.FaultInjected):
+            p.hit()  # draw 3: fires
+        p.hit()  # count exhausted: disarmed again
+        assert p.fired == fired_before + 1
+        # key scoping filters BEFORE the onset countdown: other-key
+        # draws must not consume the delay
+        faults.arm("volume.heartbeat.send", "error", after=1, key="a")
+        hp = faults.point("volume.heartbeat.send")
+        hp.hit(key="b")  # scoped out: does not consume `after`
+        hp.hit(key="a")  # consumes the delay
+        with pytest.raises(faults.FaultInjected):
+            hp.hit(key="a")
+        faults.disarm_all()
 
     def test_arm_from_spec_grammar(self):
         armed = faults.arm_from_spec(

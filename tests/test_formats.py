@@ -380,6 +380,41 @@ class TestMetricNameLint:
         assert kinds["SeaweedFS_volume_scrub_findings_total"] == "counter"
         assert kinds["SeaweedFS_volume_scrub_repairs_total"] == "counter"
         assert tool.scrub_violations() == []
+        # PR-15: streaming-session chunk states + lazy-batch outcomes
+        # (unique snake_case, stream failure reasons typed restart
+        # reasons, the whole vocabulary exercised by the suite)
+        assert kinds["SeaweedFS_volume_ec_repair_stream_chunks_total"] \
+            == "counter"
+        assert kinds["SeaweedFS_volume_ec_repair_resumed_bytes_total"] \
+            == "counter"
+        assert kinds["SeaweedFS_maintenance_lazy_batch_total"] == "counter"
+        assert tool.stream_lazy_violations() == []
+
+    def test_stream_lazy_lint_catches_violations(self, monkeypatch):
+        from seaweedfs_tpu.maintenance import scheduler as sched_mod
+        from seaweedfs_tpu.storage.erasure_coding import decoder
+
+        tool = self._tool()
+        monkeypatch.setattr(
+            decoder, "STREAM_CHUNK_STATES",
+            decoder.STREAM_CHUNK_STATES + ("BadState", "forwarded"),
+        )
+        monkeypatch.setattr(
+            sched_mod, "LAZY_OUTCOMES",
+            sched_mod.LAZY_OUTCOMES + ("NotSnake",),
+        )
+        bad = tool.stream_lazy_violations()
+        assert any("not snake_case" in b for b in bad)
+        assert any("duplicate" in b for b in bad)
+        # a streaming failure reason dropped from the restart set is a
+        # typed-fallback hole the lint must catch
+        monkeypatch.setattr(
+            decoder, "REPAIR_RESTART_REASONS",
+            tuple(r for r in decoder.REPAIR_RESTART_REASONS
+                  if r != "stream_stall"),
+        )
+        bad = tool.stream_lazy_violations()
+        assert any("stream_stall" in b and "restart" in b for b in bad)
 
     def test_scrub_lint_catches_violations(self, monkeypatch):
         from seaweedfs_tpu.maintenance import scrub

@@ -144,6 +144,146 @@ class TestScheduler:
         assert snap["limits"]["per_node_limit"] == 1
 
 
+class TestLazyWindow:
+    """The lazy-batching window (PR-11 follow-up): single-shard
+    ec_rebuild tasks sit queued briefly so co-stripe losses fold into
+    one multi-target chain pass — batches within the window, never
+    delays past it, urgent pressure bypasses it."""
+
+    def _sched(self, window=2.0):
+        return RepairScheduler(repair_rate=100, repair_burst=100,
+                               global_limit=10, per_node_limit=10,
+                               type_caps={"ec_rebuild": 10},
+                               lazy_window=window)
+
+    def _lazy_counts(self):
+        from seaweedfs_tpu.stats import default_registry
+
+        out = {}
+        for line in default_registry().render().splitlines():
+            if line.startswith("SeaweedFS_maintenance_lazy_batch_total{"):
+                outcome = line.split('outcome="')[1].split('"')[0]
+                out[outcome] = float(line.rsplit(" ", 1)[1])
+        return out
+
+    def test_batches_within_window_and_folds_targets(self):
+        s = self._sched(window=2.0)
+        before = self._lazy_counts()
+        assert s.offer(_task("ec_rebuild", vid=7, targets=[3]), now=100.0)
+        # inside the window: held, not dispatched (counted "deferred")
+        assert s.next_task(now=100.5) is None
+        after = self._lazy_counts()
+        assert after.get("deferred", 0) > before.get("deferred", 0)
+        # a second co-stripe loss detected by a later scan FOLDS into the
+        # queued task (the dedup key is effectively the target set)
+        assert s.offer(_task("ec_rebuild", vid=7, targets=[9]), now=100.8)
+        assert s.stats["folded"] == 1
+        # multi-target now: dispatches immediately (counted "batched")
+        t = s.next_task(now=100.9)
+        assert t is not None
+        assert t.params["targets"] == [3, 9]
+        assert t.params["missing"] == 2
+        assert self._lazy_counts().get("batched", 0) \
+            > before.get("batched", 0)
+
+    def test_never_delays_past_window(self):
+        s = self._sched(window=2.0)
+        before = self._lazy_counts()
+        s.offer(_task("ec_rebuild", vid=7, targets=[3]), now=100.0)
+        assert s.next_task(now=101.99) is None
+        t = s.next_task(now=102.01)  # window elapsed: repair anyway
+        assert t is not None and t.volume_id == 7
+        assert self._lazy_counts().get("expired", 0) \
+            > before.get("expired", 0)
+        # the daemon's wake shortener knows the deadline
+        s2 = self._sched(window=2.0)
+        s2.offer(_task("ec_rebuild", vid=8, targets=[1]), now=50.0)
+        d = s2.next_lazy_deadline(now=51.0)
+        assert d is not None and abs(d - 1.0) < 1e-6
+        # an ALREADY-expired hold must not report a 0.0 deadline: a task
+        # some other cap is blocking would otherwise spin the daemon's
+        # wait at its 0.05s floor (a 20 Hz full-scan busy loop) for as
+        # long as the cap holds — once expired, the ordinary tick
+        # dispatches it and no precision wakeup is needed
+        assert s2.next_lazy_deadline(now=53.0) is None
+
+    def test_urgent_pressure_bypasses_window(self):
+        # alert-driven scans (degraded reads paying for the shard NOW)
+        # and operator -now scans offer urgent: no lazy hold
+        s = self._sched(window=30.0)
+        before = self._lazy_counts()
+        s.offer(_task("ec_rebuild", vid=7, targets=[3]), now=100.0,
+                urgent=True)
+        t = s.next_task(now=100.0)
+        assert t is not None and t.volume_id == 7
+        assert self._lazy_counts().get("bypassed", 0) \
+            > before.get("bypassed", 0)
+        # an urgent RE-offer of an already-held task lifts the hold too
+        s2 = self._sched(window=30.0)
+        s2.offer(_task("ec_rebuild", vid=9, targets=[2]), now=100.0)
+        assert s2.next_task(now=100.1) is None
+        assert not s2.offer(_task("ec_rebuild", vid=9, targets=[2]),
+                            now=100.2, urgent=True)  # deduped, but...
+        assert s2.next_task(now=100.3) is not None  # ...urgency stuck
+
+    def test_window_zero_is_todays_behavior(self):
+        s = self._sched(window=0.0)
+        s.offer(_task("ec_rebuild", vid=7, targets=[3]), now=100.0)
+        assert s.next_task(now=100.0) is not None
+
+    def test_multi_target_and_online_skip_the_hold(self):
+        s = self._sched(window=30.0)
+        s.offer(_task("ec_rebuild", vid=7, targets=[3, 9]), now=100.0)
+        assert s.next_task(now=100.0) is not None  # already batched
+        s.offer(_task("ec_rebuild", vid=8, targets=[], online=True),
+                now=100.0)
+        assert s.next_task(now=100.0) is not None  # online rearm: no wait
+
+    def test_other_types_unaffected(self):
+        s = self._sched(window=30.0)
+        s.offer(_task("vacuum", vid=4, node="a"), now=100.0)
+        assert s.next_task(now=100.0) is not None
+
+    def test_pressure_and_snapshot_expose_lazy_state(self):
+        s = self._sched(window=5.0)
+        s.offer(_task("ec_rebuild", vid=7, targets=[3]), now=100.0)
+        p = s.pressure(now=101.0)
+        assert p["lazy_window"] == 5.0
+        assert p["lazy_held"] == 1
+        assert p["queued"] == 1
+        snap = s.snapshot(now=101.0)
+        lazy = snap["queued"][0]["lazy"]
+        assert lazy["held"] is True
+        assert 0 < lazy["dispatch_in"] <= 5.0
+        assert snap["limits"]["lazy_window"] == 5.0
+        # folding replaces the queued entry, not duplicates it
+        s.offer(_task("ec_rebuild", vid=7, targets=[5]), now=101.5)
+        snap = s.snapshot(now=101.5)
+        assert len(snap["queued"]) == 1
+        assert snap["queued"][0]["params"]["targets"] == [3, 5]
+
+    def test_fold_dispatches_widened_task_not_stale_heap_entry(self):
+        # the heap holds the pre-fold object; the queued map is the
+        # authority — dispatch must see the WIDENED target set
+        s = self._sched(window=0.0)
+        s.offer(_task("ec_rebuild", vid=7, targets=[3]), now=100.0)
+        s.offer(_task("ec_rebuild", vid=7, targets=[9]), now=100.0)
+        t = s.next_task(now=100.0)
+        assert t.params["targets"] == [3, 9]
+        assert s.next_task(now=100.0) is None  # stale entry skipped
+
+    def test_in_flight_does_not_fold(self):
+        s = self._sched(window=0.0)
+        s.offer(_task("ec_rebuild", vid=7, targets=[3]), now=100.0)
+        t = s.next_task(now=100.0)
+        assert t is not None
+        # a loss detected while the repair is IN FLIGHT re-detects after
+        # completion (the executor re-plans whatever is missing anyway)
+        assert not s.offer(_task("ec_rebuild", vid=7, targets=[9]),
+                           now=100.1)
+        assert s.stats["folded"] == 0
+
+
 class _FakeMaster:
     """Just enough master surface for the detectors."""
 
@@ -190,6 +330,9 @@ class TestDetectors:
         assert tasks[0].type == "ec_rebuild"
         assert tasks[0].collection == "c"
         assert tasks[0].params["missing"] == 4
+        # the concrete missing shard ids ride along: the scheduler's
+        # lazy-batching fold widens queued tasks with them
+        assert tasks[0].params["targets"] == [10, 11, 12, 13]
 
     def test_vacuum_candidates(self):
         topo = Topology(pulse_seconds=1)
@@ -202,6 +345,22 @@ class TestDetectors:
         assert [t.volume_id for t in tasks] == [1]  # RO + low-garbage skipped
         assert tasks[0].type == "vacuum"
         assert tasks[0].params["garbage_ratio"] == 0.5
+
+    def test_vacuum_skips_scrub_held_volume(self):
+        # PR-14 open note: a volume a scrub pass holds is not offered to
+        # vacuum — compaction would swap (nm, dat) under the scanner
+        topo = Topology(pulse_seconds=1)
+        hb = _hb(11, [_vol(1, size=1000, deleted=500),
+                      _vol(2, size=1000, deleted=600)])
+        hb["scrub_active"] = [1]
+        topo.sync_heartbeat(hb)
+        tasks = det.detect_vacuum_candidates(_FakeMaster(topo))
+        assert [t.volume_id for t in tasks] == [2]
+        # the pass moved on: the garbage is still there next scan
+        hb["scrub_active"] = []
+        topo.sync_heartbeat(hb)
+        tasks = det.detect_vacuum_candidates(_FakeMaster(topo))
+        assert sorted(t.volume_id for t in tasks) == [1, 2]
 
     def test_imbalance(self):
         topo = Topology(pulse_seconds=1)
@@ -537,6 +696,21 @@ class TestSelfHealing:
         out = run_command(env, "cluster.maintenance -status")
         assert "ENABLED" in out and "dry-run" in out
         assert "throttle:" in out and "fix_replication" in out
+        # the live dispatch view: token bucket + in-flight + lazy window
+        assert "pressure:" in out
+        out = run_command(
+            env, "cluster.maintenance -enable -lazyWindow 3")
+        assert "lazy window 3s" in out
+        st = get_json(f"{master.url}/debug/maintenance")
+        assert st["pressure"]["lazy_window"] == 3.0
+        assert "lazy_held" in st["pressure"]
+        assert "lazy window 3s" in run_command(
+            env, "cluster.maintenance -status")
+        # a bare re-enable preserves the lazy window
+        run_command(env, "cluster.maintenance -enable")
+        assert master.maintenance.scheduler.lazy_window == 3.0
+        run_command(env, "cluster.maintenance -enable -lazyWindow 0")
+        assert master.maintenance.scheduler.lazy_window == 0.0
         out = run_command(env, "cluster.maintenance -now vacuum")
         assert "scan" in out
         with pytest.raises(ShellError, match="unknown task type"):

@@ -107,6 +107,25 @@ class TestDigest:
         v.write_needle(Needle(cookie=1, id=999, data=b"x" * 100))
         assert v.needle_map_digest() != d1  # cache invalidated by write
 
+    def test_commit_compact_drops_digest_cache(self, tmp_path):
+        """PR-14 open note: compaction must invalidate the cached
+        needle-map digest — the cache key (size, counts) can collide
+        across the swap, and a stale digest riding the next heartbeat
+        would read as replica divergence."""
+        st = Store([str(tmp_path)])
+        v = st.add_volume(1, "")
+        _fill(v, range(1, 20))
+        v.delete_needle(Needle(id=5))
+        v.needle_map_digest()  # populate the cache
+        assert getattr(v, "_digest_cache", None) is not None
+        v.compact()
+        v.commit_compact()
+        assert getattr(v, "_digest_cache", None) is None
+        # the recomputed digest equals a from-scratch fold of the live
+        # set (compaction changes offsets, never membership)
+        assert v.needle_map_digest() \
+            == needle_set_digest(v.nm.ascending_visit())
+
 
 # --- token bucket -------------------------------------------------------------
 class TestTokenBucket:
@@ -183,6 +202,29 @@ class TestNeedleScrub:
         sc = VolumeScrubber(st, node_id="n1")
         assert sc.scrub_pass() == []
         assert sc.stats["needles_checked"] == 39
+
+    def test_concurrent_passes_keep_holds_refcounted(self, tmp_path):
+        """An operator/repair-driven targeted pass overlapping the
+        periodic loop must not clobber the loop's vacuum-guard hold:
+        holds are refcounted per pass, so `scrub_active` keeps
+        advertising a volume until EVERY pass scanning it moves on."""
+        st = Store([str(tmp_path)])
+        v = st.add_volume(1, "")
+        _fill(v, range(1, 10))
+        sc = VolumeScrubber(st, node_id="n1")
+        # pass A mid-volume...
+        held_a = sc._hold(1, None)
+        assert sc.active_volumes() == [1]
+        # ...pass B (targeted) scans the same volume, then finishes
+        held_b = sc._hold(1, None)
+        sc._hold(None, held_b)
+        # A's hold survives B's exit; releasing A clears it
+        assert sc.active_volumes() == [1]
+        sc._hold(None, held_a)
+        assert sc.active_volumes() == []
+        # a real overlapping pass also releases cleanly
+        sc.scrub_pass()
+        assert sc.active_volumes() == []
 
     @pytest.mark.parametrize("use_batch", [True, False])
     def test_bit_flip_detected_by_both_kernels(self, tmp_path, use_batch):

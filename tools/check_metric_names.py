@@ -77,7 +77,11 @@ def collect() -> tuple[dict[str, str], list[str]]:
     from seaweedfs_tpu.storage.erasure_coding import decoder as ec_decoder
 
     ec_decoder.repair_metrics()  # SeaweedFS_volume_ec_repair_* families
+    ec_decoder.stream_metrics()  # streaming-session chunk/resume families
     maintenance.ensure_metrics()  # SeaweedFS_maintenance_* families
+    from seaweedfs_tpu.maintenance import scheduler as sched_mod
+
+    sched_mod.lazy_batch_counter()  # SeaweedFS_maintenance_lazy_batch_total
     from seaweedfs_tpu.maintenance import scrub as scrub_mod
 
     scrub_mod.ensure_metrics()  # SeaweedFS_volume_scrub_* families
@@ -379,6 +383,54 @@ def repair_reason_violations() -> list[str]:
     return bad
 
 
+def stream_lazy_violations() -> list[str]:
+    """The streaming-session chunk states (the `state` label of
+    SeaweedFS_volume_ec_repair_stream_chunks_total) and the lazy-batch
+    outcomes (the `outcome` label of
+    SeaweedFS_maintenance_lazy_batch_total) — lint them like the other
+    reason sets: unique snake_case, the streaming failure reasons
+    (stream_stall, chunk_crc) declared restart reasons (so their
+    exhaustion has a typed fallback), and the whole vocabulary exercised
+    by the suite (a state nobody drives is a state nobody proved
+    reachable)."""
+    from seaweedfs_tpu.maintenance import scheduler as sched_mod
+    from seaweedfs_tpu.storage.erasure_coding import decoder
+
+    bad: list[str] = []
+    for label, names in (
+        ("stream chunk state", decoder.STREAM_CHUNK_STATES),
+        ("lazy batch outcome", sched_mod.LAZY_OUTCOMES),
+    ):
+        seen: set[str] = set()
+        for name in names:
+            if not ALERT_RULE_RE.match(name):
+                bad.append(f"{label} {name!r}: not snake_case")
+            if name in seen:
+                bad.append(f"{label} {name!r}: duplicate")
+            seen.add(name)
+    for reason in ("stream_stall", "chunk_crc"):
+        if reason not in decoder.REPAIR_RESTART_REASONS:
+            bad.append(f"streaming reason {reason!r}: not a declared"
+                       f" restart reason")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    test_src = ""
+    for tf in ("test_ec_repair.py", "test_maintenance.py",
+               "test_chaos.py"):
+        try:
+            with open(os.path.join(root, "tests", tf)) as f:
+                test_src += f.read()
+        except OSError:
+            bad.append(f"tests/{tf} missing: the streaming/lazy sets"
+                       f" must be exercised by the suite")
+    for name in ("stream_stall", "chunk_crc",
+                 *decoder.STREAM_CHUNK_STATES, *sched_mod.LAZY_OUTCOMES):
+        if name not in test_src:
+            bad.append(f"streaming/lazy name {name!r}: not exercised by"
+                       f" tests/test_ec_repair.py, test_maintenance.py"
+                       f" or test_chaos.py")
+    return bad
+
+
 def scrub_violations() -> list[str]:
     """Scrub finding kinds ride into the `kind` label of
     SeaweedFS_volume_scrub_{findings,repairs}_total, the scrub_finding
@@ -469,6 +521,7 @@ def main() -> int:
         + task_type_violations() + front_reason_violations() \
         + ec_online_reason_violations() + fault_point_violations() \
         + degraded_reason_violations() + repair_reason_violations() \
+        + stream_lazy_violations() \
         + event_type_violations() + slo_violations() + scrub_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
