@@ -1812,6 +1812,129 @@ def bench_scrub(staging: str, needles: int = 49152,
     return out
 
 
+def bench_tenant_usage(n_colls: int = 640, k: int = 64) -> dict:
+    """PR-16: tenant & heat telemetry acceptance.
+
+    * sketch accuracy — a Zipf-weighted workload over 10x-K distinct
+      collections through the Space-Saving accountant: memory stays
+      O(K), every reported count is within the exported per-key error
+      (count - err <= true <= count), and the true heavy hitters
+      survive in the top of the sketch;
+    * heat separation — a hot and a cold volume series through the
+      EWMA scorer must come out decisively apart;
+    * forecast lifecycle — a fill burst fires the capacity_forecast
+      alert pair, a deletion clears it.
+    """
+    import random as random_mod
+
+    from seaweedfs_tpu.stats import alerts as alerts_mod
+    from seaweedfs_tpu.stats import heat as heat_mod
+    from seaweedfs_tpu.stats import usage as usage_mod
+    from seaweedfs_tpu.stats.history import MetricsHistory
+    from seaweedfs_tpu.stats.metrics import Registry
+
+    out: dict = {"k": k, "collections": n_colls}
+
+    # --- sketch accuracy vs ground truth -----------------------------------
+    rng = random_mod.Random(0x5eed)
+    acct = usage_mod.UsageAccountant(k=k)
+    true: dict[str, float] = {}
+    offers = []
+    for i in range(n_colls):
+        weight = max(1, int(2000.0 / (i + 1)))  # Zipf-ish tail
+        # split each tenant's mass into chunks arriving interleaved —
+        # the adversarial order that actually exercises eviction churn
+        while weight > 0:
+            chunk = min(weight, 25)
+            offers.append((f"tenant-{i:04d}", float(chunk)))
+            weight -= chunk
+    rng.shuffle(offers)
+    t0 = time.perf_counter()
+    for coll, w in offers:
+        true[coll] = true.get(coll, 0.0) + w
+        acct.record(coll, requests=w)
+    out["offer_usec"] = round(
+        (time.perf_counter() - t0) / max(1, len(offers)) * 1e6, 3)
+    snap = acct.snapshot()
+    assert snap["tracked"] <= k, "sketch memory exceeded O(K)"
+    reported = {r["collection"]: r for r in snap["tenants"]}
+    violations = 0
+    for coll, row in reported.items():
+        t, c = true.get(coll, 0.0), row["requests"]
+        if not (c - row["requests_err"] - 1e-6 <= t <= c + 1e-6):
+            violations += 1
+    top_true = sorted(true, key=true.get, reverse=True)[:10]
+    out["sketch"] = {
+        "tracked": snap["tracked"],
+        "evictions": snap["evictions"],
+        "error_bound": round(snap["error_bound"], 1),
+        "bound_violations": violations,
+        "top10_recall": sum(1 for c in top_true if c in reported) / 10.0,
+        # folded evicted mass over the true total — can exceed 1 because
+        # an evicted count carries its own inherited overestimate
+        "other_fold_ratio": round(
+            snap["other"]["requests"] / sum(true.values()), 4),
+    }
+    assert violations == 0, "sketch error bound violated"
+    assert out["sketch"]["top10_recall"] >= 0.9
+
+    # --- heat separation ----------------------------------------------------
+    reg = Registry()
+    hist = MetricsHistory(reg, interval=1.0, slots=200)
+    c = reg.counter("SeaweedFS_volume_fastlane_volume_requests_total", "",
+                    ("server", "volume", "op"))
+    eng = heat_mod.HeatEngine(history=hist)
+    hist.scrape_once(now=1.0)
+    for step in range(1, 4):
+        c.labels("bench:1", "1", "read").inc(2000)  # ~200 ops/s: hot
+        c.labels("bench:1", "2", "read").inc(10)    # ~1 ops/s: cold
+        hist.scrape_once(now=1.0 + 10.0 * step)
+        eng.observe(now=1.0 + 10.0 * step)
+    scores = {v["volume"]: v for v in eng.snapshot()["volumes"]}
+    sep = scores["1"]["score"] / max(scores["2"]["score"], 1e-9)
+    out["heat"] = {
+        "hot_score": round(scores["1"]["score"], 1),
+        "cold_score": round(scores["2"]["score"], 2),
+        "separation": round(sep, 1),
+        "hot_flag": scores["1"]["hot"],
+    }
+    assert sep > 10 and scores["1"]["hot"] and not scores["2"]["hot"]
+
+    # --- forecast fires during the fill burst, clears after deletion --------
+    used = reg.gauge("SeaweedFS_volume_disk_used_bytes", "",
+                     ("server", "dir"))
+    free = reg.gauge("SeaweedFS_volume_disk_free_bytes", "",
+                     ("server", "dir"))
+    reg.register_collector(eng.lines, names=heat_mod.HEAT_FAMILIES)
+    free.labels("bench:1", "/data").set(2 * 86400 * 1e6)  # 2 days @ 1MB/s
+    for now in (100.0, 160.0, 220.0):
+        used.labels("bench:1", "/data").set(now * 1e6)
+        hist.scrape_once(now=now)
+    eng.observe(now=220.0)
+    hist.scrape_once(now=221.0)
+    alert_eng = alerts_mod.AlertEngine(history=hist, registry=reg)
+    try:
+        fired = alert_eng.evaluate(now=221.0)
+        fired_during_fill = "capacity_forecast" in fired
+        days = (eng.snapshot()["forecast"] or [{}])[0].get("days_to_full")
+        for now in (280.0, 340.0, 400.0):
+            used.labels("bench:1", "/data").set(max(0.0, (400 - now) * 1e6))
+            hist.scrape_once(now=now)
+        eng.observe(now=400.0)
+        hist.scrape_once(now=401.0)
+        hist.scrape_once(now=402.0)
+        cleared = "capacity_forecast" not in alert_eng.evaluate(now=402.0)
+    finally:
+        alert_eng.close()
+    out["forecast"] = {
+        "days_to_full": days,
+        "alert_fired_during_fill": fired_during_fill,
+        "alert_cleared_after_deletion": cleared,
+    }
+    assert fired_during_fill and cleared
+    return out
+
+
 def bench_hash_1m_4k(
     total_blobs: int = 1_000_000, slab: int = 65536, device: bool = True
 ) -> dict:
@@ -2027,6 +2150,12 @@ def main() -> None:
         detail["scrub"] = bench_scrub(BENCH_DIR)
     except Exception as e:
         detail["scrub"] = {"error": str(e)[:120]}
+    # PR-16: tenant sketch accuracy vs ground truth, hot/cold heat
+    # separation, and the capacity-forecast alert firing/clearing
+    try:
+        detail["tenant_usage"] = bench_tenant_usage()
+    except Exception as e:
+        detail["tenant_usage"] = {"error": str(e)[:120]}
     # end-of-run per-kernel attribution over EVERYTHING this process ran
     # (verb trials + rebuild + hash benches), from the shared registry
     try:

@@ -341,7 +341,7 @@ class MaintenanceDaemon:
         ok = state != "failed"
         retry_in = self.scheduler.complete(task, ok=ok)
         from seaweedfs_tpu.stats import events as events_mod
-        from .scheduler import task_key_str
+        from .scheduler import _coll_attr, task_key_str
 
         events_mod.emit(
             "task_done" if ok else "task_failed",
@@ -349,6 +349,7 @@ class MaintenanceDaemon:
             node=task.node, type=task.type, state=state,
             duration_ms=round(duration * 1000.0, 2),
             **({"error": error} if error is not None else {}),
+            **_coll_attr(task),
         )
         # a finished task frees a cap/throttle slot: wake the loop so the
         # next queued task dispatches now, not a full scan interval later

@@ -69,6 +69,17 @@ def task_key_str(task: RepairTask) -> str:
     return ":".join(str(p) for p in task.key)
 
 
+def _coll_attr(task: RepairTask) -> dict:
+    """The `collection` correlation key for task lifecycle events, so
+    `cluster.why <collection>` can assemble a per-tenant repair timeline.
+    Volume-scoped tasks in the unnamed collection report "default";
+    node-scoped tasks (no volume) carry no collection at all — claiming
+    the default tenant for an evacuate would lie."""
+    if task.volume_id is None:
+        return {}
+    return {"collection": task.collection or "default"}
+
+
 class RepairScheduler:
     def __init__(
         self,
@@ -189,11 +200,13 @@ class RepairScheduler:
             lazy_batch_counter().labels("folded").inc()
             events_mod.emit("task_queued", task=task_key_str(task),
                             volume=task.volume_id, node=task.node,
-                            type=task.type, reason="folded into queued task")
+                            type=task.type, reason="folded into queued task",
+                            **_coll_attr(task))
             return True
         events_mod.emit("task_queued", task=task_key_str(task),
                         volume=task.volume_id, node=task.node,
-                        type=task.type, reason=task.reason)
+                        type=task.type, reason=task.reason,
+                        **_coll_attr(task))
         return True
 
     # --- dispatch -------------------------------------------------------------
@@ -270,7 +283,7 @@ class RepairScheduler:
             lazy_batch_counter().labels(lazy_outcome).inc()
         events_mod.emit("task_dispatched", task=task_key_str(picked),
                         volume=picked.volume_id, node=picked.node,
-                        type=picked.type)
+                        type=picked.type, **_coll_attr(picked))
         return picked
 
     def _lazy_gate(self, task: RepairTask, now: float) -> str | None:
@@ -328,7 +341,7 @@ class RepairScheduler:
         events_mod.emit("task_backoff", task=task_key_str(task),
                         volume=task.volume_id, node=task.node,
                         type=task.type, retry_in=round(delay, 2),
-                        failures=failures)
+                        failures=failures, **_coll_attr(task))
         return delay
 
     def next_lazy_deadline(self, now: float | None = None) -> float | None:

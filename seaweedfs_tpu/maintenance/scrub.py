@@ -368,6 +368,7 @@ class VolumeScrubber:
 
             events_mod.emit("scrub_finding", volume=f.volume_id,
                             node=f.node or None, kind=f.kind,
+                            collection=f.collection or "default",
                             **({"needle": f"{f.needle:x}"}
                                if f.needle is not None else {}),
                             **({"shard": f.shard}

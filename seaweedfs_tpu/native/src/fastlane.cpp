@@ -41,8 +41,10 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <deque>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <shared_mutex>
@@ -282,6 +284,9 @@ struct Vol {
     // per-volume native-op counters (sw_fl_get_volume_metrics)
     std::atomic<uint64_t> m_reads{0}, m_writes{0}, m_deletes{0},
         m_read_bytes{0}, m_write_bytes{0};
+    // tenant tag for sw_fl_get_usage; guarded by Engine::reg_mu, not an
+    // atomic — it is written once at registration time before traffic
+    char collection[64] = {0};
     std::mutex append_mu;           // serializes .dat appends (C++ and Python)
     std::shared_mutex map_mu;       // guards nmap
     NMap nmap;
@@ -3779,6 +3784,21 @@ int sw_fl_register_volume(int h, uint32_t vid, int dat_fd, int idx_fd,
     return 0;
 }
 
+// Tag a registered volume with its collection so sw_fl_get_usage can
+// aggregate native-op counters per tenant (PR 16 ABI growth — the Python
+// binding hasattr-gates this like every prior optional symbol).
+int sw_fl_volume_collection_set(int h, uint32_t vid, const char* coll) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    std::unique_lock<std::shared_mutex> l(E->reg_mu);
+    auto it = E->vols.find(vid);
+    if (it == E->vols.end()) return -2;
+    const char* src = (coll != nullptr) ? coll : "";
+    strncpy(it->second->collection, src, sizeof(it->second->collection) - 1);
+    it->second->collection[sizeof(it->second->collection) - 1] = '\0';
+    return 0;
+}
+
 // arms the data plane once the Python-side bulk map load has landed
 int sw_fl_volume_serving(int h, uint32_t vid) {
     Engine* E = engine_at(h);
@@ -4311,6 +4331,43 @@ int sw_fl_get_volume_metrics(int h, uint32_t vid, unsigned long long* out6) {
     out6[4] = v->m_write_bytes.load(std::memory_order_relaxed);
     out6[5] = v->tail.load(std::memory_order_relaxed);
     return 0;
+}
+
+// Per-collection usage rollup over every registered volume's native-op
+// counters. Text exposition (one line per collection, tab-separated):
+//   <collection>\t<reads>\t<writes>\t<deletes>\t<read_bytes>\t<write_bytes>\n
+// Untagged volumes aggregate under the empty collection name (the Python
+// side maps it to its configured default). Returns bytes written;
+// -1 bad handle, -2 cap too small for the full snapshot.
+long sw_fl_get_usage(int h, char* out, size_t cap) {
+    Engine* E = engine_at(h);
+    if (!E) return -1;
+    std::map<std::string, std::array<unsigned long long, 5>> agg;
+    {
+        std::shared_lock<std::shared_mutex> l(E->reg_mu);
+        for (auto& kv : E->vols) {
+            Vol* v = kv.second.get();
+            auto& row = agg[std::string(v->collection)];
+            row[0] += v->m_reads.load(std::memory_order_relaxed);
+            row[1] += v->m_writes.load(std::memory_order_relaxed);
+            row[2] += v->m_deletes.load(std::memory_order_relaxed);
+            row[3] += v->m_read_bytes.load(std::memory_order_relaxed);
+            row[4] += v->m_write_bytes.load(std::memory_order_relaxed);
+        }
+    }
+    size_t o = 0;
+    for (auto& kv : agg) {
+        char line[256];
+        int n = snprintf(line, sizeof(line),
+                         "%s\t%llu\t%llu\t%llu\t%llu\t%llu\n",
+                         kv.first.c_str(), kv.second[0], kv.second[1],
+                         kv.second[2], kv.second[3], kv.second[4]);
+        if (n < 0) continue;
+        if (o + (size_t)n > cap) return -2;
+        memcpy(out + o, line, (size_t)n);
+        o += (size_t)n;
+    }
+    return (long)o;
 }
 
 }  // extern "C"

@@ -439,6 +439,24 @@ class S3Server:
             resp = error_response(err("InternalError", str(e)), resource)
         if bucket:
             self._apply_cors_headers(req, bucket, resp)
+            # tenant accounting (stats/usage.py): the bucket IS the
+            # collection on the S3 surface. Natively-relayed buckets never
+            # reach this dispatcher — the engine's per-collection counters
+            # cover those, so nothing double-counts.
+            try:
+                from seaweedfs_tpu.stats import usage as usage_mod
+
+                usage_mod.accountant().record(
+                    bucket,
+                    bytes_in=float(
+                        int(req.headers.get("Content-Length") or 0)
+                        if req.method in ("PUT", "POST") else 0),
+                    bytes_out=float(len(resp.body)
+                                    if req.method == "GET" else 0),
+                    error=resp.status >= 500,
+                )
+            except Exception:  # accounting must never fail a request
+                pass
         return resp
 
     @staticmethod

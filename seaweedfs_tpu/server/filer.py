@@ -1397,23 +1397,62 @@ class FilerServer:
 
         @svc.route("GET", path_re)
         def read(req: Request) -> Response:
-            return self._do_read(req, head=False)
+            resp = self._do_read(req, head=False)
+            self._account_usage(req, resp, bytes_out=len(resp.body))
+            return resp
 
         @svc.route("HEAD", path_re)
         def head(req: Request) -> Response:
-            return self._do_read(req, head=True)
+            resp = self._do_read(req, head=True)
+            self._account_usage(req, resp)
+            return resp
 
         @svc.route("POST", path_re)
         def post(req: Request) -> Response:
-            return self._do_write(req)
+            resp = self._do_write(req)
+            self._account_usage(
+                req, resp,
+                bytes_in=int(req.headers.get("Content-Length") or 0))
+            return resp
 
         @svc.route("PUT", path_re)
         def put(req: Request) -> Response:
-            return self._do_write(req)
+            resp = self._do_write(req)
+            self._account_usage(
+                req, resp,
+                bytes_in=int(req.headers.get("Content-Length") or 0))
+            return resp
 
         @svc.route("DELETE", path_re)
         def delete(req: Request) -> Response:
-            return self._do_delete(req)
+            resp = self._do_delete(req)
+            self._account_usage(req, resp)
+            return resp
+
+    def _account_usage(self, req: Request, resp: Response,
+                       bytes_in: int = 0, bytes_out: int = 0) -> None:
+        """Tenant accounting for the Python front door (stats/usage.py).
+        Requests the fastlane engine serves natively never reach these
+        handlers — the accountant folds those in separately from the
+        engine's per-collection counters, so nothing double-counts. The
+        collection resolves exactly like the write path's placement:
+        explicit ?collection=, then the fs.configure rule, then the
+        filer default."""
+        try:
+            path = normalize(urllib.parse.unquote(req.path))
+            coll = req.query.get("collection")
+            if not coll and not path.startswith("/etc/"):
+                rule = self.filer_conf.match(path) or {}
+                coll = rule.get("collection")
+            from seaweedfs_tpu.stats import usage as usage_mod
+
+            usage_mod.accountant().record(
+                coll or self.collection or "default",
+                bytes_in=float(bytes_in), bytes_out=float(bytes_out),
+                error=resp.status >= 500,
+            )
+        except Exception:  # accounting must never fail a request
+            pass
 
     # --- handlers ---------------------------------------------------------------
     @staticmethod

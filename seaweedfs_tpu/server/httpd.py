@@ -190,9 +190,14 @@ class HTTPService:
         # imports pay nothing)
         from seaweedfs_tpu.stats import events as events_mod
 
+        from seaweedfs_tpu.stats import heat as heat_mod
+        from seaweedfs_tpu.stats import usage as usage_mod
+
         history_mod.default_history().start()
         alerts_mod.engine()
         events_mod.enable()
+        usage_mod.enable()
+        heat_mod.enable()
         self.enable_tracing(role)
 
     def enable_tracing(self, role: str) -> None:
@@ -629,8 +634,60 @@ def _register_debug_routes(service: "HTTPService") -> None:
             "dropped": rec.dropped_total,
             "events": rec.events(type=type_, volume=volume,
                                  trace=q.get("trace") or None,
-                                 since=since, limit=limit),
+                                 since=since,
+                                 collection=q.get("collection") or None,
+                                 limit=limit),
         })
+
+    @service.route("GET", r"/debug/usage")
+    def debug_usage(req: Request) -> Response:
+        """The bounded-cardinality tenant accountant (stats/usage.py):
+        top-K collections by requests/bytes/errors, the `_other` fold,
+        and the sketch's exported error bound. ?n= caps the tenant rows."""
+        from seaweedfs_tpu.stats import profiler as prof_mod
+        from seaweedfs_tpu.stats import usage as usage_mod
+
+        try:
+            n = int(req.query["n"]) if "n" in req.query else None
+            if n is not None and n < 1:
+                raise ValueError(n)
+        except ValueError:
+            return Response({"error": "n must be a positive integer"}, 400)
+        out = usage_mod.accountant().snapshot(n=n)
+        out["proc"] = prof_mod.PROCESS_TOKEN
+        out["role"] = service.trace_role or service.metrics_role
+        return Response(out)
+
+    @service.route("GET", r"/debug/heat")
+    def debug_heat(req: Request) -> Response:
+        """The heat engine's view (stats/heat.py): per-volume heat
+        scores, per-node/dir days-to-full forecasts, and — on a master —
+        the heartbeat-fed collection/node rollup. ?n= caps each list."""
+        from seaweedfs_tpu.stats import heat as heat_mod
+        from seaweedfs_tpu.stats import profiler as prof_mod
+
+        try:
+            n = int(req.query["n"]) if "n" in req.query else None
+            if n is not None and n < 1:
+                raise ValueError(n)
+        except ValueError:
+            return Response({"error": "n must be a positive integer"}, 400)
+        out = heat_mod.engine().snapshot()
+        rollup_colls, rollup_nodes = [], []
+        for ru in heat_mod.rollups():
+            snap = ru.snapshot()
+            rollup_colls.extend(snap["collections"])
+            rollup_nodes.extend(snap["nodes"])
+        if rollup_colls or rollup_nodes:
+            out["collections"] = rollup_colls
+            out["nodes"] = rollup_nodes
+        if n is not None:
+            for k in ("volumes", "forecast", "collections", "nodes"):
+                if k in out:
+                    out[k] = out[k][:n]
+        out["proc"] = prof_mod.PROCESS_TOKEN
+        out["role"] = service.trace_role or service.metrics_role
+        return Response(out)
 
     @service.route("GET", r"/debug/faults")
     def debug_faults_get(req: Request) -> Response:

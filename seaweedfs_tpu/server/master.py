@@ -193,9 +193,17 @@ class MasterServer:
         scrape-time collector so /metrics always reflects the live tree —
         no per-heartbeat gauge churn, nothing stale after a node expires."""
         from seaweedfs_tpu.stats import default_registry
+        from seaweedfs_tpu.stats import heat as heat_mod
 
         self._metrics_collector = default_registry().register_collector(
             self._metrics_lines, names=self.MASTER_METRIC_FAMILIES,
+        )
+        # cluster heat rollup: heartbeat-fed per-collection/per-node
+        # access rates only the master can assemble (stats/heat.py)
+        self.heat_rollup = heat_mod.HeatRollup()
+        heat_mod.register_rollup(self.heat_rollup)
+        self._heat_collector = default_registry().register_collector(
+            self.heat_rollup.lines, names=heat_mod.ROLLUP_FAMILIES,
         )
 
     def _metrics_lines(self) -> list[str]:
@@ -420,6 +428,14 @@ class MasterServer:
 
             default_registry().unregister_collector(self._metrics_collector)
             self._metrics_collector = None
+        if getattr(self, "_heat_collector", None) is not None:
+            from seaweedfs_tpu.stats import default_registry
+            from seaweedfs_tpu.stats import heat as heat_mod
+
+            default_registry().unregister_collector(self._heat_collector)
+            self._heat_collector = None
+            heat_mod.unregister_rollup(self.heat_rollup)
+            self.heat_rollup = None
         if self.raft is not None:
             self.raft.stop()
         if getattr(self, "fastlane", None) is not None:
@@ -566,6 +582,11 @@ class MasterServer:
                 return self._not_leader_response()
             hb = req.json()
             self.topo.sync_heartbeat(hb)
+            if getattr(self, "heat_rollup", None) is not None:
+                self.heat_rollup.feed(
+                    f"{hb.get('ip', '')}:{hb.get('port', '')}",
+                    hb.get("volumes") or (),
+                )
             # any topology delta may change the writable set: drop every
             # assign profile, the next Python-served assign reinstalls
             self._fl_assign_clear()

@@ -169,6 +169,12 @@ class VolumeServer:
             for vid in self.store.volume_ids():
                 self._fl_register(vid)
             threading.Thread(target=self._fl_drain_loop, daemon=True).start()
+            # tenant accounting: native ops never reach a Python handler,
+            # so the accountant folds the engine's per-collection counter
+            # deltas in at scrape time
+            from seaweedfs_tpu.stats import usage as usage_mod
+
+            usage_mod.accountant().attach_engine(self.fastlane)
         self._register_metrics_collector()
         for loc in self.store.locations:
             loc.max_volume_count = self.max_volume_count
@@ -208,6 +214,9 @@ class VolumeServer:
             default_registry().unregister_collector(self._metrics_collector)
             self._metrics_collector = None
         if self.fastlane:
+            from seaweedfs_tpu.stats import usage as usage_mod
+
+            usage_mod.accountant().detach_engine(self.fastlane)
             self.fastlane.drain()
             self.fastlane.stop()
             self.fastlane = None
@@ -490,6 +499,19 @@ class VolumeServer:
         if self.fastlane:  # report the engine's appends, not a stale view
             self.fastlane.drain()
         hb = self.store.collect_heartbeat()
+        if self.fastlane:
+            # per-volume cumulative op counters ride the beat: the master's
+            # heat rollup (stats/heat.py) turns consecutive beats into
+            # per-collection/per-node access rates. Cumulative, not deltas —
+            # a dropped beat then costs resolution, not correctness.
+            for v in hb.get("volumes", ()):
+                vm = self.fastlane.volume_metrics(int(v.get("id", 0)))
+                if vm is None:
+                    continue
+                v["read_ops"] = vm["reads"]
+                v["write_ops"] = vm["writes"] + vm["deletes"]
+                v["read_bytes"] = vm["read_bytes"]
+                v["write_bytes"] = vm["write_bytes"]
         hb["data_center"] = self.data_center
         hb["rack"] = self.rack
         hb["max_volume_count"] = self.max_volume_count

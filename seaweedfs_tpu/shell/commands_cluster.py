@@ -598,12 +598,22 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
 
         now = _time.time()
         roles: dict[str, dict] = {}
+        # tenants/heat ride the SAME history fetch: the usage and heat
+        # collectors export into each process's ring, so no extra RPCs
+        tenants: dict[str, dict] = {}
+        heat_vols: dict[tuple, float] = {}
+        days_full: dict[tuple, float] = {}
 
         def row(role: str) -> dict:
             return roles.setdefault(role, {
                 "req_s": 0.0, "err_s": 0.0, "bytes_s": 0.0,
                 "fr_native": 0.0, "fr_fb": 0.0,
                 "buckets": {}, "uptime": None, "version": None,
+            })
+
+        def tenant(coll: str) -> dict:
+            return tenants.setdefault(coll, {
+                "req_s": 0.0, "in_s": 0.0, "out_s": 0.0, "err_s": 0.0,
             })
 
         for token in sorted(by_proc):
@@ -635,6 +645,24 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
                              "SeaweedFS_s3_fastlane_fallback_total") and rate:
                     role = "filer" if "filer" in fam else "s3"
                     row(role)["fr_fb"] += rate
+                elif fam == "SeaweedFS_usage_requests_total" and rate:
+                    tenant(labels.get("collection", "?"))["req_s"] += rate
+                elif fam == "SeaweedFS_usage_bytes_in_total" and rate:
+                    tenant(labels.get("collection", "?"))["in_s"] += rate
+                elif fam == "SeaweedFS_usage_bytes_out_total" and rate:
+                    tenant(labels.get("collection", "?"))["out_s"] += rate
+                elif fam == "SeaweedFS_usage_errors_total" and rate:
+                    tenant(labels.get("collection", "?"))["err_s"] += rate
+                elif fam == "SeaweedFS_volume_heat_score":
+                    key = (labels.get("server", "?"),
+                           labels.get("volume", "?"))
+                    heat_vols[key] = max(heat_vols.get(key, 0.0),
+                                         s.get("last") or 0.0)
+                elif fam == "SeaweedFS_node_days_to_full":
+                    key = (labels.get("node", "?"), labels.get("dir", "?"))
+                    v = s.get("last")
+                    if v is not None:
+                        days_full[key] = min(days_full.get(key, v), v)
                 elif fam == "SeaweedFS_process_start_time_seconds":
                     start_ts = s.get("last")
                 elif fam == "SeaweedFS_build_info":
@@ -695,7 +723,17 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
         ]
         for role in sorted(roles):
             r = roles[role]
-            p99 = quantile_from_bucket_rates(r["buckets"], 0.99)
+            qflags: dict = {}
+            p99 = quantile_from_bucket_rates(r["buckets"], 0.99,
+                                             flags=qflags)
+            # inf_mass: the p99 fell in the +Inf bucket — the clamped
+            # value is a lower bound, rendered ">x", never "=x"
+            if p99 is None:
+                p99_txt = "n/a"
+            elif qflags.get("inf_mass"):
+                p99_txt = f">{p99 * 1e3:.0f}"
+            else:
+                p99_txt = f"{p99 * 1e3:.2f}"
             err_pct = (
                 f"{100.0 * r['err_s'] / r['req_s']:.1f}" if r["req_s"] else "-"
             )
@@ -708,7 +746,7 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
             ex = exemplar.get(role)
             lines.append(
                 f"{role:<10} {r['req_s']:>9.1f} {err_pct:>7}"
-                f" {('n/a' if p99 is None else f'{p99 * 1e3:.2f}'):>9}"
+                f" {p99_txt:>9}"
                 f" {_fmt_bytes_rate(r['bytes_s']):>10}"
                 f" {front:>7}"
                 f" {_fmt_uptime(r['uptime']):>8}  {r['version'] or '-'}"
@@ -717,6 +755,30 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
         if not roles:
             lines.append("(no rates yet — the history ring needs two"
                          " scrapes inside the window)")
+        if tenants:
+            top5 = sorted(tenants.items(),
+                          key=lambda kv: -kv[1]["req_s"])[:5]
+            lines.append("tenants (top by req/s):")
+            for coll, t in top5:
+                lines.append(
+                    f"  {coll:<20} {t['req_s']:>8.1f}/s"
+                    f"  in={_fmt_bytes_rate(t['in_s'])}"
+                    f"  out={_fmt_bytes_rate(t['out_s'])}"
+                    + (f"  err={t['err_s']:.2f}/s" if t["err_s"] else "")
+                )
+        if heat_vols or days_full:
+            bits = []
+            if heat_vols:
+                hot = sorted(heat_vols.items(), key=lambda kv: -kv[1])[:3]
+                bits.append("hottest " + ", ".join(
+                    f"{srv} v{vid}={score:.1f}"
+                    for (srv, vid), score in hot))
+            if days_full:
+                soon = sorted(days_full.items(), key=lambda kv: kv[1])[:3]
+                bits.append("days-to-full " + ", ".join(
+                    f"{node} {d}={days:.1f}d"
+                    for (node, d), days in soon))
+            lines.append("heat: " + "; ".join(bits))
         if slo_rows:
             lines.append("slo error-budget burn (x sustainable;"
                          " fast/slow window):")
@@ -764,6 +826,167 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
     return f"cluster.top stopped after {shown} frame(s)"
 
 
+@command("cluster.heat",
+         "[-n 10] [-include url,url] [-out path] — the cluster's thermal"
+         " picture: top-K tenants from the bounded usage sketch (with its"
+         " error bound), hottest/coldest volumes by heat score, collection"
+         "/node rollups, per-node days-to-full forecasts")
+def cmd_cluster_heat(env: CommandEnv, args: list[str]) -> str:
+    """Who is using the cluster and where the heat is: every node serves
+    its bounded-cardinality tenant sketch (/debug/usage) and heat/forecast
+    view (/debug/heat); this fetches all of them concurrently, dedups
+    endpoints sharing a process, sums tenant counts across processes
+    (each process sketches its own traffic), and renders one report.
+    Sketch counts are approximate above the exported error bound — the
+    header says by how much."""
+    flags = parse_flags(args)
+    try:
+        n = int(flags.get("n", 10))
+        if n < 1:
+            raise ValueError(n)
+    except ValueError:
+        raise ShellError(
+            "usage: cluster.heat [-n k] [-include url,url] [-out path]")
+
+    endpoints = _discover_endpoints(env, flags.get("include", ""))
+    usage_res: dict[str, dict] = {}
+    heat_res: dict[str, dict] = {}
+
+    def fetch(ep: str) -> None:
+        try:
+            usage_res[ep] = env.get(f"{ep}/debug/usage", timeout=10)
+        except Exception:
+            return  # an unreachable node must not sink the view
+        try:
+            heat_res[ep] = env.get(f"{ep}/debug/heat", timeout=10)
+        except Exception:
+            pass
+
+    _fetch_concurrently(endpoints, fetch)
+    if not usage_res:
+        raise ShellError("no /debug/usage endpoint reachable")
+
+    dims = ("requests", "bytes_in", "bytes_out", "errors")
+    tenants: dict[str, dict] = {}
+    other = {d: 0.0 for d in dims}
+    error_bound, k, evictions = 0.0, None, 0
+    seen: set[str] = set()
+    for ep in sorted(usage_res):
+        out = usage_res[ep]
+        token = out.get("proc") or ep
+        if token in seen:
+            continue
+        seen.add(token)
+        for row in out.get("tenants", []):
+            t = tenants.setdefault(
+                row.get("collection", "?"),
+                {d: 0.0 for d in dims} | {d + "_err": 0.0 for d in dims})
+            for d in dims:
+                t[d] += float(row.get(d, 0) or 0)
+                t[d + "_err"] += float(row.get(d + "_err", 0) or 0)
+        for d, v in (out.get("other") or {}).items():
+            if d in other:
+                other[d] += float(v or 0)
+        error_bound = max(error_bound, float(out.get("error_bound") or 0))
+        evictions += int(out.get("evictions") or 0)
+        k = out.get("k", k)
+
+    vols: dict[tuple, dict] = {}
+    forecast: dict[tuple, float] = {}
+    coll_scores: dict[str, float] = {}
+    node_scores: dict[str, float] = {}
+    seen_heat: set[str] = set()
+    for ep in sorted(heat_res):
+        out = heat_res[ep]
+        token = out.get("proc") or ep
+        if token in seen_heat:
+            continue
+        seen_heat.add(token)
+        for v in out.get("volumes", []):
+            key = (v.get("server", "?"), str(v.get("volume", "?")))
+            cur = vols.get(key)
+            if cur is None or v.get("score", 0) > cur.get("score", 0):
+                vols[key] = v
+        for f in out.get("forecast", []):
+            key = (f.get("node", "?"), f.get("dir", "?"))
+            d = float(f.get("days_to_full", 0) or 0)
+            forecast[key] = min(forecast.get(key, d), d)
+        for c in out.get("collections", []):
+            name = c.get("collection", "?")
+            coll_scores[name] = max(coll_scores.get(name, 0.0),
+                                    float(c.get("score", 0) or 0))
+        for nd in out.get("nodes", []):
+            name = nd.get("node", "?")
+            node_scores[name] = max(node_scores.get(name, 0.0),
+                                    float(nd.get("score", 0) or 0))
+
+    lines = [
+        f"cluster.heat @ {env.master_url}  {len(seen)} process(es),"
+        f" {len(usage_res)} endpoint(s)"
+        + (f"  sketch K={k}" if k is not None else "")
+        + f"  error bound <= {error_bound:g}"
+        + (f"  ({evictions} eviction(s) into _other)" if evictions else ""),
+        f"tenants (top {n} by requests; counts approximate above the"
+        f" error bound):",
+        f"  {'collection':<20} {'requests':>12} {'bytes in':>12}"
+        f" {'bytes out':>12} {'errors':>8}",
+    ]
+    top = sorted(tenants.items(), key=lambda kv: -kv[1]["requests"])[:n]
+    for coll, t in top:
+        err = t["requests_err"]
+        req = f"{t['requests']:g}" + (f"±{err:g}" if err else "")
+        lines.append(
+            f"  {coll:<20} {req:>12} {t['bytes_in']:>12g}"
+            f" {t['bytes_out']:>12g} {t['errors']:>8g}")
+    if any(other.values()):
+        lines.append(
+            f"  {'_other':<20} {other['requests']:>12g}"
+            f" {other['bytes_in']:>12g} {other['bytes_out']:>12g}"
+            f" {other['errors']:>8g}")
+    if not tenants:
+        lines.append("  (no tenant traffic accounted yet)")
+
+    if vols:
+        ranked = sorted(vols.values(), key=lambda v: -v.get("score", 0))
+        lines.append(f"hottest volumes (of {len(ranked)} scored):")
+        for v in ranked[:n]:
+            lines.append(
+                f"  {v.get('server', '?')} v{v.get('volume', '?')}"
+                f" score={v.get('score', 0):g}"
+                + ("  HOT" if v.get("hot") else ""))
+        coldest = [v for v in reversed(ranked)][:min(n, 3)]
+        if len(ranked) > n:
+            lines.append("coldest:")
+            for v in coldest:
+                lines.append(
+                    f"  {v.get('server', '?')} v{v.get('volume', '?')}"
+                    f" score={v.get('score', 0):g}")
+    if coll_scores:
+        lines.append("collection heat (master rollup, ops/s):")
+        for name, score in sorted(coll_scores.items(),
+                                  key=lambda kv: -kv[1])[:n]:
+            lines.append(f"  {name:<20} {score:g}")
+    if node_scores:
+        lines.append("node heat (ops/s): " + "  ".join(
+            f"{name}={score:g}" for name, score in sorted(
+                node_scores.items(), key=lambda kv: -kv[1])[:n]))
+    if forecast:
+        lines.append("days-to-full (linear fit over the disk-usage ring):")
+        for (node, d), days in sorted(forecast.items(),
+                                      key=lambda kv: kv[1])[:n]:
+            lines.append(f"  {node} {d}: {days:.1f}d")
+    else:
+        lines.append("days-to-full: no positive fill trend"
+                     " (nothing filling up)")
+
+    body = "\n".join(lines)
+    if "out" in flags:
+        with open(flags["out"], "w") as f:
+            f.write(body + "\n")
+        return lines[0] + f"\nreport written to {flags['out']}"
+    return body
+
+
 def _why_describe(ev: dict) -> str:
     """One flight-recorder event as a timeline row body."""
     parts = [ev["type"]]
@@ -778,7 +1001,7 @@ def _why_describe(ev: dict) -> str:
 
 
 @command("cluster.why",
-         "<trace-id|volume-id> [-window 600] [-limit 2048]"
+         "<trace-id|volume-id|collection> [-window 600] [-limit 2048]"
          " [-include url,url] — assemble one causally-ordered cross-node"
          " timeline from every node's flight recorder (/debug/events) +"
          " trace ring: request span, degraded read, injected fault, alert"
@@ -789,9 +1012,12 @@ def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
     verb pulls the trace's spans and trace-keyed events from every node,
     widens to the volumes those events name, and folds in each volume's
     fault/alert/task/heal events inside the window; given a volume id it
-    renders that volume's whole incident timeline. Events are deduped by
-    (process token, seq) — single-process test clusters expose one ring
-    at every port."""
+    renders that volume's whole incident timeline; anything else is a
+    collection (tenant) name — events carrying that collection
+    correlation key (degraded reads, scrub findings, repair lifecycle,
+    usage-sketch overflow) assemble into a per-tenant timeline. Events
+    are deduped by (process token, seq) — single-process test clusters
+    expose one ring at every port."""
     import math
     import re as _re
 
@@ -799,8 +1025,8 @@ def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
     target = flags.get("", "").strip()
     if not target:
         raise ShellError(
-            "usage: cluster.why <trace-id|volume-id> [-window n]"
-            " [-include url,url]")
+            "usage: cluster.why <trace-id|volume-id|collection>"
+            " [-window n] [-include url,url]")
     try:
         window = float(flags.get("window", 600.0))
         limit = int(flags.get("limit", 2048))
@@ -810,14 +1036,13 @@ def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
         raise ShellError("bad -window/-limit")
     volume_id: int | None = None
     trace_id: str | None = None
+    collection: str | None = None
     if target.isdigit():
         volume_id = int(target)
     elif _re.fullmatch(r"[0-9a-f]{1,32}", target):
         trace_id = target
     else:
-        raise ShellError(
-            f"{target!r} is neither a volume id nor a (lowercase hex)"
-            f" trace id")
+        collection = target
 
     endpoints = _discover_endpoints(env, flags.get("include", ""))
     ev_res: dict[str, dict] = {}
@@ -892,13 +1117,24 @@ def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
                 f" from {len(procs)} process(es)"
                 + (f", volumes {sorted(vols)}" if vols else ""))
     else:
-        picked = [ev for ev in events if ev.get("volume") == volume_id]
+        if collection is not None:
+            # per-tenant timeline: the collection correlation key rides
+            # in attrs (degraded_read, scrub_finding, task_*,
+            # tenant_overflow, heat edges on the tenant's volumes)
+            picked = [ev for ev in events
+                      if (ev.get("attrs") or {}).get("collection")
+                      == collection]
+            what = f"collection {collection!r}"
+        else:
+            picked = [ev for ev in events
+                      if ev.get("volume") == volume_id]
+            what = f"volume {volume_id}"
         if picked:
             t1 = max(ev["ts"] for ev in picked)
             picked = [ev for ev in picked if ev["ts"] >= t1 - window]
         if not picked:
             raise ShellError(
-                f"volume {volume_id}: no events found on"
+                f"{what}: no events found on"
                 f" {len(ev_res)} endpoint(s)")
         # pull the request traces the volume's events name (the span side
         # of the story: which reads were degraded, how slow they were) —
@@ -923,7 +1159,7 @@ def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
         for sps in found.values():
             for sp in sps:
                 spans.setdefault(sp["span_id"], sp)
-        head = (f"cluster.why volume {volume_id}: {len(picked)} event(s),"
+        head = (f"cluster.why {what}: {len(picked)} event(s),"
                 f" {len(spans)} span(s) from {len(procs)} process(es)")
 
     # one causally-ordered timeline: spans (at their start time) + events

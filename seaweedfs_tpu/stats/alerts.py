@@ -150,6 +150,12 @@ DEFAULT_PARAMS = {
     # scrub_findings: ANY sustained rate of proved silent damage warns —
     # reads still succeed, so nothing else would page for bitrot
     "scrub_finding_rate": 0.0,
+    # capacity_forecast: page on the stats/heat.py days-to-full fit —
+    # warning gives humans time to add capacity, critical means the
+    # fill will win within an operational window. The gauge only exists
+    # while the fill slope is positive, so deleting data clears both.
+    "forecast_warn_days": 14.0,
+    "forecast_crit_days": 3.0,
     # SLO multi-window burn-rate alerting: the fast window pages on an
     # incident spending the error budget 14x faster than sustainable
     # (critical, self-clears once the burst ages out of the window); the
@@ -412,6 +418,33 @@ def _check_slo_slow_burn(hist, now, p):
     return worst, "; ".join(details)
 
 
+def _check_capacity_forecast_at(hist, now, p, horizon_days):
+    """Shared body of the capacity_forecast pair: any node/dir whose
+    days-to-full fit (stats/heat.py) undercuts the horizon."""
+    details, worst = [], None
+    for labels, days, _ in hist.latests("SeaweedFS_node_days_to_full"):
+        if days < 0 or days > horizon_days:
+            continue
+        details.append(
+            f"{labels.get('node', '?')} {labels.get('dir', '?')}"
+            f" full in {days:.1f}d"
+        )
+        # "worst" = soonest-to-full, but evaluate() keeps the max value;
+        # report the horizon shortfall so bigger means worse
+        worst = max(worst or 0.0, horizon_days - days)
+    if not details:
+        return None
+    return worst, "capacity forecast: " + "; ".join(sorted(details))
+
+
+def _check_capacity_forecast(hist, now, p):
+    return _check_capacity_forecast_at(hist, now, p, p["forecast_warn_days"])
+
+
+def _check_capacity_forecast_critical(hist, now, p):
+    return _check_capacity_forecast_at(hist, now, p, p["forecast_crit_days"])
+
+
 def default_rules() -> list[Rule]:
     return [
         Rule("http_error_ratio", "critical",
@@ -444,6 +477,14 @@ def default_rules() -> list[Rule]:
              "integrity scrub passes are detecting silent damage"
              " (bitrot, torn shards, diverged replicas)",
              _check_scrub_findings),
+        Rule("capacity_forecast", "warning",
+             "a data directory's fill trend reaches capacity within the"
+             " warning horizon (days-to-full linear fit)",
+             _check_capacity_forecast),
+        Rule("capacity_forecast_critical", "critical",
+             "a data directory's fill trend reaches capacity within the"
+             " critical horizon — add capacity or shed data now",
+             _check_capacity_forecast_critical),
         Rule("slo_burn_fast", "critical",
              "an SLO's error budget is burning faster than the fast-"
              "window threshold (incident in progress)",

@@ -71,6 +71,12 @@ EVENT_TYPES = {
     "heartbeat_rejoin": "a stale node's heartbeat recovered",
     "volume_state": "a volume lifecycle transition"
                     " (created|mounted|unmounted|deleted|readonly...)",
+    "tenant_overflow": "the usage sketch evicted a tenant into the"
+                       " _other bucket (top-K cardinality bound hit)",
+    "heat_promoted": "a volume's heat score crossed the promote"
+                     " threshold (hot set entry)",
+    "heat_demoted": "a hot volume's heat score fell under the demote"
+                    " threshold (hot set exit)",
 }
 
 EVENT_FAMILIES = (
@@ -175,10 +181,12 @@ class EventRecorder:
 
     def events(self, type: str | None = None, volume: int | None = None,
                trace: str | None = None, since: float | None = None,
+               collection: str | None = None,
                limit: int = 256) -> list[dict]:
         """Filtered view, causally ordered (oldest first). `since` is a
         wall-clock lower bound; `limit` keeps the NEWEST matches (the
-        tail is where the story usually is)."""
+        tail is where the story usually is). `collection` matches the
+        per-tenant correlation key events carry in attrs."""
         with self._lock:
             evs = list(self._ring)
         out = []
@@ -190,6 +198,9 @@ class EventRecorder:
             if trace is not None and ev.trace_id != trace:
                 continue
             if since is not None and ev.wall < since:
+                continue
+            if collection is not None and \
+                    ev.attrs.get("collection") != collection:
                 continue
             out.append(ev)
         if limit > 0:

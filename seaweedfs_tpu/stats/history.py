@@ -88,10 +88,18 @@ def counter_rate(samples, window: float, now: float | None = None):
     return max(total, 0.0) / span
 
 
-def quantile_from_bucket_rates(bucket_rates: dict, q: float):
+def quantile_from_bucket_rates(bucket_rates: dict, q: float,
+                               flags: dict | None = None):
     """Interpolated quantile from per-`le` cumulative bucket *rates* (the
     windowed rate of each `_bucket` series keeps the cumulative shape:
-    rate of cumulative is cumulative of rates). -> seconds | None."""
+    rate of cumulative is cumulative of rates). -> seconds | None.
+
+    When the requested rank lands in the +Inf overflow bucket the true
+    quantile is unknowable from the histogram — the value returned is the
+    largest finite bound (a LOWER bound on the truth, never a fabricated
+    finite latency) and `flags["inf_mass"]` is set True so consumers
+    (cluster.top's p99 column) can render it as ">bound" instead of
+    "=bound". With no finite bucket at all: None, still flagged."""
     items = sorted(bucket_rates.items())
     if not items:
         return None
@@ -103,7 +111,12 @@ def quantile_from_bucket_rates(bucket_rates: dict, q: float):
     for bound, cum in items:
         if cum >= rank:
             if bound == float("inf"):
-                return prev_bound  # overflow bucket: lower edge
+                # overflow bucket: clamp to the largest finite bound,
+                # flagged — a lower bound on the truth, not an estimate
+                if flags is not None:
+                    flags["inf_mass"] = True
+                finite = [b for b, _ in items if b != float("inf")]
+                return max(finite) if finite else None
             if cum <= prev_cum:
                 return bound
             frac = (rank - prev_cum) / (cum - prev_cum)

@@ -51,13 +51,15 @@ class NeedleNotFound(NotFound):
     pass
 
 
-def _emit_degraded(volume_id: int, missing_shard: int, via: str) -> None:
+def _emit_degraded(volume_id: int, missing_shard: int, via: str,
+                   collection: str = "") -> None:
     """Journal a sealed-EC reconstruction into the flight recorder
     (cold path — only runs when a shard read already failed)."""
     from seaweedfs_tpu.stats import events as events_mod
 
     events_mod.emit("degraded_read", volume=volume_id,
-                    reason="ec_reconstruct", shard=missing_shard, via=via)
+                    reason="ec_reconstruct", shard=missing_shard, via=via,
+                    collection=collection or "default")
 
 
 # sealed-shard pread seam: error/latency here exercises the local ->
@@ -238,7 +240,8 @@ class EcVolume:
                 data = None
             if data is not None and len(data) == size:
                 degraded_reads_counter().labels("ec_reconstruct").inc()
-                _emit_degraded(self.volume_id, missing_shard, "partial_fanin")
+                _emit_degraded(self.volume_id, missing_shard,
+                               "partial_fanin", self.collection)
                 return data
         present: dict[int, np.ndarray] = {}
         for shard_id in self.shards:
@@ -266,7 +269,8 @@ class EcVolume:
             )
         out = self.codec.reconstruct(present, targets=[missing_shard])
         degraded_reads_counter().labels("ec_reconstruct").inc()
-        _emit_degraded(self.volume_id, missing_shard, "full_decode")
+        _emit_degraded(self.volume_id, missing_shard, "full_decode",
+                       self.collection)
         return out[missing_shard].tobytes()
 
     def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:

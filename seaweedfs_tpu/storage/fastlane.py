@@ -182,6 +182,29 @@ def _bind_metrics(lib) -> bool:
     return lib._fastlane_metrics_bound
 
 
+def _bind_usage(lib) -> bool:
+    """Declare the OPTIONAL per-collection usage ABI (PR 16). A prebuilt
+    .so from before sw_fl_get_usage existed simply lacks the symbols — the
+    usage accountant then falls back to the Python-side vid→collection map
+    over sw_fl_get_volume_metrics, and to pure handler-path accounting."""
+    cached = getattr(lib, "_fastlane_usage_bound", None)
+    if cached is not None:
+        return cached
+    try:
+        lib.sw_fl_volume_collection_set.restype = ctypes.c_int
+        lib.sw_fl_volume_collection_set.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_char_p,
+        ]
+        lib.sw_fl_get_usage.restype = ctypes.c_long
+        lib.sw_fl_get_usage.argtypes = [
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib._fastlane_usage_bound = True
+    except AttributeError:
+        lib._fastlane_usage_bound = False
+    return lib._fastlane_usage_bound
+
+
 def _bind_ec_online(lib) -> bool:
     """Declare the OPTIONAL online-EC stripe-accumulator ABI (the
     write-path erasure coder's drain hook). A prebuilt .so from before
@@ -346,6 +369,7 @@ class Fastlane:
         self.tls = tls  # engine terminates mTLS itself: URLs are https
         self._metrics_ok = _bind_metrics(lib)
         self._ec_online_ok = _bind_ec_online(lib)
+        self._usage_ok = _bind_usage(lib)
         # can the engine natively reach upstream (volume) engines? Under
         # mTLS this needs the C++ TLS *client* context too
         self.tls_client_ok = bool(lib.sw_fl_tls_client_ok(handle))
@@ -432,6 +456,10 @@ class Fastlane:
             # until this call the engine proxies the volume's traffic:
             # arming it before the bulk load would 404 existing needles
             self._lib.sw_fl_volume_serving(self.handle, volume.id)
+            if self._usage_ok:
+                self._lib.sw_fl_volume_collection_set(
+                    self.handle, volume.id,
+                    (getattr(volume, "collection", "") or "").encode()[:63])
         return True
 
     def _load_map(self, volume) -> None:
@@ -703,6 +731,50 @@ class Fastlane:
             "write_bytes": int(out[4]),
             "tail": int(out[5]),
         }
+
+    def usage_metrics(self) -> dict | None:
+        """Per-collection cumulative native-op counters keyed by collection
+        name, or None when the .so predates the usage ABI. Falls back to a
+        Python-side aggregation over volume_metrics() when only the older
+        per-volume symbol is available."""
+        if self._usage_ok:
+            cap = 65536
+            buf = ctypes.create_string_buffer(cap)
+            n = int(self._lib.sw_fl_get_usage(self.handle, buf, cap))
+            if n >= 0:
+                out: dict[str, dict] = {}
+                for line in buf.raw[:n].decode(errors="replace").splitlines():
+                    parts = line.split("\t")
+                    if len(parts) != 6:
+                        continue
+                    coll = parts[0]
+                    try:
+                        vals = [int(x) for x in parts[1:]]
+                    except ValueError:
+                        continue
+                    out[coll] = {
+                        "reads": vals[0], "writes": vals[1],
+                        "deletes": vals[2], "read_bytes": vals[3],
+                        "write_bytes": vals[4],
+                    }
+                return out
+        # stale-.so fallback: aggregate the per-volume counters by the
+        # Python-side registry's collection tags
+        if not self._metrics_ok:
+            return None
+        out = {}
+        for vid, volume in list(self._volumes.items()):
+            m = self.volume_metrics(vid)
+            if m is None:
+                continue
+            coll = getattr(volume, "collection", "") or ""
+            row = out.setdefault(coll, {
+                "reads": 0, "writes": 0, "deletes": 0,
+                "read_bytes": 0, "write_bytes": 0,
+            })
+            for k in row:
+                row[k] += m[k]
+        return out
 
 
 def front_service(service, guard_active: bool = False, workers: int = 0,

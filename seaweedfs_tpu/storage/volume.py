@@ -452,6 +452,7 @@ class Volume:
 
         events_mod.emit("degraded_read", volume=self.id, reason=reason,
                         needle=f"{needle_id:x}",
+                        collection=self.collection or "default",
                         cause=str(cause)[:120])
         return n
 

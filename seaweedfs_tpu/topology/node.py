@@ -32,6 +32,13 @@ class VolumeInfo:
     # holders reporting different digests for one volume have silently
     # diverged — the scrub detector re-syncs from the majority holder
     needle_digest: str = ""
+    # cumulative native-op counters carried on the beat (PR 16): the
+    # master's heat rollup differentiates consecutive beats into
+    # per-collection/per-node access rates
+    read_ops: int = 0
+    write_ops: int = 0
+    read_bytes: int = 0
+    write_bytes: int = 0
 
     @staticmethod
     def from_dict(d: dict) -> "VolumeInfo":
@@ -51,6 +58,10 @@ class VolumeInfo:
                 d.get("ec_online_parity_damaged", 0)
             ),
             needle_digest=str(d.get("needle_digest", "")),
+            read_ops=int(d.get("read_ops", 0)),
+            write_ops=int(d.get("write_ops", 0)),
+            read_bytes=int(d.get("read_bytes", 0)),
+            write_bytes=int(d.get("write_bytes", 0)),
         )
 
 

@@ -114,6 +114,30 @@ class TestEventRegistry:
         assert ev["ts"] > 0 and ev["mono"] > 0 and ev["seq"] >= 1
 
 
+class TestTenantHeatEvents:
+    def test_new_types_record_and_collection_filter(self):
+        """PR-16 event types (tenant_overflow, heat_promoted,
+        heat_demoted) journal through the closed registry, and the
+        recorder's collection filter keys `cluster.why <collection>`."""
+        rec = events.EventRecorder(capacity=16)
+        rec.enable()
+        rec.record("tenant_overflow", collection="acme", k=64)
+        rec.record("heat_promoted", volume=7, node="n1:8080", score=12.5)
+        rec.record("heat_demoted", volume=7, node="n1:8080", score=1.5)
+        rec.record("degraded_read", volume=3, reason="dat_read",
+                   collection="acme")
+        mine = rec.events(collection="acme")
+        assert [e["type"] for e in mine] \
+            == ["tenant_overflow", "degraded_read"]
+        assert mine[0]["attrs"]["k"] == 64
+        # heat edges carry volume + node correlation keys
+        hot = rec.events(type="heat_promoted")
+        assert hot[0]["volume"] == 7 and hot[0]["node"] == "n1:8080"
+        assert rec.events(type="heat_demoted")[0]["attrs"]["score"] == 1.5
+        # the filter is exact: no collection attr -> excluded
+        assert rec.events(collection="other") == []
+
+
 class TestDisabledOverhead:
     def test_disabled_emit_is_one_attribute_check(self, monkeypatch):
         """The acceptance bar (the faults registry's disarmed guard,
@@ -626,8 +650,9 @@ class TestClusterWhy:
         env = flight_cluster["env"]
         with pytest.raises(ShellError, match="usage"):
             run_command(env, "cluster.why")
-        with pytest.raises(ShellError, match="neither"):
-            run_command(env, "cluster.why ZZZ-not-hex")
+        # non-hex, non-numeric targets are collection names now (PR 16)
+        with pytest.raises(ShellError, match="no events found"):
+            run_command(env, "cluster.why ZZZ-not-a-collection")
         with pytest.raises(ShellError, match="no spans or events"):
             run_command(env, "cluster.why 00000000deadbeef")
 

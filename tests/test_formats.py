@@ -389,6 +389,36 @@ class TestMetricNameLint:
             == "counter"
         assert kinds["SeaweedFS_maintenance_lazy_batch_total"] == "counter"
         assert tool.stream_lazy_violations() == []
+        # PR-16: tenant usage sketch + heat/forecast collector families,
+        # the _other sentinel, the heat event types, and the
+        # capacity_forecast alert pair
+        assert "SeaweedFS_usage_requests_total" in collector_names
+        assert "SeaweedFS_usage_error_bound" in collector_names
+        assert "SeaweedFS_volume_heat_score" in collector_names
+        assert "SeaweedFS_node_days_to_full" in collector_names
+        assert "SeaweedFS_heat_collection_score" in collector_names
+        assert tool.usage_heat_violations() == []
+
+    def test_usage_heat_lint_catches_violations(self, monkeypatch):
+        from seaweedfs_tpu.stats import heat, usage
+
+        tool = self._tool()
+        monkeypatch.setattr(
+            usage, "USAGE_FAMILIES",
+            usage.USAGE_FAMILIES + ("SeaweedFS_usage_BadName",),
+        )
+        monkeypatch.setattr(usage, "OTHER", "other")  # sentinel must be _-prefixed
+        monkeypatch.setattr(usage, "DEFAULT_K", 0)
+        bad = tool.usage_heat_violations()
+        assert any("SeaweedFS_usage_BadName" in b for b in bad)
+        assert any("sentinel" in b for b in bad)
+        assert any("DEFAULT_K" in b for b in bad)
+        monkeypatch.setattr(
+            heat, "HEAT_FAMILIES",
+            ("seaweedfs_heat_wrong_prefix",) + heat.HEAT_FAMILIES,
+        )
+        bad = tool.usage_heat_violations()
+        assert any("seaweedfs_heat_wrong_prefix" in b for b in bad)
 
     def test_stream_lazy_lint_catches_violations(self, monkeypatch):
         from seaweedfs_tpu.maintenance import scheduler as sched_mod

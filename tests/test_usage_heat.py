@@ -1,0 +1,534 @@
+"""Tenant & heat telemetry (PR 16): bounded-cardinality usage accounting,
+cluster heat map, capacity forecasting.
+
+Covers: the Space-Saving sketch's invariants under adversarial insert
+orders (count - err <= true <= count, err <= exported error bound, O(K)
+memory under 10x-K distinct collections), eviction/_other folding, the
+multi-dimension UsageAccountant (handler-path record(), native-engine
+delta folding, tenant_overflow journaling deduped per tenant), the
+HeatEngine's EWMA scoring with hysteresis promote/demote events, the
+days-to-full linear fit firing the capacity_forecast alert pair during a
+fill burst and clearing itself after a deletion, the master-side
+HeatRollup over heartbeat-carried per-volume counters, the
+quantile_from_bucket_rates +Inf-mass clamp, the /debug/usage and
+/debug/heat routes (200 + proc on every role, 400 on malformed), and the
+cluster.heat / cluster.why <collection> shell surfaces.
+"""
+
+import math
+import random
+import sys
+
+import pytest
+
+from seaweedfs_tpu.server.httpd import get_json, http_request
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.shell.env import ShellError
+from seaweedfs_tpu.stats import alerts as alerts_mod
+from seaweedfs_tpu.stats import events
+from seaweedfs_tpu.stats import heat as heat_mod
+from seaweedfs_tpu.stats import usage as usage_mod
+from seaweedfs_tpu.stats.history import (
+    MetricsHistory,
+    quantile_from_bucket_rates,
+)
+from seaweedfs_tpu.stats.metrics import Registry
+
+
+class TestSpaceSaving:
+    def test_k_must_be_positive(self):
+        with pytest.raises(ValueError):
+            usage_mod.SpaceSaving(0)
+
+    def test_exact_below_capacity(self):
+        sk = usage_mod.SpaceSaving(8)
+        for key, inc in (("a", 3.0), ("b", 1.0), ("a", 2.0)):
+            assert sk.offer(key, inc) is None
+        assert sk.top() == [("a", 5.0, 0.0), ("b", 1.0, 0.0)]
+        assert sk.other == 0 and sk.evictions == 0 and sk.error_bound == 0
+
+    def test_eviction_folds_into_other_and_bounds_error(self):
+        sk = usage_mod.SpaceSaving(2)
+        sk.offer("a", 5.0)
+        sk.offer("b", 2.0)
+        # full: the newcomer displaces the min-count key, inherits its
+        # count as both head start and error bound
+        assert sk.offer("c", 1.0) == "b"
+        assert sk.counts == {"a": 5.0, "c": 3.0}
+        assert sk.errs["c"] == 2.0
+        assert sk.other == 2.0
+        assert sk.evictions == 1
+        assert sk.error_bound == 2.0
+
+    def test_property_invariants_adversarial_orders(self):
+        """count - err <= true <= count for every tracked key, err never
+        exceeds the exported error_bound — under sorted, reversed,
+        interleaved and shuffled arrival orders."""
+        rng = random.Random(0xbeef)
+        base = [(f"t{i:02d}", float(1 + i * 3)) for i in range(40)]
+        orders = {
+            "sorted": sorted(base, key=lambda kv: kv[1]),
+            "reversed": sorted(base, key=lambda kv: -kv[1]),
+            "interleaved": [kv for pair in zip(base[::2], base[1::2])
+                            for kv in pair],
+        }
+        for name in ("shuffle1", "shuffle2", "shuffle3"):
+            o = list(base)
+            rng.shuffle(o)
+            orders[name] = o
+        for name, order in orders.items():
+            sk = usage_mod.SpaceSaving(8)
+            true: dict[str, float] = {}
+            # adversarial unit-increment stream: each weight arrives as
+            # many singleton offers, interleaved round-robin
+            stream = []
+            for key, weight in order:
+                stream.extend([key] * int(weight))
+            rng.shuffle(stream)
+            for key in stream:
+                true[key] = true.get(key, 0.0) + 1.0
+                sk.offer(key, 1.0)
+            assert len(sk.counts) <= 8, name
+            total = sum(true.values())
+            assert sum(sk.counts.values()) == pytest.approx(total), name
+            for key, count in sk.counts.items():
+                err = sk.errs[key]
+                t = true.get(key, 0.0)
+                assert count - err <= t + 1e-9, (name, key)
+                assert t <= count + 1e-9, (name, key)
+                assert err <= sk.error_bound + 1e-9, (name, key)
+
+    def test_memory_stays_o_k_under_10x_cardinality(self):
+        """The acceptance bar: 10x-K distinct collections must not grow
+        the sketch past K entries (that is the whole point)."""
+        k = 16
+        sk = usage_mod.SpaceSaving(k)
+        for i in range(10 * k):
+            sk.offer(f"tenant-{i}", float(1 + i % 7))
+        assert len(sk.counts) <= k
+        assert len(sk.errs) <= k
+        assert sk.evictions == 10 * k - k
+        assert sk.other > 0
+        # the container footprint itself is bounded, not just len()
+        assert sys.getsizeof(sk.counts) < sys.getsizeof(
+            dict.fromkeys(range(4 * k)))
+
+
+class TestUsageAccountant:
+    def test_record_and_snapshot(self):
+        acct = usage_mod.UsageAccountant(k=8)
+        acct.record("acme", bytes_in=100.0)
+        acct.record("acme", bytes_out=50.0)
+        acct.record("globex", error=True)
+        acct.record("")  # empty collection -> "default"
+        snap = acct.snapshot()
+        assert snap["k"] == 8
+        by_coll = {r["collection"]: r for r in snap["tenants"]}
+        assert by_coll["acme"]["requests"] == 2.0
+        assert by_coll["acme"]["bytes_in"] == 100.0
+        assert by_coll["acme"]["bytes_out"] == 50.0
+        assert by_coll["globex"]["errors"] == 1.0
+        assert "default" in by_coll
+        assert snap["tracked"] == 3 and snap["evictions"] == 0
+        # n caps the rows, highest-requests first
+        snap2 = acct.snapshot(n=1)
+        assert len(snap2["tenants"]) == 1
+        assert snap2["tenants"][0]["collection"] == "acme"
+
+    def test_overflow_emits_once_per_tenant(self):
+        events.recorder().enable()
+        rec = events.recorder()
+        import time as time_mod
+        t0 = time_mod.time() - 0.001
+        acct = usage_mod.UsageAccountant(k=1)
+        acct.record("a")
+        acct.record("b")  # evicts a -> journal
+        acct.record("a")  # evicts b -> journal
+        acct.record("b")  # evicts a AGAIN -> deduped, no second event
+        acct.record("a")  # evicts b AGAIN -> deduped
+        got = [e for e in rec.events(type="tenant_overflow", since=t0)
+               if e["attrs"].get("k") == 1]
+        assert sorted(e["attrs"]["collection"] for e in got) == ["a", "b"]
+        assert all(e["attrs"]["k"] == 1 for e in got)
+
+    def test_engine_deltas_folded_not_cumulative(self):
+        """The native feed folds counter DELTAS vs the engine's previous
+        snapshot — scraping twice must not double-count."""
+        class FakeEngine:
+            def __init__(self):
+                self.rows = {"hot": {"reads": 10, "writes": 5, "deletes": 0,
+                                     "read_bytes": 1000, "write_bytes": 500}}
+
+            def usage_metrics(self):
+                return {c: dict(r) for c, r in self.rows.items()}
+
+        acct = usage_mod.UsageAccountant(k=8)
+        eng = FakeEngine()
+        acct.attach_engine(eng)
+        snap = acct.snapshot()
+        row = next(r for r in snap["tenants"] if r["collection"] == "hot")
+        assert row["requests"] == 15.0
+        assert row["bytes_in"] == 500.0 and row["bytes_out"] == 1000.0
+        # unchanged engine counters -> no growth
+        row = next(r for r in acct.snapshot()["tenants"]
+                   if r["collection"] == "hot")
+        assert row["requests"] == 15.0
+        # +3 reads -> +3, not +18
+        eng.rows["hot"]["reads"] = 13
+        row = next(r for r in acct.snapshot()["tenants"]
+                   if r["collection"] == "hot")
+        assert row["requests"] == 18.0
+        acct.detach_engine(eng)
+        eng.rows["hot"]["reads"] = 1000
+        row = next(r for r in acct.snapshot()["tenants"]
+                   if r["collection"] == "hot")
+        assert row["requests"] == 18.0  # detached: no further folding
+
+    def test_lines_exposition_shape(self):
+        acct = usage_mod.UsageAccountant(k=2)
+        acct.record("a", bytes_in=10.0)
+        acct.record("a")
+        acct.record("b")
+        acct.record("c")  # evicts b (the unambiguous min) -> _other mass
+        text = "\n".join(acct.lines())
+        assert "# TYPE SeaweedFS_usage_requests_total counter" in text
+        assert 'SeaweedFS_usage_requests_total{collection="a"}' in text
+        assert 'collection="_other"' in text
+        assert "SeaweedFS_usage_tracked_collections 2" in text
+        assert "SeaweedFS_usage_error_bound" in text
+        assert "SeaweedFS_usage_overflow_total 1" in text
+
+
+def _heat_fixture(promote=10.0, demote=2.0):
+    reg = Registry()
+    hist = MetricsHistory(reg, interval=1.0, slots=200)
+    c = reg.counter("SeaweedFS_volume_fastlane_volume_requests_total", "",
+                    ("server", "volume", "op"))
+    eng = heat_mod.HeatEngine(history=hist, alpha=0.3, window=60.0,
+                              promote=promote, demote=demote)
+    return reg, hist, c, eng
+
+
+class TestHeatEngine:
+    def test_demote_must_not_exceed_promote(self):
+        with pytest.raises(ValueError):
+            heat_mod.HeatEngine(history=MetricsHistory(Registry()),
+                                promote=5.0, demote=6.0)
+
+    def test_ewma_scores_separate_hot_from_cold(self):
+        events.recorder().enable()
+        rec = events.recorder()
+        import time as time_mod
+        t0 = time_mod.time() - 0.001
+        _, hist, c, eng = _heat_fixture()
+        # the first scrape must be at t > 0 for new counter series to
+        # zero-seed (the ring treats last_scrape == 0 as "never scraped")
+        hist.scrape_once(now=1.0)
+        c.labels("n1:1", "7", "read").inc(1000)   # ~100 ops/s
+        c.labels("n1:1", "8", "read").inc(5)      # ~0.5 ops/s
+        hist.scrape_once(now=11.0)
+        eng.observe(now=11.0)
+        snap = eng.snapshot()
+        by_vol = {v["volume"]: v for v in snap["volumes"]}
+        assert by_vol["7"]["score"] > 10 * by_vol["8"]["score"]
+        assert by_vol["7"]["hot"] and not by_vol["8"]["hot"]
+        assert snap["volumes"][0]["volume"] == "7"  # hottest first
+        promoted = [e for e in rec.events(type="heat_promoted", since=t0)
+                    if e["volume"] == 7]
+        assert promoted and promoted[0]["node"] == "n1:1"
+        assert promoted[0]["attrs"]["score"] >= eng.promote
+        text = "\n".join(eng.lines())
+        assert "# TYPE SeaweedFS_volume_heat_score gauge" in text
+        assert 'server="n1:1"' in text and 'volume="7"' in text
+
+    def test_quiet_series_decays_and_demotes(self):
+        events.recorder().enable()
+        rec = events.recorder()
+        import time as time_mod
+        t0 = time_mod.time() - 0.001
+        _, hist, c, eng = _heat_fixture()
+        hist.scrape_once(now=1.0)
+        c.labels("n2:1", "9", "write").inc(500)   # ~50 ops/s -> hot
+        hist.scrape_once(now=11.0)
+        eng.observe(now=11.0)
+        assert eng.snapshot()["volumes"][0]["hot"]
+        # traffic stops: the rate window empties, the score decays
+        # through the demote threshold, the edge is journaled, and the
+        # entry eventually evaporates instead of freezing stale
+        now = 11.0
+        for _ in range(40):
+            now += 70.0  # past the rate window
+            hist.scrape_once(now=now)
+            eng.observe(now=now)
+            if not eng.snapshot()["volumes"]:
+                break
+        assert eng.snapshot()["volumes"] == []
+        demoted = [e for e in rec.events(type="heat_demoted", since=t0)
+                   if e["volume"] == 9]
+        assert demoted and demoted[0]["node"] == "n2:1"
+
+
+class TestLinearSlope:
+    def test_exact_fit(self):
+        pts = [(0.0, 5.0), (10.0, 25.0), (20.0, 45.0)]
+        assert heat_mod.linear_slope(pts) == pytest.approx(2.0)
+
+    def test_degenerate(self):
+        assert heat_mod.linear_slope([]) is None
+        assert heat_mod.linear_slope([(0, 1), (1, 2)]) is None
+        assert heat_mod.linear_slope([(5, 1), (5, 2), (5, 3)]) is None
+
+
+class TestCapacityForecast:
+    def _fill_fixture(self):
+        reg = Registry()
+        hist = MetricsHistory(reg, interval=1.0, slots=200)
+        used = reg.gauge("SeaweedFS_volume_disk_used_bytes", "",
+                         ("server", "dir"))
+        free = reg.gauge("SeaweedFS_volume_disk_free_bytes", "",
+                         ("server", "dir"))
+        eng = heat_mod.HeatEngine(history=hist)
+        reg.register_collector(eng.lines, names=heat_mod.HEAT_FAMILIES)
+        return reg, hist, used, free, eng
+
+    def test_fill_burst_fires_alert_then_deletion_clears_it(self):
+        """The acceptance chain: a 1 MB/s fill with 2 days of free space
+        -> SeaweedFS_node_days_to_full ~= 2 -> capacity_forecast warning
+        AND critical fire; a mass deletion flattens the fit -> the gauge
+        disappears -> both alerts clear."""
+        reg, hist, used, free, eng = self._fill_fixture()
+        free.labels("n1:1", "/data").set(2 * 86400 * 1e6)  # 2 days @ 1MB/s
+        for now in (0.0, 60.0, 120.0):
+            used.labels("n1:1", "/data").set(now * 1e6)
+            hist.scrape_once(now=now)
+        eng.observe(now=120.0)
+        snap = eng.snapshot()
+        assert len(snap["forecast"]) == 1
+        f = snap["forecast"][0]
+        assert f["node"] == "n1:1" and f["dir"] == "/data"
+        assert f["days_to_full"] == pytest.approx(2.0, rel=0.05)
+        text = "\n".join(eng.lines())
+        assert "# TYPE SeaweedFS_node_days_to_full gauge" in text
+        assert 'node="n1:1"' in text
+        # the collector's gauge rides the ring into the alert pair
+        hist.scrape_once(now=121.0)
+        alert_eng = alerts_mod.AlertEngine(history=hist, registry=reg)
+        try:
+            fired = alert_eng.evaluate(now=121.0)
+            assert "capacity_forecast" in fired
+            assert fired["capacity_forecast"]["severity"] == "warning"
+            assert "n1:1 /data full in" in fired["capacity_forecast"]["detail"]
+            assert "capacity_forecast_critical" in fired  # 2d < 3d horizon
+            # deletion: usage drops, the positive-slope gate empties the
+            # forecast, the gauge vanishes from the next scrapes, and
+            # require_current latests() lets both alerts clear
+            for now in (180.0, 240.0, 300.0):
+                used.labels("n1:1", "/data").set(max(0.0, 1e6 * (300 - now)))
+                hist.scrape_once(now=now)
+            eng.observe(now=300.0)
+            assert eng.snapshot()["forecast"] == []
+            hist.scrape_once(now=301.0)
+            hist.scrape_once(now=302.0)
+            fired = alert_eng.evaluate(now=302.0)
+            assert "capacity_forecast" not in fired
+            assert "capacity_forecast_critical" not in fired
+        finally:
+            alert_eng.close()
+
+    def test_slow_fill_beyond_horizon_stays_quiet(self):
+        reg, hist, used, free, eng = self._fill_fixture()
+        free.labels("n1:1", "/data").set(400 * 86400 * 1e6)  # 400 days out
+        for now in (0.0, 60.0, 120.0):
+            used.labels("n1:1", "/data").set(now * 1e6)
+            hist.scrape_once(now=now)
+        eng.observe(now=120.0)
+        assert eng.snapshot()["forecast"][0]["days_to_full"] > 300
+        hist.scrape_once(now=121.0)
+        alert_eng = alerts_mod.AlertEngine(history=hist, registry=reg)
+        try:
+            fired = alert_eng.evaluate(now=121.0)
+            assert "capacity_forecast" not in fired
+        finally:
+            alert_eng.close()
+
+
+class TestHeatRollup:
+    def test_heartbeat_deltas_become_collection_rates(self):
+        ru = heat_mod.HeatRollup(alpha=0.3)
+        beat1 = [{"id": 1, "collection": "hot", "read_ops": 0,
+                  "write_ops": 0},
+                 {"id": 2, "collection": "", "read_ops": 0, "write_ops": 0}]
+        ru.feed("n1:8080", beat1, now=0.0)
+        assert ru.snapshot() == {"collections": [], "nodes": []}  # no delta yet
+        beat2 = [{"id": 1, "collection": "hot", "read_ops": 800,
+                  "write_ops": 200},
+                 {"id": 2, "collection": "", "read_ops": 40, "write_ops": 10}]
+        ru.feed("n1:8080", beat2, now=10.0)
+        snap = ru.snapshot()
+        by_coll = {c["collection"]: c["score"] for c in snap["collections"]}
+        assert by_coll["hot"] == pytest.approx(100.0)
+        assert by_coll["default"] == pytest.approx(5.0)  # "" -> default
+        assert snap["nodes"][0]["node"] == "n1:8080"
+        assert snap["nodes"][0]["score"] == pytest.approx(105.0)
+        text = "\n".join(ru.lines())
+        assert 'SeaweedFS_heat_collection_score{collection="hot"}' in text
+        assert 'SeaweedFS_heat_node_score{node="n1:8080"}' in text
+
+    def test_counter_reset_and_expiry(self):
+        ru = heat_mod.HeatRollup(alpha=1.0, expire=60.0)
+        ru.feed("n1:1", [{"id": 1, "collection": "x", "read_ops": 1000,
+                          "write_ops": 0}], now=0.0)
+        # restart: cumulative ops went BACKWARD -> treat as fresh count
+        ru.feed("n1:1", [{"id": 1, "collection": "x", "read_ops": 50,
+                          "write_ops": 0}], now=10.0)
+        by_coll = {c["collection"]: c["score"]
+                   for c in ru.snapshot()["collections"]}
+        assert by_coll["x"] == pytest.approx(5.0)
+        # a second node keeps beating; the first goes silent past expire
+        ru.feed("n2:1", [{"id": 9, "collection": "y", "read_ops": 0,
+                          "write_ops": 0}], now=50.0)
+        ru.feed("n2:1", [{"id": 9, "collection": "y", "read_ops": 100,
+                          "write_ops": 0}], now=100.0)
+        names = {c["collection"] for c in ru.snapshot()["collections"]}
+        assert names == {"y"}
+
+
+class TestQuantileInfMass:
+    def test_inf_mass_clamps_to_largest_finite_bound(self):
+        """p99 mass in the overflow bucket must not render a fictitious
+        finite latency: the clamp returns the largest finite bound as a
+        LOWER bound and flags it."""
+        rates = {0.1: 1.0, 1.0: 2.0, math.inf: 100.0}
+        flags: dict = {}
+        val = quantile_from_bucket_rates(rates, 0.99, flags=flags)
+        assert val == 1.0
+        assert flags.get("inf_mass") is True
+
+    def test_finite_mass_not_flagged(self):
+        rates = {0.1: 50.0, 1.0: 100.0, math.inf: 100.0}
+        flags: dict = {}
+        val = quantile_from_bucket_rates(rates, 0.5, flags=flags)
+        assert 0.0 < val <= 0.1
+        assert "inf_mass" not in flags
+
+    def test_only_inf_bucket_returns_none_still_flagged(self):
+        flags: dict = {}
+        assert quantile_from_bucket_rates(
+            {math.inf: 10.0}, 0.99, flags=flags) is None
+        assert flags.get("inf_mass") is True
+
+    def test_flags_optional(self):
+        assert quantile_from_bucket_rates(
+            {0.1: 1.0, math.inf: 10.0}, 0.99) == 0.1
+
+
+@pytest.fixture(scope="class")
+def heat_cluster(tmp_path_factory):
+    """master + volume + filer in one process: the three roles the
+    /debug/usage + /debug/heat routes and cluster.heat are asserted on."""
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    tmp = tmp_path_factory.mktemp("heatstack")
+    master = MasterServer(port=0, pulse_seconds=1, volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer([str(tmp / "v0")], master.url, port=0, rack="r0",
+                      pulse_seconds=1, max_volume_count=30)
+    vs.start()
+    filer = FilerServer(master.url, port=0, chunk_size_mb=1)
+    filer.start()
+    env = CommandEnv(master.url)
+    yield {"master": master, "vs": vs, "filer": filer, "env": env}
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+class TestDebugRoutes:
+    def test_usage_and_heat_served_on_every_role(self, heat_cluster):
+        urls = [heat_cluster["master"].url, heat_cluster["vs"].service.url,
+                heat_cluster["filer"].service.url]
+        for url in urls:
+            out = get_json(f"{url}/debug/usage")
+            assert out["proc"] and "tenants" in out and "k" in out
+            assert "error_bound" in out
+            out = get_json(f"{url}/debug/heat")
+            assert out["proc"] and "volumes" in out and "forecast" in out
+
+    def test_filer_traffic_lands_in_the_accountant(self, heat_cluster):
+        filer = heat_cluster["filer"]
+        st, _, _ = http_request(
+            "POST", f"{filer.service.url}/b/obj1?collection=acmetest",
+            b"x" * 1000)
+        assert st in (200, 201)
+        st, _, body = http_request(
+            "GET", f"{filer.service.url}/b/obj1?collection=acmetest")
+        assert st == 200 and body == b"x" * 1000
+        out = get_json(f"{filer.service.url}/debug/usage")
+        row = next(r for r in out["tenants"]
+                   if r["collection"] == "acmetest")
+        assert row["requests"] >= 2
+        assert row.get("bytes_in", 0) >= 1000
+        assert row.get("bytes_out", 0) >= 1000
+
+    def test_master_rollup_appears_in_debug_heat(self, heat_cluster):
+        master = heat_cluster["master"]
+        # the heartbeat loop has been feeding the rollup since start();
+        # the per-volume counters only produce a rate once traffic flowed
+        out = get_json(f"{master.url}/debug/heat")
+        # rollup block present only when rates exist — but the route must
+        # always answer with the engine view
+        assert "volumes" in out and "forecast" in out
+
+    def test_malformed_n_returns_400(self, heat_cluster):
+        url = heat_cluster["master"].url
+        for path in ("/debug/usage?n=0", "/debug/usage?n=abc",
+                     "/debug/heat?n=-3", "/debug/heat?n=banana"):
+            status, _, body = http_request("GET", url + path)
+            assert status == 400, path
+            assert b"positive integer" in body, path
+
+    def test_n_caps_rows(self, heat_cluster):
+        filer = heat_cluster["filer"]
+        for i in range(3):
+            http_request("POST",
+                         f"{filer.service.url}/b/o{i}?collection=cap{i}",
+                         b"y")
+        out = get_json(f"{filer.service.url}/debug/usage?n=2")
+        assert len(out["tenants"]) <= 2
+
+
+class TestClusterHeatVerb:
+    def test_renders_tenants_and_forecast_sections(self, heat_cluster):
+        filer = heat_cluster["filer"]
+        for i in range(3):
+            http_request("POST",
+                         f"{filer.service.url}/b/hv{i}?collection=verbt",
+                         b"z" * 100)
+        # the process-wide accountant carries every suite-run tenant, so
+        # ask for enough rows that a 3-request tenant can't be cut off
+        out = run_command(heat_cluster["env"], "cluster.heat -n 99")
+        assert "cluster.heat @" in out
+        assert "tenants (top" in out
+        assert "verbt" in out
+        assert "days-to-full" in out  # section renders even when empty
+
+    def test_out_flag_writes_report(self, heat_cluster, tmp_path):
+        dest = tmp_path / "heat.txt"
+        out = run_command(heat_cluster["env"], f"cluster.heat -out {dest}")
+        assert f"report written to {dest}" in out
+        assert "tenants (top" in dest.read_text()
+
+    def test_bad_n_raises_usage(self, heat_cluster):
+        with pytest.raises(ShellError, match="usage"):
+            run_command(heat_cluster["env"], "cluster.heat -n nope")
+        with pytest.raises(ShellError, match="usage"):
+            run_command(heat_cluster["env"], "cluster.heat -n 0")
+
+    def test_cluster_why_collection_timeline(self, heat_cluster):
+        events.recorder().enable()
+        events.emit("tenant_overflow", collection="whytenant", k=4)
+        out = run_command(heat_cluster["env"], "cluster.why whytenant")
+        assert "cluster.why collection 'whytenant'" in out
+        assert "tenant_overflow" in out

@@ -36,7 +36,7 @@ import sys
 NAME_RE = re.compile(
     r"^SeaweedFS_"
     r"(master|volume|filer|s3|http|stats|mount|mq|iam|alerts|process"
-    r"|maintenance|faults|events|slo)_"
+    r"|maintenance|faults|events|slo|usage|heat|node)_"
     r"[a-z][a-z0-9]*(_[a-z0-9]+)*$"
 )
 
@@ -103,6 +103,8 @@ def collect() -> tuple[dict[str, str], list[str]]:
     from seaweedfs_tpu.server.filer import FilerServer
 
     from seaweedfs_tpu.stats import events as events_mod
+    from seaweedfs_tpu.stats import heat as heat_mod
+    from seaweedfs_tpu.stats import usage as usage_mod
 
     collector_names = sorted(
         set(MasterServer.MASTER_METRIC_FAMILIES)
@@ -116,6 +118,9 @@ def collect() -> tuple[dict[str, str], list[str]]:
         | set(alerts.SLO_FAMILIES)
         | set(events_mod.EVENT_FAMILIES)
         | set(maintenance.MAINTENANCE_FAMILIES)
+        | set(usage_mod.USAGE_FAMILIES)
+        | set(heat_mod.HEAT_FAMILIES)
+        | set(heat_mod.ROLLUP_FAMILIES)
     )
     return kinds, collector_names
 
@@ -496,6 +501,44 @@ def degraded_reason_violations() -> list[str]:
     return bad
 
 
+def usage_heat_violations() -> list[str]:
+    """The tenant/heat telemetry contract: every usage/heat family
+    declared, the sketch's _other sentinel reserved (a real collection
+    named `_other` would alias the overflow row), the three heat/usage
+    event types registered, and the capacity-forecast alert pair present
+    with the right severities — so a renamed gauge can't silently
+    un-wire cluster.check's days-to-full failure mode."""
+    from seaweedfs_tpu.stats import alerts
+    from seaweedfs_tpu.stats import events as events_mod
+    from seaweedfs_tpu.stats import heat as heat_mod
+    from seaweedfs_tpu.stats import usage as usage_mod
+
+    bad: list[str] = []
+    for fam in (*usage_mod.USAGE_FAMILIES, *heat_mod.HEAT_FAMILIES,
+                *heat_mod.ROLLUP_FAMILIES):
+        if fam in SPECIAL_NAMES:
+            continue
+        if not NAME_RE.match(fam):
+            bad.append(f"usage/heat family {fam!r}: does not match"
+                       f" SeaweedFS_<subsystem>_<snake_case>")
+    if not usage_mod.OTHER.startswith("_"):
+        bad.append(f"usage overflow sentinel {usage_mod.OTHER!r}: must"
+                   f" start with '_' (real collections are snake_case)")
+    if usage_mod.DEFAULT_K < 1:
+        bad.append(f"usage DEFAULT_K {usage_mod.DEFAULT_K}: must be >= 1")
+    for ev in ("tenant_overflow", "heat_promoted", "heat_demoted"):
+        if ev not in events_mod.EVENT_TYPES:
+            bad.append(f"event type {ev!r}: missing from the flight"
+                       f" recorder registry")
+    severities = {r.name: r.severity for r in alerts.default_rules()}
+    if severities.get("capacity_forecast") != "warning":
+        bad.append("alert rule capacity_forecast: missing or not warning")
+    if severities.get("capacity_forecast_critical") != "critical":
+        bad.append("alert rule capacity_forecast_critical: missing or"
+                   " not critical")
+    return bad
+
+
 def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
     bad: list[str] = []
     for name in sorted(set(kinds) | set(collector_names)):
@@ -522,7 +565,8 @@ def main() -> int:
         + ec_online_reason_violations() + fault_point_violations() \
         + degraded_reason_violations() + repair_reason_violations() \
         + stream_lazy_violations() \
-        + event_type_violations() + slo_violations() + scrub_violations()
+        + event_type_violations() + slo_violations() + scrub_violations() \
+        + usage_heat_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
         print(f"{len(bad)} metric-name violation(s) in {total} families:")
