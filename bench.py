@@ -1935,6 +1935,128 @@ def bench_tenant_usage(n_colls: int = 640, k: int = 64) -> dict:
     return out
 
 
+def bench_cluster_telemetry(gateways: int = 4, tenants: int = 200,
+                            frames: int = 200) -> dict:
+    """PR-18: cluster telemetry plane acceptance.
+
+    * frame economics — a realistic gateway registry (per-role request
+      counters + latency histogram + a K=64 usage sketch over `tenants`
+      collections) serialized as a telemetry frame, against the full
+      /metrics exposition the old N-endpoint fan-out shipped per poll;
+    * merge overhead — `frames` frames from `gateways` synthetic senders
+      through TelemetryAggregator.ingest: per-frame ingest wall cost,
+      the aggregator's own merge_seconds accounting, and the one-fetch
+      snapshot (GET /debug/cluster/telemetry body) cost;
+    * live frame age — a real TelemetryPusher on a 200ms cadence against
+      a real master, frame age sampled from the one-fetch endpoint:
+      p50/p99 of how stale the master's view of the sender is.
+    """
+    import json as json_mod
+    import random as random_mod
+
+    from seaweedfs_tpu.server.httpd import get_json
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.stats import aggregate as agg_mod
+    from seaweedfs_tpu.stats import usage as usage_mod
+    from seaweedfs_tpu.stats.metrics import Registry
+
+    def pct(sorted_vals, q):
+        if not sorted_vals:
+            return None
+        i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+        return sorted_vals[i]
+
+    out: dict = {"gateways": gateways, "tenants": tenants, "frames": frames}
+
+    # --- frame economics: bytes/frame vs the full exposition ----------------
+    rng = random_mod.Random(0x18)
+    reg = Registry()
+    req = reg.counter("SeaweedFS_http_request_total", "requests",
+                      ("role", "method", "code"))
+    lat = reg.histogram("SeaweedFS_http_request_seconds", "latency",
+                        ("role", "method"))
+    for role in ("s3", "filer"):
+        for method in ("GET", "PUT", "DELETE", "HEAD"):
+            for code in ("200", "204", "404", "500"):
+                req.labels(role, method, code).inc(rng.randrange(1, 5000))
+            for _ in range(50):
+                lat.labels(role, method).observe(rng.random() * 0.2)
+    acct = usage_mod.UsageAccountant(k=64)
+    for i in range(tenants):
+        acct.record(f"tenant-{i:04d}", requests=float(max(1, 2000 // (i + 1))),
+                    bytes_in=4096.0, bytes_out=8192.0)
+    t0 = time.perf_counter()
+    n_builds = 50
+    for _ in range(n_builds):
+        frame = agg_mod.build_frame("s3", "bench-gw:8333",
+                                    registry=reg, acct=acct)
+    out["build_usec_per_frame"] = round(
+        (time.perf_counter() - t0) / n_builds * 1e6, 1)
+    frame_bytes = len(json_mod.dumps(frame).encode())
+    scrape_bytes = len(reg.render().encode())
+    out["frame_bytes"] = frame_bytes
+    out["scrape_bytes"] = scrape_bytes
+    out["frame_vs_scrape_ratio"] = round(frame_bytes / max(1, scrape_bytes), 4)
+    assert frame_bytes < scrape_bytes, \
+        "a telemetry frame must undercut the full exposition it replaces"
+
+    # --- merge overhead per frame at the aggregator -------------------------
+    ag = agg_mod.TelemetryAggregator()
+    base = time.time() - frames / gateways
+    t0 = time.perf_counter()
+    for i in range(frames):
+        g = i % gateways
+        t = base + (i // gateways)
+        f = dict(frame)
+        f.update(node=f"gw{g}:8333", proc=f"bench-proc-{g}",
+                 seq=i // gateways + 1, ts=t)
+        # counters must advance between frames for rates to exist
+        f["samples"] = [[n, dict(l), v * (1.0 + 0.05 * (i // gateways))]
+                        for n, l, v in frame["samples"]]
+        assert ag.ingest(f, now=t)
+    ingest_wall = time.perf_counter() - t0
+    out["ingest_usec_per_frame"] = round(ingest_wall / frames * 1e6, 1)
+    out["merge_usec_per_frame"] = round(
+        ag.merge_seconds / max(1, ag.frames_total) * 1e6, 1)
+    t0 = time.perf_counter()
+    snap = ag.snapshot(now=base + frames / gateways)
+    out["one_fetch_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+    assert len(snap["senders"]) == gateways
+    top = snap["usage"]["tenants"][0]
+    # every gateway shipped the same sketch proc-distinct: merged top
+    # count must still be bracketed by the composed bound vs gateways x
+    # the per-gateway true count of tenant-0000
+    true_top = 2000.0 * gateways
+    assert top["requests"] - top.get("requests_err", 0.0) <= true_top + 1e-6
+    assert true_top <= top["requests"] + snap["usage"]["error_bound"] + 1e-6
+
+    # --- live frame age at the master ---------------------------------------
+    master = MasterServer(port=0, pulse_seconds=1)
+    master.start()
+    pusher = agg_mod.TelemetryPusher("s3", "bench-gw:8333", master.url,
+                                     interval=0.2)
+    try:
+        pusher.start()
+        deadline = time.time() + 2.5
+        ages = []
+        while time.time() < deadline:
+            tele = get_json(f"{master.url}/debug/cluster/telemetry")
+            s = tele.get("senders", {}).get("bench-gw:8333")
+            if s is not None:
+                ages.append(s["age"])
+            time.sleep(0.1)
+    finally:
+        pusher.stop()
+        master.stop()
+    ages.sort()
+    out["frame_age_samples"] = len(ages)
+    out["frame_age_p50_s"] = round(pct(ages, 0.50), 3) if ages else None
+    out["frame_age_p99_s"] = round(pct(ages, 0.99), 3) if ages else None
+    assert ages and out["frame_age_p99_s"] < 5.0, \
+        "pushed frames never became visible/fresh at the master"
+    return out
+
+
 def bench_hash_1m_4k(
     total_blobs: int = 1_000_000, slab: int = 65536, device: bool = True
 ) -> dict:
@@ -2156,6 +2278,12 @@ def main() -> None:
         detail["tenant_usage"] = bench_tenant_usage()
     except Exception as e:
         detail["tenant_usage"] = {"error": str(e)[:120]}
+    # PR-18: telemetry frame economics vs full-scrape fan-out, per-frame
+    # merge overhead at the aggregator, live frame age at the master
+    try:
+        detail["cluster_telemetry"] = bench_cluster_telemetry()
+    except Exception as e:
+        detail["cluster_telemetry"] = {"error": str(e)[:120]}
     # end-of-run per-kernel attribution over EVERYTHING this process ran
     # (verb trials + rebuild + hash benches), from the shared registry
     try:
@@ -2297,6 +2425,8 @@ def summary_line(
             "rebuild_wallclock_regressed": (
                 detail.get("rebuild_bandwidth", {})
                 .get("wallclock_guard") or {}).get("regressed"),
+            "cluster_frame_vs_scrape": detail.get(
+                "cluster_telemetry", {}).get("frame_vs_scrape_ratio"),
             "note": "host GFNI engine carries the verb (DRAM-bound ~4GB/s;"
             " chip link dead — see device_status); detail in"
             " BENCH_full.json",

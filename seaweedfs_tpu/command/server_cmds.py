@@ -356,7 +356,8 @@ def run_server(args: list[str]) -> int:
             if opts.s3_config:
                 with open(opts.s3_config) as fh:
                     config = _json.load(fh)
-            s3 = S3Server(f.url, host=opts.ip, port=opts.s3_port, config=config)
+            s3 = S3Server(f.url, host=opts.ip, port=opts.s3_port,
+                          config=config, master_url=m.url)
             s3.start()
             print(f"s3 gateway listening at {s3.url}")
     return _wait_forever()
@@ -389,6 +390,9 @@ def run_s3(args: list[str]) -> int:
     p.add_argument("-port", type=int, default=8333)
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-master", default="",
+                   help="master url: ship telemetry frames (usage sketch +"
+                        " SLO counters) to the cluster aggregator")
     p.add_argument("-config", default=None, help="identities json (s3.json)")
     p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
                    help="log requests slower than this many ms for this "
@@ -408,8 +412,11 @@ def run_s3(args: list[str]) -> int:
     filer = opts.filer
     if not filer.startswith("http"):
         filer = peer_url(filer)
+    master = opts.master
+    if master and not master.startswith("http"):
+        master = peer_url(master)
     s3 = S3Server(filer, host=opts.ip, port=opts.port, config=config,
-                  slow_ms=opts.slow_ms)
+                  slow_ms=opts.slow_ms, master_url=master or None)
     s3.start()
     print(f"s3 gateway listening at {s3.url}")
     return _wait_forever()
@@ -421,6 +428,9 @@ def run_webdav(args: list[str]) -> int:
     p.add_argument("-port", type=int, default=7333)
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-filer", default="http://127.0.0.1:8888")
+    p.add_argument("-master", default="",
+                   help="master url: ship telemetry frames (usage sketch +"
+                        " SLO counters) to the cluster aggregator")
     p.add_argument("-readOnly", action="store_true")
     p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
                    help="log requests slower than this many ms for this "
@@ -432,8 +442,12 @@ def run_webdav(args: list[str]) -> int:
     filer = opts.filer
     if not filer.startswith("http"):
         filer = peer_url(filer)
+    master = opts.master
+    if master and not master.startswith("http"):
+        master = peer_url(master)
     srv = WebDavServer(filer, host=opts.ip, port=opts.port,
-                       read_only=opts.readOnly, slow_ms=opts.slow_ms)
+                       read_only=opts.readOnly, slow_ms=opts.slow_ms,
+                       master_url=master or None)
     srv.start()
     print(f"webdav listening at {srv.url}")
     return _wait_forever()

@@ -79,8 +79,15 @@ class S3Server:
         config: dict | None = None,
         circuit_breaker: CircuitBreaker | None = None,
         slow_ms: float | None = None,
+        master_url: str | None = None,
     ) -> None:
         self.fc = FilerClient(filer_url)
+        # the gateway has no heartbeat/register link of its own, so an
+        # optional master_url starts a TelemetryPusher (stats/aggregate):
+        # without it this process's tenant sketches and 5xx never reach
+        # the cluster aggregate
+        self.master_url = master_url
+        self._telemetry_pusher = None
         self.iam = IdentityAccessManagement()
         if config:
             self.iam.load_config(config)
@@ -268,8 +275,17 @@ class S3Server:
                         pass
 
             threading.Thread(target=sweeper, daemon=True).start()
+        if self.master_url:
+            from seaweedfs_tpu.stats import aggregate as agg_mod
+
+            self._telemetry_pusher = agg_mod.TelemetryPusher(
+                "s3", lambda: self.url, self.master_url)
+            self._telemetry_pusher.start()
 
     def stop(self) -> None:
+        if self._telemetry_pusher is not None:
+            self._telemetry_pusher.stop()
+            self._telemetry_pusher = None
         if self._sweep_stop is not None:
             self._sweep_stop.set()
         if getattr(self, "_fl_reval_stop", None) is not None:

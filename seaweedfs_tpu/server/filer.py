@@ -613,11 +613,20 @@ class FilerServer:
         try:
             from seaweedfs_tpu.server.httpd import http_request
 
+            payload = {"type": "filer", "address": self.url}
+            try:
+                # cluster telemetry frame rides the registration beat
+                # (stats/aggregate.py) — same piggyback the volume
+                # heartbeat uses, no extra connection
+                from seaweedfs_tpu.stats import aggregate as agg_mod
+
+                payload["telemetry"] = agg_mod.build_frame(
+                    "filer", self.url, interval=5.0)
+            except Exception:
+                pass
             http_request(
                 "POST", self.client.master_url + "/cluster/register",
-                body=json.dumps(
-                    {"type": "filer", "address": self.url}
-                ).encode(),
+                body=json.dumps(payload).encode(),
                 headers={"Content-Type": "application/json"}, timeout=5,
             )
         except Exception:

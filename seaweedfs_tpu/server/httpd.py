@@ -548,9 +548,17 @@ def _register_debug_routes(service: "HTTPService") -> None:
             max_samples = int(req.query.get("samples", 16))
             if not math.isfinite(window) or window <= 0:
                 raise ValueError(window)
+            # ?since=<mono_ts>: incremental cursor — ship only samples
+            # after the caller's watermark (the previous response's
+            # "watermark" field), not the full ring every poll
+            since = req.query.get("since")
+            if since is not None:
+                since = float(since)
+                if not math.isfinite(since):
+                    raise ValueError(since)
         except ValueError:
             return Response(
-                {"error": "window/samples must be positive finite numbers"},
+                {"error": "window/samples/since must be finite numbers"},
                 400,
             )
         hist.ensure_fresh()
@@ -561,11 +569,18 @@ def _register_debug_routes(service: "HTTPService") -> None:
             "slots": hist.slots,
             "window": window,
             "scrapes": hist.scrapes_total,
+            # pass this back as ?since= for the next incremental poll.
+            # Unrounded on purpose: sample timestamps are rounded to 3
+            # decimals for display, so a rounded-DOWN watermark could sit
+            # below the exact stored timestamp of the scrape it names and
+            # re-ship that scrape's samples on the next poll.
+            "watermark": hist.last_scrape,
             "proc": prof_mod.PROCESS_TOKEN,  # cluster.top dedup key
             "series": hist.snapshot(
                 family=req.query.get("family") or None,
                 window=window,
                 max_samples=max(0, max_samples),
+                since=since,
             ),
             # histogram exemplars ride here, not in the 0.0.4 text format
             # (which has no exemplar syntax): per (labels, upper bucket),

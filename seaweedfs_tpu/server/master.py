@@ -205,6 +205,17 @@ class MasterServer:
         self._heat_collector = default_registry().register_collector(
             self.heat_rollup.lines, names=heat_mod.ROLLUP_FAMILIES,
         )
+        # cluster telemetry plane: frames ride heartbeats / register
+        # payloads / POST /cluster/telemetry into the leader's aggregator
+        # (stats/aggregate.py); one GET /debug/cluster/telemetry serves
+        # the merged view cluster.top/cluster.check consume
+        from seaweedfs_tpu.stats import aggregate as agg_mod
+
+        self.telemetry = agg_mod.TelemetryAggregator()
+        self._telemetry_collector = default_registry().register_collector(
+            self.telemetry.lines, names=agg_mod.CLUSTER_FAMILIES,
+        )
+        self._telemetry_self_ts = 0.0
 
     def _metrics_lines(self) -> list[str]:
         from seaweedfs_tpu.stats.metrics import _fmt_labels
@@ -436,6 +447,12 @@ class MasterServer:
             self._heat_collector = None
             heat_mod.unregister_rollup(self.heat_rollup)
             self.heat_rollup = None
+        if getattr(self, "_telemetry_collector", None) is not None:
+            from seaweedfs_tpu.stats import default_registry
+
+            default_registry().unregister_collector(self._telemetry_collector)
+            self._telemetry_collector = None
+            self.telemetry = None
         if self.raft is not None:
             self.raft.stop()
         if getattr(self, "fastlane", None) is not None:
@@ -464,10 +481,35 @@ class MasterServer:
                     ).inc(n - last_assigns)
                     last_assigns = n
             self.topo.expire_dead_nodes()
+            self._telemetry_self_feed()
             try:
                 self._vacuum_check()
             except Exception:
                 pass
+
+    def _telemetry_self_feed(self) -> None:
+        """The master is a telemetry sender too — its own frame (role
+        'master') enters the aggregator on the pulse cadence, so the
+        cluster view covers the control plane without a network hop.
+        Rate-limited: the debug handler also calls this on demand."""
+        tele = getattr(self, "telemetry", None)
+        if tele is None:
+            return
+        now = time.time()
+        interval = max(float(self.topo.pulse_seconds), 1.0)
+        if now - self._telemetry_self_ts < interval:
+            return
+        self._telemetry_self_ts = now
+        try:
+            from seaweedfs_tpu.stats import aggregate as agg_mod
+
+            port = self.fastlane.port if getattr(self, "fastlane", None) \
+                else self.service.port
+            tele.ingest(agg_mod.build_frame(
+                "master", f"{self.service.host}:{port}", interval=interval,
+            ), now=now)
+        except Exception:
+            pass
 
     # --- growth ----------------------------------------------------------------
     def _is_ec_online(self, collection: str) -> bool:
@@ -587,6 +629,9 @@ class MasterServer:
                     f"{hb.get('ip', '')}:{hb.get('port', '')}",
                     hb.get("volumes") or (),
                 )
+            tele = hb.get("telemetry")
+            if tele and getattr(self, "telemetry", None) is not None:
+                self.telemetry.ingest(tele)
             # any topology delta may change the writable set: drop every
             # assign profile, the next Python-served assign reinstalls
             self._fl_assign_clear()
@@ -828,7 +873,50 @@ class MasterServer:
                 # longest-lived member leads its group)
                 "created_ts": prev["created_ts"] if prev else time.time(),
             }
+            tele = p.get("telemetry")
+            if tele and getattr(self, "telemetry", None) is not None:
+                self.telemetry.ingest(tele)
             return Response({"ok": True, "leader": self.url})
+
+        @svc.route("POST", r"/cluster/telemetry")
+        def cluster_telemetry_push(req: Request) -> Response:
+            """Telemetry frames from roles with no other master link (S3,
+            webdav, tests). Leader-only like the heartbeat — the response
+            names the leader so pushers re-target."""
+            from seaweedfs_tpu.stats import trace
+
+            trace.annotate(noise=True)  # periodic push chatter
+            if not self._is_leader():
+                return self._not_leader_response()
+            tele = getattr(self, "telemetry", None)
+            if tele is None:
+                return Response({"error": "telemetry not started"}, 503)
+            ok = tele.ingest(req.json())
+            if not ok:
+                return Response(
+                    {"error": "malformed or replayed frame",
+                     "leader": self.leader_url()}, 400)
+            return Response({"ok": True, "leader": self.leader_url()})
+
+        @svc.route("GET", r"/debug/cluster/telemetry")
+        def cluster_telemetry_get(req: Request) -> Response:
+            """The one-fetch cluster state: merged tenants + error bound,
+            per-role rates, cluster SLO burn, per-sender staleness."""
+            tele = getattr(self, "telemetry", None)
+            if tele is None:
+                return Response({"error": "telemetry not started"}, 503)
+            self._telemetry_self_feed()
+            n = req.query.get("n")
+            try:
+                n = int(n) if n else None
+            except ValueError:
+                return Response({"error": "bad n"}, 400)
+            out = tele.snapshot(n=n)
+            out["leader"] = self.leader_url()
+            from seaweedfs_tpu.stats import profiler as prof_mod
+
+            out["proc"] = prof_mod.PROCESS_TOKEN
+            return Response(out)
 
         @svc.route("GET", r"/cluster/leader")
         def cluster_leader(req: Request) -> Response:

@@ -525,6 +525,9 @@ class VolumeServer:
             # volumes a scrub pass holds right now: the master's vacuum
             # detector defers their compaction until the pass moves on
             hb["scrub_active"] = self.scrubber.active_volumes()
+        tele = self._telemetry_frame()
+        if tele is not None:
+            hb["telemetry"] = tele
         body = _json.dumps(hb).encode()
         tried = 0
         rotation = [u for u in self.master_urls if u != self.master_url]
@@ -554,6 +557,26 @@ class VolumeServer:
                 self.master_url = rotation.pop(0)
                 continue
             return
+
+    def _telemetry_frame(self):
+        """Cluster telemetry frame riding the heartbeat body
+        (stats/aggregate.py). Rate-limited to the pulse: heartbeat_once
+        also fires on state changes (mounts, vacuum, rebuilds), and a
+        churn burst must not pay sketch serialization per event."""
+        now = time.time()
+        interval = max(float(self.pulse_seconds), 2.0)
+        if now - getattr(self, "_telemetry_ts", 0.0) < interval:
+            return None
+        self._telemetry_ts = now
+        try:
+            from seaweedfs_tpu.stats import aggregate as agg_mod
+
+            return agg_mod.build_frame(
+                "volume", f"{self._host}:{self.data_port}",
+                interval=interval, now=now,
+            )
+        except Exception:
+            return None
 
     def _active_rebuild_tmps(self) -> set[str]:
         """Tmp shard paths belonging to IN-FLIGHT pipelined rebuilds —

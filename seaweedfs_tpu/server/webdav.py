@@ -37,9 +37,14 @@ def _iso8601(ts: float) -> str:
 class WebDavServer:
     def __init__(self, filer_url: str, host: str = "127.0.0.1",
                  port: int = 7333, read_only: bool = False,
-                 slow_ms: float | None = None) -> None:
+                 slow_ms: float | None = None,
+                 master_url: str | None = None) -> None:
         self.fc = FilerClient(filer_url)
         self.read_only = read_only
+        # like the S3 gateway: no master link of its own, so an optional
+        # master_url ships telemetry frames (stats/aggregate.py)
+        self.master_url = master_url
+        self._telemetry_pusher = None
         self.service = HTTPService(host, port)
         # request metrics + tracing + the /debug and /debug/pprof surface,
         # like every other role. The WebDAV namespace is a catch-all (any
@@ -61,8 +66,17 @@ class WebDavServer:
 
     def start(self) -> None:
         self.service.start()
+        if self.master_url:
+            from seaweedfs_tpu.stats import aggregate as agg_mod
+
+            self._telemetry_pusher = agg_mod.TelemetryPusher(
+                "webdav", lambda: self.url, self.master_url)
+            self._telemetry_pusher.start()
 
     def stop(self) -> None:
+        if self._telemetry_pusher is not None:
+            self._telemetry_pusher.stop()
+            self._telemetry_pusher = None
         self.service.stop()
 
     @property

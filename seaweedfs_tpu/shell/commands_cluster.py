@@ -51,6 +51,20 @@ def _fmt_gb(n: float) -> str:
     return f"{n / 1024**3:.1f}GB"
 
 
+def _fetch_cluster_telemetry(env: CommandEnv, timeout: float = 10):
+    """The master's one-fetch cluster aggregate (stats/aggregate.py), or
+    None when the aggregator isn't live (old master, no senders yet) —
+    callers fall back to the N-endpoint fan-out."""
+    try:
+        out = env.get(f"{env.master_url}/debug/cluster/telemetry",
+                      timeout=timeout)
+    except Exception:
+        return None
+    if not isinstance(out, dict) or not out.get("senders"):
+        return None
+    return out
+
+
 @command("cluster.check",
          "[-fail] [-capacityPct 90] [-include url,url] — health dashboard:"
          " replica/EC health, per-node disk + heartbeat freshness, volumes"
@@ -200,21 +214,42 @@ def cmd_cluster_check(env: CommandEnv, args: list[str]) -> str:
         )
 
     # alerts fire per PROCESS: in a multi-process cluster the filer/s3
-    # engines are separate — poll every OTHER discovered endpoint's
-    # /debug/alerts too (the filer's catch-all main port has no /metrics,
-    # but its debug routes shadow file paths)
-    seen = {env.master_url} | {sv.http for sv in servers}
-    for ep in sorted(_discover_endpoints(env, flags.get("include", ""),
-                                         servers=servers) - seen):
-        try:
-            out = env.get(f"{ep}/debug/alerts", timeout=10)
-        except Exception:
-            continue  # an unreachable gateway must not sink the check
-        for a in out.get("alerts", []):
-            if a.get("firing"):
-                name = a.get("name", "?")
+    # engines are separate. When the master's telemetry aggregator is
+    # live, ONE fetch covers them all — every sender's frame carries its
+    # current alert edges, and the cluster-scope rules (merged SLO burn,
+    # stale senders) only exist there. Fall back to fanning out
+    # /debug/alerts across every discovered endpoint otherwise (the
+    # filer's catch-all main port has no /metrics, but its debug routes
+    # shadow file paths).
+    tele = _fetch_cluster_telemetry(env)
+    if tele is not None:
+        senders = tele.get("senders") or {}
+        stale = sorted(n for n, s in senders.items() if s.get("stale"))
+        lines.append(
+            f"telemetry: one-fetch master aggregate, {len(senders)}"
+            f" sender(s)" + (f", {len(stale)} stale ({', '.join(stale)})"
+                             if stale else ""))
+        for name, info in (tele.get("alerts") or {}).items():
+            if firing_alerts.get(name) != "critical":
+                firing_alerts[name] = info.get("severity", "warning")
+        for s in senders.values():
+            for a in s.get("alerts") or ():
+                name = a.get("alert", "?")
                 if firing_alerts.get(name) != "critical":
                     firing_alerts[name] = a.get("severity", "warning")
+    else:
+        seen = {env.master_url} | {sv.http for sv in servers}
+        for ep in sorted(_discover_endpoints(env, flags.get("include", ""),
+                                             servers=servers) - seen):
+            try:
+                out = env.get(f"{ep}/debug/alerts", timeout=10)
+            except Exception:
+                continue  # an unreachable gateway must not sink the check
+            for a in out.get("alerts", []):
+                if a.get("firing"):
+                    name = a.get("name", "?")
+                    if firing_alerts.get(name) != "critical":
+                        firing_alerts[name] = a.get("severity", "warning")
 
     for alert, sev in sorted(firing_alerts.items()):
         if sev == "critical":
@@ -567,8 +602,18 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
         )
     once = "once" in flags
 
+    # endpoint discovery is cached ACROSS watch frames: re-walking
+    # /dir/status + /cluster/ps every redraw turns a 30-node watch
+    # session into a topology-hammering loop. The cache is invalidated
+    # only when an endpoint fails to answer, so a node that moved (new
+    # port, restart) heals on the next frame.
+    cache: dict = {"endpoints": None}
+
     def frame() -> str:
-        endpoints = _discover_endpoints(env, flags.get("include", ""))
+        endpoints = cache["endpoints"]
+        if not endpoints:
+            endpoints = cache["endpoints"] = _discover_endpoints(
+                env, flags.get("include", ""))
         hist_res: dict[str, dict] = {}
         alert_res: dict[str, dict] = {}
 
@@ -588,8 +633,15 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
                 pass
 
         _fetch_concurrently(endpoints, fetch)
+        if len(hist_res) < len(endpoints):
+            cache["endpoints"] = None  # refetch topology next frame
         if not hist_res:
             raise ShellError("no /debug/metrics/history endpoint reachable")
+
+        # cluster-rollup header: the master aggregate's merged view
+        # (global rates, top tenants WITH error bars, burning cluster
+        # SLOs) — one extra fetch, not one per node
+        tele = _fetch_cluster_telemetry(env)
 
         # one representative endpoint per process (cluster.profile's dedup)
         by_proc: dict[str, str] = {}
@@ -717,10 +769,36 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
         lines = [
             f"cluster.top @ {env.master_url}  window={window:g}s  "
             f"{len(by_proc)} process(es), {len(hist_res)} endpoint(s)",
+        ]
+        if tele is not None:
+            rates = tele.get("rates") or {}
+            total_req = sum(r.get("req_rate", 0.0) for r in rates.values())
+            total_err = sum(r.get("err_rate", 0.0) for r in rates.values())
+            err_pct = 100.0 * total_err / total_req if total_req else 0.0
+            senders = tele.get("senders") or {}
+            n_stale = sum(1 for s in senders.values() if s.get("stale"))
+            bits = [
+                f"cluster: {total_req:.1f} req/s  5xx {err_pct:.2f}%  "
+                f"senders {len(senders)}"
+                + (f" ({n_stale} stale)" if n_stale else "")
+            ]
+            top3 = (tele.get("usage") or {}).get("tenants") or []
+            if top3:
+                bits.append("top tenants: " + ", ".join(
+                    f"{t['collection']}"
+                    f" {t.get('requests', 0):.0f}"
+                    f"±{t.get('requests_err', 0):.0f}"
+                    for t in top3[:3]))
+            burning = sorted(
+                name for name in (tele.get("alerts") or {})
+                if name.startswith("cluster_slo_burn"))
+            bits.append("burning: " + (", ".join(burning) or "none"))
+            lines.append("  ".join(bits))
+        lines.append(
             f"{'role':<10} {'req/s':>9} {'5xx%':>7} {'p99 ms':>9}"
             f" {'bytes/s':>10} {'front%':>7} {'uptime':>8}  version"
-            f"  p99-trace",
-        ]
+            f"  p99-trace"
+        )
         for role in sorted(roles):
             r = roles[role]
             qflags: dict = {}
@@ -808,10 +886,11 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
     shown = 0
     try:
         while True:
-            # clear + home, like top(1); each frame re-discovers endpoints.
-            # A transient fetch failure (master restarting, network blip)
-            # renders as a frame and the watch keeps going — only Ctrl-C
-            # (or -count) ends it, like top(1).
+            # clear + home, like top(1); endpoints come from the cached
+            # discovery (refreshed only after a failed fetch). A transient
+            # fetch failure (master restarting, network blip) renders as a
+            # frame and the watch keeps going — only Ctrl-C (or -count)
+            # ends it, like top(1).
             try:
                 body = frame()
             except ShellError as e:

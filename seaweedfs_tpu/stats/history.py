@@ -325,12 +325,20 @@ class MetricsHistory:
         return out
 
     def snapshot(self, family: str | None = None, window: float | None = None,
-                 max_samples: int = 16, now: float | None = None) -> list[dict]:
+                 max_samples: int = 16, now: float | None = None,
+                 since: float | None = None) -> list[dict]:
         """JSON-ready series view for /debug/metrics/history: last value,
         windowed rate (counter-suffixed families only), and up to
         `max_samples` trailing raw points (0 omits them). `family` matches
         exactly or as a prefix (`SeaweedFS_http_request_seconds` pulls its
-        _bucket/_sum/_count components too)."""
+        _bucket/_sum/_count components too).
+
+        `since` is an incremental cursor: only samples strictly after that
+        timestamp are returned (series with nothing new are omitted
+        entirely), so a poller passing the previous response's watermark
+        (`last_scrape`) stops re-shipping the full ring every cycle. The
+        windowed `rate` still uses the full window — a cursor narrows the
+        shipped points, not the math."""
         now = time.time() if now is None else now
         window = self.retention_seconds if window is None else window
         cutoff = now - window
@@ -346,6 +354,10 @@ class MetricsHistory:
             win = [(t, v) for t, v in pts if t >= cutoff]
             if not win:
                 continue
+            fresh = win if since is None \
+                else [(t, v) for t, v in win if t > since]
+            if not fresh:
+                continue  # nothing new past the cursor: omit the series
             entry = {
                 "family": name,
                 "labels": labels,
@@ -358,7 +370,7 @@ class MetricsHistory:
             }
             if max_samples > 0:
                 entry["samples"] = [
-                    [round(t, 3), v] for t, v in win[-max_samples:]
+                    [round(t, 3), v] for t, v in fresh[-max_samples:]
                 ]
             out.append(entry)
         return out

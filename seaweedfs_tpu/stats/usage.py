@@ -113,6 +113,81 @@ class SpaceSaving:
             items = items[:n]
         return [(k, c, self.errs[k]) for k, c in items]
 
+    # --- wire format / merge -------------------------------------------------
+    def to_dict(self) -> dict:
+        """JSON-ready serialization for a telemetry frame (stats/aggregate).
+        Zero errs are elided — most tracked keys never displaced anyone."""
+        return {
+            "k": self.k,
+            "counts": dict(self.counts),
+            "errs": {k: e for k, e in self.errs.items() if e},
+            "other": self.other,
+            "evictions": self.evictions,
+            "error_bound": self.error_bound,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SpaceSaving":
+        sk = cls(max(1, int(d.get("k") or DEFAULT_K)))
+        counts = d.get("counts") or {}
+        errs = d.get("errs") or {}
+        # defensive truncation: a malformed frame must not grow the sketch
+        # past its own declared capacity (deterministic order for tests)
+        items = sorted(counts.items(),
+                       key=lambda kv: (-float(kv[1]), kv[0]))[:sk.k]
+        for key, c in items:
+            sk.counts[str(key)] = float(c)
+            sk.errs[str(key)] = float(errs.get(key, 0.0))
+        sk.other = float(d.get("other") or 0.0)
+        sk.evictions = int(d.get("evictions") or 0)
+        sk.error_bound = float(d.get("error_bound") or 0.0)
+        return sk
+
+    def merge(self, other: "SpaceSaving") -> "SpaceSaving":
+        """Merge two sketches into a NEW sketch (inputs untouched), keeping
+        the per-key invariant count - err <= true <= count under composed
+        error bounds (the mergeable-summaries construction):
+
+          * a key tracked by only one input may have occurred up to that
+            input's min-count uX times while untracked there, so the
+            absent side contributes (count=uX, err=uX) — 0 <= true <= uX
+            keeps both sides of the invariant;
+          * tracked-by-both keys sum counts and errs;
+          * the union is truncated back to k = max(ka, kb) by count
+            (deterministic tie-break on key, so merge is exactly
+            commutative); truncated mass folds into `other`;
+          * the exported scalar bound composes: it covers every kept
+            key's err AND every truncated count (an untracked key's true
+            count never exceeds what was dropped for it).
+        """
+        ua = min(self.counts.values()) if len(self.counts) >= self.k else 0.0
+        ub = (min(other.counts.values())
+              if len(other.counts) >= other.k else 0.0)
+        union: dict[str, tuple[float, float]] = {}
+        for key in self.counts.keys() | other.counts.keys():
+            if key in self.counts:
+                ca, ea = self.counts[key], self.errs[key]
+            else:
+                ca = ea = ua
+            if key in other.counts:
+                cb, eb = other.counts[key], other.errs[key]
+            else:
+                cb = eb = ub
+            union[key] = (ca + cb, ea + eb)
+        out = SpaceSaving(max(self.k, other.k))
+        ranked = sorted(union.items(), key=lambda kv: (-kv[1][0], kv[0]))
+        kept, dropped = ranked[:out.k], ranked[out.k:]
+        for key, (c, e) in kept:
+            out.counts[key] = c
+            out.errs[key] = e
+        out.other = self.other + other.other + sum(c for _, (c, _e) in dropped)
+        out.evictions = self.evictions + other.evictions + len(dropped)
+        out.error_bound = max(
+            self.error_bound + other.error_bound,
+            max((c for _, (c, _e) in dropped), default=0.0),
+        )
+        return out
+
 
 class UsageAccountant:
     """Thread-safe multi-dimension tenant accountant: one Space-Saving
@@ -239,6 +314,14 @@ class UsageAccountant:
                 "evictions": req.evictions,
                 "tracked": len(req.counts),
             }
+
+    def export_sketches(self) -> dict:
+        """Serialized per-dimension sketches for a telemetry frame
+        (stats/aggregate.build_frame): native-engine deltas folded first,
+        then a consistent copy of all four dimensions under the lock."""
+        self._fold_engines()
+        with self._lock:
+            return {dim: self._sketches[dim].to_dict() for dim in _DIMS}
 
     def lines(self) -> list[str]:
         """Prometheus text-format lines (Collector fn)."""

@@ -398,6 +398,43 @@ class TestMetricNameLint:
         assert "SeaweedFS_node_days_to_full" in collector_names
         assert "SeaweedFS_heat_collection_score" in collector_names
         assert tool.usage_heat_violations() == []
+        # PR-18: cluster telemetry plane — merged-usage families, the
+        # stale/self-observability gauges, and the cluster-scope rules
+        assert "SeaweedFS_cluster_usage_requests_total" in collector_names
+        assert "SeaweedFS_cluster_usage_error_bound" in collector_names
+        assert "SeaweedFS_cluster_slo_burn_rate" in collector_names
+        assert "SeaweedFS_cluster_telemetry_stale" in collector_names
+        assert "SeaweedFS_cluster_alerts_firing" in collector_names
+        assert tool.cluster_telemetry_violations() == []
+
+    def test_cluster_telemetry_lint_catches_violations(self, monkeypatch):
+        from seaweedfs_tpu.stats import aggregate
+
+        tool = self._tool()
+        monkeypatch.setattr(
+            aggregate, "CLUSTER_FAMILIES",
+            tuple(f for f in aggregate.CLUSTER_FAMILIES
+                  if f != "SeaweedFS_cluster_telemetry_stale")
+            + ("SeaweedFS_cluster_BadName",
+               "SeaweedFS_usage_not_cluster_total"),
+        )
+        monkeypatch.setattr(
+            aggregate, "CLUSTER_RULES",
+            aggregate.CLUSTER_RULES + (
+                ("cluster_slo_burn_fast", "critical"),  # duplicate
+                ("slo_burn_fast", "critical"),          # missing prefix
+                ("cluster_bad_severity", "page-me"),    # unknown severity
+            ),
+        )
+        bad = tool.cluster_telemetry_violations()
+        assert any("SeaweedFS_cluster_BadName" in b for b in bad)
+        assert any("SeaweedFS_usage_not_cluster_total" in b
+                   and "subsystem" in b for b in bad)
+        assert any("SeaweedFS_cluster_telemetry_stale" in b
+                   and "missing" in b for b in bad)
+        assert any("duplicate" in b for b in bad)
+        assert any("slo_burn_fast" in b and "prefix" in b for b in bad)
+        assert any("page-me" in b for b in bad)
 
     def test_usage_heat_lint_catches_violations(self, monkeypatch):
         from seaweedfs_tpu.stats import heat, usage

@@ -36,7 +36,7 @@ import sys
 NAME_RE = re.compile(
     r"^SeaweedFS_"
     r"(master|volume|filer|s3|http|stats|mount|mq|iam|alerts|process"
-    r"|maintenance|faults|events|slo|usage|heat|node)_"
+    r"|maintenance|faults|events|slo|usage|heat|node|cluster)_"
     r"[a-z][a-z0-9]*(_[a-z0-9]+)*$"
 )
 
@@ -102,6 +102,7 @@ def collect() -> tuple[dict[str, str], list[str]]:
     from seaweedfs_tpu.s3api.s3_server import S3Server
     from seaweedfs_tpu.server.filer import FilerServer
 
+    from seaweedfs_tpu.stats import aggregate as aggregate_mod
     from seaweedfs_tpu.stats import events as events_mod
     from seaweedfs_tpu.stats import heat as heat_mod
     from seaweedfs_tpu.stats import usage as usage_mod
@@ -121,6 +122,7 @@ def collect() -> tuple[dict[str, str], list[str]]:
         | set(usage_mod.USAGE_FAMILIES)
         | set(heat_mod.HEAT_FAMILIES)
         | set(heat_mod.ROLLUP_FAMILIES)
+        | set(aggregate_mod.CLUSTER_FAMILIES)
     )
     return kinds, collector_names
 
@@ -539,6 +541,51 @@ def usage_heat_violations() -> list[str]:
     return bad
 
 
+def cluster_telemetry_violations() -> list[str]:
+    """The cluster telemetry plane's contract (stats/aggregate.py): every
+    `cluster` family well-formed, the staleness + self-observability
+    families present (a renamed stale gauge would silently un-wire the
+    "gateway went quiet" finding), and the cluster-scope alert rule names
+    unique snake_case with known severities — they become the `alert`
+    label of SeaweedFS_cluster_alerts_firing."""
+    from seaweedfs_tpu.stats import aggregate as aggregate_mod
+
+    bad: list[str] = []
+    fams = aggregate_mod.CLUSTER_FAMILIES
+    for fam in fams:
+        if not NAME_RE.match(fam):
+            bad.append(f"cluster family {fam!r}: does not match"
+                       f" SeaweedFS_<subsystem>_<snake_case>")
+        elif not fam.startswith("SeaweedFS_cluster_"):
+            bad.append(f"cluster family {fam!r}: must live in the"
+                       f" `cluster` subsystem")
+    for required in ("SeaweedFS_cluster_telemetry_stale",
+                     "SeaweedFS_cluster_telemetry_senders",
+                     "SeaweedFS_cluster_telemetry_frames_total",
+                     "SeaweedFS_cluster_telemetry_frame_age_seconds",
+                     "SeaweedFS_cluster_usage_error_bound",
+                     "SeaweedFS_cluster_slo_burn_rate",
+                     "SeaweedFS_cluster_alerts_firing"):
+        if required not in fams:
+            bad.append(f"cluster family {required!r}: missing from"
+                       f" CLUSTER_FAMILIES")
+    seen: set[str] = set()
+    for name, severity in aggregate_mod.CLUSTER_RULES:
+        if name in seen:
+            bad.append(f"cluster rule {name!r}: duplicate name")
+        seen.add(name)
+        if not name.startswith("cluster_"):
+            bad.append(f"cluster rule {name!r}: must carry the cluster_"
+                       f" prefix (dashboards must tell cluster-scope"
+                       f" firing from per-process slo_burn_*)")
+        if not ALERT_RULE_RE.match(name):
+            bad.append(f"cluster rule {name!r}: not snake_case")
+        if severity not in ALERT_SEVERITIES:
+            bad.append(f"cluster rule {name!r}: severity {severity!r}"
+                       f" not in {sorted(ALERT_SEVERITIES)}")
+    return bad
+
+
 def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
     bad: list[str] = []
     for name in sorted(set(kinds) | set(collector_names)):
@@ -566,7 +613,7 @@ def main() -> int:
         + degraded_reason_violations() + repair_reason_violations() \
         + stream_lazy_violations() \
         + event_type_violations() + slo_violations() + scrub_violations() \
-        + usage_heat_violations()
+        + usage_heat_violations() + cluster_telemetry_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
         print(f"{len(bad)} metric-name violation(s) in {total} families:")
