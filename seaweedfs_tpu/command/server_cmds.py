@@ -46,6 +46,24 @@ def _add_faults_flag(p) -> None:
     )
 
 
+def _add_telemetry_flags(p) -> None:
+    p.add_argument(
+        "-telemetry.dir", dest="telemetry_dir", default=None,
+        help="durable telemetry spool directory (stats/store.py):"
+             " history samples + flight-recorder events persist in CRC'd"
+             " segment files (5s raw -> 1m -> 10m rollups) and replay on"
+             " restart, so /debug/metrics/history, /debug/events and"
+             " cluster.why survive a crash; unset = in-memory only",
+    )
+    p.add_argument(
+        "-telemetry.retention", dest="telemetry_retention", type=float,
+        default=None,
+        help="telemetry spool byte budget in MB (default 64), carved"
+             " across the raw/1m/10m/event tiers; oldest segments evict"
+             " first so the spool never fills the disk",
+    )
+
+
 def _arm_faults(opts) -> None:
     if getattr(opts, "faults", None) is None:
         return
@@ -94,6 +112,7 @@ def run_master(args: list[str]) -> int:
                    default=None,
                    help="online-EC stripe block bytes per shard "
                         "(default 1MB)")
+    _add_telemetry_flags(p)
     _add_faults_flag(p)
     opts = p.parse_args(args)
     _arm_faults(opts)
@@ -119,6 +138,8 @@ def run_master(args: list[str]) -> int:
         repair_lazy_window=opts.repair_lazy_window,
         ec_online=opts.ec_online,
         ec_online_block=opts.ec_online_block,
+        telemetry_dir=opts.telemetry_dir,
+        telemetry_retention_mb=opts.telemetry_retention,
     )
     m.start()
     print(f"master listening at {m.url}")
@@ -151,6 +172,7 @@ def run_volume(args: list[str]) -> int:
                    default=8.0,
                    help="scrub read-budget in MB/s (token bucket; scrubbing"
                         " never starves foreground traffic)")
+    _add_telemetry_flags(p)
     _add_faults_flag(p)
     opts = p.parse_args(args)
     _arm_faults(opts)
@@ -172,6 +194,8 @@ def run_volume(args: list[str]) -> int:
         slow_ms=opts.slow_ms,
         scrub_interval=opts.scrub_interval,
         scrub_rate_mb=opts.scrub_rate,
+        telemetry_dir=opts.telemetry_dir,
+        telemetry_retention_mb=opts.telemetry_retention,
     )
     vs.start()
     print(f"volume server listening at {vs.url}")
@@ -210,6 +234,7 @@ def run_filer(args: list[str]) -> int:
     p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
                    help="log requests slower than this many ms for this "
                         "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
+    _add_telemetry_flags(p)
     _add_faults_flag(p)
     opts = p.parse_args(args)
     _arm_faults(opts)
@@ -241,6 +266,8 @@ def run_filer(args: list[str]) -> int:
         dedup=opts.dedup,
         security=sec,
         slow_ms=opts.slow_ms,
+        telemetry_dir=opts.telemetry_dir,
+        telemetry_retention_mb=opts.telemetry_retention,
     )
     f.start()
     print(f"filer listening at {f.url}")
@@ -300,6 +327,7 @@ def run_server(args: list[str]) -> int:
     p.add_argument("-scrub.rate", dest="scrub_rate", type=float,
                    default=8.0,
                    help="scrub read-budget in MB/s (token bucket)")
+    _add_telemetry_flags(p)
     _add_faults_flag(p)
     opts = p.parse_args(args)
     _arm_faults(opts)
@@ -320,6 +348,8 @@ def run_server(args: list[str]) -> int:
         repair_lazy_window=opts.repair_lazy_window,
         ec_online=opts.ec_online,
         ec_online_block=opts.ec_online_block,
+        telemetry_dir=opts.telemetry_dir,
+        telemetry_retention_mb=opts.telemetry_retention,
     )
     m.start()
     print(f"master listening at {m.url}")
@@ -397,6 +427,7 @@ def run_s3(args: list[str]) -> int:
     p.add_argument("-slowMs", dest="slow_ms", type=float, default=None,
                    help="log requests slower than this many ms for this "
                         "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
+    _add_telemetry_flags(p)
     _add_faults_flag(p)
     opts = p.parse_args(args)
     _arm_faults(opts)
@@ -416,7 +447,9 @@ def run_s3(args: list[str]) -> int:
     if master and not master.startswith("http"):
         master = peer_url(master)
     s3 = S3Server(filer, host=opts.ip, port=opts.port, config=config,
-                  slow_ms=opts.slow_ms, master_url=master or None)
+                  slow_ms=opts.slow_ms, master_url=master or None,
+                  telemetry_dir=opts.telemetry_dir,
+                  telemetry_retention_mb=opts.telemetry_retention)
     s3.start()
     print(f"s3 gateway listening at {s3.url}")
     return _wait_forever()

@@ -80,6 +80,8 @@ class S3Server:
         circuit_breaker: CircuitBreaker | None = None,
         slow_ms: float | None = None,
         master_url: str | None = None,
+        telemetry_dir: str | None = None,
+        telemetry_retention_mb: float | None = None,
     ) -> None:
         self.fc = FilerClient(filer_url)
         # the gateway has no heartbeat/register link of its own, so an
@@ -96,6 +98,11 @@ class S3Server:
         self._sweep_stop = None
         self.service = HTTPService(host, port)
         self.service.enable_metrics("s3", serve_route=False)
+        # -telemetry.dir: durable history/event spool (stats/store.py)
+        if telemetry_dir:
+            from seaweedfs_tpu.stats import store as store_mod
+
+            store_mod.enable(telemetry_dir, telemetry_retention_mb)
         if slow_ms is not None:  # -slowMs: per-role slow-span threshold
             from seaweedfs_tpu.stats import trace as trace_mod
 

@@ -68,6 +68,8 @@ class FilerServer:
         dedup_max: int = 512 * 1024,
         local_socket: str | None = None,
         slow_ms: float | None = None,
+        telemetry_dir: str | None = None,
+        telemetry_retention_mb: float | None = None,
     ) -> None:
         from seaweedfs_tpu.security import Guard, SecurityConfig
 
@@ -88,6 +90,11 @@ class FilerServer:
         # /metrics), so metrics get their own listener (`-metricsPort`;
         # -1 = ephemeral port, 0 = disabled, >0 = fixed)
         self.service.enable_metrics("filer", serve_route=False)
+        # -telemetry.dir: durable history/event spool (stats/store.py)
+        if telemetry_dir:
+            from seaweedfs_tpu.stats import store as store_mod
+
+            store_mod.enable(telemetry_dir, telemetry_retention_mb)
         if slow_ms is not None:  # -slowMs: per-role slow-span threshold
             from seaweedfs_tpu.stats import trace as trace_mod
 

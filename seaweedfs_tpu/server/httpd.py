@@ -445,6 +445,23 @@ class HTTPService:
         return f"{scheme}://{self.host}:{self.port}"
 
 
+def _since_param(query: dict):
+    """Parse the shared `?since=` incremental cursor (None when absent;
+    ValueError on anything non-finite — the routes turn that into a 400,
+    never an unhandled 500). Both /debug/metrics/history and
+    /debug/events use this: pass the previous response's unrounded
+    `watermark` back and only strictly-newer items ship."""
+    import math
+
+    since = query.get("since")
+    if since is None:
+        return None
+    since = float(since)
+    if not math.isfinite(since):
+        raise ValueError(since)
+    return since
+
+
 def _register_debug_routes(service: "HTTPService") -> None:
     """`/debug/traces` (recent finished traces, JSON; ?limit= & ?min_ms=),
     `/debug/requests` (in-flight spans; ?limit=), and the profiling
@@ -551,11 +568,7 @@ def _register_debug_routes(service: "HTTPService") -> None:
             # ?since=<mono_ts>: incremental cursor — ship only samples
             # after the caller's watermark (the previous response's
             # "watermark" field), not the full ring every poll
-            since = req.query.get("since")
-            if since is not None:
-                since = float(since)
-                if not math.isfinite(since):
-                    raise ValueError(since)
+            since = _since_param(req.query)
         except ValueError:
             return Response(
                 {"error": "window/samples/since must be finite numbers"},
@@ -615,10 +628,11 @@ def _register_debug_routes(service: "HTTPService") -> None:
     def debug_events(req: Request) -> Response:
         """The flight-recorder journal (stats/events.py): typed events
         with correlation keys, filterable by ?type= / ?volume= /
-        ?trace= / ?since= (+ ?limit=). cluster.why fans this out across
-        every node and assembles the causal timeline."""
-        import math
-
+        ?trace= / ?since= (+ ?limit=). `?since=` is the same strictly-
+        after cursor /debug/metrics/history carries: pass the previous
+        response's unrounded `watermark` back and a watch-mode poller
+        stops re-shipping the whole ring. cluster.why fans this out
+        across every node and assembles the causal timeline."""
         from seaweedfs_tpu.stats import events as events_mod
         from seaweedfs_tpu.stats import profiler as prof_mod
 
@@ -626,9 +640,7 @@ def _register_debug_routes(service: "HTTPService") -> None:
         try:
             limit = int(q.get("limit", 256))
             volume = int(q["volume"]) if "volume" in q else None
-            since = float(q["since"]) if "since" in q else None
-            if since is not None and not math.isfinite(since):
-                raise ValueError(since)
+            since = _since_param(q)
         except ValueError:
             return Response(
                 {"error": "limit/volume/since must be finite numbers"}, 400
@@ -647,6 +659,10 @@ def _register_debug_routes(service: "HTTPService") -> None:
             "capacity": rec.capacity,
             "recorded": rec.recorded_total,
             "dropped": rec.dropped_total,
+            # pass back as ?since= next poll. Unrounded on purpose: event
+            # ts are rounded to 6 decimals for display, and a rounded-
+            # DOWN watermark would re-ship its own newest event.
+            "watermark": rec.last_wall,
             "events": rec.events(type=type_, volume=volume,
                                  trace=q.get("trace") or None,
                                  since=since,

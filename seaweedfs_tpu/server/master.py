@@ -47,6 +47,8 @@ class MasterServer:
         repair_lazy_window: float = 0.0,
         ec_online: str = "",
         ec_online_block: int | None = None,
+        telemetry_dir: str | None = None,
+        telemetry_retention_mb: float | None = None,
     ) -> None:
         seq = MemorySequencer(f"{meta_dir}/sequence.json" if meta_dir else None)
         self.topo = Topology(
@@ -68,6 +70,12 @@ class MasterServer:
         if self.security.white_list:
             self.service.guard = Guard(self.security.white_list)
         self.service.enable_metrics("master")
+        # -telemetry.dir: durable spool — replay the pre-crash tail into
+        # the history/event rings, then flush them to disk continuously
+        if telemetry_dir:
+            from seaweedfs_tpu.stats import store as store_mod
+
+            store_mod.enable(telemetry_dir, telemetry_retention_mb)
         if slow_ms is not None:  # -slowMs: per-role slow-span threshold
             from seaweedfs_tpu.stats import trace as _trace
 

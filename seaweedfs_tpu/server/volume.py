@@ -81,6 +81,8 @@ class VolumeServer:
         slow_ms: float | None = None,
         scrub_interval: float = 0.0,
         scrub_rate_mb: float = 8.0,
+        telemetry_dir: str | None = None,
+        telemetry_retention_mb: float | None = None,
     ) -> None:
         # -mserver may list several masters; heartbeats follow the raft
         # leader hint (`volume_grpc_client_to_master.go` re-dial on redirect)
@@ -95,6 +97,13 @@ class VolumeServer:
         if self.security.white_list:
             self.service.guard = Guard(self.security.white_list)
         self.service.enable_metrics("volume")
+        # -telemetry.dir: durable spool under the data dir — pre-crash
+        # history/events replay into the rings before traffic starts, so
+        # /debug/metrics/history and /debug/events survive a kill -9
+        if telemetry_dir:
+            from seaweedfs_tpu.stats import store as store_mod
+
+            store_mod.enable(telemetry_dir, telemetry_retention_mb)
         if slow_ms is not None:  # -slowMs: per-role slow-span threshold
             from seaweedfs_tpu.stats import trace as _trace
 

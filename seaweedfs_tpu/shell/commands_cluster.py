@@ -568,9 +568,12 @@ def _fmt_uptime(sec: float | None) -> str:
 
 @command("cluster.top",
          "[-once] [-interval 2] [-window 60] [-count n] [-include url,url]"
-         " — live dashboard: per-role request rates, 5xx%, p99, bytes/s,"
-         " front-door native ratio, uptime and firing alerts from every"
-         " node's history ring. -once renders a single frame and returns")
+         " [-spool dir] [-snapshot file] — live dashboard: per-role"
+         " request rates, 5xx%, p99, bytes/s, front-door native ratio,"
+         " uptime and firing alerts from every node's history ring. -once"
+         " renders a single frame and returns; -spool appends a dead"
+         " process's rate history from its telemetry spool; -snapshot"
+         " dumps one frame's cluster state as JSON")
 def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
     """The rates-over-time view cluster.check can't give: every reachable
     node serves its self-scraped history ring (/debug/metrics/history)
@@ -600,7 +603,9 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
             "usage: cluster.top [-once] [-interval n] [-window n]"
             " [-count n] [-include url,url]"
         )
-    once = "once" in flags
+    # -snapshot implies -once: the JSON artifact is one frame's state
+    once = "once" in flags or "snapshot" in flags
+    spool_dir = flags.get("spool", "").strip()
 
     # endpoint discovery is cached ACROSS watch frames: re-walking
     # /dir/status + /cluster/ps every redraw turns a 30-node watch
@@ -635,13 +640,14 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
         _fetch_concurrently(endpoints, fetch)
         if len(hist_res) < len(endpoints):
             cache["endpoints"] = None  # refetch topology next frame
-        if not hist_res:
+        if not hist_res and not spool_dir:
             raise ShellError("no /debug/metrics/history endpoint reachable")
 
         # cluster-rollup header: the master aggregate's merged view
         # (global rates, top tenants WITH error bars, burning cluster
-        # SLOs) — one extra fetch, not one per node
-        tele = _fetch_cluster_telemetry(env)
+        # SLOs) — one extra fetch, not one per node (skipped in
+        # spool-only post-mortem mode: the cluster is dead)
+        tele = _fetch_cluster_telemetry(env) if hist_res else None
 
         # one representative endpoint per process (cluster.profile's dedup)
         by_proc: dict[str, str] = {}
@@ -766,6 +772,32 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
                 if cur is None or e.get("value", 0) > cur.get("value", 0):
                     exemplar[role] = e
 
+        # -snapshot rides the render pass: the same numbers the table
+        # shows, pre-formatting, so the JSON artifact and the terminal
+        # frame can never disagree
+        snap: dict = {
+            "ts": now,
+            "master": env.master_url,
+            "window": window,
+            "processes": len(by_proc),
+            "endpoints": len(hist_res),
+            "cluster_telemetry": tele,
+            "roles": {},
+            "tenants": tenants,
+            "heat": [
+                {"server": srv, "volume": vid, "score": score}
+                for (srv, vid), score in sorted(heat_vols.items(),
+                                                key=lambda kv: -kv[1])
+            ],
+            "days_to_full": [
+                {"node": node, "dir": d, "days": days}
+                for (node, d), days in sorted(days_full.items(),
+                                              key=lambda kv: kv[1])
+            ],
+            "slos": slo_rows,
+            "alerts_firing": firing,
+        }
+        cache["snap"] = snap
         lines = [
             f"cluster.top @ {env.master_url}  window={window:g}s  "
             f"{len(by_proc)} process(es), {len(hist_res)} endpoint(s)",
@@ -822,6 +854,15 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
                 f"{100.0 * r['fr_native'] / fr_total:.1f}" if fr_total else "-"
             )
             ex = exemplar.get(role)
+            snap["roles"][role] = {
+                "req_s": r["req_s"], "err_s": r["err_s"],
+                "bytes_s": r["bytes_s"],
+                "p99_s": p99,
+                "p99_lower_bound": bool(qflags.get("inf_mass")),
+                "front_native": r["fr_native"], "front_fallback": r["fr_fb"],
+                "uptime_s": r["uptime"], "version": r["version"],
+                "p99_trace": ex["trace_id"] if ex else None,
+            }
             lines.append(
                 f"{role:<10} {r['req_s']:>9.1f} {err_pct:>7}"
                 f" {p99_txt:>9}"
@@ -879,10 +920,64 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
                 )
         else:
             lines.append("no alerts firing")
+        if spool_dir:
+            # post-mortem: the dead process's rate history, straight off
+            # its telemetry spool's segment files — no live endpoint
+            from seaweedfs_tpu.stats import store as store_mod
+
+            try:
+                info = store_mod.spool_info(spool_dir)
+                series = store_mod.read_series(
+                    spool_dir, "SeaweedFS_http_request_total",
+                    tiers=("raw", "1m"))
+            except OSError as e:
+                raise ShellError(f"spool {spool_dir}: {e}")
+            total = sum(t.get("bytes", 0) for t in info.values())
+            rates: dict[str, float] = {}
+            t_lo = t_hi = None
+            for (_fam, labels), pts in sorted(series.items()):
+                if len(pts) < 2:
+                    continue
+                (ta, va), (tb, vb) = pts[0], pts[-1]
+                t_lo = ta if t_lo is None else min(t_lo, ta)
+                t_hi = tb if t_hi is None else max(t_hi, tb)
+                if tb > ta and vb >= va:  # counter reset inside: skip
+                    role = dict(labels).get("role", "?")
+                    rates[role] = rates.get(role, 0.0) \
+                        + (vb - va) / (tb - ta)
+            lines.append(
+                f"post-mortem spool {spool_dir}: " + "  ".join(
+                    f"{t}={info[t]['bytes']}B/{info[t]['segments']}seg"
+                    for t, _, _ in store_mod.TIERS)
+                + f"  total={total}B")
+            if t_lo is not None:
+                lines.append(
+                    f"  request counters cover {t_hi - t_lo:.0f}s;"
+                    " req/s by role: "
+                    + (", ".join(f"{role}={v:.2f}"
+                                 for role, v in sorted(rates.items()))
+                       or "n/a"))
+            else:
+                lines.append("  no request-counter history in spool")
+            snap["spool"] = {
+                "dir": spool_dir, "tiers": info, "total_bytes": total,
+                "req_rates": rates,
+                "covers_seconds": (t_hi - t_lo) if t_lo is not None
+                else 0.0,
+            }
         return "\n".join(lines)
 
     if once:
-        return frame()
+        body = frame()
+        if "snapshot" in flags:
+            import json as _json
+
+            with open(flags["snapshot"], "w") as f:
+                _json.dump(cache["snap"], f, indent=2, sort_keys=True,
+                           default=str)
+                f.write("\n")
+            return body + f"\nsnapshot json written to {flags['snapshot']}"
+        return body
     shown = 0
     try:
         while True:
@@ -1081,10 +1176,12 @@ def _why_describe(ev: dict) -> str:
 
 @command("cluster.why",
          "<trace-id|volume-id|collection> [-window 600] [-limit 2048]"
-         " [-include url,url] — assemble one causally-ordered cross-node"
-         " timeline from every node's flight recorder (/debug/events) +"
-         " trace ring: request span, degraded read, injected fault, alert"
-         " edges, repair task lifecycle, heal")
+         " [-include url,url] [-spool dir,dir] [-out file] — assemble one"
+         " causally-ordered cross-node timeline from every node's flight"
+         " recorder (/debug/events) + trace ring: request span, degraded"
+         " read, injected fault, alert edges, repair task lifecycle, heal."
+         " -spool folds in a dead process's on-disk journal; -out dumps"
+         " the timeline as JSON for a bug report")
 def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
     """The question the disconnected counters never answered: WHY was
     this read degraded / WHAT healed this volume. Given a trace id, the
@@ -1096,7 +1193,12 @@ def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
     correlation key (degraded reads, scrub findings, repair lifecycle,
     usage-sketch overflow) assemble into a per-tenant timeline. Events
     are deduped by (process token, seq) — single-process test clusters
-    expose one ring at every port."""
+    expose one ring at every port.
+
+    Post-mortem: `-spool <dir>` reads a telemetry spool's event journal
+    straight off its segment files (stats/store.py), so the timeline of
+    a process that is still DEAD — crashed, not restarted — assembles
+    next to whatever the live nodes remember."""
     import math
     import re as _re
 
@@ -1140,8 +1242,10 @@ def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
             except Exception:
                 pass
 
+    spool_dirs = [d.strip() for d in flags.get("spool", "").split(",")
+                  if d.strip()]
     _fetch_concurrently(endpoints, fetch)
-    if not ev_res:
+    if not ev_res and not spool_dirs:
         raise ShellError("no /debug/events endpoint reachable")
 
     # dedup: one ring per process, exposed at every one of its ports
@@ -1158,6 +1262,37 @@ def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
             seen.add(key)
             procs.add(token)
             events.append(ev)
+
+    # post-mortem spools: the dead process has no /debug/events port, so
+    # its journal comes straight off the segment files. A RESTARTED
+    # process replays the same journal into its live ring — the
+    # (ts, seq, type) key keeps those events from appearing twice (the
+    # proc token changes across a restart, so the live dedup can't).
+    if spool_dirs:
+        from seaweedfs_tpu.stats import store as store_mod
+
+        live_keys = {(round(ev.get("ts", 0.0), 6), ev.get("seq"),
+                      ev.get("type")) for ev in events}
+        for d in spool_dirs:
+            try:
+                replayed = store_mod.read_events(d, limit=limit)
+            except OSError as e:
+                raise ShellError(f"spool {d}: {e}")
+            fresh = 0
+            for ev in replayed:
+                key = (round(ev.get("ts", 0.0), 6), ev.get("seq"),
+                       ev.get("type"))
+                if key in live_keys:
+                    continue
+                live_keys.add(key)
+                events.append(ev)
+                fresh += 1
+            if fresh:
+                procs.add(f"spool:{d}")
+    if not events and not ev_res:
+        raise ShellError(
+            "no events: every endpoint unreachable and the spool(s)"
+            f" {spool_dirs} hold no journal records")
 
     spans: dict[str, dict] = {}
     for ep in sorted(tr_res):
@@ -1213,8 +1348,8 @@ def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
             picked = [ev for ev in picked if ev["ts"] >= t1 - window]
         if not picked:
             raise ShellError(
-                f"{what}: no events found on"
-                f" {len(ev_res)} endpoint(s)")
+                f"{what}: no events found on {len(ev_res)} endpoint(s)"
+                + (f" + {len(spool_dirs)} spool(s)" if spool_dirs else ""))
         # pull the request traces the volume's events name (the span side
         # of the story: which reads were degraded, how slow they were) —
         # ONE fan-out with all lookups batched per endpoint, so a single
@@ -1256,6 +1391,28 @@ def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
     t0 = rows[0][0] if rows else 0.0
     lines = [head]
     lines.extend(f"  +{ts - t0:8.3f}s  {body}" for ts, body in rows)
+    if "out" in flags:
+        # the bug-report artifact: the raw assembled timeline as JSON
+        # (events + spans, pre-rendering), symmetric with cluster.heat
+        # -out but machine-readable — attach it, don't screenshot it
+        import json as _json
+
+        doc = {
+            "target": target,
+            "kind": ("trace" if trace_id is not None
+                     else "collection" if collection is not None
+                     else "volume"),
+            "window": window,
+            "processes": sorted(procs),
+            "spools": spool_dirs,
+            "head": head,
+            "events": sorted(picked, key=lambda e: e.get("ts", 0.0)),
+            "spans": sorted(spans.values(), key=lambda s: s["start"]),
+        }
+        with open(flags["out"], "w") as f:
+            _json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return head + f"\ntimeline json written to {flags['out']}"
     return "\n".join(lines)
 
 

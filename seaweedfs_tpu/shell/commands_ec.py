@@ -289,11 +289,17 @@ class PipelinedRebuildError(ShellError):
 def plan_rebuild_pipelined(
     env: CommandEnv, vid: int, collection: str = "",
     exclude: tuple[str, ...] = (),
+    prefer_rebuilder: str | None = None,
 ) -> dict | None:
     """The partial-sum chain plan: decode coefficients per holder, hops
     ordered with the rebuilder LAST (it lands the accumulated sum in its
     /admin/ec/partial/start state). `exclude` drops dead hops on a chain
-    restart. None when nothing is missing; ShellError when the surviving
+    restart. `prefer_rebuilder` pins the writer on restarts: the
+    committed frontier lives in the old rebuilder's partial state, and
+    the (shard-count, free_slots) ranking can flip between plans while
+    volumes move underneath — switching writers would silently discard
+    landed chunks, so a still-usable preferred holder always wins.
+    None when nothing is missing; ShellError when the surviving
     (non-excluded) shards drop below 10."""
     servers = env.servers()
     all_holders = [sv for sv in servers if vid in sv.ec_shards]
@@ -313,7 +319,9 @@ def plan_rebuild_pipelined(
             f" (excluding {list(exclude)}), cannot rebuild"
         )
     use, matrix = ec_decoder.repair_coefficients(usable, missing)
-    rebuilder = max(
+    rebuilder = next(
+        (sv for sv in holders if sv.id == prefer_rebuilder), None
+    ) or max(
         holders, key=lambda sv: (len(sv.ec_shards[vid]), sv.free_slots())
     )
     # each `use` shard contributes from exactly one hop; hops ordered
@@ -464,6 +472,7 @@ def apply_rebuild_pipelined(
                     new_plan = plan_rebuild_pipelined(
                         env, plan["volume"], plan["collection"],
                         exclude=tuple(excluded),
+                        prefer_rebuilder=plan["rebuilder"],
                     )
                 except ShellError as err:
                     raise PipelinedRebuildError(

@@ -156,6 +156,11 @@ DEFAULT_PARAMS = {
     # while the fill slope is positive, so deleting data clears both.
     "forecast_warn_days": 14.0,
     "forecast_crit_days": 3.0,
+    # telemetry_spool_near_cap: a durable-telemetry tier (stats/store.py)
+    # holding this share of its byte cap is about to evict (or already
+    # evicting) its oldest segments — retention is now bounded by
+    # -telemetry.retention, not by time; raise it to keep more history
+    "telemetry_spool_ratio": 0.9,
     # SLO multi-window burn-rate alerting: the fast window pages on an
     # incident spending the error budget 14x faster than sustainable
     # (critical, self-clears once the burst ages out of the window); the
@@ -437,6 +442,36 @@ def _check_capacity_forecast_at(hist, now, p, horizon_days):
     return worst, "capacity forecast: " + "; ".join(sorted(details))
 
 
+def _check_telemetry_spool(hist, now, p):
+    """Any durable-telemetry tier (stats/store.py) holding >= the ratio
+    of its byte cap: oldest-segment eviction is imminent (or running),
+    so retention is byte-bounded — an ops heads-up, like the capacity
+    forecast, not an incident page."""
+    caps = {
+        labels.get("tier", ""): v
+        for labels, v, _ in hist.latests(
+            "SeaweedFS_telemetry_spool_cap_bytes")
+        if v > 0
+    }
+    details, worst = [], None
+    for labels, used, _ in hist.latests("SeaweedFS_telemetry_spool_bytes"):
+        cap = caps.get(labels.get("tier", ""))
+        if not cap:
+            continue
+        ratio = used / cap
+        if ratio < p["telemetry_spool_ratio"]:
+            continue
+        details.append(
+            f"tier {labels.get('tier', '?')} at {ratio:.0%} of"
+            f" {int(cap)}B cap")
+        worst = max(worst or 0.0, ratio)
+    if not details:
+        return None
+    return worst, ("telemetry spool near cap (oldest segments evict;"
+                   " raise -telemetry.retention to keep more): "
+                   + "; ".join(sorted(details)))
+
+
 def _check_capacity_forecast(hist, now, p):
     return _check_capacity_forecast_at(hist, now, p, p["forecast_warn_days"])
 
@@ -477,6 +512,10 @@ def default_rules() -> list[Rule]:
              "integrity scrub passes are detecting silent damage"
              " (bitrot, torn shards, diverged replicas)",
              _check_scrub_findings),
+        Rule("telemetry_spool_near_cap", "warning",
+             "a durable-telemetry spool tier is near its byte cap —"
+             " oldest segments are being evicted (retention is now"
+             " byte-bounded)", _check_telemetry_spool),
         Rule("capacity_forecast", "warning",
              "a data directory's fill trend reaches capacity within the"
              " warning horizon (days-to-full linear fit)",

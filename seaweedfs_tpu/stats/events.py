@@ -124,6 +124,22 @@ class Event:
             out["attrs"] = dict(self.attrs)
         return out
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "Event":
+        """Rebuild a journaled event (stats/store.py replay) with its
+        original timestamps/seq — bypasses __init__'s time.time()."""
+        ev = cls.__new__(cls)
+        ev.type = d.get("type", "")
+        ev.seq = int(d.get("seq", 0))
+        ev.wall = float(d.get("ts", 0.0))
+        ev.mono = float(d.get("mono", 0.0))
+        ev.trace_id = d.get("trace_id")
+        ev.volume = d.get("volume")
+        ev.node = d.get("node")
+        ev.task = d.get("task")
+        ev.attrs = dict(d.get("attrs") or {})
+        return ev
+
 
 class EventRecorder:
     """Bounded per-process event ring. `enabled` is the one-attribute
@@ -143,6 +159,10 @@ class EventRecorder:
         self.recorded_total = 0
         self.dropped_total = 0
         self.recorded_by_type: dict[str, int] = {}
+        # unrounded wall clock of the newest event: the /debug/events
+        # incremental-cursor watermark (to_dict rounds ts for display, so
+        # a rounded watermark could re-ship its own event next poll)
+        self.last_wall = 0.0
 
     def enable(self) -> None:
         self.enabled = True
@@ -175,6 +195,7 @@ class EventRecorder:
                 self.dropped_total += 1
             self._ring.append(ev)
             self.recorded_total += 1
+            self.last_wall = ev.wall
             self.recorded_by_type[type_] = \
                 self.recorded_by_type.get(type_, 0) + 1
         return ev
@@ -184,9 +205,12 @@ class EventRecorder:
                collection: str | None = None,
                limit: int = 256) -> list[dict]:
         """Filtered view, causally ordered (oldest first). `since` is a
-        wall-clock lower bound; `limit` keeps the NEWEST matches (the
-        tail is where the story usually is). `collection` matches the
-        per-tenant correlation key events carry in attrs."""
+        strictly-after wall-clock cursor (pass the previous response's
+        `last_wall` watermark back to stop re-shipping the ring — the
+        same incremental-poll contract as MetricsHistory.snapshot);
+        `limit` keeps the NEWEST matches (the tail is where the story
+        usually is). `collection` matches the per-tenant correlation key
+        events carry in attrs."""
         with self._lock:
             evs = list(self._ring)
         out = []
@@ -197,7 +221,7 @@ class EventRecorder:
                 continue
             if trace is not None and ev.trace_id != trace:
                 continue
-            if since is not None and ev.wall < since:
+            if since is not None and ev.wall <= since:
                 continue
             if collection is not None and \
                     ev.attrs.get("collection") != collection:
@@ -206,6 +230,32 @@ class EventRecorder:
         if limit > 0:
             out = out[-limit:]
         return [ev.to_dict() for ev in out]
+
+    # --- durable-store seam (stats/store.py) ----------------------------------
+    def tail(self, after_seq: int, limit: int = 4096) -> list[Event]:
+        """Raw events with seq strictly past `after_seq`, oldest first —
+        the telemetry store's flusher pulls the ring through this seq
+        watermark (emit() never sees the store; the ring is the buffer,
+        and a seq gap past the watermark is a counted loss)."""
+        with self._lock:
+            out = [ev for ev in self._ring if ev.seq > after_seq]
+        return out[:limit] if limit > 0 else out
+
+    def preload(self, dicts) -> int:
+        """Inject replayed journal events (restart replay): original
+        seqs/timestamps preserved, `_seq` advanced past them so live
+        events never collide, oldest replayed events trimmed silently if
+        the batch exceeds the ring (they are still on disk). Counters
+        stay zero — they account THIS process's recording."""
+        evs = [Event.from_dict(d) for d in dicts]
+        with self._lock:
+            merged = sorted(list(self._ring) + evs,
+                            key=lambda e: (e.wall, e.seq))
+            self._ring = collections.deque(merged[-self.capacity:])
+            for ev in evs:
+                self._seq = max(self._seq, ev.seq)
+                self.last_wall = max(self.last_wall, ev.wall)
+        return len(evs)
 
     def clear(self) -> None:
         """Drop the journal (tests: isolate scenarios). Counters

@@ -105,6 +105,9 @@ class HeatEngine:
         self._scores: dict[tuple, float] = {}   # (server, volume) -> EWMA
         self._hot: set[tuple] = set()
         self._days: dict[tuple, float] = {}     # (node, dir) -> days
+        # seconds of signal the last fit actually covered (>= the raw
+        # forecast_window once the durable 1m tier contributes)
+        self._fit_window = float(forecast_window)
         self._listener = None
 
     # --- lifecycle -----------------------------------------------------------
@@ -180,19 +183,48 @@ class HeatEngine:
             "SeaweedFS_volume_disk_used_bytes",
             window=self.forecast_window,
             max_samples=self.history.slots, now=now)
+        # durable extension: when the telemetry store (stats/store.py)
+        # holds 1m rollups of the fill series, the OLS fit rides
+        # hours-to-days of real signal instead of the 5-minute in-memory
+        # window — a days-scale extrapolation finally fitted on a
+        # days-scale trend. Spool points older than the raw window
+        # prepend; raw ring points carry the fresh tail.
+        durable: dict[tuple, list] = {}
+        try:
+            from seaweedfs_tpu.stats import store as store_mod
+
+            st = store_mod.store()
+            if st is not None:
+                for lk, pts in st.forecast_points(
+                        "SeaweedFS_volume_disk_used_bytes").items():
+                    labels = dict(lk)
+                    key = (str(labels.get("server", "")),
+                           str(labels.get("dir", "")))
+                    durable.setdefault(key, []).extend(pts)
+        except Exception:
+            pass
         fresh: dict[tuple, float] = {}
+        window_used = self.forecast_window
         for entry in snap:
             labels = entry.get("labels", {})
             key = (str(labels.get("server", "")), str(labels.get("dir", "")))
-            slope = linear_slope(entry.get("samples") or ())
+            raw = [(t, v) for t, v in (entry.get("samples") or ())]
+            raw_t0 = raw[0][0] if raw else now
+            pts = sorted(
+                p for p in durable.get(key, ()) if p[0] < raw_t0
+            ) + raw
+            slope = linear_slope(pts)
             if slope is None or slope < self.min_slope:
                 continue
             fb = free.get(key)
             if fb is None or fb < 0:
                 continue
             fresh[key] = fb / slope / 86400.0
+            if pts:
+                window_used = max(window_used, now - pts[0][0])
         with self._lock:
             self._days = fresh
+            self._fit_window = window_used
 
     # --- export --------------------------------------------------------------
     def lines(self) -> list[str]:
@@ -232,7 +264,8 @@ class HeatEngine:
             "forecast": forecast,
             "params": {"alpha": self.alpha, "window": self.window,
                        "promote": self.promote, "demote": self.demote,
-                       "forecast_window": self.forecast_window},
+                       "forecast_window": self.forecast_window,
+                       "fit_window": round(self._fit_window, 1)},
         }
 
 

@@ -294,6 +294,49 @@ class MetricsHistory:
             if fn in self._listeners:
                 self._listeners.remove(fn)
 
+    # --- durable-store seam (stats/store.py) -----------------------------------
+    def samples_since(self, since: float) -> list[tuple]:
+        """-> [(t, family, labels_dict, value)] every stored sample
+        strictly after `since`, oldest first — the telemetry store's
+        flusher pulls the ring tail through this watermark (the ring is
+        the buffer; a deferred flush just re-pulls the same tail)."""
+        out = []
+        with self._lock:
+            for (name, _), (labels, dq) in self._series.items():
+                for t, v in dq:
+                    if t > since:
+                        out.append((t, name, dict(labels), v))
+        out.sort(key=lambda p: p[0])
+        return out
+
+    def preload(self, points) -> int:
+        """Inject replayed samples (t, family, labels_dict, value) from a
+        spool — restart replay, before live scraping. The replay
+        watermark becomes `last_scrape`, so the next live scrape
+        zero-seeds nothing that already has history (replayed keys join
+        `_ever_seen`) and `counter_rate`'s reset clamp turns the restart
+        into a plain counter reset instead of a phantom spike."""
+        pts = sorted(points, key=lambda p: p[0])
+        n = 0
+        with self._lock:
+            for t, name, labels, v in pts:
+                key = (name, tuple(sorted(labels.items())))
+                ent = self._series.get(key)
+                if ent is None:
+                    if len(self._series) >= self.max_series:
+                        self.dropped_series_total += 1
+                        continue
+                    if len(self._ever_seen) < 8 * self.max_series:
+                        self._ever_seen.add(key)
+                    ent = self._series[key] = (
+                        dict(labels),
+                        collections.deque(maxlen=self.slots))
+                ent[1].append((float(t), float(v)))
+                n += 1
+            if pts:
+                self.last_scrape = max(self.last_scrape, pts[-1][0])
+        return n
+
     # --- views -----------------------------------------------------------------
     def rates(self, family: str, window: float, now: float | None = None):
         """-> [(labels_dict, rate | None)] for every series of `family`."""

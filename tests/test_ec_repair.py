@@ -134,6 +134,77 @@ class TestAutoMode:
                 "node_inflight"} <= set(p)
 
 
+class _FakeHolder:
+    def __init__(self, id_, shards, free):
+        self.id = id_
+        self.http = f"http://{id_}"
+        self.ec_shards = {7: list(shards)}
+        self._free = free
+
+    def free_slots(self):
+        return self._free
+
+
+class _FakeEnv:
+    def __init__(self, holders):
+        self._holders = holders
+
+    def servers(self):
+        return self._holders
+
+
+class TestPreferRebuilder:
+    """Restart stickiness: the committed frontier lives in the old
+    writer's partial state, so a chain restart must re-plan with the
+    SAME rebuilder whenever it is still usable — the (shard-count,
+    free_slots) ranking shifts while volumes move, and a writer flip
+    mid-ladder silently discards every landed chunk (the resumed_bytes
+    flake this pins down)."""
+
+    def _env(self, free_a=5, free_b=9):
+        return _FakeEnv([
+            _FakeHolder("a:1", [0, 1, 2, 3], free_a),
+            _FakeHolder("b:1", [4, 5, 6, 7], free_b),
+            _FakeHolder("c:1", [8, 9, 10], 2),
+            _FakeHolder("d:1", [11, 12], 1),
+        ])
+
+    def test_default_ranking_unchanged(self):
+        pplan = plan_rebuild_pipelined(self._env(), 7)
+        assert pplan["rebuilder"] == "b:1"
+        assert pplan["chain"][-1]["server"] == "b:1"
+        assert pplan["chain"][-1]["write"]
+
+    def test_preferred_writer_wins_over_ranking(self):
+        pplan = plan_rebuild_pipelined(
+            self._env(), 7, prefer_rebuilder="a:1")
+        assert pplan["rebuilder"] == "a:1"
+        assert pplan["chain"][-1]["server"] == "a:1"
+        assert pplan["chain"][-1]["write"]
+        # the chain still covers every decode input exactly once
+        contributed = [s for hop in pplan["chain"] for s in hop["shards"]]
+        assert sorted(contributed) == sorted(set(contributed))
+        assert set(pplan["use"]) == set(contributed)
+
+    def test_sticky_across_free_slot_flip(self):
+        # first plan ranks b; volumes move and the tiebreak flips to a —
+        # a restart that passes the old writer must NOT follow the flip
+        first = plan_rebuild_pipelined(self._env(free_a=5, free_b=9), 7)
+        assert first["rebuilder"] == "b:1"
+        again = plan_rebuild_pipelined(
+            self._env(free_a=20, free_b=1), 7,
+            prefer_rebuilder=first["rebuilder"])
+        assert again["rebuilder"] == "b:1"
+
+    def test_gone_preferred_falls_back_to_ranking(self):
+        pplan = plan_rebuild_pipelined(
+            self._env(), 7, exclude=("c:1",), prefer_rebuilder="c:1")
+        assert pplan["rebuilder"] == "b:1"
+        pplan = plan_rebuild_pipelined(
+            self._env(), 7, prefer_rebuilder="nope:0")
+        assert pplan["rebuilder"] == "b:1"
+
+
 def _wire_bytes(mode: str) -> float:
     from seaweedfs_tpu.stats import default_registry
 

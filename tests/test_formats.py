@@ -406,6 +406,15 @@ class TestMetricNameLint:
         assert "SeaweedFS_cluster_telemetry_stale" in collector_names
         assert "SeaweedFS_cluster_alerts_firing" in collector_names
         assert tool.cluster_telemetry_violations() == []
+        # PR-19: durable-telemetry spool families (stats/store.py) —
+        # spool gauge/cap pair, flush + replay timers, eviction counter
+        assert kinds["SeaweedFS_telemetry_spool_bytes"] == "gauge"
+        assert kinds["SeaweedFS_telemetry_spool_cap_bytes"] == "gauge"
+        assert kinds["SeaweedFS_telemetry_flush_seconds"] == "histogram"
+        assert kinds["SeaweedFS_telemetry_replay_seconds"] == "histogram"
+        assert kinds["SeaweedFS_telemetry_segments_evicted_total"] \
+            == "counter"
+        assert tool.telemetry_violations() == []
 
     def test_cluster_telemetry_lint_catches_violations(self, monkeypatch):
         from seaweedfs_tpu.stats import aggregate
@@ -435,6 +444,40 @@ class TestMetricNameLint:
         assert any("duplicate" in b for b in bad)
         assert any("slo_burn_fast" in b and "prefix" in b for b in bad)
         assert any("page-me" in b for b in bad)
+
+    def test_telemetry_lint_catches_violations(self, monkeypatch):
+        from seaweedfs_tpu.stats import alerts
+        from seaweedfs_tpu.stats import store as store_mod
+
+        tool = self._tool()
+        monkeypatch.setattr(
+            store_mod, "TELEMETRY_FAMILIES",
+            tuple(f for f in store_mod.TELEMETRY_FAMILIES
+                  if f != "SeaweedFS_telemetry_flush_seconds")
+            + ("SeaweedFS_telemetry_BadName",
+               "SeaweedFS_spool_not_telemetry_bytes"),
+        )
+        # drop the 10m tier and unbalance the retention shares
+        monkeypatch.setattr(
+            store_mod, "TIERS",
+            (("raw", "raw", 0.25), ("1m", "m1", 0.25),
+             ("events", "ev", 0.25)),
+        )
+        orig_rules = alerts.default_rules
+        monkeypatch.setattr(
+            alerts, "default_rules",
+            lambda: [r for r in orig_rules()
+                     if r.name != "telemetry_spool_near_cap"],
+        )
+        bad = tool.telemetry_violations()
+        assert any("SeaweedFS_telemetry_BadName" in b for b in bad)
+        assert any("SeaweedFS_spool_not_telemetry_bytes" in b
+                   and "subsystem" in b for b in bad)
+        assert any("SeaweedFS_telemetry_flush_seconds" in b
+                   and "missing" in b for b in bad)
+        assert any("'10m'" in b and "TIERS" in b for b in bad)
+        assert any("shares" in b for b in bad)
+        assert any("telemetry_spool_near_cap" in b for b in bad)
 
     def test_usage_heat_lint_catches_violations(self, monkeypatch):
         from seaweedfs_tpu.stats import heat, usage

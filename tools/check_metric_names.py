@@ -36,7 +36,7 @@ import sys
 NAME_RE = re.compile(
     r"^SeaweedFS_"
     r"(master|volume|filer|s3|http|stats|mount|mq|iam|alerts|process"
-    r"|maintenance|faults|events|slo|usage|heat|node|cluster)_"
+    r"|maintenance|faults|events|slo|usage|heat|node|cluster|telemetry)_"
     r"[a-z][a-z0-9]*(_[a-z0-9]+)*$"
 )
 
@@ -85,6 +85,9 @@ def collect() -> tuple[dict[str, str], list[str]]:
     from seaweedfs_tpu.maintenance import scrub as scrub_mod
 
     scrub_mod.ensure_metrics()  # SeaweedFS_volume_scrub_* families
+    from seaweedfs_tpu.stats import store as store_mod
+
+    store_mod.ensure_metrics()  # SeaweedFS_telemetry_* spool families
     from seaweedfs_tpu.storage.volume import degraded_reads_counter
     from seaweedfs_tpu.util import faults as faults_mod
 
@@ -586,6 +589,49 @@ def cluster_telemetry_violations() -> list[str]:
     return bad
 
 
+def telemetry_violations() -> list[str]:
+    """The durable-telemetry contract (stats/store.py): every spool
+    family declared, in the `telemetry` subsystem, with the spool gauge
+    + cap pair both present (the near-cap alert divides one by the
+    other, so a renamed gauge would silently un-wire it), the flush and
+    replay timers present, and the telemetry_spool_near_cap rule a
+    warning — eviction is an ops heads-up, never an incident page."""
+    from seaweedfs_tpu.stats import alerts
+    from seaweedfs_tpu.stats import store as store_mod
+
+    bad: list[str] = []
+    fams = store_mod.TELEMETRY_FAMILIES
+    for fam in fams:
+        if not NAME_RE.match(fam):
+            bad.append(f"telemetry family {fam!r}: does not match"
+                       f" SeaweedFS_<subsystem>_<snake_case>")
+        elif not fam.startswith("SeaweedFS_telemetry_"):
+            bad.append(f"telemetry family {fam!r}: must live in the"
+                       f" `telemetry` subsystem")
+    for required in ("SeaweedFS_telemetry_spool_bytes",
+                     "SeaweedFS_telemetry_spool_cap_bytes",
+                     "SeaweedFS_telemetry_flush_seconds",
+                     "SeaweedFS_telemetry_replay_seconds",
+                     "SeaweedFS_telemetry_segments_evicted_total"):
+        if required not in fams:
+            bad.append(f"telemetry family {required!r}: missing from"
+                       f" TELEMETRY_FAMILIES")
+    tiers = {t for t, _, _ in store_mod.TIERS}
+    for required_tier in ("raw", "1m", "10m", "events"):
+        if required_tier not in tiers:
+            bad.append(f"telemetry tier {required_tier!r}: missing from"
+                       f" store.TIERS (the spool gauge's tier label set)")
+    shares = sum(share for _, _, share in store_mod.TIERS)
+    if not 0.99 <= shares <= 1.01:
+        bad.append(f"telemetry tier shares sum to {shares:g}: the"
+                   f" -telemetry.retention budget must be fully carved")
+    severities = {r.name: r.severity for r in alerts.default_rules()}
+    if severities.get("telemetry_spool_near_cap") != "warning":
+        bad.append("alert rule telemetry_spool_near_cap: missing or"
+                   " not warning")
+    return bad
+
+
 def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
     bad: list[str] = []
     for name in sorted(set(kinds) | set(collector_names)):
@@ -613,7 +659,8 @@ def main() -> int:
         + degraded_reason_violations() + repair_reason_violations() \
         + stream_lazy_violations() \
         + event_type_violations() + slo_violations() + scrub_violations() \
-        + usage_heat_violations() + cluster_telemetry_violations()
+        + usage_heat_violations() + cluster_telemetry_violations() \
+        + telemetry_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
         print(f"{len(bad)} metric-name violation(s) in {total} families:")
