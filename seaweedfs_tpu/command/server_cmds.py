@@ -64,6 +64,19 @@ def _add_telemetry_flags(p) -> None:
     )
 
 
+def _add_qos_flag(p) -> None:
+    p.add_argument(
+        "-qos.limits", dest="qos_limits", default=None,
+        help="arm QoS admission control (qos/admission.py) with"
+             " per-collection token-bucket limits:"
+             " 'tenant-a=100,tenant-b=50:200,*=25' (rps[:burst], '*' ="
+             " default for unlisted tenants). Also starts the SLO-burn"
+             " actuator; limits stay adjustable at runtime via"
+             " POST /qos/limits and the cluster.qos shell verb. Unset ="
+             " admission disarmed (one attribute check per request)",
+    )
+
+
 def _arm_faults(opts) -> None:
     if getattr(opts, "faults", None) is None:
         return
@@ -236,6 +249,7 @@ def run_filer(args: list[str]) -> int:
                         "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
     _add_telemetry_flags(p)
     _add_faults_flag(p)
+    _add_qos_flag(p)
     opts = p.parse_args(args)
     _arm_faults(opts)
     from seaweedfs_tpu.server.filer import FilerServer
@@ -268,6 +282,7 @@ def run_filer(args: list[str]) -> int:
         slow_ms=opts.slow_ms,
         telemetry_dir=opts.telemetry_dir,
         telemetry_retention_mb=opts.telemetry_retention,
+        qos_limits=opts.qos_limits,
     )
     f.start()
     print(f"filer listening at {f.url}")
@@ -329,6 +344,7 @@ def run_server(args: list[str]) -> int:
                    help="scrub read-budget in MB/s (token bucket)")
     _add_telemetry_flags(p)
     _add_faults_flag(p)
+    _add_qos_flag(p)
     opts = p.parse_args(args)
     _arm_faults(opts)
 
@@ -374,6 +390,7 @@ def run_server(args: list[str]) -> int:
             compress=opts.filer_compress == "true",
             dedup=opts.filer_dedup,
             security=sec,
+            qos_limits=opts.qos_limits,
         )
         f.start()
         print(f"filer listening at {f.url}")
@@ -387,7 +404,8 @@ def run_server(args: list[str]) -> int:
                 with open(opts.s3_config) as fh:
                     config = _json.load(fh)
             s3 = S3Server(f.url, host=opts.ip, port=opts.s3_port,
-                          config=config, master_url=m.url)
+                          config=config, master_url=m.url,
+                          qos_limits=opts.qos_limits)
             s3.start()
             print(f"s3 gateway listening at {s3.url}")
     return _wait_forever()
@@ -429,6 +447,7 @@ def run_s3(args: list[str]) -> int:
                         "server's role (overrides SEAWEEDFS_TPU_SLOW_MS)")
     _add_telemetry_flags(p)
     _add_faults_flag(p)
+    _add_qos_flag(p)
     opts = p.parse_args(args)
     _arm_faults(opts)
     _load_security()
@@ -449,7 +468,8 @@ def run_s3(args: list[str]) -> int:
     s3 = S3Server(filer, host=opts.ip, port=opts.port, config=config,
                   slow_ms=opts.slow_ms, master_url=master or None,
                   telemetry_dir=opts.telemetry_dir,
-                  telemetry_retention_mb=opts.telemetry_retention)
+                  telemetry_retention_mb=opts.telemetry_retention,
+                  qos_limits=opts.qos_limits)
     s3.start()
     print(f"s3 gateway listening at {s3.url}")
     return _wait_forever()

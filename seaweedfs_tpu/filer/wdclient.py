@@ -60,6 +60,7 @@ class WeedClient:
         collection: str = "",
         ttl: str = "",
         data_center: str = "",
+        shard: str = "",
     ) -> dict:
         qs = f"count={count}"
         if replication:
@@ -70,6 +71,10 @@ class WeedClient:
             qs += f"&ttl={ttl}"
         if data_center:
             qs += f"&dataCenter={data_center}"
+        if shard:
+            # "i:n" — constrain the pick to vids where vid % n == i (the
+            # gateway lease-pool vid-space sharding; see FilerServer)
+            qs += f"&shard={shard}"
         return self._master_get(f"/dir/assign?{qs}")
 
     def _master_get(self, path_qs: str) -> dict:

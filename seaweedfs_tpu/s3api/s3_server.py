@@ -82,6 +82,7 @@ class S3Server:
         master_url: str | None = None,
         telemetry_dir: str | None = None,
         telemetry_retention_mb: float | None = None,
+        qos_limits: str | None = None,
     ) -> None:
         self.fc = FilerClient(filer_url)
         # the gateway has no heartbeat/register link of its own, so an
@@ -107,6 +108,17 @@ class S3Server:
             from seaweedfs_tpu.stats import trace as trace_mod
 
             trace_mod.set_slow_threshold_ms(slow_ms, role="s3")
+        # -qos.limits: arm admission control (qos/) + the burn actuator;
+        # the bucket IS the collection on the S3 surface, so the same
+        # tenant limit holds here and on the filer front door
+        if qos_limits is not None:
+            from seaweedfs_tpu.qos import actuator as qos_act
+            from seaweedfs_tpu.qos import admission as qos_mod
+
+            limits, default = qos_mod.parse_limits_spec(qos_limits)
+            qos_mod.controller().set_limits(limits=limits, default=default)
+            qos_mod.enable()
+            qos_act.start(master_url=master_url)
         self._iam_subscriber = None
         self._routes()
 
@@ -127,6 +139,7 @@ class S3Server:
         )
         self._fl_s3_on = False
         self._fl_native_buckets: dict[str, int] = {}
+        self._fl_qos_revoked: set[str] = set()  # buckets shed off native
         self._fl_meta_dirty: set[str] = set()
         self._fl_uploads: set[tuple[str, str]] = set()
         self._fl_collector = None
@@ -240,6 +253,34 @@ class S3Server:
         # tick; same-gateway changes push synchronously from the handlers
         while not self._fl_reval_stop.wait(2.0):
             try:
+                # QoS lever over the native front: a bucket in admission
+                # deficit (qos/admission.py over_limit — its token bucket
+                # ran dry, possibly from natively-served traffic charged
+                # through the usage ABI fold) gets its native flags
+                # revoked so the NEXT requests land on this dispatcher,
+                # where typed 429/503s are served; flags restore within
+                # one tick of the bucket recovering
+                from seaweedfs_tpu.qos import admission as qos_ctl
+
+                ctl = qos_ctl.controller()
+                if ctl.armed:
+                    from seaweedfs_tpu.storage import fastlane as fl_mod
+
+                    self._qos_usage_state = fl_mod.qos_charge_usage(
+                        self.fastlane,
+                        getattr(self, "_qos_usage_state", {}))
+                    for bucket in list(self._fl_native_buckets):
+                        if ctl.over_limit(bucket):
+                            self._fl_revoke_bucket(bucket)
+                            self._fl_qos_revoked.add(bucket)
+                    for bucket in list(self._fl_qos_revoked):
+                        if not ctl.over_limit(bucket):
+                            self._fl_qos_revoked.discard(bucket)
+                            self._fl_push_bucket(bucket)
+                elif self._fl_qos_revoked:
+                    for bucket in list(self._fl_qos_revoked):
+                        self._fl_push_bucket(bucket)
+                    self._fl_qos_revoked.clear()
                 for bucket in list(self._fl_native_buckets):
                     self._fl_push_bucket(bucket)
                 # uploads completed/aborted through ANOTHER gateway leave
@@ -389,6 +430,35 @@ class S3Server:
         pairs = self._query_pairs(req)
         q = dict(pairs)
         resource = f"/{bucket}/{key}" if key else f"/{bucket}"
+        if bucket:
+            # QoS admission (qos/admission.py) before auth or body bytes:
+            # the bucket IS the collection. A shed is a typed S3 error —
+            # SlowDown (429, tenant-caused) / ServiceUnavailable (503,
+            # capacity) — with Retry-After + machine-readable reason.
+            # The unconfigured path is one attribute check.
+            from seaweedfs_tpu import qos as qos_mod
+
+            if qos_mod.controller().armed:
+                d = None
+                try:
+                    cls = qos_mod.classify(
+                        req.method, req.headers,
+                        background_hint=(req.method == "GET" and not key))
+                    d = qos_mod.admit(bucket, cls)
+                except Exception:
+                    d = None  # admission must never fail a request untyped
+                if d is not None:
+                    code = ("SlowDown" if d.status == 429
+                            else "ServiceUnavailable")
+                    resp = error_response(
+                        S3ApiError(code,
+                                   f"qos {d.reason}: request shed;"
+                                   f" retry after {d.retry_after:.1f}s",
+                                   d.status),
+                        resource)
+                    resp.headers.update(d.headers())
+                    self._apply_cors_headers(req, bucket, resp)
+                    return resp
         if (
             req.method == "POST"
             and bucket

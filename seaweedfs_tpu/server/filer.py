@@ -70,6 +70,7 @@ class FilerServer:
         slow_ms: float | None = None,
         telemetry_dir: str | None = None,
         telemetry_retention_mb: float | None = None,
+        qos_limits: str | None = None,
     ) -> None:
         from seaweedfs_tpu.security import Guard, SecurityConfig
 
@@ -99,6 +100,16 @@ class FilerServer:
             from seaweedfs_tpu.stats import trace as trace_mod
 
             trace_mod.set_slow_threshold_ms(slow_ms, role="filer")
+        # -qos.limits: arm admission control (qos/) + the burn actuator;
+        # without the flag the per-request check is one attribute read
+        if qos_limits is not None:
+            from seaweedfs_tpu.qos import actuator as qos_act
+            from seaweedfs_tpu.qos import admission as qos_mod
+
+            limits, default = qos_mod.parse_limits_spec(qos_limits)
+            qos_mod.controller().set_limits(limits=limits, default=default)
+            qos_mod.enable()
+            qos_act.start(master_url=master_url)
         self.metrics_service = (
             MetricsService(host, max(metrics_port, 0)) if metrics_port != 0 else None
         )
@@ -157,6 +168,11 @@ class FilerServer:
         self.filer.subscribe(self._conf_on_meta)
         self._register_stop = __import__("threading").Event()
         self._fl_collector = None
+        # gateway ordinal/count from the master's cluster registry
+        # (/cluster/register response): shards the fid lease vid-space
+        # so N filer front doors never contend on the same volume
+        self._gateway_ordinal = 0
+        self._gateway_count = 1
         self._routes()
 
     def _conf_on_meta(self, ev) -> None:
@@ -178,12 +194,18 @@ class FilerServer:
         self.filer_conf = FilerConf.from_bytes(bytes(content))
         self._fl_push_rules()
 
+    # control-plane namespaces the native front door must always defer
+    # to Python — a query-less POST /qos/limits is a config update for
+    # the route table, not an inline file write
+    FL_RESERVED_PREFIXES = ("/qos/",)
+
     def _fl_push_rules(self) -> None:
         """Tell the engine which prefixes carry storage rules (their
         writes must resolve collection/replication/ttl in Python)."""
         if not getattr(self, "_fl_filer_on", False) or self.fastlane is None:
             return
-        prefixes = self.filer_conf.prefixes()
+        prefixes = list(self.FL_RESERVED_PREFIXES) \
+            + list(self.filer_conf.prefixes())
         blob = b"".join(p.encode() + b"\0" for p in prefixes)
         self.fastlane._lib.sw_fl_filer_rules_set(
             self.fastlane.handle, blob, len(prefixes))
@@ -448,6 +470,12 @@ class FilerServer:
             a = self.client.assign(
                 count=count, replication=self.default_replication,
                 collection=self.collection,
+                # lease-pool vid-space sharding: with N registered filer
+                # gateways, this one only leases volumes in its slice
+                # (the master falls back to the whole space when the
+                # slice has no writables — correctness over partition)
+                shard=(f"{self._gateway_ordinal}:{self._gateway_count}"
+                       if getattr(self, "_gateway_count", 1) > 1 else ""),
             )
             if a.get("error"):
                 return
@@ -631,11 +659,23 @@ class FilerServer:
                     "filer", self.url, interval=5.0)
             except Exception:
                 pass
-            http_request(
+            _status, _hdrs, body = http_request(
                 "POST", self.client.master_url + "/cluster/register",
                 body=json.dumps(payload).encode(),
                 headers={"Content-Type": "application/json"}, timeout=5,
             )
+            # the registry answers with this filer's position among the
+            # live filer group — the fid-lease shard key (each gateway
+            # leases only vids where vid % gateways == ordinal, so front
+            # doors scale without lease contention)
+            try:
+                out = json.loads(body)
+                n = int(out.get("gateways", 0))
+                i = int(out.get("ordinal", -1))
+                if n >= 1 and 0 <= i < n:
+                    self._gateway_ordinal, self._gateway_count = i, n
+            except Exception:
+                pass
         except Exception:
             pass
 
@@ -643,6 +683,17 @@ class FilerServer:
         while not self._register_stop.wait(5.0):
             self._register_once()
             self.dlm.sweep()
+            try:
+                # native-path admission check (storage/fastlane.py):
+                # requests the engine front door served still debit the
+                # tenant's qos bucket via the usage ABI deltas
+                from seaweedfs_tpu.storage import fastlane as fl_mod
+
+                self._qos_usage_state = fl_mod.qos_charge_usage(
+                    getattr(self, "fastlane", None),
+                    getattr(self, "_qos_usage_state", {}))
+            except Exception:
+                pass
 
     def stop(self) -> None:
         self._register_stop.set()
@@ -1413,18 +1464,27 @@ class FilerServer:
 
         @svc.route("GET", path_re)
         def read(req: Request) -> Response:
+            shed = self._admit(req)
+            if shed is not None:
+                return shed
             resp = self._do_read(req, head=False)
             self._account_usage(req, resp, bytes_out=len(resp.body))
             return resp
 
         @svc.route("HEAD", path_re)
         def head(req: Request) -> Response:
+            shed = self._admit(req)
+            if shed is not None:
+                return shed
             resp = self._do_read(req, head=True)
             self._account_usage(req, resp)
             return resp
 
         @svc.route("POST", path_re)
         def post(req: Request) -> Response:
+            shed = self._admit(req)
+            if shed is not None:
+                return shed
             resp = self._do_write(req)
             self._account_usage(
                 req, resp,
@@ -1433,6 +1493,9 @@ class FilerServer:
 
         @svc.route("PUT", path_re)
         def put(req: Request) -> Response:
+            shed = self._admit(req)
+            if shed is not None:
+                return shed
             resp = self._do_write(req)
             self._account_usage(
                 req, resp,
@@ -1441,29 +1504,56 @@ class FilerServer:
 
         @svc.route("DELETE", path_re)
         def delete(req: Request) -> Response:
+            shed = self._admit(req)
+            if shed is not None:
+                return shed
             resp = self._do_delete(req)
             self._account_usage(req, resp)
             return resp
+
+    def _resolve_collection(self, req: Request) -> str:
+        """The tenant dimension both usage accounting AND qos admission
+        key on — resolved exactly like the write path's placement:
+        explicit ?collection=, then the fs.configure rule, then the
+        filer default."""
+        path = normalize(urllib.parse.unquote(req.path))
+        coll = req.query.get("collection")
+        if not coll and not path.startswith("/etc/"):
+            rule = self.filer_conf.match(path) or {}
+            coll = rule.get("collection")
+        return coll or self.collection or "default"
+
+    def _admit(self, req: Request) -> Response | None:
+        """QoS admission at the engine boundary (qos/admission.py),
+        BEFORE any bytes move. None = admitted; otherwise a typed
+        429/503 with Retry-After and a machine-readable reason — never
+        an untyped failure. The unconfigured path is one attribute
+        check inside qos.admit."""
+        from seaweedfs_tpu import qos as qos_mod
+
+        if not qos_mod.controller().armed:
+            return None
+        try:
+            coll = self._resolve_collection(req)
+            cls = qos_mod.classify(req.method, req.headers)
+            d = qos_mod.admit(coll, cls)
+        except Exception:  # admission must never fail a request untyped
+            return None
+        if d is None:
+            return None
+        return Response(d.to_dict(), d.status, headers=d.headers())
 
     def _account_usage(self, req: Request, resp: Response,
                        bytes_in: int = 0, bytes_out: int = 0) -> None:
         """Tenant accounting for the Python front door (stats/usage.py).
         Requests the fastlane engine serves natively never reach these
         handlers — the accountant folds those in separately from the
-        engine's per-collection counters, so nothing double-counts. The
-        collection resolves exactly like the write path's placement:
-        explicit ?collection=, then the fs.configure rule, then the
-        filer default."""
+        engine's per-collection counters, so nothing double-counts."""
         try:
-            path = normalize(urllib.parse.unquote(req.path))
-            coll = req.query.get("collection")
-            if not coll and not path.startswith("/etc/"):
-                rule = self.filer_conf.match(path) or {}
-                coll = rule.get("collection")
             from seaweedfs_tpu.stats import usage as usage_mod
 
             usage_mod.accountant().record(
-                coll or self.collection or "default",
+                self._resolve_collection(req),
                 bytes_in=float(bytes_in), bytes_out=float(bytes_out),
                 error=resp.status >= 500,
             )

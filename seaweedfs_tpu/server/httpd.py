@@ -278,12 +278,20 @@ class HTTPService:
                 req = None
                 resp = Response({"error": f"no route {handler.command} {path}"}, 404)
         if self.metrics_role is not None:
-            self._m_total.labels(
-                self.metrics_role, handler.command, str(resp.status)
-            ).inc()
-            self._m_seconds.labels(self.metrics_role, handler.command).observe(
-                _time.monotonic() - start
-            )
+            # a QoS shed (X-Sw-Qos-Reason rides every one) is a
+            # deliberate refusal AHEAD of service, not a service
+            # failure: counting its 503 in http_request_total would
+            # burn the very availability SLO the actuator watches and
+            # the shed would sustain itself — locally and cluster-wide,
+            # since telemetry frames ship these counters to the master.
+            # SeaweedFS_qos_shed_total is the canonical record.
+            if "X-Sw-Qos-Reason" not in resp.headers:
+                self._m_total.labels(
+                    self.metrics_role, handler.command, str(resp.status)
+                ).inc()
+                self._m_seconds.labels(
+                    self.metrics_role, handler.command
+                ).observe(_time.monotonic() - start)
         if span is not None:
             from seaweedfs_tpu.stats import trace as _trace
 
@@ -719,6 +727,57 @@ def _register_debug_routes(service: "HTTPService") -> None:
         out["proc"] = prof_mod.PROCESS_TOKEN
         out["role"] = service.trace_role or service.metrics_role
         return Response(out)
+
+    @service.route("GET", r"/qos/limits")
+    def qos_limits_get(req: Request) -> Response:
+        """This process's admission-control state (qos/admission.py):
+        limits, gates, queue bounds, admitted/queued/shed counters and
+        live bucket levels. `/debug/qos` is the same payload."""
+        from seaweedfs_tpu.qos import admission as qos_mod
+        from seaweedfs_tpu.stats import profiler as prof_mod
+
+        out = qos_mod.controller().status()
+        act = None
+        from seaweedfs_tpu.qos import actuator as act_mod
+
+        a = act_mod.actuator()
+        if a is not None:
+            act = {"level": a.level, "burn": round(a.last_burn, 3),
+                   "fast_burn": a.fast_burn}
+        out["actuator"] = act
+        out["proc"] = prof_mod.PROCESS_TOKEN
+        out["role"] = service.trace_role or service.metrics_role
+        return Response(out)
+
+    service.route("GET", r"/debug/qos")(qos_limits_get)
+
+    @service.route("POST", r"/qos/limits")
+    def qos_limits_post(req: Request) -> Response:
+        """Runtime limit updates for THIS process — the cluster.qos verb
+        fans this out across discovered gateways. Body (all optional):
+          {"limits": {"tenant-a": 100, "tenant-b": [50, 200]},
+           "default": 25, "queue_depth": 32, "queue_wait": 0.25,
+           "spec": "tenant-a=100,*=25"}
+        `limits`/`spec` replace the whole table (declarative, like the
+        CLI flag); values are rps or [rps, burst]. Posting any config
+        arms admission on a metered server."""
+        from seaweedfs_tpu.qos import admission as qos_mod
+
+        p = req.json()
+        ctl = qos_mod.controller()
+        try:
+            limits, default = p.get("limits"), p.get("default")
+            if "spec" in p:
+                limits, default = qos_mod.parse_limits_spec(p["spec"])
+            ctl.set_limits(limits=limits, default=default,
+                           queue_depth=p.get("queue_depth"),
+                           queue_wait=p.get("queue_wait"))
+            qos_mod.enable()
+        except (ValueError, TypeError) as e:
+            return Response({"error": str(e)}, 400)
+        return Response({"ok": True, "armed": ctl.armed,
+                         "limits": ctl.status()["limits"],
+                         "default": ctl.status()["default"]})
 
     @service.route("GET", r"/debug/faults")
     def debug_faults_get(req: Request) -> Response:

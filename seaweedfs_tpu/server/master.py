@@ -310,10 +310,15 @@ class MasterServer:
         return lines
 
     def _fl_assign_install(self, req, count: int, replication: str,
-                           collection: str, ttl: str, dc: str) -> None:
+                           collection: str, ttl: str, dc: str,
+                           shard: tuple[int, int] | None = None) -> None:
         """After a Python-served assign: teach the engine this exact query.
         The profile snapshot is the layout's current writable volume set;
-        any heartbeat clears every profile (sync is cheap, staleness isn't)."""
+        any heartbeat clears every profile (sync is cheap, staleness isn't).
+        The profile keys on the raw query, so a gateway's `?shard=i:n`
+        lease slice gets its own profile — restricted to the slice's
+        vids (falling back to the full set when the slice is empty,
+        mirroring VolumeLayout.pick_for_write's soft constraint)."""
         if self.fastlane is None or count != 1 or not self._is_leader():
             return
         import json as _json
@@ -322,7 +327,12 @@ class MasterServer:
         lo = self.topo.layout(collection, rp, TTL.parse(ttl).to_u32())
         entries = []
         with lo._lock:
-            for vid in lo.writables:
+            writables = list(lo.writables)
+            if shard is not None and shard[1] > 1:
+                sliced = [v for v in writables if v % shard[1] == shard[0]]
+                if sliced:
+                    writables = sliced
+            for vid in writables:
                 nodes = lo.locations.get(vid, [])
                 if not nodes:
                     continue
@@ -713,6 +723,20 @@ class MasterServer:
             collection = req.query.get("collection", "")
             ttl = req.query.get("ttl", "")
             dc = req.query.get("dataCenter", "")
+            # ?shard=i:n — gateway lease-pool vid-space sharding: prefer
+            # vids where vid % n == i (soft: falls back to the whole
+            # space when the slice has no writables)
+            shard = None
+            shard_s = req.query.get("shard", "")
+            if shard_s:
+                try:
+                    i_s, _, n_s = shard_s.partition(":")
+                    shard = (int(i_s), int(n_s))
+                    if shard[1] < 1 or not 0 <= shard[0] < shard[1]:
+                        raise ValueError(shard_s)
+                except ValueError:
+                    return Response(
+                        {"error": f"bad shard {shard_s!r} (want i:n)"}, 400)
             rp = ReplicaPlacement.parse(replication)
             ttl_u32 = TTL.parse(ttl).to_u32()
             from seaweedfs_tpu.raft import NotLeader
@@ -728,7 +752,7 @@ class MasterServer:
             try:
                 self._ensure_sequence_lease(count)
                 fid, cnt, nodes = self.topo.pick_for_write(
-                    count, replication, ttl, collection, dc
+                    count, replication, ttl, collection, dc, shard=shard
                 )
             except NotLeader:
                 return self._not_leader_response()
@@ -737,7 +761,7 @@ class MasterServer:
                 try:
                     self._grow_volumes(collection, rp, ttl_u32, dc)
                     fid, cnt, nodes = self.topo.pick_for_write(
-                        count, replication, ttl, collection, dc
+                        count, replication, ttl, collection, dc, shard=shard
                     )
                 except NotLeader:
                     return self._not_leader_response()
@@ -761,7 +785,7 @@ class MasterServer:
                 )
             else:
                 self._fl_assign_install(req, count, replication, collection,
-                                        ttl, dc)
+                                        ttl, dc, shard=shard)
             return Response(out)
 
         svc.route("GET", r"/dir/assign")(do_assign)
@@ -884,7 +908,22 @@ class MasterServer:
             tele = p.get("telemetry")
             if tele and getattr(self, "telemetry", None) is not None:
                 self.telemetry.ingest(tele)
-            return Response({"ok": True, "leader": self.url})
+            # answer with the member's position among its live peer group
+            # (ordered by first-seen, like group leadership): filers use
+            # ordinal/gateways to shard the fid-lease vid-space so N
+            # front doors never contend on the same volume
+            now = time.time()
+            ptype = p.get("type", "filer")
+            peers = sorted(
+                (m for m in self._members.values()
+                 if m["type"] == ptype and now - m["last_seen"] < 30),
+                key=lambda m: (m["created_ts"], m["address"]),
+            )
+            addrs = [m["address"] for m in peers]
+            out = {"ok": True, "leader": self.url, "gateways": len(addrs)}
+            if p["address"] in addrs:
+                out["ordinal"] = addrs.index(p["address"])
+            return Response(out)
 
         @svc.route("POST", r"/cluster/telemetry")
         def cluster_telemetry_push(req: Request) -> Response:

@@ -674,6 +674,16 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
                 "req_s": 0.0, "in_s": 0.0, "out_s": 0.0, "err_s": 0.0,
             })
 
+        # qos admission plane (qos/admission.py): per-class admit/queue/
+        # shed rates + the tenants being shed, off the same history fetch
+        qos_cls: dict[str, dict] = {}
+        qos_shed_colls: dict[str, float] = {}
+
+        def qrow(cls: str) -> dict:
+            return qos_cls.setdefault(cls, {
+                "admit_s": 0.0, "queue_s": 0.0, "shed_s": 0.0,
+            })
+
         for token in sorted(by_proc):
             series = hist_res[by_proc[token]].get("series", [])
             start_ts = None
@@ -711,6 +721,15 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
                     tenant(labels.get("collection", "?"))["out_s"] += rate
                 elif fam == "SeaweedFS_usage_errors_total" and rate:
                     tenant(labels.get("collection", "?"))["err_s"] += rate
+                elif fam == "SeaweedFS_qos_admitted_total" and rate:
+                    qrow(labels.get("class", "?"))["admit_s"] += rate
+                elif fam == "SeaweedFS_qos_queued_total" and rate:
+                    qrow(labels.get("class", "?"))["queue_s"] += rate
+                elif fam == "SeaweedFS_qos_shed_total" and rate:
+                    qrow(labels.get("class", "?"))["shed_s"] += rate
+                    coll = labels.get("collection", "?")
+                    qos_shed_colls[coll] = \
+                        qos_shed_colls.get(coll, 0.0) + rate
                 elif fam == "SeaweedFS_volume_heat_score":
                     key = (labels.get("server", "?"),
                            labels.get("volume", "?"))
@@ -796,6 +815,14 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
             ],
             "slos": slo_rows,
             "alerts_firing": firing,
+            "qos": {
+                "classes": qos_cls,
+                "top_shed": [
+                    {"collection": coll, "shed_s": r}
+                    for coll, r in sorted(qos_shed_colls.items(),
+                                          key=lambda kv: -kv[1])
+                ],
+            },
         }
         cache["snap"] = snap
         lines = [
@@ -885,6 +912,23 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
                     f"  out={_fmt_bytes_rate(t['out_s'])}"
                     + (f"  err={t['err_s']:.2f}/s" if t["err_s"] else "")
                 )
+        if qos_cls:
+            from seaweedfs_tpu.qos import PRIORITY_CLASSES as _QOS_CLASSES
+
+            lines.append("qos (admitted/queued/shed per class):")
+            order = [c for c in _QOS_CLASSES if c in qos_cls] + sorted(
+                c for c in qos_cls if c not in _QOS_CLASSES)
+            for cls in order:
+                q = qos_cls[cls]
+                lines.append(
+                    f"  {cls:<12} {q['admit_s']:>8.1f}/s"
+                    f"  queued={q['queue_s']:.2f}/s"
+                    f"  shed={q['shed_s']:.2f}/s")
+            top_shed = sorted(qos_shed_colls.items(),
+                              key=lambda kv: -kv[1])[:3]
+            if top_shed:
+                lines.append("  top shed tenants: " + ", ".join(
+                    f"{coll} {r:.2f}/s" for coll, r in top_shed))
         if heat_vols or days_full:
             bits = []
             if heat_vols:
@@ -1191,7 +1235,9 @@ def cmd_cluster_why(env: CommandEnv, args: list[str]) -> str:
     renders that volume's whole incident timeline; anything else is a
     collection (tenant) name — events carrying that collection
     correlation key (degraded reads, scrub findings, repair lifecycle,
-    usage-sketch overflow) assemble into a per-tenant timeline. Events
+    usage-sketch overflow, qos_shed admission rejections) assemble into
+    a per-tenant timeline, so "why is tenant X seeing 429s" reads as
+    the shed events next to whatever else hit that tenant. Events
     are deduped by (process token, seq) — single-process test clusters
     expose one ring at every port.
 
@@ -1559,6 +1605,107 @@ def cmd_cluster_faults(env: CommandEnv, args: list[str]) -> str:
     if len(seen) == 0:
         lines.append("  (no seams registered yet — servers not started?)")
     return "\n".join(lines)
+
+
+@command("cluster.qos",
+         "[-show] | [-limit 'coll=rps[:burst],…,*=rps'] [-default rps]"
+         " [-queueDepth n] [-queueWait s] [-node url] [-include url,url]"
+         " — show or set token-bucket admission limits across gateways")
+def cmd_cluster_qos(env: CommandEnv, args: list[str]) -> str:
+    """The admission-control switchboard (qos/admission.py): with no
+    flags, fan out GET /debug/qos across every discovered endpoint and
+    render armed state, per-collection limits, class gates and shed
+    counters. With -limit/-default/-queueDepth/-queueWait, POST the new
+    configuration to every gateway (filers and S3, plus -include'd
+    endpoints) so the whole admission plane moves together. -node
+    scopes either direction to one endpoint. Sheds show up in
+    cluster.top's qos block and as qos_shed events in cluster.why."""
+    flags = parse_flags(args)
+    endpoints = _discover_endpoints(env, flags.get("include", ""))
+    if "node" in flags:
+        node = flags["node"].rstrip("/")
+        if not node.startswith(("http://", "https://")):
+            node = "http://" + node
+        endpoints = {node}
+
+    setters = {"limit", "default", "queueDepth", "queueWait"}
+    if setters & flags.keys():
+        body: dict = {}
+        try:
+            if "limit" in flags:
+                body["spec"] = flags["limit"]
+            if "default" in flags:
+                body["default"] = float(flags["default"])
+            if "queueDepth" in flags:
+                body["queue_depth"] = int(flags["queueDepth"])
+            if "queueWait" in flags:
+                body["queue_wait"] = float(flags["queueWait"])
+        except ValueError as e:
+            raise ShellError(f"bad numeric flag: {e}")
+        ok, failed = [], []
+        armed_n = 0
+        for ep in sorted(endpoints):
+            try:
+                out = env.post(f"{ep}/qos/limits", body, timeout=10)
+                ok.append(ep)
+                if out.get("armed"):
+                    armed_n += 1
+            except Exception as e:
+                failed.append(f"{ep} ({e})")
+        lines = [
+            f"qos limits applied on {len(ok)}/{len(endpoints)}"
+            f" endpoint(s), {armed_n} armed"
+        ]
+        lines.extend(f"  failed: {f}" for f in failed)
+        if not ok:
+            raise ShellError("\n".join(lines))
+        return "\n".join(lines)
+
+    # default: -show — per-endpoint admission state
+    lines = []
+    reached = 0
+    for ep in sorted(endpoints):
+        try:
+            out = env.get(f"{ep}/debug/qos", timeout=10)
+        except Exception:
+            continue
+        reached += 1
+        armed = "armed" if out.get("armed") else "disarmed"
+        role = out.get("role", "?")
+        lines.append(f"  {ep} [{role}]: {armed}")
+        limits = out.get("limits") or {}
+        default = out.get("default")
+        if limits or default is not None:
+            parts = [
+                f"{c}={v[0]:g}:{v[1]:g}" for c, v in sorted(limits.items())
+            ]
+            if default is not None:
+                parts.append(f"*={default[0]:g}:{default[1]:g}")
+            lines.append(f"    limits: {', '.join(parts)}")
+        gates = out.get("gates") or {}
+        tightened = {c: g for c, g in gates.items() if g < 1.0}
+        if tightened:
+            act = out.get("actuator") or {}
+            lines.append(
+                "    gates: " + ", ".join(
+                    f"{c}={g:g}" for c, g in sorted(tightened.items()))
+                + f" (actuator level {act.get('level', '?')},"
+                  f" burn {act.get('burn', 0):.2f})"
+            )
+        # shed is {class: {"reason:collection": n}} — flatten for display
+        flat = {
+            f"{cls}/{key}": n
+            for cls, by_key in (out.get("shed") or {}).items()
+            for key, n in by_key.items()
+        }
+        if flat:
+            top = sorted(flat.items(), key=lambda kv: -kv[1])[:4]
+            lines.append(
+                "    shed: " + ", ".join(f"{k}={int(v)}" for k, v in top))
+    if not reached:
+        raise ShellError("no /debug/qos endpoint reachable")
+    return "\n".join(
+        [f"qos admission state across {reached} endpoint(s):"] + lines)
 
 
 # --- mq.* (`weed/shell/command_mq_topic_list.go` etc.) -----------------------

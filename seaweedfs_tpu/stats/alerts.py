@@ -79,9 +79,24 @@ DEFAULT_SLOS = (
 )
 
 
-def slo_burn(hist, slo: Slo, window: float, now: float):
+# minimum request rate (req/s over the window) below which a burn rate
+# is not computed at all: with a handful of samples, one slow cold-start
+# request IS the p99 and "burns" 100x for the whole window — which the
+# QoS actuator would dutifully answer by shedding every write on an
+# otherwise idle cluster. Same idea as error_min_rate for
+# http_error_ratio: don't judge an SLO on statistical noise. Latency
+# needs the higher floor: under ~1 req/s a window can't tell a p99
+# violation from a p67 one, while availability error shares stay
+# meaningful at lower traffic (mirroring error_min_rate = 0.5).
+SLO_MIN_RATE = {"availability": 0.5, "latency": 1.0}
+
+
+def slo_burn(hist, slo: Slo, window: float, now: float,
+             min_rate: float | None = None):
     """Error-budget burn rate for one SLO over one window -> float | None
     (None = not enough traffic/samples to judge, distinct from 0.0)."""
+    if min_rate is None:
+        min_rate = SLO_MIN_RATE.get(slo.kind, 0.0)
     budget = 1.0 - slo.objective
     if budget <= 0:
         return None
@@ -90,7 +105,7 @@ def slo_burn(hist, slo: Slo, window: float, now: float):
             hist, "SeaweedFS_http_request_total", window, now,
             match=lambda l: l.get("role") == slo.role,
         )
-        if not total:
+        if not total or total < min_rate:
             return None
         errs = _sum_rates(
             hist, "SeaweedFS_http_request_total", window, now,
@@ -111,7 +126,7 @@ def slo_burn(hist, slo: Slo, window: float, now: float):
         bound = float("inf") if le == "+Inf" else float(le)
         per_bound[bound] = per_bound.get(bound, 0.0) + rate
     total = per_bound.get(float("inf"))
-    if not total:
+    if not total or total < min_rate:
         return None
     candidates = [b for b in per_bound
                   if b != float("inf") and b >= slo.threshold_s - 1e-12]
@@ -173,6 +188,11 @@ DEFAULT_PARAMS = {
     # the SLO set itself is a param so deployments (and tests/bench) can
     # swap objectives without subclassing the engine
     "slos": DEFAULT_SLOS,
+    # qos_shed_interactive: the HIGHEST priority class being shed at a
+    # sustained rate is an incident, never policy — the qos actuator
+    # sheds background, then writes, and only a tenant's own exhausted
+    # bucket (or an explicit operator floor) touches interactive
+    "qos_interactive_shed_rate": 0.5,
 }
 
 
@@ -480,6 +500,27 @@ def _check_capacity_forecast_critical(hist, now, p):
     return _check_capacity_forecast_at(hist, now, p, p["forecast_crit_days"])
 
 
+def _check_qos_shed_interactive(hist, now, p):
+    """Interactive (highest-class) requests being shed sustainedly: a
+    tenant limit is starving foreground traffic or an operator lowered
+    the interactive floor under real load. `cluster.check -fail` exits
+    nonzero on this (criticals are problems)."""
+    per_reason: dict[str, float] = {}
+    for labels, rate in hist.rates("SeaweedFS_qos_shed_total",
+                                   p["window"], now):
+        if rate is None or labels.get("class") != "interactive":
+            continue
+        r = labels.get("reason", "?")
+        per_reason[r] = per_reason.get(r, 0.0) + rate
+    total = sum(per_reason.values())
+    if total <= p["qos_interactive_shed_rate"]:
+        return None
+    top = max(per_reason.items(), key=lambda kv: kv[1])
+    return total, (f"interactive requests shed at {total:.1f}/s"
+                   f" (mostly '{top[0]}') — the highest priority class"
+                   " must not shed sustainedly")
+
+
 def default_rules() -> list[Rule]:
     return [
         Rule("http_error_ratio", "critical",
@@ -532,6 +573,11 @@ def default_rules() -> list[Rule]:
              "an SLO's error budget is burning at a sustained multiple"
              " over the slow window (and still burning now)",
              _check_slo_slow_burn),
+        Rule("qos_shed_interactive", "critical",
+             "admission control is shedding the highest priority class"
+             " at a sustained rate (tenant limit starving foreground"
+             " traffic, or the interactive floor was lowered)",
+             _check_qos_shed_interactive),
     ]
 
 

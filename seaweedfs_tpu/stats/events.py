@@ -77,6 +77,8 @@ EVENT_TYPES = {
                      " threshold (hot set entry)",
     "heat_demoted": "a hot volume's heat score fell under the demote"
                     " threshold (hot set exit)",
+    "qos_shed": "admission control shed a request with a typed 429/503"
+                " (closed reason set; collection-correlated)",
 }
 
 EVENT_FAMILIES = (

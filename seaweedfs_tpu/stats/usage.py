@@ -265,6 +265,7 @@ class UsageAccountant:
                 continue
             key = id(eng)
             evicted_all = []
+            charges = []
             with self._lock:
                 last = self._engine_last.get(key, {})
                 for coll, row in snap.items():
@@ -282,6 +283,7 @@ class UsageAccountant:
                         ev = sk["requests"].offer(name, float(d_req))
                         if ev is not None:
                             evicted_all.append(ev)
+                        charges.append((name, float(d_req)))
                     if d_in > 0:
                         sk["bytes_in"].offer(name, float(d_in))
                     if d_out > 0:
@@ -289,6 +291,17 @@ class UsageAccountant:
                 self._engine_last[key] = snap
             for ev in evicted_all:
                 self._note_overflow(ev)
+            if charges:
+                # native-path admission check (qos/admission.py): requests
+                # the engine front door served still debit the tenant's
+                # token bucket, so a limit holds across both paths. The
+                # unarmed path is one attribute check, like emit()
+                from seaweedfs_tpu.qos import admission as qos_mod
+
+                ctl = qos_mod.controller()
+                if ctl.armed:
+                    for name, d_req in charges:
+                        ctl.charge(name, d_req)
 
     # --- export --------------------------------------------------------------
     def snapshot(self, n: int | None = None) -> dict:

@@ -361,6 +361,39 @@ def front_metric_lines(engine: "Fastlane", prefix: str,
     return lines
 
 
+def qos_charge_usage(engine: "Fastlane", state: dict) -> dict:
+    """Native-path admission check via the usage ABI: fold the engine's
+    per-collection request counters (sw_fl_get_usage deltas vs `state`,
+    the caller-held previous snapshot) into the QoS admission
+    controller's token buckets (qos/admission.py). The engine front door
+    never blocks on Python, so natively-served requests can't be gated
+    inline — instead they DEBIT the tenant's bucket after the fact,
+    so the limit holds across both paths: once the bucket runs dry the
+    gateway's next Python-path requests shed typed, and the S3
+    revalidation loop revokes the bucket's native flags entirely.
+    Returns the new snapshot to hold for the next call. Charges nothing
+    while the controller is unarmed (one attribute check)."""
+    from seaweedfs_tpu.qos import admission as qos_mod
+
+    if engine is None or engine.stopped:
+        return state
+    try:
+        snap = engine.usage_metrics()
+    except Exception:
+        snap = None
+    if not snap:
+        return state
+    ctl = qos_mod.controller()
+    if ctl.armed:
+        for coll, row in snap.items():
+            prev = state.get(coll, {})
+            d_req = sum(max(0, row[f] - prev.get(f, 0))
+                        for f in ("reads", "writes", "deletes"))
+            if d_req > 0:
+                ctl.charge(coll or "default", float(d_req))
+    return snap
+
+
 class Fastlane:
     def __init__(self, lib, handle: int, tls: bool = False) -> None:
         self._lib = lib

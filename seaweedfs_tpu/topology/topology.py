@@ -204,14 +204,17 @@ class Topology:
         ttl: str = "",
         collection: str = "",
         data_center: str = "",
+        shard: tuple[int, int] | None = None,
     ) -> tuple[str, int, list[DataNode]]:
-        """-> (fid, count, replica locations) (`topology.go:248` PickForWrite)."""
+        """-> (fid, count, replica locations) (`topology.go:248` PickForWrite).
+        `shard=(i, n)` soft-constrains the pick to vids in a gateway's
+        lease slice (vid % n == i) — see VolumeLayout.pick_for_write."""
         rp = ReplicaPlacement.parse(replication)
         ttl_u32 = TTL.parse(ttl).to_u32()
         lo = self.layout(collection, rp, ttl_u32)
         # no auto-grow here: growth requires contacting volume servers, which
         # is the master server's job (`MasterServer._grow_volumes`)
-        vid, nodes = lo.pick_for_write(data_center)
+        vid, nodes = lo.pick_for_write(data_center, shard=shard)
         key = self.sequencer.next_file_id(count)
         cookie = random.randint(0, 0xFFFFFFFF)
         from seaweedfs_tpu.storage.file_id import format_needle_id_cookie

@@ -86,10 +86,16 @@ class VolumeLayout:
             self.writables.discard(vid)
 
     def pick_for_write(
-        self, data_center: str = ""
+        self, data_center: str = "",
+        shard: tuple[int, int] | None = None,
     ) -> tuple[int, list[DataNode]]:
         """Random writable volume, optionally constrained to a DC
-        (`volume_layout.go:290` PickForWrite)."""
+        (`volume_layout.go:290` PickForWrite). `shard=(i, n)` prefers
+        vids where vid % n == i — the gateway lease-pool vid-space
+        partition. The constraint is SOFT: an empty slice falls back to
+        the whole writable set (a small cluster must still assign), so
+        it removes contention when volumes are plentiful and costs
+        nothing when they are not."""
         with self._lock:
             candidates = list(self.writables)
             if data_center:
@@ -100,6 +106,11 @@ class VolumeLayout:
                         n.dc_name() == data_center for n in self.locations[vid]
                     )
                 ]
+            if shard is not None and shard[1] > 1:
+                sliced = [vid for vid in candidates
+                          if vid % shard[1] == shard[0]]
+                if sliced:
+                    candidates = sliced
             if not candidates:
                 raise NoWritableVolume(
                     f"no writable volumes (rp={self.replica_placement}, "

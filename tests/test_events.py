@@ -137,6 +137,32 @@ class TestTenantHeatEvents:
         # the filter is exact: no collection attr -> excluded
         assert rec.events(collection="other") == []
 
+    def test_qos_shed_journals_through_admission_seam(self, monkeypatch):
+        """PR-20: a typed admission rejection emits a `qos_shed` event
+        carrying the collection correlation key, so `cluster.why
+        <tenant>` renders the tenant's 429 timeline next to its
+        degraded reads."""
+        from seaweedfs_tpu.qos import admission as qos_mod
+
+        rec = events.EventRecorder(capacity=16)
+        rec.enable()
+        monkeypatch.setattr(events, "_recorder", rec)
+        clock = [100.0]
+        ctl = qos_mod.AdmissionController(now=lambda: clock[0])
+        ctl.set_limits(limits={"acme": (1.0, 1.0)})
+        ctl.enable()
+        assert ctl.admit("acme", "interactive") is None  # drains the bucket
+        d = ctl.admit("acme", "interactive")  # 1s refill > queue_wait
+        assert d is not None and d.status == 429
+        evs = rec.events(type="qos_shed")
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev["attrs"]["collection"] == "acme"
+        assert ev["attrs"]["reason"] == "over_limit"
+        assert ev["attrs"]["status"] == 429
+        # the collection filter keys cluster.why tenant timelines
+        assert rec.events(collection="acme")[0]["type"] == "qos_shed"
+
 
 class TestDisabledOverhead:
     def test_disabled_emit_is_one_attribute_check(self, monkeypatch):
@@ -345,6 +371,27 @@ class TestSloBurn:
         # 10% of requests over the 250ms bound / 1% allowance = 10x
         burn = alerts_mod.slo_burn(hist, slo, 60.0, 15.0)
         assert burn == pytest.approx(10.0, rel=0.05)
+
+    def test_low_traffic_latency_reads_none_not_burn(self):
+        # two cold-start requests, one slow: that one request IS the
+        # p99 and would read as a 100x burn — which the QoS actuator
+        # would answer by shedding every write on an idle cluster. The
+        # min-rate guard makes it None (can't judge), not a page.
+        reg = Registry()
+        h = reg.histogram("SeaweedFS_http_request_seconds", "",
+                          ("role", "method"))
+        hist = MetricsHistory(reg, interval=1.0, slots=200)
+        h.labels("filer", "GET").observe(0.01)
+        hist.scrape_once(now=0.0)
+        h.labels("filer", "GET").observe(2.0)
+        hist.scrape_once(now=30.0)
+        slo = next(s for s in alerts_mod.DEFAULT_SLOS
+                   if s.name == "filer_p99")
+        assert alerts_mod.slo_burn(hist, slo, 60.0, 30.0) is None
+        # with the guard lifted the same traffic reads as a huge burn —
+        # the rate floor is what stands between cold start and level 3
+        assert alerts_mod.slo_burn(
+            hist, slo, 60.0, 30.0, min_rate=0.0) > 14.0
 
     def test_fast_burn_fires_then_clears_with_events(self):
         events.recorder().enable()
@@ -667,9 +714,12 @@ class TestExemplarsEndToEnd:
             "?family=SeaweedFS_http_request_seconds&window=600&samples=0")
         ex = out["exemplars"].get("SeaweedFS_http_request_seconds")
         assert ex, out["exemplars"]
-        sample = ex[0]
+        # the registry (and its exemplars) outlives the bounded trace
+        # ring: an old bucket's exemplar may point at an evicted trace.
+        # The FRESHEST exemplar is from the requests just made above —
+        # that one's trace must resolve via the point lookup.
+        sample = max(ex, key=lambda s: s["ts"])
         assert sample["trace_id"] and sample["labels"]["role"]
-        # the exemplar's trace resolves via the point lookup
         looked = get_json(
             f"{master.url}/debug/traces?id={sample['trace_id']}")
         assert looked["found"]

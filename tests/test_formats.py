@@ -415,6 +415,48 @@ class TestMetricNameLint:
         assert kinds["SeaweedFS_telemetry_segments_evicted_total"] \
             == "counter"
         assert tool.telemetry_violations() == []
+        # PR-20: QoS admission families (qos/admission.py) — the three
+        # counters, the closed shed-reason/priority-class vocabularies
+        # with 429/503 mappings, the qos_shed event seam, and the
+        # critical qos_shed_interactive rule
+        assert "SeaweedFS_qos_admitted_total" in collector_names
+        assert "SeaweedFS_qos_shed_total" in collector_names
+        assert "SeaweedFS_qos_queued_total" in collector_names
+        assert "SeaweedFS_qos_limit_rps" in collector_names
+        assert "SeaweedFS_qos_gate" in collector_names
+        assert tool.qos_violations() == []
+
+    def test_qos_lint_catches_violations(self, monkeypatch):
+        from seaweedfs_tpu.qos import admission as qos_mod
+        from seaweedfs_tpu.stats import alerts
+
+        tool = self._tool()
+        monkeypatch.setattr(
+            qos_mod, "QOS_FAMILIES",
+            tuple(f for f in qos_mod.QOS_FAMILIES
+                  if f != "SeaweedFS_qos_shed_total")
+            + ("SeaweedFS_qos_BadName",
+               "SeaweedFS_usage_not_qos_total"),
+        )
+        monkeypatch.setattr(
+            qos_mod, "SHED_REASONS",
+            qos_mod.SHED_REASONS + ("Not-Snake", "unmapped_reason"),
+        )
+        orig_rules = alerts.default_rules
+        monkeypatch.setattr(
+            alerts, "default_rules",
+            lambda: [r for r in orig_rules()
+                     if r.name != "qos_shed_interactive"],
+        )
+        bad = tool.qos_violations()
+        assert any("SeaweedFS_qos_BadName" in b for b in bad)
+        assert any("SeaweedFS_usage_not_qos_total" in b
+                   and "subsystem" in b for b in bad)
+        assert any("SeaweedFS_qos_shed_total" in b
+                   and "missing" in b for b in bad)
+        assert any("Not-Snake" in b and "snake_case" in b for b in bad)
+        assert any("unmapped_reason" in b and "429/503" in b for b in bad)
+        assert any("qos_shed_interactive" in b for b in bad)
 
     def test_cluster_telemetry_lint_catches_violations(self, monkeypatch):
         from seaweedfs_tpu.stats import aggregate

@@ -36,7 +36,8 @@ import sys
 NAME_RE = re.compile(
     r"^SeaweedFS_"
     r"(master|volume|filer|s3|http|stats|mount|mq|iam|alerts|process"
-    r"|maintenance|faults|events|slo|usage|heat|node|cluster|telemetry)_"
+    r"|maintenance|faults|events|slo|usage|heat|node|cluster|telemetry"
+    r"|qos)_"
     r"[a-z][a-z0-9]*(_[a-z0-9]+)*$"
 )
 
@@ -105,6 +106,7 @@ def collect() -> tuple[dict[str, str], list[str]]:
     from seaweedfs_tpu.s3api.s3_server import S3Server
     from seaweedfs_tpu.server.filer import FilerServer
 
+    from seaweedfs_tpu.qos import admission as qos_mod
     from seaweedfs_tpu.stats import aggregate as aggregate_mod
     from seaweedfs_tpu.stats import events as events_mod
     from seaweedfs_tpu.stats import heat as heat_mod
@@ -126,6 +128,7 @@ def collect() -> tuple[dict[str, str], list[str]]:
         | set(heat_mod.HEAT_FAMILIES)
         | set(heat_mod.ROLLUP_FAMILIES)
         | set(aggregate_mod.CLUSTER_FAMILIES)
+        | set(qos_mod.QOS_FAMILIES)
     )
     return kinds, collector_names
 
@@ -632,6 +635,74 @@ def telemetry_violations() -> list[str]:
     return bad
 
 
+def qos_violations() -> list[str]:
+    """The admission-control contract (qos/admission.py): every QoS
+    family declared in the `qos` subsystem, the shed-reason and
+    priority-class vocabularies closed (unique snake_case — they become
+    the `reason`/`class` labels of SeaweedFS_qos_shed_total and the
+    machine-readable 429/503 bodies clients retry on), every reason
+    mapped to a 429 or 503, the qos_shed event registered AND emitted
+    by the admission seam, and the qos_shed_interactive rule critical —
+    sustained interactive-class shedding is exactly what cluster.check
+    -fail must exit nonzero on."""
+    from seaweedfs_tpu.qos import admission as qos_mod
+    from seaweedfs_tpu.stats import alerts
+    from seaweedfs_tpu.stats import events as events_mod
+
+    bad: list[str] = []
+    for fam in qos_mod.QOS_FAMILIES:
+        if not NAME_RE.match(fam):
+            bad.append(f"qos family {fam!r}: does not match"
+                       f" SeaweedFS_<subsystem>_<snake_case>")
+        elif not fam.startswith("SeaweedFS_qos_"):
+            bad.append(f"qos family {fam!r}: must live in the `qos`"
+                       f" subsystem")
+    for required in ("SeaweedFS_qos_admitted_total",
+                     "SeaweedFS_qos_shed_total",
+                     "SeaweedFS_qos_queued_total"):
+        if required not in qos_mod.QOS_FAMILIES:
+            bad.append(f"qos family {required!r}: missing from"
+                       f" QOS_FAMILIES")
+    for label, names in (
+        ("qos shed reason", qos_mod.SHED_REASONS),
+        ("qos priority class", qos_mod.PRIORITY_CLASSES),
+    ):
+        seen: set[str] = set()
+        for name in names:
+            if not ALERT_RULE_RE.match(name):
+                bad.append(f"{label} {name!r}: not snake_case")
+            if name in seen:
+                bad.append(f"{label} {name!r}: duplicate")
+            seen.add(name)
+    for reason in qos_mod.SHED_REASONS:
+        status = qos_mod._REASON_STATUS.get(reason)
+        if status not in (429, 503):
+            bad.append(f"qos shed reason {reason!r}: no 429/503 status"
+                       f" mapping (clients can't type the rejection)")
+    for reason in qos_mod._REASON_STATUS:
+        if reason not in qos_mod.SHED_REASONS:
+            bad.append(f"qos status mapping {reason!r}: not a declared"
+                       f" shed reason")
+    if "qos_shed" not in events_mod.EVENT_TYPES:
+        bad.append("event type 'qos_shed': missing from the flight"
+                   " recorder registry")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    adm = os.path.join(root, "seaweedfs_tpu", "qos", "admission.py")
+    try:
+        with open(adm) as f:
+            adm_src = f.read()
+    except OSError:
+        adm_src = ""
+    if '"qos_shed"' not in adm_src and "'qos_shed'" not in adm_src:
+        bad.append("event type 'qos_shed': not emitted by"
+                   " qos/admission.py (the shed seam must journal)")
+    severities = {r.name: r.severity for r in alerts.default_rules()}
+    if severities.get("qos_shed_interactive") != "critical":
+        bad.append("alert rule qos_shed_interactive: missing or not"
+                   " critical")
+    return bad
+
+
 def violations(kinds: dict[str, str], collector_names: list[str]) -> list[str]:
     bad: list[str] = []
     for name in sorted(set(kinds) | set(collector_names)):
@@ -660,7 +731,7 @@ def main() -> int:
         + stream_lazy_violations() \
         + event_type_violations() + slo_violations() + scrub_violations() \
         + usage_heat_violations() + cluster_telemetry_violations() \
-        + telemetry_violations()
+        + telemetry_violations() + qos_violations()
     total = len(set(kinds) | set(collector_names))
     if bad:
         print(f"{len(bad)} metric-name violation(s) in {total} families:")
